@@ -1,0 +1,50 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Task/actor core runtime with a shared-memory object store and
+topology-aware gang scheduling; JAX/XLA/pjit as the intra-slice parallelism
+substrate; libraries for data pipelines, distributed training, hyperparameter
+tuning, online serving, and RL — the capability surface of the reference
+(astron8t-voyagerx/ray) redesigned TPU-first.
+"""
+from ray_tpu._version import version as __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "exceptions",
+]

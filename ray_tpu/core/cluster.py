@@ -1,0 +1,14 @@
+"""Cluster bootstrap: start or connect to a head node.
+
+Analogue of the reference's node bootstrap (ref: python/ray/_private/node.py
+start_head_processes :1315, start_ray_processes :1344).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def connect_or_start(address: Optional[str] = None, **kwargs):
+    from ray_tpu.core.distributed.driver import connect_or_start_cluster
+
+    return connect_or_start_cluster(address=address, **kwargs)

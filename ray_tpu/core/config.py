@@ -1,0 +1,116 @@
+"""Central config/flag registry.
+
+TPU-native analogue of the reference's RAY_CONFIG knob system
+(ref: src/ray/common/ray_config_def.h — 218 knobs, each overridable via an
+env var). Every knob here can be overridden with `RAY_TPU_<NAME>` in the
+environment; values are parsed to the declared type.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if t is int:
+        return int(raw)
+    if t is float:
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- control plane ----
+    # GCS-equivalent server port (0 = pick a free port).
+    gcs_port: int = 0
+    # Storage backend for control-plane state: "memory" (default, like the
+    # reference's gcs_storage="memory") or a file path for persistence.
+    gcs_storage: str = "memory"
+    # Health-check cadence (ref: ray_config_def.h:841-843 — 5s initial delay,
+    # 3s period, failure threshold).
+    health_check_initial_delay_ms: int = 5000
+    health_check_period_ms: int = 3000
+    health_check_failure_threshold: int = 5
+    # How long raylets may take to reconnect to a restarted control plane.
+    gcs_rpc_server_reconnect_timeout_s: int = 60
+
+    # ---- node daemon / scheduling ----
+    # Hybrid scheduling policy threshold: prefer the local node until its
+    # critical resource utilization crosses this fraction, then spill to the
+    # top-k least-utilized nodes (ref: policy/hybrid_scheduling_policy.h:26-49).
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+    # Worker pool
+    num_workers_soft_limit: int = 0  # 0 => num_cpus
+    worker_lease_timeout_ms: int = 30000
+    idle_worker_killing_time_threshold_ms: int = 1000
+    worker_register_timeout_s: int = 30
+    # Object transfer chunk size over DCN (ref: ray_config_def.h:352 — 5 MiB).
+    object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    # Memory monitor
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
+
+    # ---- object store ----
+    # Per-node shared-memory store capacity. 0 => 30% of system RAM
+    # (matches the reference's default plasma sizing).
+    object_store_memory: int = 0
+    # Inline small objects in task replies instead of the shm store
+    # (ref: max_direct_call_object_size, 100 KiB).
+    max_inline_object_size: int = 100 * 1024
+    # Fallback directory when /dev/shm is exhausted.
+    object_spilling_dir: str = "/tmp/ray_tpu_spill"
+    object_spilling_threshold: float = 0.8
+
+    # ---- ownership / lineage ----
+    # Keep lineage for reconstruction while refs exist
+    # (ref: ray_config_def.h:145 lineage_pinning_enabled, 1 GiB cap :158).
+    lineage_pinning_enabled: bool = True
+    max_lineage_bytes: int = 1024 * 1024 * 1024
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+
+    # ---- timeouts ----
+    get_timeout_milliseconds: int = 0  # 0 = no timeout
+    rpc_connect_timeout_s: int = 30
+    actor_creation_timeout_s: int = 120
+
+    # ---- TPU topology ----
+    # Resource name used for TPU chips (ref: _private/accelerators/tpu.py
+    # resource name "TPU") and the slice-head gang resource pattern
+    # "TPU-{pod_type}-head" (ref: tpu.py:382).
+    tpu_resource_name: str = "TPU"
+    tpu_head_resource_format: str = "TPU-{pod_type}-head"
+
+    # ---- observability ----
+    metrics_export_port: int = 0
+    event_log_enabled: bool = True
+    task_events_max_buffer: int = 100000
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

@@ -1,0 +1,107 @@
+"""Object serialization: cloudpickle + out-of-band zero-copy buffers.
+
+TPU-native analogue of the reference's SerializationContext
+(ref: python/ray/_private/serialization.py): pickle protocol 5 with
+out-of-band buffers so large numpy/arrow payloads are written into the
+shared-memory store without an extra copy, and read back zero-copy via mmap.
+
+Wire format (used both for the shm store and chunked DCN transfer):
+
+    magic   u32   "RTPU"
+    version u8
+    flags   u8    bit0 = payload is a serialized exception
+    nbufs   u16
+    pkl_len u64
+    buf_len u64 * nbufs
+    <pickle bytes>
+    <64-byte-aligned buffer 0> ...
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+MAGIC = 0x52545055
+_HEADER = struct.Struct("<IBBHQ")
+ALIGN = 64
+
+FLAG_ERROR = 1
+
+
+def _align(n: int) -> int:
+    return (n + ALIGN - 1) & ~(ALIGN - 1)
+
+
+def serialize(obj: Any, *, is_error: bool = False) -> Tuple[bytes, List[memoryview]]:
+    """Serialize to (header+pickle bytes, out-of-band buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    pkl = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    flags = FLAG_ERROR if is_error else 0
+    head = _HEADER.pack(MAGIC, 1, flags, len(views), len(pkl))
+    lens = struct.pack(f"<{len(views)}Q", *(len(v) for v in views)) if views else b""
+    return head + lens + pkl, views
+
+
+def serialized_size(meta: bytes, buffers: List[memoryview]) -> int:
+    total = len(meta)
+    for v in buffers:
+        total = _align(total) + len(v)
+    return total
+
+
+def write_to(buf: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
+    """Write the full serialized object into `buf`; returns bytes written."""
+    off = len(meta)
+    buf[:off] = meta
+    for v in buffers:
+        off = _align(off)
+        buf[off : off + len(v)] = v
+        off += len(v)
+    return off
+
+
+def dumps(obj: Any, *, is_error: bool = False) -> bytes:
+    meta, buffers = serialize(obj, is_error=is_error)
+    out = io.BytesIO()
+    out.write(meta)
+    off = len(meta)
+    for v in buffers:
+        pad = _align(off) - off
+        out.write(b"\x00" * pad)
+        out.write(v)
+        off = _align(off) + len(v)
+    return out.getvalue()
+
+
+def deserialize(data) -> Any:
+    """Deserialize from bytes/memoryview. Zero-copy: out-of-band buffers are
+    memoryview slices of `data` (keep the backing mmap alive via the views)."""
+    view = memoryview(data)
+    magic, version, flags, nbufs, pkl_len = _HEADER.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt object: bad magic")
+    off = _HEADER.size
+    lens = struct.unpack_from(f"<{nbufs}Q", view, off) if nbufs else ()
+    off += 8 * nbufs
+    pkl = view[off : off + pkl_len]
+    off += pkl_len
+    bufs = []
+    for ln in lens:
+        off = _align(off)
+        bufs.append(view[off : off + ln])
+        off += ln
+    obj = pickle.loads(pkl, buffers=bufs)
+    if flags & FLAG_ERROR:
+        raise obj
+    return obj
+
+
+def is_error_payload(data) -> bool:
+    view = memoryview(data)
+    _, _, flags, _, _ = _HEADER.unpack_from(view, 0)
+    return bool(flags & FLAG_ERROR)

@@ -1,0 +1,17 @@
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    ActorClassNode,
+    ActorMethodNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode",
+    "FunctionNode",
+    "ActorClassNode",
+    "ActorMethodNode",
+    "InputNode",
+    "MultiOutputNode",
+]

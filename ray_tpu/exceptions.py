@@ -1,0 +1,129 @@
+"""Exception types raised by the runtime.
+
+Mirrors the error taxonomy of the reference runtime
+(ref: python/ray/exceptions.py) with a TPU-native runtime behind it.
+"""
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task or actor method.
+
+    The original traceback is captured as text in the executing worker and
+    re-raised at the `get()` call site (ref: python/ray/exceptions.py
+    RayTaskError semantics).
+    """
+
+    def __init__(
+        self,
+        function_name: str = "<unknown>",
+        traceback_str: str = "",
+        cause: Optional[BaseException] = None,
+        pid: int = 0,
+        node_id: str = "",
+    ):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        self.pid = pid
+        self.node_id = node_id
+        super().__init__(traceback_str or str(cause))
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, function_name: str, pid: int = 0,
+                       node_id: str = "") -> "TaskError":
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )
+        return cls(function_name=function_name, traceback_str=tb, cause=exc,
+                   pid=pid, node_id=node_id)
+
+    def __str__(self):
+        return (
+            f"Task '{self.function_name}' failed (pid={self.pid}, "
+            f"node={self.node_id[:8]}):\n{self.traceback_str}"
+        )
+
+
+class ActorError(TaskError):
+    """An actor method invocation failed."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor backing a handle has died and will not be restarted."""
+
+    def __init__(self, actor_id: str = "", reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id[:8]} died: {reason}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object was evicted/lost and could not be reconstructed."""
+
+    def __init__(self, object_id: str = "", message: str = ""):
+        self.object_id = object_id
+        super().__init__(message or f"Object {object_id[:8]} was lost.")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction of a lost object failed."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The owner (submitting worker) of an object died; value unrecoverable."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get(..., timeout=)` expired before the object was ready."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node (daemon) died while hosting tasks/objects."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing a task died unexpectedly."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Creating the runtime environment for a task/actor failed."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Worker killed by the memory monitor."""
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    """Placement group cannot be scheduled with current cluster resources."""
+
+
+class PendingCallsLimitExceededError(RayTpuError):
+    """Backpressure: actor's pending call queue is full."""
+
+
+class CrossLanguageError(RayTpuError):
+    """Error crossing a language boundary."""
+
+
+class ChannelError(RayTpuError):
+    """Compiled-graph channel read/write failure."""
+
+
+class ChannelTimeoutError(ChannelError, TimeoutError):
+    """Compiled-graph channel read/write timed out."""

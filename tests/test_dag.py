@@ -1,0 +1,80 @@
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(autouse=True)
+def _local():
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_function_dag():
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x, y):
+        return x * y
+
+    with InputNode() as inp:
+        dag = b.bind(a.bind(inp), a.bind(inp))
+    assert ray_tpu.get(dag.execute(3)) == 16
+
+
+def test_shared_subgraph_executes_once():
+    calls = []
+
+    @ray_tpu.remote
+    class Tracker:
+        def __init__(self):
+            self.count = 0
+
+        def tick(self):
+            self.count += 1
+            return self.count
+
+    @ray_tpu.remote
+    def consume(a, b):
+        return (a, b)
+
+    t = Tracker.remote()
+    with InputNode() as inp:  # noqa: F841
+        shared = t.tick.bind()
+        dag = consume.bind(shared, shared)
+    a, b = ray_tpu.get(dag.execute())
+    assert a == b == 1
+
+
+def test_multi_output():
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([f.bind(inp), f.bind(inp)])
+    refs = dag.execute(5)
+    assert ray_tpu.get(refs) == [10, 10]
+
+
+def test_compiled_dag_reuses_actors():
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self):
+            self.calls = 0
+
+        def step(self, x):
+            self.calls += 1
+            return x + self.calls
+
+    with InputNode() as inp:
+        node = Stage.bind()
+        dag = node.step.bind(inp)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(0)) == 1
+    # Same actor across executions => state persists.
+    assert ray_tpu.get(compiled.execute(0)) == 2
+    compiled.teardown()

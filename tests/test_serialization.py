@@ -1,0 +1,42 @@
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization as ser
+
+
+def test_roundtrip_simple():
+    for obj in [1, "x", None, [1, 2], {"a": (1, 2)}, {1: {2: 3}}]:
+        assert ser.deserialize(ser.dumps(obj)) == obj
+
+
+def test_roundtrip_numpy_zero_copy():
+    x = np.arange(1 << 16, dtype=np.float32).reshape(256, 256)
+    data = ser.dumps(x)
+    y = ser.deserialize(data)
+    np.testing.assert_array_equal(x, y)
+    # Zero-copy: the deserialized array's buffer lives inside `data`.
+    assert not y.flags.owndata
+
+
+def test_error_payload_reraises():
+    data = ser.dumps(ValueError("boom"), is_error=True)
+    assert ser.is_error_payload(data)
+    with pytest.raises(ValueError, match="boom"):
+        ser.deserialize(data)
+
+
+def test_lambda_and_closure():
+    n = 42
+    f = lambda x: x + n  # noqa: E731
+    g = ser.deserialize(ser.dumps(f))
+    assert g(1) == 43
+
+
+def test_alignment_of_buffers():
+    x = np.ones(7, dtype=np.uint8)
+    y = np.arange(100, dtype=np.float64)
+    data = ser.dumps((x, y, "tail"))
+    a, b, s = ser.deserialize(data)
+    np.testing.assert_array_equal(a, x)
+    np.testing.assert_array_equal(b, y)
+    assert s == "tail"
