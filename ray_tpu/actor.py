@@ -32,18 +32,19 @@ class ActorMethod:
 
     def options(self, **updates) -> "ActorMethod":
         m = ActorMethod(self._handle, self._method_name, self._num_returns)
-        m._call_options = updates
+        # Validate against the full option schema (same path as
+        # RemoteFunction.options) so typos fail loudly.
+        m._call_options = _merge_options(self._handle._options, **updates)
         return m
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         from ray_tpu.api import _global_worker
 
         worker = _global_worker()
-        opts = dataclasses.replace(
-            self._handle._options,
-            num_returns=getattr(self, "_call_options", {}).get(
-                "num_returns", self._num_returns),
-        )
+        opts = getattr(self, "_call_options", None)
+        if opts is None:
+            opts = dataclasses.replace(self._handle._options,
+                                       num_returns=self._num_returns)
         refs = worker.submit_actor_task(
             self._handle._actor_id, self._method_name, list(args),
             dict(kwargs), opts)

@@ -9,6 +9,12 @@ from typing import Optional
 
 
 def connect_or_start(address: Optional[str] = None, **kwargs):
-    from ray_tpu.core.distributed.driver import connect_or_start_cluster
+    try:
+        from ray_tpu.core.distributed.driver import connect_or_start_cluster
+    except ImportError as e:
+        raise NotImplementedError(
+            "The multi-process cluster runtime is not available in this "
+            "build; use ray_tpu.init(local_mode=True)."
+        ) from e
 
     return connect_or_start_cluster(address=address, **kwargs)

@@ -47,6 +47,15 @@ class _Store:
             self._cond.notify_all()
         ev.set()
 
+    def put_if_absent(self, oid: ObjectID, payload: bytes) -> None:
+        with self._lock:
+            if oid in self._data:
+                return
+            self._data[oid] = payload
+            ev = self._events.setdefault(oid, threading.Event())
+            self._cond.notify_all()
+        ev.set()
+
     def wait_any(self, oids, timeout: Optional[float]) -> None:
         """Block until any of `oids` is present (or timeout)."""
         with self._lock:
@@ -98,6 +107,11 @@ class _LocalActor:
             max_workers=maxc, thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
         )
         self._order_lock = threading.Lock()
+        # Return ids of calls accepted but not yet stored — failed with
+        # ActorDiedError if the actor is killed first (otherwise get() on
+        # them would hang forever).
+        self.pending_lock = threading.Lock()
+        self.pending_returns: set = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         if self._is_async:
             self._loop = asyncio.new_event_loop()
@@ -159,7 +173,9 @@ class LocalCoreWorker:
                                         thread_name_prefix="task")
         self._actors: Dict[ActorID, _LocalActor] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
-        self._lock = threading.Lock()
+        # RLock: _ref_removed can re-enter from ObjectRef.__del__ during GC
+        # triggered while _ref_added already holds the lock on this thread.
+        self._lock = threading.RLock()
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._cancelled: set = set()
         install_refcounter(self._ref_added, self._ref_removed)
@@ -349,6 +365,13 @@ class LocalCoreWorker:
                 self._store_error(oid, err)
             return [ObjectRef(oid, self.address) for oid in return_ids]
 
+        with actor.pending_lock:
+            actor.pending_returns.update(return_ids)
+
+        def finish():
+            with actor.pending_lock:
+                actor.pending_returns.difference_update(return_ids)
+
         def run_and_store(actor: _LocalActor, method_name, args, kwargs,
                           is_async=False):
             fname = f"{actor._cls.__name__}.{method_name}"
@@ -374,9 +397,12 @@ class LocalCoreWorker:
                                 err = rexc.ActorError.from_exception(e, fname)
                                 for oid in return_ids:
                                     self._store_error(oid, err)
+                            finally:
+                                finish()
                         return _await_and_store()
                     result = asyncio.run(result)
                 self._store_returns(return_ids, num_returns, result, fname)
+                finish()
             except BaseException as e:  # noqa: BLE001
                 if isinstance(e, rexc.RayTpuError):
                     err = e
@@ -384,6 +410,7 @@ class LocalCoreWorker:
                     err = rexc.ActorError.from_exception(e, fname)
                 for oid in return_ids:
                     self._store_error(oid, err)
+                finish()
             return None
 
         actor.submit(method_name, args, kwargs, run_and_store)
@@ -405,6 +432,15 @@ class LocalCoreWorker:
             if actor.name:
                 self._named_actors.pop(
                     (actor.options.namespace or "default", actor.name), None)
+            # Fail every accepted-but-unfinished call so get() raises instead
+            # of hanging (a completed call's result is never overwritten).
+            with actor.pending_lock:
+                pending = list(actor.pending_returns)
+                actor.pending_returns.clear()
+            err = rexc.ActorDiedError(actor_id.hex(), actor.death_reason)
+            payload = serialization.dumps(err, is_error=True)
+            for oid in pending:
+                self._store.put_if_absent(oid, payload)
 
     def actor_state(self, actor_id: ActorID) -> str:
         with self._lock:

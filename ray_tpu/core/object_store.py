@@ -1,0 +1,293 @@
+"""Python client for the native shared-memory object store.
+
+Pairs with ray_tpu/native/object_store.cc (the plasma equivalent — ref:
+src/ray/object_manager/plasma/client.h). Values are serialized with the
+protocol-5 out-of-band format and written straight into the mmap'd object
+file; reads deserialize zero-copy from the mapping (numpy arrays alias shm).
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+import weakref
+from typing import Any, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+
+RTS_OK = 0
+RTS_ERR_IO = -1
+RTS_ERR_EXISTS = -2
+RTS_ERR_NOT_FOUND = -3
+RTS_ERR_FULL = -4
+RTS_ERR_STATE = -5
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+def _load_lib() -> ctypes.CDLL:
+    from ray_tpu.native.build import library_path
+
+    lib = ctypes.CDLL(library_path())
+    lib.rts_connect.restype = ctypes.c_void_p
+    lib.rts_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                ctypes.c_uint64]
+    lib.rts_disconnect.argtypes = [ctypes.c_void_p]
+    lib.rts_capacity.restype = ctypes.c_uint64
+    lib.rts_capacity.argtypes = [ctypes.c_void_p]
+    lib.rts_used.restype = ctypes.c_uint64
+    lib.rts_used.argtypes = [ctypes.c_void_p]
+    lib.rts_num_objects.restype = ctypes.c_uint64
+    lib.rts_num_objects.argtypes = [ctypes.c_void_p]
+    lib.rts_evict.restype = ctypes.c_uint64
+    lib.rts_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rts_create.restype = ctypes.c_int
+    lib.rts_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64,
+                               ctypes.POINTER(ctypes.c_int)]
+    lib.rts_seal.restype = ctypes.c_int
+    lib.rts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_abort.restype = ctypes.c_int
+    lib.rts_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_get.restype = ctypes.c_int
+    lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_uint64),
+                            ctypes.POINTER(ctypes.c_int)]
+    lib.rts_release.restype = ctypes.c_int
+    lib.rts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_contains.restype = ctypes.c_int
+    lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rts_delete.restype = ctypes.c_int
+    lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.rts_list.restype = ctypes.c_uint64
+    lib.rts_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class _StoreState:
+    """Shared between an ObjectStore and its outstanding SharedBuffers so the
+    native handle is only freed after the last buffer releases (a finalizer
+    running after disconnect() must not touch freed memory)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.live_buffers = 0
+        self.closed = False
+        self.lock = threading.Lock()
+
+    def buffer_acquired(self):
+        with self.lock:
+            self.live_buffers += 1
+
+    def buffer_released(self, oid_binary: bytes):
+        with self.lock:
+            if not self.closed:
+                get_lib().rts_release(self.handle, oid_binary)
+            self.live_buffers -= 1
+            if self.closed and self.live_buffers == 0 and self.handle:
+                get_lib().rts_disconnect(self.handle)
+                self.handle = None
+
+    def close(self):
+        with self.lock:
+            self.closed = True
+            if self.live_buffers == 0 and self.handle:
+                get_lib().rts_disconnect(self.handle)
+                self.handle = None
+
+
+class SharedBuffer:
+    """A read-only view over a sealed object's mmap; releases the store ref
+    when garbage collected (or released explicitly)."""
+
+    def __init__(self, state: _StoreState, oid: ObjectID, mm: mmap.mmap,
+                 size: int):
+        self._mm = mm
+        self.size = size
+        self.view = memoryview(mm)[:size]
+        state.buffer_acquired()
+        self._finalizer = weakref.finalize(
+            self, SharedBuffer._release_static, state, oid.binary(), mm,
+            self.view)
+
+    def release(self) -> None:
+        self._finalizer()
+
+    @staticmethod
+    def _release_static(state: _StoreState, oid_binary: bytes,
+                        mm: mmap.mmap, view: memoryview) -> None:
+        try:
+            view.release()
+            mm.close()
+        except BufferError:
+            pass  # numpy views still alive; mmap closes when they drop
+        try:
+            state.buffer_released(oid_binary)
+        except Exception:
+            pass
+
+
+class ObjectStore:
+    """One connection to the node-local shm store."""
+
+    def __init__(self, directory: str, capacity: int = 0,
+                 num_slots: int = 65536):
+        if capacity <= 0:
+            import psutil
+
+            capacity = int(psutil.virtual_memory().total * 0.3)
+        self.directory = directory
+        self.capacity = capacity
+        handle = get_lib().rts_connect(directory.encode(), capacity, num_slots)
+        if not handle:
+            raise RuntimeError(f"Failed to connect to object store at "
+                               f"{directory}")
+        self._state = _StoreState(handle)
+
+    @property
+    def _handle(self):
+        return self._state.handle
+
+    # -- write path -----------------------------------------------------
+    def put_serialized(self, oid: ObjectID, meta: bytes,
+                       buffers: List[memoryview]) -> int:
+        """Write a pre-serialized object; returns its size in bytes."""
+        size = serialization.serialized_size(meta, buffers)
+        lib = get_lib()
+        fd = ctypes.c_int(-1)
+        rc = lib.rts_create(self._handle, oid.binary(), size,
+                            ctypes.byref(fd))
+        if rc == RTS_ERR_EXISTS:
+            raise ObjectExistsError(oid.hex())
+        if rc == RTS_ERR_FULL:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit "
+                f"(used {self.used}/{self.capacity})")
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_create failed: {rc}")
+        try:
+            with mmap.mmap(fd.value, size) as mm:
+                view = memoryview(mm)
+                serialization.write_to(view, meta, buffers)
+                view.release()
+        except BaseException:
+            os.close(fd.value)
+            lib.rts_abort(self._handle, oid.binary())
+            raise
+        else:
+            os.close(fd.value)
+        rc = lib.rts_seal(self._handle, oid.binary())
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_seal failed: {rc}")
+        return size
+
+    def put(self, oid: ObjectID, value: Any, *, is_error: bool = False) -> int:
+        meta, buffers = serialization.serialize(value, is_error=is_error)
+        return self.put_serialized(oid, meta, buffers)
+
+    def put_raw(self, oid: ObjectID, data: bytes) -> int:
+        """Write pre-framed bytes (e.g. received from a remote node)."""
+        lib = get_lib()
+        fd = ctypes.c_int(-1)
+        size = len(data)
+        rc = lib.rts_create(self._handle, oid.binary(), size,
+                            ctypes.byref(fd))
+        if rc == RTS_ERR_EXISTS:
+            raise ObjectExistsError(oid.hex())
+        if rc == RTS_ERR_FULL:
+            raise ObjectStoreFullError(str(size))
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_create failed: {rc}")
+        try:
+            if size:
+                with mmap.mmap(fd.value, size) as mm:
+                    mm[:size] = data
+        except BaseException:
+            os.close(fd.value)
+            lib.rts_abort(self._handle, oid.binary())
+            raise
+        else:
+            os.close(fd.value)
+        rc = lib.rts_seal(self._handle, oid.binary())
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_seal failed: {rc}")
+        return size
+
+    # -- read path ------------------------------------------------------
+    def get_buffer(self, oid: ObjectID) -> Optional[SharedBuffer]:
+        lib = get_lib()
+        size = ctypes.c_uint64(0)
+        fd = ctypes.c_int(-1)
+        rc = lib.rts_get(self._handle, oid.binary(), ctypes.byref(size),
+                         ctypes.byref(fd))
+        if rc == RTS_ERR_NOT_FOUND:
+            return None
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_get failed: {rc}")
+        try:
+            mm = mmap.mmap(fd.value, size.value, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd.value)
+        return SharedBuffer(self._state, oid, mm, size.value)
+
+    def get(self, oid: ObjectID) -> Tuple[Any, Optional[SharedBuffer]]:
+        """Deserialize; the returned SharedBuffer must stay alive as long as
+        zero-copy views into it (numpy arrays) are in use."""
+        buf = self.get_buffer(oid)
+        if buf is None:
+            raise KeyError(oid.hex())
+        value = serialization.deserialize(buf.view)
+        return value, buf
+
+    # -- management -----------------------------------------------------
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(get_lib().rts_contains(self._handle, oid.binary()))
+
+    def delete(self, oid: ObjectID, force: bool = False) -> bool:
+        return get_lib().rts_delete(self._handle, oid.binary(),
+                                    1 if force else 0) == RTS_OK
+
+    def evict(self, nbytes: int) -> int:
+        return get_lib().rts_evict(self._handle, nbytes)
+
+    def list_objects(self, max_objects: int = 100000) -> List[ObjectID]:
+        buf = ctypes.create_string_buffer(20 * max_objects)
+        n = get_lib().rts_list(self._handle, buf, max_objects)
+        return [ObjectID(bytes(buf[i * 20:(i + 1) * 20])) for i in range(n)]
+
+    @property
+    def used(self) -> int:
+        return get_lib().rts_used(self._handle)
+
+    @property
+    def num_objects(self) -> int:
+        return get_lib().rts_num_objects(self._handle)
+
+    def disconnect(self) -> None:
+        self._state.close()
+
+    @staticmethod
+    def destroy(directory: str) -> None:
+        """Remove every object file + index for a store directory."""
+        import shutil
+
+        shutil.rmtree(directory, ignore_errors=True)

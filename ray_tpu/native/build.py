@@ -1,0 +1,33 @@
+"""Build the native library (g++ → .so), caching by source mtime.
+
+The reference builds its native substrate with bazel (ref: BUILD.bazel);
+here a single translation unit compiled on demand keeps the loop tight. A
+CMakeLists.txt is provided for standalone builds too.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_NATIVE_DIR, "object_store.cc")
+_OUT_DIR = os.path.join(_NATIVE_DIR, "_build")
+_LIB = os.path.join(_OUT_DIR, "libray_tpu_store.so")
+_lock = threading.Lock()
+
+
+def library_path() -> str:
+    """Return the path to the built library, building if stale/missing."""
+    with _lock:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            os.makedirs(_OUT_DIR, exist_ok=True)
+            tmp = _LIB + ".tmp"
+            cmd = [
+                "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+                "-Wall", "-o", tmp, _SRC, "-lpthread",
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB)
+    return _LIB

@@ -1,0 +1,139 @@
+"""Native shm object store tests (ref test model: src/ray/object_manager/
+plasma/test/ + python/ray/tests/test_object_store.py style)."""
+import multiprocessing
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (
+    ObjectExistsError,
+    ObjectStore,
+    ObjectStoreFullError,
+)
+
+
+@pytest.fixture
+def store():
+    d = tempfile.mkdtemp(prefix="rts_test_", dir="/dev/shm")
+    s = ObjectStore(d, capacity=64 * 1024 * 1024, num_slots=1024)
+    yield s
+    s.disconnect()
+    ObjectStore.destroy(d)
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    store.put(oid, {"x": 1, "arr": np.arange(100)})
+    value, buf = store.get(oid)
+    assert value["x"] == 1
+    np.testing.assert_array_equal(value["arr"], np.arange(100))
+    buf.release()
+
+
+def test_zero_copy_read(store):
+    oid = ObjectID.from_random()
+    x = np.random.rand(512, 512)
+    store.put(oid, x)
+    y, buf = store.get(oid)
+    assert not y.flags.owndata  # aliases the shm mapping
+    np.testing.assert_array_equal(x, y)
+    del y
+    buf.release()
+
+
+def test_contains_delete(store):
+    oid = ObjectID.from_random()
+    assert not store.contains(oid)
+    store.put(oid, [1, 2, 3])
+    assert store.contains(oid)
+    assert store.delete(oid)
+    assert not store.contains(oid)
+    assert store.get_buffer(oid) is None
+
+
+def test_duplicate_create_rejected(store):
+    oid = ObjectID.from_random()
+    store.put(oid, "a")
+    with pytest.raises(ObjectExistsError):
+        store.put(oid, "b")
+
+
+def test_eviction_lru(store):
+    # Fill beyond capacity; oldest unreferenced objects evicted.
+    big = np.zeros(8 * 1024 * 1024 // 8)  # 8 MB each
+    oids = []
+    for i in range(12):  # 96 MB > 64 MB capacity
+        oid = ObjectID.from_random()
+        store.put(oid, big)
+        oids.append(oid)
+    assert store.used <= store.capacity
+    # Oldest should be gone, newest present.
+    assert not store.contains(oids[0])
+    assert store.contains(oids[-1])
+
+
+def test_pinned_objects_not_evicted(store):
+    big = np.zeros(8 * 1024 * 1024 // 8)
+    first = ObjectID.from_random()
+    store.put(first, big)
+    _, buf = store.get(first)  # hold a reference => pinned
+    for _ in range(12):
+        store.put(ObjectID.from_random(), big)
+    assert store.contains(first)
+    buf.release()
+
+
+def test_store_full_when_all_pinned(store):
+    big = np.zeros(30 * 1024 * 1024, dtype=np.uint8)
+    bufs = []
+    for _ in range(2):
+        oid = ObjectID.from_random()
+        store.put(oid, big)
+        bufs.append(store.get(oid)[1])
+    with pytest.raises(ObjectStoreFullError):
+        store.put(ObjectID.from_random(), big)
+    for b in bufs:
+        b.release()
+
+
+def test_list_and_stats(store):
+    for i in range(5):
+        store.put(ObjectID.from_random(), i)
+    assert store.num_objects == 5
+    assert len(store.list_objects()) == 5
+    assert store.used > 0
+
+
+def _child_read(directory, oid_binary, expected_sum, q):
+    s = ObjectStore(directory, capacity=64 * 1024 * 1024, num_slots=1024)
+    value, buf = s.get(ObjectID(oid_binary))
+    q.put(float(value.sum()) == expected_sum)
+    buf.release()
+    s.disconnect()
+
+
+def test_cross_process_read(store):
+    oid = ObjectID.from_random()
+    x = np.arange(1000, dtype=np.float64)
+    store.put(oid, x)
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_read,
+                    args=(store.directory, oid.binary(), float(x.sum()), q))
+    p.start()
+    p.join(30)
+    assert q.get(timeout=5) is True
+
+
+def test_put_raw_roundtrip(store):
+    from ray_tpu.core import serialization
+
+    oid = ObjectID.from_random()
+    data = serialization.dumps({"k": np.ones(10)})
+    store.put_raw(oid, data)
+    value, buf = store.get(oid)
+    np.testing.assert_array_equal(value["k"], np.ones(10))
+    buf.release()
