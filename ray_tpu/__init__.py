@@ -25,6 +25,7 @@ from ray_tpu.api import (
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
 
 __all__ = [
@@ -46,5 +47,6 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",
+    "get_runtime_context",
     "exceptions",
 ]

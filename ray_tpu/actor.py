@@ -32,9 +32,12 @@ class ActorMethod:
 
     def options(self, **updates) -> "ActorMethod":
         m = ActorMethod(self._handle, self._method_name, self._num_returns)
-        # Validate against the full option schema (same path as
-        # RemoteFunction.options) so typos fail loudly.
-        m._call_options = _merge_options(self._handle._options, **updates)
+        # Merge over the method-level defaults (num_returns resets to the
+        # method's own default, not the actor's creation options) and
+        # validate against the full option schema so typos fail loudly.
+        base = dataclasses.replace(self._handle._options,
+                                   num_returns=self._num_returns)
+        m._call_options = _merge_options(base, **updates)
         return m
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:

@@ -1,0 +1,730 @@
+"""DistributedCoreWorker: the per-process runtime core.
+
+Analogue of the reference core worker (ref: src/ray/core_worker/
+core_worker.h:291 — task submission, ownership/refcount, memory store,
+actor transport; direct task push after lease,
+transport/direct_task_transport.h:75). Embedded in the driver and in every
+worker process.
+
+Data path: every put/task-return lands in the executing node's shm store and
+its location is registered in the GCS object directory; small payloads also
+ride inline in task replies as a read shortcut. get() resolves
+local-store → inline-cache → remote pull (chunked stream from the holding
+node's daemon, ref: object_manager.h:117 pull/push in 5 MiB chunks).
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+import time
+from collections import defaultdict
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as rexc
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef, install_refcounter, uninstall_refcounter
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.task_spec import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TaskOptions,
+)
+from ray_tpu.core.distributed import protocol
+from ray_tpu.core.distributed.rpc import (
+    AsyncRpcClient,
+    EventLoopThread,
+    RpcError,
+    SyncRpcClient,
+)
+
+logger = logging.getLogger(__name__)
+
+ACTOR_STATES_TRANSIENT = ("PENDING_CREATION", "RESTARTING")
+
+
+class DistributedCoreWorker:
+    def __init__(
+        self,
+        *,
+        gcs_address: str,
+        node_id: str,
+        daemon_address: str,
+        store_dir: str,
+        job_id: str,
+        is_driver: bool,
+        worker_address: str = "",
+        loop_thread: Optional[EventLoopThread] = None,
+    ):
+        self.gcs_address = gcs_address
+        self.node_id = node_id
+        self.node_id_hex = node_id
+        self.daemon_address = daemon_address
+        self.job_id = job_id
+        self.is_driver = is_driver
+        self.address = worker_address or f"driver-{os.getpid()}"
+
+        # grpc.aio binds its poller to one event loop per process — every
+        # grpc object (server + clients) must live on this single loop.
+        self.loop_thread = loop_thread or EventLoopThread(
+            name="core-worker-rpc")
+        self.gcs = SyncRpcClient(gcs_address, self.loop_thread)
+        self.daemon = SyncRpcClient(daemon_address, self.loop_thread)
+        self.store = ObjectStore(store_dir)
+
+        # ---- ownership / refcounts (owner = this process) ----
+        self._lock = threading.RLock()
+        self._owned: set = set()                 # ObjectIDs owned here
+        self._refcounts: Dict[ObjectID, int] = defaultdict(int)
+        self._free_batch: List[bytes] = []
+        self._inline_cache: Dict[ObjectID, bytes] = {}
+        self._inline_cache_order: List[ObjectID] = []
+
+        # ---- pending tasks (futures resolve when reply arrives) ----
+        self._pending_objects: Dict[ObjectID, Future] = {}
+
+        # ---- function table cache ----
+        self._exported_fns: set = set()
+        self._fn_cache: Dict[bytes, Any] = {}
+
+        # ---- actor address cache ----
+        self._actor_cache: Dict[str, dict] = {}
+        self._actor_seq: Dict[str, int] = defaultdict(int)
+        self._actor_clients: Dict[str, SyncRpcClient] = {}
+
+        self._shutdown = False
+        install_refcounter(self._ref_added, self._ref_removed)
+        if is_driver:
+            atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    # reference counting / distributed GC
+    # ------------------------------------------------------------------
+    def _ref_added(self, ref: ObjectRef) -> None:
+        with self._lock:
+            self._refcounts[ref.id()] += 1
+
+    def _ref_removed(self, ref: ObjectRef) -> None:
+        if self._shutdown:
+            return
+        with self._lock:
+            n = self._refcounts.get(ref.id())
+            if n is None:
+                return
+            if n <= 1:
+                del self._refcounts[ref.id()]
+                if ref.id() in self._owned:
+                    self._owned.discard(ref.id())
+                    self._inline_cache.pop(ref.id(), None)
+                    self._free_batch.append(ref.id().binary())
+                    if len(self._free_batch) >= 100:
+                        self._flush_frees_locked()
+            else:
+                self._refcounts[ref.id()] = n - 1
+
+    def _flush_frees_locked(self) -> None:
+        batch, self._free_batch = self._free_batch, []
+        if not batch:
+            return
+
+        async def free():
+            try:
+                client = AsyncRpcClient(self.gcs_address)
+                await client.call("ObjectDirectory", "free_objects",
+                                  object_ids=batch, timeout=30)
+                await client.close()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("free_objects failed: %s", e)
+
+        self.loop_thread.submit(free())
+
+    # ------------------------------------------------------------------
+    # object API
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self._store_local(oid, value)
+        ref = ObjectRef(oid, self.address)
+        with self._lock:
+            self._owned.add(oid)
+        return ref
+
+    def _store_local(self, oid: ObjectID, value: Any,
+                     is_error: bool = False) -> int:
+        from ray_tpu.core.object_store import ObjectExistsError
+
+        meta, buffers = serialization.serialize(value, is_error=is_error)
+        try:
+            size = self.store.put_serialized(oid, meta, buffers)
+        except ObjectExistsError:
+            return 0
+        self.gcs.call("ObjectDirectory", "add_location",
+                      object_id=oid.binary(), node_id=self.node_id,
+                      size=size, timeout=30)
+        return size
+
+    def _cache_inline(self, oid: ObjectID, payload: bytes) -> None:
+        with self._lock:
+            if oid in self._inline_cache:
+                return
+            self._inline_cache[oid] = payload
+            self._inline_cache_order.append(oid)
+            while len(self._inline_cache_order) > 10000:
+                old = self._inline_cache_order.pop(0)
+                self._inline_cache.pop(old, None)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id()
+        backoff = 0.002
+        while True:
+            # 1) inline cache
+            payload = self._inline_cache.get(oid)
+            if payload is not None:
+                return serialization.deserialize(payload)
+            # 2) local store (zero-copy)
+            buf = self.store.get_buffer(oid)
+            if buf is not None:
+                return serialization.deserialize(buf.view)
+            # 3) pending local task result
+            fut = self._pending_objects.get(oid)
+            if fut is not None:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise rexc.GetTimeoutError(ref.hex())
+                try:
+                    fut.result(timeout=remaining)
+                except TimeoutError:
+                    raise rexc.GetTimeoutError(ref.hex()) from None
+                continue
+            # 4) remote fetch via directory
+            payload = self._try_pull_remote(oid)
+            if payload is not None:
+                continue  # now in local store
+            if deadline is not None and time.monotonic() >= deadline:
+                raise rexc.GetTimeoutError(ref.hex())
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
+
+    def _try_pull_remote(self, oid: ObjectID) -> Optional[bool]:
+        info = self.gcs.call("ObjectDirectory", "get_locations",
+                             object_id=oid.binary(), timeout=30)
+        for node in info["nodes"]:
+            if node["node_id"] == self.node_id:
+                continue  # local store already checked
+            try:
+                data = self._pull_from(node["address"], oid)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("pull from %s failed: %s", node["address"], e)
+                continue
+            if data is not None:
+                try:
+                    self.store.put_raw(oid, data)
+                except Exception:  # noqa: BLE001 already raced in
+                    pass
+                return True
+        return None
+
+    def _pull_from(self, address: str, oid: ObjectID) -> Optional[bytes]:
+        async def pull():
+            client = AsyncRpcClient(address)
+            try:
+                chunks = []
+                async for item in client.stream(
+                        "NodeDaemon", "stream_pull_object",
+                        object_id=oid.binary(), timeout=120):
+                    if item.get("missing"):
+                        return None
+                    chunks.append(item["data"])
+                return b"".join(chunks)
+            finally:
+                await client.close()
+
+        return self.loop_thread.run(pull(), timeout=150)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        ready = ready[:num_returns]
+        return ready, [r for r in refs if r not in ready]
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id()
+        if oid in self._inline_cache or self.store.contains(oid):
+            return True
+        fut = self._pending_objects.get(oid)
+        if fut is not None:
+            return fut.done()
+        info = self.gcs.call("ObjectDirectory", "get_locations",
+                             object_id=oid.binary(), timeout=30)
+        return bool(info["nodes"])
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def waiter():
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return fut
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+    def _export_function(self, func) -> bytes:
+        key, blob = protocol.function_key(func)
+        if key not in self._exported_fns:
+            self.gcs.call("KV", "put", namespace="fn", key=key, value=blob,
+                          overwrite=False, timeout=30)
+            self._exported_fns.add(key)
+        return key
+
+    def fetch_function(self, key: bytes) -> Any:
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self.gcs.call("KV", "get", namespace="fn", key=key,
+                                 timeout=30)
+            if blob is None:
+                raise rexc.RayTpuError(f"function {key.hex()} not found")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # task submission
+    # ------------------------------------------------------------------
+    def _promote_ref(self, ref: ObjectRef) -> None:
+        """Ensure a ref's value is resolvable by another process: if only in
+        the inline cache, write it to the shm store + directory."""
+        oid = ref.id()
+        if self.store.contains(oid):
+            return
+        payload = self._inline_cache.get(oid)
+        if payload is not None:
+            try:
+                self.store.put_raw(oid, payload)
+                self.gcs.call("ObjectDirectory", "add_location",
+                              object_id=oid.binary(), node_id=self.node_id,
+                              size=len(payload), timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _scheduling_fields(self, options: TaskOptions) -> dict:
+        strategy = "hybrid"
+        affinity = None
+        soft = False
+        placement = None
+        st = options.scheduling_strategy
+        if isinstance(st, SpreadSchedulingStrategy):
+            strategy = "spread"
+        elif isinstance(st, NodeAffinitySchedulingStrategy):
+            strategy = "node_affinity"
+            affinity = st.node_id
+            soft = st.soft
+        elif isinstance(st, PlacementGroupSchedulingStrategy):
+            pg = st.placement_group
+            placement = (pg.id.hex(), st.placement_group_bundle_index)
+        return {"strategy": strategy, "affinity": affinity, "soft": soft,
+                "placement": placement}
+
+    def submit_task(self, func, args, kwargs, options: TaskOptions
+                    ) -> List[ObjectRef]:
+        fn_key = self._export_function(func)
+        args_blob, deps = protocol.pack_args(args, kwargs, self._promote_ref)
+        task_id = TaskID.generate()
+        num_returns = options.num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(1, num_returns + 1)]
+        demand = options.resource_demand(default_cpus=1.0)
+        sched = self._scheduling_fields(options)
+
+        fut: Future = Future()
+        with self._lock:
+            for oid in return_ids:
+                self._pending_objects[oid] = fut
+                self._owned.add(oid)
+
+        spec = protocol.make_task_spec(
+            task_id=task_id.binary(), fn_key=fn_key, args_blob=args_blob,
+            num_returns=num_returns, caller_address=self.address,
+            job_id=self.job_id,
+            options={"max_retries": options.max_retries,
+                     "retry_exceptions": options.retry_exceptions,
+                     "name": options.name
+                     or getattr(func, "__qualname__", "task")},
+        )
+
+        t = threading.Thread(
+            target=self._run_task_to_completion,
+            args=(spec, demand, sched, return_ids, fut), daemon=True)
+        t.start()
+        return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    def _run_task_to_completion(self, spec, demand, sched, return_ids, fut):
+        """Lease a worker, push the task, store results; retries on system
+        failure (ref: task retry in task_manager.h:208)."""
+        opts = spec["options"]
+        max_retries = max(0, opts.get("max_retries", 3))
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while attempt <= max_retries:
+            spec["attempt"] = attempt
+            try:
+                reply = self._lease_and_push(spec, demand, sched)
+            except rexc.TaskError as e:
+                # Application error: retry only with retry_exceptions.
+                if opts.get("retry_exceptions") and attempt < max_retries:
+                    attempt += 1
+                    continue
+                self._finish_task(return_ids, fut, error=e)
+                return
+            except BaseException as e:  # noqa: BLE001 system failure
+                last_err = e
+                attempt += 1
+                time.sleep(min(0.1 * attempt, 1.0))
+                continue
+            self._finish_task(return_ids, fut, results=reply["results"])
+            return
+        err = rexc.WorkerCrashedError(
+            f"task failed after {max_retries + 1} attempts: {last_err}")
+        self._finish_task(return_ids, fut, error=err)
+
+    def _client(self, address: str) -> SyncRpcClient:
+        """Cached channel to a peer (daemon or worker)."""
+        client = self._actor_clients.get(address)
+        if client is None:
+            client = SyncRpcClient(address, self.loop_thread)
+            self._actor_clients[address] = client
+        return client
+
+    def _lease_and_push(self, spec, demand, sched) -> dict:
+        cfg = get_config()
+        daemon_addr = self.daemon_address
+        for _ in range(16):  # bounded spillback hops
+            daemon = (self.daemon if daemon_addr == self.daemon_address
+                      else self._client(daemon_addr))
+            grant = daemon.call(
+                "NodeDaemon", "request_lease", demand=demand,
+                strategy=sched["strategy"], affinity=sched["affinity"],
+                soft=sched["soft"], placement=sched["placement"],
+                timeout=cfg.worker_lease_timeout_ms / 1000)
+            if grant.get("spill_to"):
+                daemon_addr = grant["spill_to"]
+                continue
+            if not grant.get("granted"):
+                raise rexc.RayTpuError(
+                    grant.get("error", "lease not granted"))
+            worker_addr = grant["worker_address"]
+            lease_id = grant["lease_id"]
+            try:
+                worker = self._client(worker_addr)
+                reply = worker.call("Worker", "push_task", spec=spec,
+                                    timeout=None)
+            finally:
+                try:
+                    daemon.call("NodeDaemon", "return_lease",
+                                lease_id=lease_id, timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            if reply.get("error") is not None:
+                raise reply["error"]
+            return reply
+        raise rexc.RayTpuError("too many spillback hops")
+
+    def _finish_task(self, return_ids, fut, results=None, error=None):
+        if error is not None:
+            payload = serialization.dumps(error, is_error=True)
+            for oid in return_ids:
+                self._cache_inline(oid, payload)
+        else:
+            for r in results:
+                oid = ObjectID(r.oid)
+                if r.inline is not None:
+                    self._cache_inline(oid, r.inline)
+        with self._lock:
+            for oid in return_ids:
+                self._pending_objects.pop(oid, None)
+        if not fut.done():
+            fut.set_result(None)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, cls, args, kwargs, options: TaskOptions
+                     ) -> ActorID:
+        key, blob = protocol.function_key(cls)
+        if key not in self._exported_fns:
+            self.gcs.call("KV", "put", namespace="fn", key=key, value=blob,
+                          overwrite=False, timeout=30)
+            self._exported_fns.add(key)
+        args_blob, _ = protocol.pack_args(args, kwargs, self._promote_ref)
+        actor_id = ActorID.generate()
+        # Actors hold 0 CPUs while alive unless explicitly requested (the
+        # reference's default: creation needs a worker, lifetime is free —
+        # ref: ray_option_utils actor defaults), so long-lived actors don't
+        # starve the task pool.
+        demand = options.resource_demand(default_cpus=0.0)
+        sched = self._scheduling_fields(options)
+        self.gcs.call(
+            "ActorManager", "create_actor",
+            record={
+                "actor_id": actor_id.hex(),
+                "cls_blob_key": key,
+                "cls_name": getattr(cls, "__name__", "Actor"),
+                "args_blob": args_blob,
+                "demand": demand,
+                "max_restarts": options.max_restarts,
+                "name": options.name,
+                "namespace": options.namespace or "default",
+                "detached": options.lifetime == "detached",
+                "owner_job": self.job_id,
+                "max_concurrency": options.max_concurrency,
+                "placement": sched["placement"],
+            }, timeout=60)
+        return actor_id
+
+    def _resolve_actor(self, actor_id_hex: str,
+                       timeout: float = 60.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while True:
+            info = self._actor_cache.get(actor_id_hex)
+            if info and info["state"] == "ALIVE":
+                return info
+            info = self.gcs.call("ActorManager", "get_actor",
+                                 actor_id=actor_id_hex, timeout=30)
+            if info is None:
+                raise rexc.ActorDiedError(actor_id_hex, "actor not found")
+            self._actor_cache[actor_id_hex] = info
+            if info["state"] == "ALIVE":
+                return info
+            if info["state"] == "DEAD":
+                raise rexc.ActorDiedError(actor_id_hex,
+                                          info.get("death_reason", ""))
+            if time.monotonic() > deadline:
+                raise rexc.GetTimeoutError(
+                    f"actor {actor_id_hex[:8]} not ready in {timeout}s "
+                    f"(state={info['state']})")
+            time.sleep(0.05)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
+                          kwargs, options: TaskOptions) -> List[ObjectRef]:
+        aid = actor_id.hex()
+        args_blob, _ = protocol.pack_args(args, kwargs, self._promote_ref)
+        task_id = TaskID.generate()
+        num_returns = options.num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(1, num_returns + 1)]
+        with self._lock:
+            seq = self._actor_seq[aid]
+            self._actor_seq[aid] += 1
+        fut: Future = Future()
+        with self._lock:
+            for oid in return_ids:
+                self._pending_objects[oid] = fut
+                self._owned.add(oid)
+        spec = protocol.make_task_spec(
+            task_id=task_id.binary(), fn_key=b"", args_blob=args_blob,
+            num_returns=num_returns, caller_address=self.address,
+            job_id=self.job_id, actor_id=aid, method_name=method_name,
+            seq=seq,
+            options={"max_retries": options.max_task_retries,
+                     "name": method_name},
+        )
+        t = threading.Thread(target=self._run_actor_task, args=(
+            aid, spec, return_ids, fut, options), daemon=True)
+        t.start()
+        return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    def _actor_client(self, address: str) -> SyncRpcClient:
+        client = self._actor_clients.get(address)
+        if client is None:
+            client = SyncRpcClient(address, self.loop_thread)
+            self._actor_clients[address] = client
+        return client
+
+    def _run_actor_task(self, aid, spec, return_ids, fut, options):
+        max_retries = max(0, options.max_task_retries)
+        attempt = 0
+        used_address = None
+        while True:
+            try:
+                info = self._resolve_actor(aid)
+                used_address = info["worker_address"]
+                client = self._actor_client(used_address)
+                reply = client.call("Worker", "push_actor_task", spec=spec,
+                                    timeout=None)
+                if reply.get("error") is not None:
+                    raise reply["error"]
+                self._finish_task(return_ids, fut, results=reply["results"])
+                return
+            except (rexc.ActorDiedError, rexc.GetTimeoutError) as e:
+                self._finish_task(return_ids, fut, error=e)
+                return
+            except rexc.TaskError as e:
+                self._finish_task(return_ids, fut, error=e)
+                return
+            except BaseException as e:  # noqa: BLE001 connection-level
+                self._actor_cache.pop(aid, None)
+                # A restarted actor serves at a new address — refreshing a
+                # stale address is not a task retry (the push never landed).
+                try:
+                    fresh = self._resolve_actor(aid, timeout=60)
+                except BaseException as e2:  # noqa: BLE001
+                    self._finish_task(return_ids, fut, error=e2)
+                    return
+                if fresh["worker_address"] != used_address:
+                    # The new incarnation's ActorRuntime has fresh seq state;
+                    # let it adopt this caller's counter as the base.
+                    spec["allow_base_reset"] = True
+                    continue
+                if attempt >= max_retries:
+                    self._finish_task(return_ids, fut,
+                                      error=rexc.ActorUnavailableError(
+                                          f"actor call failed: {e}"))
+                    return
+                attempt += 1
+                time.sleep(min(0.1 * attempt, 1.0))
+
+    def get_actor(self, name: str, namespace: Optional[str]) -> ActorID:
+        info = self.gcs.call("ActorManager", "get_actor", name=name,
+                             namespace=namespace or "default", timeout=30)
+        if info is None:
+            raise ValueError(f"Failed to look up actor '{name}'")
+        return ActorID.from_hex(info["actor_id"])
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.gcs.call("ActorManager", "kill_actor", actor_id=actor_id.hex(),
+                      no_restart=no_restart, timeout=30)
+        self._actor_cache.pop(actor_id.hex(), None)
+
+    def actor_state(self, actor_id: ActorID) -> str:
+        info = self.gcs.call("ActorManager", "get_actor",
+                             actor_id=actor_id.hex(), timeout=30)
+        return "DEAD" if info is None else info["state"]
+
+    # ------------------------------------------------------------------
+    # placement groups
+    # ------------------------------------------------------------------
+    def create_placement_group(self, pg_id, bundles, strategy,
+                               name=None, detached=False) -> None:
+        self.gcs.call("PlacementGroups", "create_pg", pg_id=pg_id.hex(),
+                      bundles=bundles, strategy=strategy, name=name,
+                      owner_job=self.job_id, detached=detached, timeout=60)
+
+    def get_placement_group(self, pg_id) -> Optional[dict]:
+        return self.gcs.call("PlacementGroups", "get_pg", pg_id=pg_id.hex(),
+                             timeout=30)
+
+    def remove_placement_group(self, pg_id) -> None:
+        self.gcs.call("PlacementGroups", "remove_pg", pg_id=pg_id.hex(),
+                      timeout=60)
+
+    def list_placement_groups(self) -> List[dict]:
+        return self.gcs.call("PlacementGroups", "list_pgs", timeout=30)
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True) -> None:
+        # Round-1: cancellation of queued (not yet leased) tasks happens by
+        # the lease timing out; running tasks are not interrupted.
+        logger.warning("cancel() is best-effort in this build")
+
+    # ------------------------------------------------------------------
+    # cluster introspection
+    # ------------------------------------------------------------------
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = defaultdict(float)
+        for n in self.gcs.call("NodeInfo", "list_nodes", timeout=30):
+            if n["alive"]:
+                for k, v in n["total"].items():
+                    out[k] += v
+        return dict(out)
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = defaultdict(float)
+        for n in self.gcs.call("NodeInfo", "list_nodes", timeout=30):
+            if n["alive"]:
+                for k, v in n["available"].items():
+                    out[k] += v
+        return dict(out)
+
+    def nodes(self) -> List[dict]:
+        return [
+            {"NodeID": n["node_id"], "Alive": n["alive"],
+             "Resources": n["total"], "Available": n["available"],
+             "Address": n["address"]}
+            for n in self.gcs.call("NodeInfo", "list_nodes", timeout=30)
+        ]
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        uninstall_refcounter()
+        if self.is_driver:
+            try:
+                self.gcs.call("JobManager", "finish_job", job_id=self.job_id,
+                              timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            self._stop_spawned_processes()
+        try:
+            self.store.disconnect()
+        except Exception:  # noqa: BLE001
+            pass
+        self.loop_thread.stop()
+
+    def _stop_spawned_processes(self) -> None:
+        # Reverse order: daemons (which kill their workers on SIGTERM) go
+        # down before the GCS.
+        procs = list(reversed(getattr(self, "_spawned_processes", [])))
+        for p in procs:
+            try:
+                p.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except Exception:  # noqa: BLE001
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+        tmp = getattr(self, "_cluster_tmpdir", None)
+        if tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,179 @@
+"""Driver bootstrap: start a local cluster (head) or connect to one.
+
+Analogue of the reference node bootstrap (ref: python/ray/_private/node.py
+start_head_processes :1315 — GCS server then raylet then auxiliaries;
+driver connect worker.py:2176).
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.core.distributed.core_worker import DistributedCoreWorker
+
+logger = logging.getLogger(__name__)
+
+_HANDSHAKE_TIMEOUT = 60
+
+
+def child_env() -> Dict[str, str]:
+    """Environment for spawned runtime processes: ensures the package root is
+    importable even when ray_tpu runs from a source checkout."""
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    env = dict(os.environ)
+    parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(":")
+                          if p]
+    env["PYTHONPATH"] = ":".join(dict.fromkeys(parts))
+    return env
+
+
+def _read_handshake(proc: subprocess.Popen, pattern: str,
+                    what: str) -> Dict[str, str]:
+    """Read `KEY=VALUE ...` handshake line from a child's stdout.
+
+    Non-blocking so the deadline holds even if the child is alive but
+    silent (a blocking readline() would wait forever)."""
+    deadline = time.monotonic() + _HANDSHAKE_TIMEOUT
+    rx = re.compile(pattern)
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    buf = b""
+    while time.monotonic() < deadline:
+        try:
+            chunk = os.read(fd, 4096)
+        except BlockingIOError:
+            chunk = None
+        if chunk:
+            buf += chunk
+            m = rx.search(buf.decode(errors="replace"))
+            if m:
+                os.set_blocking(fd, True)
+                return m.groupdict()
+        elif proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited with code {proc.returncode} during startup")
+        else:
+            time.sleep(0.01)
+    raise RuntimeError(f"{what} did not hand-shake within "
+                       f"{_HANDSHAKE_TIMEOUT}s")
+
+
+def start_gcs_process(host: str = "127.0.0.1",
+                      port: int = 0) -> tuple:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.distributed.gcs_server",
+         "--host", host, "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=None, env=child_env())
+    info = _read_handshake(proc, r"GCS_PORT=(?P<port>\d+)", "GCS server")
+    return proc, f"{host}:{info['port']}"
+
+
+def start_node_daemon_process(
+    gcs_address: str,
+    *,
+    host: str = "127.0.0.1",
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[dict] = None,
+    store_dir: Optional[str] = None,
+    object_store_memory: int = 0,
+    node_id: Optional[str] = None,
+) -> tuple:
+    import json
+
+    cmd = [sys.executable, "-m", "ray_tpu.core.distributed.node_daemon",
+           "--gcs-address", gcs_address, "--host", host,
+           "--resources", json.dumps(resources or {})]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if num_tpus is not None:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if store_dir:
+        cmd += ["--store-dir", store_dir]
+    if object_store_memory:
+        cmd += ["--object-store-memory", str(object_store_memory)]
+    if node_id:
+        cmd += ["--node-id", node_id]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
+                            env=child_env())
+    info = _read_handshake(
+        proc,
+        r"DAEMON_PORT=(?P<port>\d+) NODE_ID=(?P<node_id>\w+) "
+        r"STORE_DIR=(?P<store_dir>\S+)",
+        "node daemon")
+    return proc, {
+        "address": f"{host}:{info['port']}",
+        "node_id": info["node_id"],
+        "store_dir": info["store_dir"],
+    }
+
+
+def connect_or_start_cluster(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict] = None,
+    namespace: Optional[str] = None,
+    object_store_memory: Optional[int] = None,
+) -> DistributedCoreWorker:
+    spawned: List[subprocess.Popen] = []
+    if address is None:
+        gcs_proc, gcs_address = start_gcs_process()
+        spawned.append(gcs_proc)
+        daemon_proc, node_info = start_node_daemon_process(
+            gcs_address, num_cpus=num_cpus, num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory or 0)
+        spawned.append(daemon_proc)
+    else:
+        gcs_address = address
+        # Find this host's daemon via the GCS node table.
+        from ray_tpu.core.distributed.rpc import EventLoopThread, SyncRpcClient
+
+        loop = EventLoopThread("bootstrap")
+        gcs = SyncRpcClient(gcs_address, loop)
+        node_info = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            nodes = [n for n in gcs.call("NodeInfo", "list_nodes",
+                                         timeout=10) if n["alive"]]
+            if nodes:
+                # Prefer a daemon whose store dir exists locally (same host).
+                local = [n for n in nodes
+                         if os.path.isdir(n["store_dir"])]
+                chosen = (local or nodes)[0]
+                node_info = {"address": chosen["address"],
+                             "node_id": chosen["node_id"],
+                             "store_dir": chosen["store_dir"]}
+                break
+            time.sleep(0.2)
+        gcs.close()
+        loop.stop()
+        if node_info is None:
+            raise RuntimeError(f"no alive nodes behind GCS at {address}")
+
+    job_id = uuid.uuid4().hex[:8]
+    worker = DistributedCoreWorker(
+        gcs_address=gcs_address,
+        node_id=node_info["node_id"],
+        daemon_address=node_info["address"],
+        store_dir=node_info["store_dir"],
+        job_id=job_id,
+        is_driver=True,
+    )
+    worker._spawned_processes = spawned
+    worker.gcs.call("JobManager", "register_job", job_id=job_id,
+                    driver_address=worker.address, timeout=30)
+    return worker

@@ -1,0 +1,690 @@
+"""GCS: the cluster control plane.
+
+Analogue of the reference GCS server (ref: src/ray/gcs/gcs_server/
+gcs_server.cc:182 DoStart — node manager, resource manager, health check,
+job manager, PG manager, actor manager, worker manager, task manager; storage
+tables gcs_table_storage.h). One asyncio process hosting:
+
+  NodeInfo         — node registry + heartbeats + health checks
+  KV               — cluster KV store (also the function table)
+  ActorManager     — actor scheduling, restarts, named actors
+  ObjectDirectory  — object locations + distributed free
+  PlacementGroups  — bundle reservation across nodes
+  JobManager       — driver/job registry
+  TaskEvents       — task event sink powering the state API
+  Pubsub           — long-poll pub/sub (ref: src/ray/pubsub/)
+
+State lives in memory (the reference's default, ray_config_def.h:402
+gcs_storage="memory"); a Redis-equivalent durable backend can be slotted in
+at the _Store seam.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.distributed import resources as rs
+from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
+from ray_tpu.core.distributed.scheduler import (
+    ClusterView,
+    NodeView,
+    pick_node,
+    place_bundles,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Pubsub:
+    """Channelized pub/sub over server-streaming RPCs (ref: src/ray/pubsub/
+    publisher.h — long-poll batched delivery)."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[asyncio.Queue]] = defaultdict(list)
+
+    def publish(self, channel: str, message: Any) -> int:
+        for q in list(self._subs.get(channel, [])):
+            q.put_nowait(message)
+        return len(self._subs.get(channel, []))
+
+    async def stream_subscribe(self, channel: str):
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs[channel].append(q)
+        try:
+            while True:
+                yield await q.get()
+        finally:
+            self._subs[channel].remove(q)
+
+
+class KV:
+    """Namespaced key-value store (ref: gcs InternalKV — used for the
+    function table, runtime env URIs, cluster metadata)."""
+
+    def __init__(self):
+        self._data: Dict[Tuple[str, bytes], bytes] = {}
+
+    def put(self, namespace: str, key: bytes, value: bytes,
+            overwrite: bool = True) -> bool:
+        k = (namespace, key)
+        if not overwrite and k in self._data:
+            return False
+        self._data[k] = value
+        return True
+
+    def get(self, namespace: str, key: bytes) -> Optional[bytes]:
+        return self._data.get((namespace, key))
+
+    def delete(self, namespace: str, key: bytes) -> bool:
+        return self._data.pop((namespace, key), None) is not None
+
+    def keys(self, namespace: str, prefix: bytes = b"") -> List[bytes]:
+        return [k for (ns, k) in self._data if ns == namespace
+                and k.startswith(prefix)]
+
+
+class NodeInfo:
+    """Node registry + heartbeat-driven health checking (ref:
+    gcs_node_manager.h:44, gcs_health_check_manager.h:39)."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self.view = ClusterView()
+
+    def register_node(self, node_id: str, address: str,
+                      resources: Dict[str, float], store_dir: str,
+                      labels: Optional[Dict[str, str]] = None) -> dict:
+        self.view.nodes[node_id] = NodeView(
+            node_id=node_id, address=address, total=dict(resources),
+            available=dict(resources), store_dir=store_dir,
+            labels=labels or {})
+        logger.info("node %s registered at %s resources=%s", node_id[:8],
+                    address, resources)
+        self._gcs.pubsub.publish(
+            "node", {"event": "added", "node_id": node_id,
+                     "address": address, "resources": resources,
+                     "store_dir": store_dir})
+        return {"node_id": node_id}
+
+    def heartbeat(self, node_id: str, available: Dict[str, float]) -> dict:
+        n = self.view.nodes.get(node_id)
+        if n is None:
+            return {"registered": False}  # ask the node to re-register
+        if not n.alive:
+            return {"registered": False}
+        self.view.update(node_id, available)
+        return {"registered": True}
+
+    def list_nodes(self) -> List[dict]:
+        return [
+            {
+                "node_id": n.node_id,
+                "address": n.address,
+                "alive": n.alive,
+                "total": n.total,
+                "available": n.available,
+                "store_dir": n.store_dir,
+                "labels": n.labels,
+            }
+            for n in self.view.nodes.values()
+        ]
+
+    def drain_node(self, node_id: str) -> dict:
+        return self.mark_dead(node_id, reason="drained")
+
+    def mark_dead(self, node_id: str, reason: str = "health check failed"
+                  ) -> dict:
+        n = self.view.nodes.get(node_id)
+        if n is None or not n.alive:
+            return {"ok": False}
+        n.alive = False
+        logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        self._gcs.pubsub.publish(
+            "node", {"event": "dead", "node_id": node_id, "reason": reason})
+        self._gcs.actors.on_node_dead(node_id)
+        self._gcs.objects.on_node_dead(node_id)
+        self._gcs.placement_groups.on_node_dead(node_id)
+        return {"ok": True}
+
+    async def health_check_loop(self):
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000
+        threshold = cfg.health_check_failure_threshold
+        await asyncio.sleep(cfg.health_check_initial_delay_ms / 1000)
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for n in list(self.view.nodes.values()):
+                if n.alive and now - n.last_heartbeat > period * threshold:
+                    self.mark_dead(n.node_id)
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclasses.dataclass
+class ActorRecord:
+    actor_id: str
+    cls_blob_key: bytes            # function-table key for the pickled class
+    cls_name: str
+    args_blob: bytes               # serialized (args, kwargs)
+    demand: Dict[str, float]
+    max_restarts: int
+    restarts_used: int = 0
+    name: Optional[str] = None
+    namespace: str = "default"
+    detached: bool = False
+    owner_job: str = ""
+    state: str = ACTOR_PENDING
+    node_id: str = ""
+    worker_address: str = ""
+    death_reason: str = ""
+    max_concurrency: int = 1
+    placement: Optional[Tuple[str, int]] = None  # (pg_id, bundle_idx)
+
+
+class ActorManager:
+    """Actor scheduling + fault handling (ref: gcs_actor_manager.h:281,
+    gcs_actor_scheduler.h). Creation flow: pick node → ask its daemon to
+    start a dedicated worker → push the creation task → publish address."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self.actors: Dict[str, ActorRecord] = {}
+        self.named: Dict[Tuple[str, str], str] = {}
+        self._pending: asyncio.Queue = asyncio.Queue()
+
+    # -- RPC surface ----------------------------------------------------
+    async def create_actor(self, record: dict) -> dict:
+        rec = ActorRecord(**record)
+        if rec.name:
+            key = (rec.namespace, rec.name)
+            if key in self.named:
+                raise ValueError(
+                    f"Actor name '{rec.name}' already taken in namespace "
+                    f"'{rec.namespace}'")
+            self.named[key] = rec.actor_id
+        self.actors[rec.actor_id] = rec
+        await self._pending.put(rec.actor_id)
+        return {"actor_id": rec.actor_id}
+
+    def get_actor(self, actor_id: Optional[str] = None,
+                  name: Optional[str] = None,
+                  namespace: str = "default") -> Optional[dict]:
+        if actor_id is None and name is not None:
+            actor_id = self.named.get((namespace, name))
+        rec = self.actors.get(actor_id) if actor_id else None
+        if rec is None:
+            return None
+        return {
+            "actor_id": rec.actor_id, "state": rec.state,
+            "worker_address": rec.worker_address, "node_id": rec.node_id,
+            "cls_name": rec.cls_name, "name": rec.name,
+            "death_reason": rec.death_reason,
+            "max_concurrency": rec.max_concurrency,
+        }
+
+    def list_actors(self) -> List[dict]:
+        return [self.get_actor(a) for a in self.actors]
+
+    async def kill_actor(self, actor_id: str, no_restart: bool = True) -> dict:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return {"ok": False}
+        if no_restart:
+            rec.max_restarts = 0
+        if rec.worker_address:
+            try:
+                client = self._gcs.daemon_client(rec.node_id)
+                if client is not None:
+                    await client.call("NodeDaemon", "kill_worker",
+                                      worker_address=rec.worker_address,
+                                      timeout=5)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("kill_actor RPC failed: %s", e)
+        self._mark_dead(rec, "killed via kill()")
+        return {"ok": True}
+
+    def report_actor_failure(self, actor_id: str, reason: str) -> dict:
+        """Called by daemons when an actor's worker process exits."""
+        rec = self.actors.get(actor_id)
+        if rec is None or rec.state == ACTOR_DEAD:
+            return {"ok": False}
+        self._handle_failure(rec, reason)
+        return {"ok": True}
+
+    # -- internals ------------------------------------------------------
+    def _mark_dead(self, rec: ActorRecord, reason: str) -> None:
+        rec.state = ACTOR_DEAD
+        rec.death_reason = reason
+        rec.worker_address = ""
+        if rec.name:
+            self.named.pop((rec.namespace, rec.name), None)
+        self._publish(rec)
+
+    def _publish(self, rec: ActorRecord) -> None:
+        self._gcs.pubsub.publish("actor", {
+            "actor_id": rec.actor_id, "state": rec.state,
+            "worker_address": rec.worker_address,
+            "death_reason": rec.death_reason,
+        })
+
+    def _handle_failure(self, rec: ActorRecord, reason: str) -> None:
+        if rec.restarts_used < rec.max_restarts or rec.max_restarts < 0:
+            rec.restarts_used += 1
+            rec.state = ACTOR_RESTARTING
+            rec.worker_address = ""
+            self._publish(rec)
+            self._pending.put_nowait(rec.actor_id)
+            logger.info("actor %s restarting (%d/%s)", rec.actor_id[:8],
+                        rec.restarts_used, rec.max_restarts)
+        else:
+            self._mark_dead(rec, reason)
+
+    def on_node_dead(self, node_id: str) -> None:
+        for rec in self.actors.values():
+            if rec.node_id == node_id and rec.state in (ACTOR_ALIVE,
+                                                        ACTOR_PENDING):
+                self._handle_failure(rec, f"node {node_id[:8]} died")
+
+    def on_job_finished(self, job_id: str) -> None:
+        for rec in list(self.actors.values()):
+            if (not rec.detached and rec.owner_job == job_id
+                    and rec.state != ACTOR_DEAD):
+                asyncio.ensure_future(self.kill_actor(rec.actor_id))
+
+    async def scheduling_loop(self):
+        while True:
+            actor_id = await self._pending.get()
+            rec = self.actors.get(actor_id)
+            if rec is None or rec.state == ACTOR_DEAD:
+                continue
+            try:
+                ok = await self._try_schedule(rec)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("actor scheduling error: %s", e)
+                ok = False
+            if not ok and rec.state != ACTOR_DEAD:
+                # Re-queue with a delay; resources may free up.
+                async def requeue(aid=actor_id):
+                    await asyncio.sleep(0.5)
+                    await self._pending.put(aid)
+
+                asyncio.ensure_future(requeue())
+
+    async def _try_schedule(self, rec: ActorRecord) -> bool:
+        view = self._gcs.nodes.view
+        node = None
+        if rec.placement is not None:
+            pg_id, bundle_idx = rec.placement
+            node_id = self._gcs.placement_groups.bundle_node(pg_id,
+                                                             bundle_idx)
+            if node_id is not None:
+                node = view.nodes.get(node_id)
+        else:
+            node = pick_node(view, rec.demand)
+        if node is None or not node.alive:
+            return False
+        client = self._gcs.daemon_client(node.node_id)
+        if client is None:
+            return False
+        try:
+            reply = await client.call(
+                "NodeDaemon", "start_actor",
+                actor_id=rec.actor_id,
+                cls_blob_key=rec.cls_blob_key,
+                args_blob=rec.args_blob,
+                demand=rec.demand,
+                max_concurrency=rec.max_concurrency,
+                placement=rec.placement,
+                timeout=get_config().actor_creation_timeout_s)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("start_actor on %s failed: %s", node.node_id[:8],
+                           e)
+            return False
+        if not reply.get("ok"):
+            err = reply.get("error", "unknown")
+            if reply.get("creation_error"):
+                # The user constructor raised — do not retry elsewhere.
+                self._mark_dead(rec, f"creation failed: {err}")
+                return True
+            return False
+        rec.node_id = node.node_id
+        rec.worker_address = reply["worker_address"]
+        rec.state = ACTOR_ALIVE
+        self._publish(rec)
+        logger.info("actor %s alive on %s", rec.actor_id[:8],
+                    rec.worker_address)
+        return True
+
+
+class ObjectDirectory:
+    """Object location registry + distributed free (the centralized stand-in
+    for the reference's owner-based directory,
+    ref: ownership_based_object_directory.h — centralization trades peak
+    scalability for simplicity; the owner remains the refcount authority)."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self.locations: Dict[bytes, Set[str]] = defaultdict(set)
+        self.sizes: Dict[bytes, int] = {}
+
+    def add_location(self, object_id: bytes, node_id: str,
+                     size: int = 0) -> dict:
+        self.locations[object_id].add(node_id)
+        if size:
+            self.sizes[object_id] = size
+        return {"ok": True}
+
+    def remove_location(self, object_id: bytes, node_id: str) -> dict:
+        self.locations[object_id].discard(node_id)
+        return {"ok": True}
+
+    def get_locations(self, object_id: bytes) -> dict:
+        nodes = []
+        for nid in self.locations.get(object_id, ()):  # only alive nodes
+            n = self._gcs.nodes.view.nodes.get(nid)
+            if n is not None and n.alive:
+                nodes.append({"node_id": nid, "address": n.address,
+                              "store_dir": n.store_dir})
+        return {"nodes": nodes, "size": self.sizes.get(object_id, 0)}
+
+    async def free_objects(self, object_ids: List[bytes]) -> dict:
+        by_node: Dict[str, List[bytes]] = defaultdict(list)
+        for oid in object_ids:
+            for nid in self.locations.pop(oid, ()):  # consume
+                by_node[nid].append(oid)
+            self.sizes.pop(oid, None)
+        for nid, oids in by_node.items():
+            client = self._gcs.daemon_client(nid)
+            if client is None:
+                continue
+            try:
+                await client.call("NodeDaemon", "delete_objects",
+                                  object_ids=oids, timeout=10)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("free on %s failed: %s", nid[:8], e)
+        return {"ok": True}
+
+    def on_node_dead(self, node_id: str) -> None:
+        for oid in list(self.locations):
+            self.locations[oid].discard(node_id)
+
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+@dataclasses.dataclass
+class PgRecord:
+    pg_id: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    name: Optional[str] = None
+    state: str = PG_PENDING
+    nodes: List[str] = dataclasses.field(default_factory=list)
+    owner_job: str = ""
+    detached: bool = False
+
+
+class PlacementGroupManager:
+    """Gang resource reservation (ref: gcs_placement_group_manager.h:230,
+    gcs_placement_group_scheduler.h:274 — prepare/commit two-phase). On TPU
+    the flagship use is slice-atomic gangs: one bundle per host of a slice,
+    STRICT_PACK within an ICI domain."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self.groups: Dict[str, PgRecord] = {}
+        self._pending: asyncio.Queue = asyncio.Queue()
+
+    async def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
+                        strategy: str, name: Optional[str] = None,
+                        owner_job: str = "", detached: bool = False) -> dict:
+        rec = PgRecord(pg_id=pg_id, bundles=bundles, strategy=strategy,
+                       name=name, owner_job=owner_job, detached=detached)
+        self.groups[pg_id] = rec
+        await self._pending.put(pg_id)
+        return {"pg_id": pg_id}
+
+    def get_pg(self, pg_id: str) -> Optional[dict]:
+        rec = self.groups.get(pg_id)
+        if rec is None:
+            return None
+        return {"pg_id": rec.pg_id, "state": rec.state, "nodes": rec.nodes,
+                "bundles": rec.bundles, "strategy": rec.strategy}
+
+    def list_pgs(self) -> List[dict]:
+        return [self.get_pg(pid) for pid in self.groups]
+
+    def bundle_node(self, pg_id: str, bundle_idx: int) -> Optional[str]:
+        rec = self.groups.get(pg_id)
+        if rec is None or rec.state != PG_CREATED:
+            return None
+        if bundle_idx < 0:
+            return rec.nodes[0] if rec.nodes else None
+        if bundle_idx >= len(rec.nodes):
+            return None
+        return rec.nodes[bundle_idx]
+
+    async def remove_pg(self, pg_id: str) -> dict:
+        rec = self.groups.get(pg_id)
+        if rec is None or rec.state == PG_REMOVED:
+            return {"ok": False}
+        for idx, nid in enumerate(rec.nodes):
+            client = self._gcs.daemon_client(nid)
+            if client is None:
+                continue
+            try:
+                await client.call("NodeDaemon", "return_pg_bundle",
+                                  pg_id=pg_id, bundle_idx=idx, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        rec.state = PG_REMOVED
+        rec.nodes = []
+        return {"ok": True}
+
+    def on_node_dead(self, node_id: str) -> None:
+        for rec in self.groups.values():
+            if rec.state == PG_CREATED and node_id in rec.nodes:
+                # Re-reserve the whole gang (gang-granular recovery: a TPU
+                # slice loses a host => the slice's gang must re-form).
+                rec.state = PG_PENDING
+                rec.nodes = []
+                self._pending.put_nowait(rec.pg_id)
+
+    def on_job_finished(self, job_id: str) -> None:
+        for rec in list(self.groups.values()):
+            if (not rec.detached and rec.owner_job == job_id
+                    and rec.state != PG_REMOVED):
+                asyncio.ensure_future(self.remove_pg(rec.pg_id))
+
+    async def scheduling_loop(self):
+        while True:
+            pg_id = await self._pending.get()
+            rec = self.groups.get(pg_id)
+            if rec is None or rec.state != PG_PENDING:
+                continue
+            ok = await self._try_reserve(rec)
+            if not ok and rec.state == PG_PENDING:
+                async def requeue(pid=pg_id):
+                    await asyncio.sleep(0.5)
+                    await self._pending.put(pid)
+
+                asyncio.ensure_future(requeue())
+
+    async def _try_reserve(self, rec: PgRecord) -> bool:
+        placement = place_bundles(self._gcs.nodes.view, rec.bundles,
+                                  rec.strategy)
+        if placement is None:
+            return False
+        reserved: List[Tuple[str, int]] = []
+        for idx, (nid, bundle) in enumerate(zip(placement, rec.bundles)):
+            client = self._gcs.daemon_client(nid)
+            ok = False
+            if client is not None:
+                try:
+                    reply = await client.call(
+                        "NodeDaemon", "reserve_pg_bundle", pg_id=rec.pg_id,
+                        bundle_idx=idx, resources=bundle, timeout=10)
+                    ok = reply.get("ok", False)
+                except Exception:  # noqa: BLE001
+                    ok = False
+            if not ok:
+                # rollback
+                for rnid, ridx in reserved:
+                    rclient = self._gcs.daemon_client(rnid)
+                    if rclient is not None:
+                        try:
+                            await rclient.call("NodeDaemon",
+                                               "return_pg_bundle",
+                                               pg_id=rec.pg_id,
+                                               bundle_idx=ridx, timeout=10)
+                        except Exception:  # noqa: BLE001
+                            pass
+                return False
+            reserved.append((nid, idx))
+        rec.nodes = placement
+        rec.state = PG_CREATED
+        self._gcs.pubsub.publish("pg", {"pg_id": rec.pg_id,
+                                        "state": PG_CREATED,
+                                        "nodes": placement})
+        return True
+
+
+class JobManager:
+    """Driver/job registry (ref: gcs_job_manager.h)."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self.jobs: Dict[str, dict] = {}
+
+    def register_job(self, job_id: str, driver_address: str,
+                     metadata: Optional[dict] = None) -> dict:
+        self.jobs[job_id] = {
+            "job_id": job_id, "driver_address": driver_address,
+            "start_time": time.time(), "finished": False,
+            "metadata": metadata or {},
+        }
+        return {"ok": True}
+
+    def finish_job(self, job_id: str) -> dict:
+        job = self.jobs.get(job_id)
+        if job is not None:
+            job["finished"] = True
+            job["end_time"] = time.time()
+        self._gcs.actors.on_job_finished(job_id)
+        self._gcs.placement_groups.on_job_finished(job_id)
+        return {"ok": True}
+
+    def list_jobs(self) -> List[dict]:
+        return list(self.jobs.values())
+
+
+class TaskEvents:
+    """Task event sink (ref: gcs_task_manager.h — powers `ray list tasks`
+    and the timeline)."""
+
+    def __init__(self, max_events: int = 100000):
+        self.events: deque = deque(maxlen=max_events)
+
+    def add_events(self, events: List[dict]) -> dict:
+        self.events.extend(events)
+        return {"ok": True}
+
+    def list_events(self, job_id: Optional[str] = None,
+                    limit: int = 10000) -> List[dict]:
+        out = []
+        for e in reversed(self.events):
+            if job_id is None or e.get("job_id") == job_id:
+                out.append(e)
+                if len(out) >= limit:
+                    break
+        return out
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.pubsub = Pubsub()
+        self.kv = KV()
+        self.nodes = NodeInfo(self)
+        self.actors = ActorManager(self)
+        self.objects = ObjectDirectory(self)
+        self.placement_groups = PlacementGroupManager(self)
+        self.jobs = JobManager(self)
+        self.task_events = TaskEvents()
+        self.server = RpcServer(host, port)
+        self._daemon_clients: Dict[str, AsyncRpcClient] = {}
+        self._tasks: List[asyncio.Task] = []
+
+    def daemon_client(self, node_id: str) -> Optional[AsyncRpcClient]:
+        n = self.nodes.view.nodes.get(node_id)
+        if n is None or not n.alive:
+            return None
+        client = self._daemon_clients.get(node_id)
+        if client is None or client.address != n.address:
+            client = AsyncRpcClient(n.address)
+            self._daemon_clients[node_id] = client
+        return client
+
+    async def start(self) -> int:
+        for name, svc in [
+            ("NodeInfo", self.nodes), ("KV", self.kv),
+            ("ActorManager", self.actors), ("ObjectDirectory", self.objects),
+            ("PlacementGroups", self.placement_groups),
+            ("JobManager", self.jobs), ("TaskEvents", self.task_events),
+            ("Pubsub", self.pubsub),
+        ]:
+            self.server.add_service(name, svc)
+        port = await self.server.start()
+        self._tasks = [
+            asyncio.ensure_future(self.nodes.health_check_loop()),
+            asyncio.ensure_future(self.actors.scheduling_loop()),
+            asyncio.ensure_future(self.placement_groups.scheduling_loop()),
+        ]
+        logger.info("GCS listening on %s", self.server.address)
+        return port
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        await self.server.stop()
+
+
+def main():
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[gcs] %(asctime)s %(levelname)s %(message)s")
+
+    async def run():
+        gcs = GcsServer(args.host, args.port)
+        port = await gcs.start()
+        # Handshake: parent reads the bound port from stdout.
+        print(f"GCS_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,591 @@
+"""Node daemon: the per-host raylet equivalent.
+
+Analogue of the reference raylet (ref: src/ray/raylet/node_manager.h:125 —
+worker lease protocol, local scheduling, worker pool worker_pool.h:156,
+dependency mgmt, PG resource reservation placement_group_resource_manager.h;
+object transfer object_manager.h:117). One process per host:
+
+  * registers with the GCS, heartbeats available resources
+  * owns the host's shm object store directory and serves chunked pulls
+  * spawns/pools worker processes; grants leases against local resources
+  * spills tasks to other nodes via the hybrid policy when overloaded
+  * reserves/returns placement-group bundles
+  * starts dedicated actor workers on GCS request; reports worker deaths
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.distributed import resources as rs
+from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
+from ray_tpu.core.distributed.scheduler import ClusterView, NodeView, pick_node
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen, worker_id: str):
+        self.proc = proc
+        self.worker_id = worker_id
+        self.address: Optional[str] = None      # set on register
+        self.busy = False
+        self.actor_id: Optional[str] = None
+        self.last_idle = time.monotonic()
+        self.registered = asyncio.Event()
+
+
+class Lease:
+    def __init__(self, lease_id: str, demand: rs.ResourceSet,
+                 worker: WorkerHandle,
+                 placement: Optional[Tuple[str, int]]):
+        self.lease_id = lease_id
+        self.demand = demand
+        self.worker = worker
+        self.placement = placement
+        self.granted_at = time.monotonic()
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        *,
+        gcs_address: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        node_id: Optional[str] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        custom_resources: Optional[Dict[str, float]] = None,
+        store_dir: Optional[str] = None,
+        object_store_memory: int = 0,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.gcs_address = gcs_address
+        self.node_id = node_id or uuid.uuid4().hex
+        self.server = RpcServer(host, port)
+        self.total = rs.detect_node_resources(num_cpus, num_tpus,
+                                              custom=custom_resources)
+        self.available = dict(self.total)
+        self.labels = labels or {}
+        self.store_dir = store_dir or f"/dev/shm/raytpu_{self.node_id[:12]}"
+        self.store = ObjectStore(self.store_dir,
+                                 capacity=object_store_memory or 0)
+        self.gcs: Optional[AsyncRpcClient] = None
+
+        self._workers: Dict[str, WorkerHandle] = {}     # worker_id -> handle
+        self._idle: deque = deque()                      # idle task workers
+        self._leases: Dict[str, Lease] = {}
+        self._pg_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._lease_waiters: deque = deque()             # asyncio futures
+        self._view = ClusterView()
+        self._tasks: List[asyncio.Task] = []
+        self._soft_limit = int(get_config().num_workers_soft_limit
+                               or self.total.get("CPU", 1))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self) -> int:
+        self.server.add_service("NodeDaemon", self)
+        port = await self.server.start()
+        self.gcs = AsyncRpcClient(self.gcs_address)
+        await self.gcs.call(
+            "NodeInfo", "register_node", node_id=self.node_id,
+            address=self.server.address, resources=self.total,
+            store_dir=self.store_dir, labels=self.labels, timeout=30)
+        self._tasks = [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._monitor_workers_loop()),
+            asyncio.ensure_future(self._refresh_view_loop()),
+        ]
+        logger.info("node daemon %s on %s (resources=%s store=%s)",
+                    self.node_id[:8], self.server.address, self.total,
+                    self.store_dir)
+        return port
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self._workers.values()):
+            try:
+                w.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        await self.server.stop()
+        self.store.disconnect()
+        ObjectStore.destroy(self.store_dir)
+
+    async def _heartbeat_loop(self):
+        period = get_config().health_check_period_ms / 1000 / 2
+        while True:
+            try:
+                reply = await self.gcs.call(
+                    "NodeInfo", "heartbeat", node_id=self.node_id,
+                    available=dict(self.available), timeout=10)
+                if not reply.get("registered"):
+                    await self.gcs.call(
+                        "NodeInfo", "register_node", node_id=self.node_id,
+                        address=self.server.address, resources=self.total,
+                        store_dir=self.store_dir, labels=self.labels,
+                        timeout=10)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("heartbeat failed: %s", e)
+            await asyncio.sleep(period)
+
+    async def _refresh_view_loop(self):
+        while True:
+            try:
+                nodes = await self.gcs.call("NodeInfo", "list_nodes",
+                                            timeout=10)
+                view = ClusterView()
+                for n in nodes:
+                    view.nodes[n["node_id"]] = NodeView(
+                        node_id=n["node_id"], address=n["address"],
+                        total=n["total"], available=n["available"],
+                        alive=n["alive"], store_dir=n["store_dir"])
+                self._view = view
+            except Exception:  # noqa: BLE001
+                pass
+            await asyncio.sleep(1.0)
+
+    # ------------------------------------------------------------------
+    # worker pool (ref: worker_pool.h:156)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self, actor_id: Optional[str] = None) -> WorkerHandle:
+        from ray_tpu.core.distributed.driver import child_env
+
+        worker_id = uuid.uuid4().hex
+        env = child_env()
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.distributed.worker_main",
+            "--gcs-address", self.gcs_address,
+            "--daemon-address", self.server.address,
+            "--node-id", self.node_id,
+            "--store-dir", self.store_dir,
+            "--worker-id", worker_id,
+        ]
+        proc = subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=None)
+        handle = WorkerHandle(proc, worker_id)
+        handle.actor_id = actor_id
+        self._workers[worker_id] = handle
+        return handle
+
+    async def register_worker(self, worker_id: str, address: str,
+                              pid: int) -> dict:
+        handle = self._workers.get(worker_id)
+        if handle is None:
+            return {"ok": False}
+        handle.address = address
+        handle.registered.set()
+        if handle.actor_id is None and not handle.busy:
+            if handle not in self._idle:
+                self._idle.append(handle)
+            self._pump_lease_queue()
+        return {"ok": True}
+
+    async def _get_idle_worker(self) -> WorkerHandle:
+        while self._idle:
+            handle = self._idle.popleft()
+            if handle.proc.poll() is None and handle.address:
+                return handle
+        # Spawn a fresh one and wait for registration.
+        handle = self._spawn_worker()
+        try:
+            await asyncio.wait_for(
+                handle.registered.wait(),
+                timeout=get_config().worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            handle.proc.kill()
+            self._workers.pop(handle.worker_id, None)
+            raise RuntimeError("worker failed to register in time")
+        return handle
+
+    async def _monitor_workers_loop(self):
+        while True:
+            await asyncio.sleep(0.25)
+            for wid, handle in list(self._workers.items()):
+                if handle.proc.poll() is not None:
+                    self._workers.pop(wid, None)
+                    if handle in self._idle:
+                        self._idle.remove(handle)
+                    if handle.actor_id is not None:
+                        try:
+                            await self.gcs.call(
+                                "ActorManager", "report_actor_failure",
+                                actor_id=handle.actor_id,
+                                reason=f"worker process exited with code "
+                                       f"{handle.proc.returncode}",
+                                timeout=10)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    # Leases held by the dead worker are returned.
+                    for lease in list(self._leases.values()):
+                        if lease.worker is handle:
+                            self._return_lease_internal(lease.lease_id)
+
+    # ------------------------------------------------------------------
+    # lease protocol (ref: NodeManager::HandleRequestWorkerLease,
+    # node_manager.cc:1696; local dispatch local_task_manager.h:58)
+    # ------------------------------------------------------------------
+    async def request_lease(self, demand: Dict[str, float],
+                            strategy: str = "hybrid",
+                            affinity: Optional[str] = None,
+                            soft: bool = False,
+                            placement: Optional[Tuple[str, int]] = None
+                            ) -> dict:
+        cfg = get_config()
+        # Placement-group leases draw from the reserved bundle.
+        if placement is not None:
+            pg_id, bundle_idx = placement
+            if bundle_idx < 0:
+                bundle_idx = self._find_pg_bundle(pg_id, demand)
+                if bundle_idx is None:
+                    spill = await self._pg_spill_target(pg_id)
+                    if spill:
+                        return {"spill_to": spill}
+                    return {"granted": False,
+                            "error": f"placement group {pg_id[:8]} has no "
+                                     f"bundle fitting {demand} here"}
+                placement = (pg_id, bundle_idx)
+            bundle = self._pg_bundles.get((pg_id, bundle_idx))
+            if bundle is None:
+                spill = await self._pg_spill_target(pg_id, bundle_idx)
+                if spill:
+                    return {"spill_to": spill}
+                return {"granted": False,
+                        "error": f"bundle {pg_id[:8]}:{bundle_idx} not "
+                                 f"reserved on this node"}
+            if not rs.fits(bundle["available"], demand):
+                return await self._wait_for_lease(demand, placement)
+            rs.subtract(bundle["available"], demand)
+            return await self._grant(demand, placement)
+
+        # Affinity pins to a node.
+        if strategy == "node_affinity" and affinity is not None:
+            if affinity != self.node_id:
+                target = self._view.nodes.get(affinity)
+                if target is not None and target.alive:
+                    return {"spill_to": target.address}
+                if not soft:
+                    return {"granted": False,
+                            "error": f"node {affinity[:8]} not available"}
+
+        if not rs.feasible(self.total, demand):
+            # Never runnable here: spill to a feasible node.
+            node = pick_node(self._view, demand, strategy="hybrid")
+            if node is not None and node.node_id != self.node_id:
+                return {"spill_to": node.address}
+            return {"granted": False,
+                    "error": f"no node can satisfy {demand}"}
+
+        if rs.fits(self.available, demand):
+            rs.subtract(self.available, demand)
+            return await self._grant(demand, None)
+
+        # Local node busy: consider spilling (hybrid policy).
+        node = pick_node(self._view, demand, strategy=strategy,
+                         local_node_id=self.node_id,
+                         spread_threshold=cfg.scheduler_spread_threshold)
+        if node is not None and node.node_id != self.node_id:
+            return {"spill_to": node.address}
+        return await self._wait_for_lease(demand, None)
+
+    async def _wait_for_lease(self, demand, placement) -> dict:
+        fut = asyncio.get_running_loop().create_future()
+        self._lease_waiters.append((demand, placement, fut))
+        return await fut
+
+    def _pump_lease_queue(self) -> None:
+        """Grant queued lease requests that now fit (FIFO with skip)."""
+        if not self._lease_waiters:
+            return
+        remaining = deque()
+
+        async def grant_later(demand, placement, fut):
+            try:
+                reply = await self._grant(demand, placement)
+                if not fut.done():
+                    fut.set_result(reply)
+            except Exception as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+
+        while self._lease_waiters:
+            demand, placement, fut = self._lease_waiters.popleft()
+            if fut.done():
+                continue
+            ok = False
+            if placement is not None:
+                bundle = self._pg_bundles.get(tuple(placement))
+                if bundle is not None and rs.fits(bundle["available"],
+                                                  demand):
+                    rs.subtract(bundle["available"], demand)
+                    ok = True
+            elif rs.fits(self.available, demand):
+                rs.subtract(self.available, demand)
+                ok = True
+            if ok:
+                asyncio.ensure_future(grant_later(demand, placement, fut))
+            else:
+                remaining.append((demand, placement, fut))
+        self._lease_waiters = remaining
+
+    async def _grant(self, demand, placement) -> dict:
+        try:
+            worker = await self._get_idle_worker()
+        except Exception as e:  # noqa: BLE001
+            # Roll back the resource subtraction.
+            self._release_demand(demand, placement)
+            return {"granted": False, "error": str(e)}
+        worker.busy = True
+        lease_id = uuid.uuid4().hex
+        self._leases[lease_id] = Lease(lease_id, demand, worker, placement)
+        return {"granted": True, "worker_address": worker.address,
+                "lease_id": lease_id}
+
+    def _release_demand(self, demand, placement) -> None:
+        if placement is not None:
+            bundle = self._pg_bundles.get(tuple(placement))
+            if bundle is not None:
+                rs.add(bundle["available"], demand)
+        else:
+            rs.add(self.available, demand)
+
+    def return_lease(self, lease_id: str) -> dict:
+        self._return_lease_internal(lease_id)
+        return {"ok": True}
+
+    def _return_lease_internal(self, lease_id: str) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self._release_demand(lease.demand, lease.placement)
+        worker = lease.worker
+        if worker.proc.poll() is None and worker.actor_id is None:
+            worker.busy = False
+            worker.last_idle = time.monotonic()
+            self._idle.append(worker)
+        self._pump_lease_queue()
+
+    def _find_pg_bundle(self, pg_id: str, demand) -> Optional[int]:
+        for (pid, idx), bundle in self._pg_bundles.items():
+            if pid == pg_id and rs.fits(bundle["available"], demand):
+                return idx
+        return None
+
+    async def _pg_spill_target(self, pg_id: str,
+                               bundle_idx: Optional[int] = None
+                               ) -> Optional[str]:
+        """Daemon address of the node hosting this PG bundle (GCS lookup)."""
+        try:
+            info = await self.gcs.call("PlacementGroups", "get_pg",
+                                       pg_id=pg_id, timeout=10)
+        except Exception:  # noqa: BLE001
+            return None
+        if info is None or info["state"] != "CREATED" or not info["nodes"]:
+            return None
+        if bundle_idx is None or bundle_idx < 0:
+            candidates = [n for n in info["nodes"] if n != self.node_id]
+            target = candidates[0] if candidates else None
+        else:
+            target = info["nodes"][bundle_idx] if bundle_idx < len(
+                info["nodes"]) else None
+        if target is None or target == self.node_id:
+            return None
+        node = self._view.nodes.get(target)
+        return node.address if node is not None and node.alive else None
+
+    # ------------------------------------------------------------------
+    # placement groups (ref: placement_group_resource_manager.h)
+    # ------------------------------------------------------------------
+    def reserve_pg_bundle(self, pg_id: str, bundle_idx: int,
+                          resources: Dict[str, float]) -> dict:
+        if not rs.fits(self.available, resources):
+            return {"ok": False, "error": "insufficient resources"}
+        rs.subtract(self.available, resources)
+        self._pg_bundles[(pg_id, bundle_idx)] = {
+            "resources": dict(resources),
+            "available": dict(resources),
+        }
+        return {"ok": True}
+
+    def return_pg_bundle(self, pg_id: str, bundle_idx: int) -> dict:
+        bundle = self._pg_bundles.pop((pg_id, bundle_idx), None)
+        if bundle is not None:
+            rs.add(self.available, bundle["resources"])
+            self._pump_lease_queue()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def start_actor(self, actor_id: str, cls_blob_key: bytes,
+                          args_blob: bytes, demand: Dict[str, float],
+                          max_concurrency: int = 1,
+                          placement: Optional[Tuple[str, int]] = None
+                          ) -> dict:
+        if placement is not None:
+            placement = tuple(placement)
+            bundle = self._pg_bundles.get(placement)
+            if bundle is None or not rs.fits(bundle["available"], demand):
+                return {"ok": False, "error": "pg bundle unavailable"}
+            rs.subtract(bundle["available"], demand)
+        else:
+            if not rs.fits(self.available, demand):
+                return {"ok": False, "error": "insufficient resources"}
+            rs.subtract(self.available, demand)
+
+        handle = self._spawn_worker(actor_id=actor_id)
+        try:
+            await asyncio.wait_for(
+                handle.registered.wait(),
+                timeout=get_config().worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            handle.proc.kill()
+            self._workers.pop(handle.worker_id, None)
+            self._release_demand(demand, placement)
+            return {"ok": False, "error": "actor worker failed to start"}
+        handle.busy = True
+        client = AsyncRpcClient(handle.address)
+        try:
+            reply = await client.call(
+                "Worker", "create_actor", actor_id=actor_id,
+                cls_blob_key=cls_blob_key, args_blob=args_blob,
+                max_concurrency=max_concurrency,
+                timeout=get_config().actor_creation_timeout_s)
+        finally:
+            await client.close()
+        if not reply.get("ok"):
+            handle.proc.kill()
+            self._workers.pop(handle.worker_id, None)
+            self._release_demand(demand, placement)
+            return {"ok": False, "error": reply.get("error"),
+                    "creation_error": True}
+        # Track so the demand is returned if/when the actor dies.
+        lease_id = f"actor-{actor_id}"
+        self._leases[lease_id] = Lease(lease_id, demand, handle, placement)
+        return {"ok": True, "worker_address": handle.address}
+
+    async def kill_worker(self, worker_address: str) -> dict:
+        for handle in self._workers.values():
+            if handle.address == worker_address:
+                handle.proc.kill()
+                return {"ok": True}
+        return {"ok": False}
+
+    # ------------------------------------------------------------------
+    # object plane
+    # ------------------------------------------------------------------
+    async def stream_pull_object(self, object_id: bytes):
+        """Chunked zero-copy-read transfer (ref: object_manager.proto Push,
+        5 MiB chunks ray_config_def.h:352)."""
+        oid = ObjectID(object_id)
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            yield {"missing": True}
+            return
+        try:
+            chunk = get_config().object_transfer_chunk_bytes
+            total = buf.size
+            for off in range(0, total, chunk):
+                yield {
+                    "offset": off,
+                    "total_size": total,
+                    "data": bytes(buf.view[off:off + chunk]),
+                }
+            if total == 0:
+                yield {"offset": 0, "total_size": 0, "data": b""}
+        finally:
+            buf.release()
+
+    def delete_objects(self, object_ids: List[bytes]) -> dict:
+        for ob in object_ids:
+            self.store.delete(ObjectID(ob), force=False)
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # stats
+    # ------------------------------------------------------------------
+    def node_stats(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "total": self.total,
+            "available": self.available,
+            "num_workers": len(self._workers),
+            "num_idle": len(self._idle),
+            "num_leases": len(self._leases),
+            "store_used": self.store.used,
+            "store_objects": self.store.num_objects,
+            "pg_bundles": list(self._pg_bundles.keys()),
+        }
+
+    def ping(self) -> dict:
+        return {"ok": True}
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--store-dir", default=None)
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--resources", default="{}")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[raylet] %(asctime)s %(levelname)s %(message)s")
+
+    import json
+
+    async def run():
+        import signal
+
+        daemon = NodeDaemon(
+            gcs_address=args.gcs_address, host=args.host, port=args.port,
+            node_id=args.node_id, num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            custom_resources=json.loads(args.resources),
+            store_dir=args.store_dir,
+            object_store_memory=args.object_store_memory)
+        port = await daemon.start()
+        print(f"DAEMON_PORT={port} NODE_ID={daemon.node_id} "
+              f"STORE_DIR={daemon.store_dir}", flush=True)
+        stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        # Workers fate-share with the daemon (ref: runtime_env
+        # ARCHITECTURE.md "fate-shares"): on TERM/INT, kill every child
+        # worker before exiting.
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_event.set)
+        await stop_event.wait()
+        await daemon.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Wire-format helpers shared by driver, daemon, and workers.
+
+The task-spec/args framing analogue of the reference's TaskSpecification
+protobuf (ref: src/ray/protobuf/common.proto TaskSpec) — here plain dicts
+pickled by the RPC layer, with ObjectRef args replaced by resolvable markers
+(inline small values ride in the spec itself, like the reference's inline
+direct-call objects ≤ max_direct_call_object_size).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class RefMarker:
+    """Placeholder for a top-level ObjectRef argument."""
+
+    __slots__ = ("oid_binary",)
+
+    def __init__(self, oid_binary: bytes):
+        self.oid_binary = oid_binary
+
+
+def function_key(func_or_cls) -> bytes:
+    """Content hash of the pickled function/class; the function-table key
+    (ref: python/ray/_private/function_manager.py export-by-hash)."""
+    blob = cloudpickle.dumps(func_or_cls, protocol=5)
+    return hashlib.sha1(blob).digest(), blob
+
+
+def pack_args(args: List[Any], kwargs: Dict[str, Any],
+              promote) -> Tuple[bytes, List[bytes]]:
+    """Serialize (args, kwargs) replacing top-level ObjectRefs with markers.
+
+    `promote(ref)` must guarantee the ref's value is readable from the shm
+    store / directory by the executing worker. Returns (blob, dep_oids).
+    """
+    deps: List[bytes] = []
+
+    def conv(v):
+        if isinstance(v, ObjectRef):
+            promote(v)
+            deps.append(v.id().binary())
+            return RefMarker(v.id().binary())
+        return v
+
+    packed = ([conv(a) for a in args],
+              {k: conv(v) for k, v in kwargs.items()})
+    return serialization.dumps(packed), deps
+
+
+def unpack_args(blob: bytes, fetch) -> Tuple[List[Any], Dict[str, Any]]:
+    """Deserialize an args blob, resolving RefMarkers via `fetch(oid)`."""
+    args, kwargs = serialization.deserialize(blob)
+
+    def conv(v):
+        if isinstance(v, RefMarker):
+            return fetch(ObjectID(v.oid_binary))
+        return v
+
+    return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
+
+
+@dataclasses.dataclass
+class TaskResult:
+    oid: bytes
+    size: int
+    inline: Optional[bytes] = None   # full framed payload if small
+    is_error: bool = False
+
+
+def make_task_spec(
+    *,
+    task_id: bytes,
+    fn_key: bytes,
+    args_blob: bytes,
+    num_returns: int,
+    caller_address: str,
+    job_id: str,
+    options: Dict[str, Any],
+    actor_id: Optional[bytes] = None,
+    method_name: str = "",
+    seq: int = -1,
+    attempt: int = 0,
+) -> Dict[str, Any]:
+    return {
+        "task_id": task_id,
+        "fn_key": fn_key,
+        "args_blob": args_blob,
+        "num_returns": num_returns,
+        "caller_address": caller_address,
+        "job_id": job_id,
+        "options": options,
+        "actor_id": actor_id,
+        "method_name": method_name,
+        "seq": seq,
+        "attempt": attempt,
+    }

@@ -1,0 +1,91 @@
+"""Resource sets and node resource accounting.
+
+Analogue of the reference's scheduling resources (ref: src/ray/common/
+scheduling/resource_set.h, cluster_resource_data.h). Resources are
+name→float maps ("CPU", "TPU", "memory", custom labels, and gang resources
+like "TPU-v5e-16-head" per the reference's slice-head pattern,
+_private/accelerators/tpu.py:382).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+ResourceSet = Dict[str, float]
+
+EPS = 1e-9
+
+
+def fits(available: ResourceSet, demand: ResourceSet) -> bool:
+    for k, v in demand.items():
+        if v > EPS and available.get(k, 0.0) + EPS < v:
+            return False
+    return True
+
+
+def feasible(total: ResourceSet, demand: ResourceSet) -> bool:
+    """Could the demand EVER fit on a node with these total resources?"""
+    return fits(total, demand)
+
+
+def subtract(avail: ResourceSet, demand: ResourceSet) -> None:
+    for k, v in demand.items():
+        if v > EPS:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def add(avail: ResourceSet, demand: ResourceSet) -> None:
+    for k, v in demand.items():
+        if v > EPS:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+def utilization(total: ResourceSet, available: ResourceSet,
+                demand: Optional[ResourceSet] = None) -> float:
+    """Critical-resource utilization in [0,1]: the max over resource types
+    the demand cares about (all types if demand is None). Matches the
+    reference's best-node scoring input (ref: policy/scheduling_options.h)."""
+    worst = 0.0
+    keys = demand.keys() if demand else total.keys()
+    for k in keys:
+        t = total.get(k, 0.0)
+        if t <= EPS:
+            continue
+        used = t - available.get(k, 0.0)
+        worst = max(worst, used / t)
+    return worst
+
+
+def detect_node_resources(num_cpus: Optional[float] = None,
+                          num_tpus: Optional[float] = None,
+                          memory: Optional[int] = None,
+                          custom: Optional[ResourceSet] = None) -> ResourceSet:
+    """Autodetect this host's resources (TPU chips via jax when present —
+    the analogue of the reference's TPUAcceleratorManager autodetection,
+    ref: _private/accelerators/tpu.py:52-230 which reads GCE/GKE metadata)."""
+    import os
+
+    res: ResourceSet = {}
+    res["CPU"] = float(num_cpus if num_cpus is not None
+                       else (os.cpu_count() or 1))
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    else:
+        try:
+            import jax
+
+            tpus = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+            if tpus:
+                res["TPU"] = float(len(tpus))
+        except Exception:
+            pass
+    if memory is None:
+        try:
+            import psutil
+
+            memory = int(psutil.virtual_memory().total * 0.7)
+        except Exception:
+            memory = 8 << 30
+    res["memory"] = float(memory)
+    if custom:
+        res.update(custom)
+    return res

@@ -1,0 +1,235 @@
+"""gRPC plumbing: generic pickle-codec services without protoc codegen.
+
+Role parity with the reference RPC framework (ref: src/ray/rpc/grpc_server.h:85,
+grpc_client.h:92, client_call.h:188 — completion-queue wrappers around
+generated stubs). Here services are plain Python objects whose public async
+methods become unary-unary RPCs at `/raytpu.<Service>/<method>`; requests and
+responses are dicts serialized with cloudpickle. Streaming methods (name
+prefixed `stream_`) become unary-stream RPCs for chunked object transfer and
+pub/sub long-polls.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import pickle
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+import grpc
+import grpc.aio
+
+
+def _ser(obj: Any) -> bytes:
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def _de(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 512 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+    ("grpc.so_reuseport", 0),
+]
+
+
+class RpcError(Exception):
+    pass
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, services: Dict[str, Any]):
+        self._services = services
+
+    def service(self, handler_call_details):
+        path = handler_call_details.method  # "/raytpu.Svc/method"
+        try:
+            _, svc_method = path.split("/raytpu.", 1)
+            svc_name, method_name = svc_method.split("/", 1)
+        except ValueError:
+            return None
+        svc = self._services.get(svc_name)
+        if svc is None:
+            return None
+        fn = getattr(svc, method_name, None)
+        if fn is None or method_name.startswith("_"):
+            return None
+        if method_name.startswith("stream_"):
+            async def stream_handler(request_bytes, context):
+                kwargs = _de(request_bytes)
+                async for item in fn(**kwargs):
+                    yield _ser(item)
+
+            return grpc.unary_stream_rpc_method_handler(
+                stream_handler, request_deserializer=None,
+                response_serializer=None)
+
+        async def unary_handler(request_bytes, context):
+            kwargs = _de(request_bytes)
+            try:
+                result = fn(**kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
+                return _ser({"ok": True, "result": result})
+            except Exception as e:  # noqa: BLE001
+                import traceback
+
+                return _ser({
+                    "ok": False,
+                    "error": e,
+                    "traceback": traceback.format_exc(),
+                })
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_handler, request_deserializer=None,
+            response_serializer=None)
+
+
+class RpcServer:
+    """grpc.aio server hosting named services on one port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._services: Dict[str, Any] = {}
+        self._server: Optional[grpc.aio.Server] = None
+
+    def add_service(self, name: str, service: Any) -> None:
+        self._services[name] = service
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._server.add_generic_rpc_handlers(
+            (_GenericHandler(self._services),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if self.port == 0:
+            raise RpcError(f"could not bind {self.host}")
+        await self._server.start()
+        return self.port
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+
+
+class AsyncRpcClient:
+    """Channel to one peer; call services by name from async code."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.aio.insecure_channel(address,
+                                                  options=GRPC_OPTIONS)
+
+    async def call(self, service: str, method: str,
+                   timeout: Optional[float] = None, **kwargs) -> Any:
+        rpc = self._channel.unary_unary(
+            f"/raytpu.{service}/{method}",
+            request_serializer=None, response_deserializer=None)
+        try:
+            reply_bytes = await rpc(_ser(kwargs), timeout=timeout)
+        except grpc.aio.AioRpcError as e:
+            raise RpcError(
+                f"RPC {service}.{method} to {self.address} failed: "
+                f"{e.code().name} {e.details()}") from e
+        reply = _de(reply_bytes)
+        if not reply["ok"]:
+            raise reply["error"]
+        return reply["result"]
+
+    def stream(self, service: str, method: str,
+               timeout: Optional[float] = None, **kwargs):
+        rpc = self._channel.unary_stream(
+            f"/raytpu.{service}/{method}",
+            request_serializer=None, response_deserializer=None)
+        call = rpc(_ser(kwargs), timeout=timeout)
+
+        async def gen():
+            try:
+                async for item_bytes in call:
+                    yield _de(item_bytes)
+            except grpc.aio.AioRpcError as e:
+                raise RpcError(
+                    f"stream {service}.{method} to {self.address} failed: "
+                    f"{e.code().name} {e.details()}") from e
+
+        return gen()
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a background thread.
+
+    Synchronous frontends (the user's driver thread, worker task threads)
+    submit coroutines here; all gRPC aio machinery lives on this loop. The
+    analogue of the instrumented asio event loop each reference process runs
+    (ref: src/ray/common/asio/).
+    """
+
+    def __init__(self, name: str = "rpc-loop"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self._started.set()
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run coroutine on the loop, blocking the calling thread."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro):
+        """Fire-and-forget (returns concurrent Future)."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=2)
+
+
+class SyncRpcClient:
+    """Blocking facade over AsyncRpcClient via an EventLoopThread."""
+
+    def __init__(self, address: str, loop_thread: EventLoopThread):
+        self._loop = loop_thread
+        self._client: Optional[AsyncRpcClient] = None
+        self.address = address
+
+    def _ensure(self) -> AsyncRpcClient:
+        if self._client is None:
+            async def mk():
+                return AsyncRpcClient(self.address)
+
+            self._client = self._loop.run(mk())
+        return self._client
+
+    def call(self, service: str, method: str,
+             timeout: Optional[float] = None, **kwargs) -> Any:
+        client = self._ensure()
+        return self._loop.run(
+            client.call(service, method, timeout=timeout, **kwargs),
+            timeout=None if timeout is None else timeout + 5)
+
+    def close(self):
+        if self._client is not None:
+            self._loop.run(self._client.close())
+            self._client = None

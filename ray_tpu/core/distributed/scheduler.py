@@ -1,0 +1,171 @@
+"""Cluster scheduling policies.
+
+Analogue of the reference's scheduler policy umbrella
+(ref: src/ray/raylet/scheduling/scheduling_policy.h:26; hybrid top-k design
+comment policy/hybrid_scheduling_policy.h:26-49; spread/affinity/bundle
+policies in policy/*.h). Operates on a ClusterView assembled from GCS
+heartbeats; used both by node daemons (task spillback) and by the GCS
+(actor/PG placement).
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core.distributed import resources as rs
+
+
+@dataclasses.dataclass
+class NodeView:
+    node_id: str
+    address: str            # daemon RPC address
+    total: rs.ResourceSet
+    available: rs.ResourceSet
+    alive: bool = True
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    store_dir: str = ""
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class ClusterView:
+    def __init__(self):
+        self.nodes: Dict[str, NodeView] = {}
+
+    def alive_nodes(self) -> List[NodeView]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def update(self, node_id: str, available: rs.ResourceSet) -> None:
+        n = self.nodes.get(node_id)
+        if n is not None:
+            n.available = available
+            n.last_heartbeat = time.monotonic()
+
+
+def pick_node(
+    view: ClusterView,
+    demand: rs.ResourceSet,
+    *,
+    strategy: str = "hybrid",          # hybrid | spread | node_affinity
+    local_node_id: Optional[str] = None,
+    affinity_node_id: Optional[str] = None,
+    affinity_soft: bool = False,
+    spread_threshold: float = 0.5,
+    top_k_fraction: float = 0.2,
+    rng: Optional[random.Random] = None,
+) -> Optional[NodeView]:
+    """Pick a node for `demand`, or None if nothing fits right now.
+
+    hybrid (default, ref hybrid_scheduling_policy.h): prefer the local node
+    while its critical utilization stays under `spread_threshold`; otherwise
+    pick uniformly among the top-k least-utilized nodes that fit. This
+    approximates bin-packing at low load and spreads at high load.
+    """
+    rng = rng or random
+    alive = view.alive_nodes()
+    if not alive:
+        return None
+
+    if strategy == "node_affinity" and affinity_node_id is not None:
+        n = view.nodes.get(affinity_node_id)
+        if n is not None and n.alive and rs.fits(n.available, demand):
+            return n
+        if not affinity_soft:
+            return None
+        strategy = "hybrid"
+
+    fitting = [n for n in alive if rs.fits(n.available, demand)]
+    if not fitting:
+        return None
+
+    if strategy == "spread":
+        # Least utilized first => round-robin-ish spread under churn.
+        fitting.sort(key=lambda n: rs.utilization(n.total, n.available,
+                                                  demand))
+        return fitting[0]
+
+    # hybrid
+    if local_node_id is not None:
+        local = view.nodes.get(local_node_id)
+        if (local is not None and local.alive
+                and rs.fits(local.available, demand)
+                and rs.utilization(local.total, local.available,
+                                   demand) < spread_threshold):
+            return local
+    fitting.sort(key=lambda n: rs.utilization(n.total, n.available, demand))
+    k = max(1, int(len(fitting) * top_k_fraction))
+    return rng.choice(fitting[:k])
+
+
+# ---------------------------------------------------------------------------
+# Placement group bundle placement (ref: policy/bundle_scheduling_policy.h)
+# ---------------------------------------------------------------------------
+
+def place_bundles(
+    view: ClusterView,
+    bundles: List[rs.ResourceSet],
+    strategy: str,
+) -> Optional[List[str]]:
+    """Map each bundle to a node id, or None if unplaceable.
+
+    PACK: minimize node count (all on one node if possible).
+    SPREAD: spread across distinct nodes, best effort.
+    STRICT_PACK: all bundles on a single node or fail — on TPU this is the
+    slice-atomic gang (a pjit program's hosts must share an ICI domain).
+    STRICT_SPREAD: each bundle on a distinct node or fail.
+    """
+    alive = sorted(view.alive_nodes(),
+                   key=lambda n: rs.utilization(n.total, n.available))
+    if not alive:
+        return None
+
+    def try_fit_all_on(node: NodeView) -> bool:
+        avail = dict(node.available)
+        for b in bundles:
+            if not rs.fits(avail, b):
+                return False
+            rs.subtract(avail, b)
+        return True
+
+    if strategy in ("PACK", "STRICT_PACK"):
+        for n in alive:
+            if try_fit_all_on(n):
+                return [n.node_id] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+        # PACK fallback: greedy first-fit over nodes.
+        return _greedy(alive, bundles, prefer_distinct=False)
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        placement = _greedy(alive, bundles, prefer_distinct=True)
+        if placement is None:
+            return None
+        if strategy == "STRICT_SPREAD" and len(set(placement)) != len(bundles):
+            return None
+        return placement
+
+    raise ValueError(f"unknown placement strategy {strategy}")
+
+
+def _greedy(nodes: List[NodeView], bundles: List[rs.ResourceSet],
+            prefer_distinct: bool) -> Optional[List[str]]:
+    avail = {n.node_id: dict(n.available) for n in nodes}
+    placement: List[str] = []
+    used_nodes: set = set()
+    for b in bundles:
+        chosen = None
+        candidates = sorted(
+            nodes, key=lambda n: (n.node_id in used_nodes
+                                  if prefer_distinct else False,
+                                  rs.utilization(n.total, avail[n.node_id])))
+        for n in candidates:
+            if rs.fits(avail[n.node_id], b):
+                chosen = n
+                break
+        if chosen is None:
+            return None
+        rs.subtract(avail[chosen.node_id], b)
+        used_nodes.add(chosen.node_id)
+        placement.append(chosen.node_id)
+    return placement

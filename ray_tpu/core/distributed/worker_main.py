@@ -1,0 +1,357 @@
+"""Worker process: executes tasks and hosts at most one actor.
+
+Analogue of the reference worker (ref: python/ray/_private/workers/
+default_worker.py bootstrapping a C++ CoreWorker; task execution callback
+_raylet.pyx:2251; actor call ordering transport/actor_scheduling_queue.h).
+Exposes a `Worker` RPC service the submitters push tasks to directly after a
+lease grant (the reference's CoreWorkerService.PushTask,
+core_worker.proto:430).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import logging
+import os
+import threading
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import exceptions as rexc
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.distributed import protocol
+from ray_tpu.core.distributed.core_worker import DistributedCoreWorker
+from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class ActorRuntime:
+    """Hosts the single actor instance of this worker; enforces per-caller
+    submission-order execution (ref: SequentialActorSubmitQueue +
+    actor_scheduling_queue.h), with `max_concurrency` pools and async-actor
+    event-loop concurrency."""
+
+    def __init__(self, instance, max_concurrency: int):
+        self.instance = instance
+        self._is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(type(instance),
+                                           inspect.isfunction))
+        maxc = max(1, max_concurrency)
+        if self._is_async and max_concurrency == 1:
+            maxc = 1000
+        self.max_concurrency = maxc
+        self._ordered = (maxc == 1 and not self._is_async)
+        self._pool = ThreadPoolExecutor(max_workers=maxc)
+        self._expected: Dict[str, int] = defaultdict(int)
+        self._seen_callers: set = set()
+        self._buffered: Dict[str, Dict[int, Any]] = defaultdict(dict)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        if self._is_async:
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever,
+                             daemon=True).start()
+
+    async def submit(self, spec: dict, execute) -> dict:
+        """Admit in per-caller seq order, then execute; returns the reply."""
+        caller = spec["caller_address"]
+        seq = spec["seq"]
+        main_loop = asyncio.get_running_loop()
+        fut: asyncio.Future = main_loop.create_future()
+        if caller not in self._seen_callers:
+            self._seen_callers.add(caller)
+            # A caller whose counter advanced against a previous incarnation
+            # re-sends with allow_base_reset; adopt its counter as our base.
+            if spec.get("allow_base_reset") and seq > self._expected[caller]:
+                self._expected[caller] = seq
+        if seq < self._expected[caller]:
+            # Stale-but-valid retry from the restart window: run immediately
+            # rather than orphaning it below the adopted base.
+            self._dispatch(spec, fut, execute, main_loop)
+            return await fut
+        self._buffered[caller][seq] = (spec, fut)
+        self._drain(caller, execute, main_loop)
+        return await fut
+
+    def _drain(self, caller: str, execute, main_loop) -> None:
+        buf = self._buffered[caller]
+        while self._expected[caller] in buf:
+            seq = self._expected[caller]
+            spec, fut = buf.pop(seq)
+            self._expected[caller] += 1
+            self._dispatch(spec, fut, execute, main_loop)
+
+    def _dispatch(self, spec, fut, execute, main_loop) -> None:
+        method = getattr(self.instance, spec["method_name"], None)
+        if (self._loop is not None and method is not None
+                and inspect.iscoroutinefunction(method)):
+            async def run_async():
+                # Arg resolution may block (remote gets): run it on the pool
+                # and await via wrap_future (works across loops — the future
+                # from another loop's run_in_executor would not).
+                reply = await asyncio.wrap_future(
+                    self._pool.submit(execute, spec, True))
+                if isinstance(reply, dict):       # arg resolution failed
+                    main_loop.call_soon_threadsafe(
+                        lambda: fut.done() or fut.set_result(reply))
+                    return
+                args, kwargs = reply
+                out = await execute(spec, coro_args=(args, kwargs))
+                main_loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(out))
+
+            asyncio.run_coroutine_threadsafe(run_async(), self._loop)
+            return
+
+        def run_sync():
+            reply = execute(spec)
+            main_loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(reply))
+
+        self._pool.submit(run_sync)
+
+
+class WorkerService:
+    def __init__(self, core: DistributedCoreWorker, worker_id: str):
+        self.core = core
+        self.worker_id = worker_id
+        self.actor: Optional[ActorRuntime] = None
+        self.actor_id: Optional[str] = None
+        self._task_pool = ThreadPoolExecutor(max_workers=4,
+                                             thread_name_prefix="exec")
+        self._max_inline = get_config().max_inline_object_size
+
+    # ---- helpers ------------------------------------------------------
+    def _fetch_arg(self, oid: ObjectID) -> Any:
+        return self.core.get([_mkref(oid)], timeout=300)[0]
+
+    def _store_results(self, spec: dict, value: Any,
+                       is_error: bool = False) -> List[protocol.TaskResult]:
+        num_returns = spec["num_returns"]
+        task_id_b = spec["task_id"]
+        out: List[protocol.TaskResult] = []
+        if is_error:
+            values = [value] * num_returns
+        elif num_returns == 1:
+            values = [value]
+        elif isinstance(value, (tuple, list)) and len(value) == num_returns:
+            values = list(value)
+        else:
+            err = rexc.TaskError(
+                spec["options"].get("name", "task"),
+                f"declared num_returns={num_returns} but returned "
+                f"{type(value).__name__}")
+            return self._store_results(spec, err, is_error=True)
+        from ray_tpu.core.ids import TaskID
+
+        task_id = TaskID(task_id_b)
+        for i, v in enumerate(values):
+            oid = ObjectID.for_task_return(task_id, i + 1)
+            payload = serialization.dumps(v, is_error=is_error)
+            try:
+                self.core.store.put_raw(oid, payload)
+                self.core.gcs.call(
+                    "ObjectDirectory", "add_location",
+                    object_id=oid.binary(), node_id=self.core.node_id,
+                    size=len(payload), timeout=30)
+            except Exception:  # noqa: BLE001  (duplicate on retry)
+                pass
+            inline = payload if len(payload) <= self._max_inline else None
+            out.append(protocol.TaskResult(oid=oid.binary(),
+                                           size=len(payload),
+                                           inline=inline,
+                                           is_error=is_error))
+        return out
+
+    def _execute(self, spec: dict) -> dict:
+        name = spec["options"].get("name", "task")
+        try:
+            fn = self.core.fetch_function(spec["fn_key"])
+            args, kwargs = protocol.unpack_args(spec["args_blob"],
+                                                self._fetch_arg)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return {"results": self._store_results(spec, result),
+                    "error": None}
+        except BaseException as e:  # noqa: BLE001
+            err = (e if isinstance(e, rexc.RayTpuError)
+                   else rexc.TaskError.from_exception(
+                       e, name, pid=os.getpid(),
+                       node_id=self.core.node_id))
+            try:
+                self._store_results(spec, err, is_error=True)
+            except Exception:  # noqa: BLE001
+                pass
+            return {"results": [], "error": err}
+
+    # ---- RPC surface --------------------------------------------------
+    async def push_task(self, spec: dict) -> dict:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._task_pool, self._execute,
+                                          spec)
+
+    async def create_actor(self, actor_id: str, cls_blob_key: bytes,
+                           args_blob: bytes,
+                           max_concurrency: int = 1) -> dict:
+        loop = asyncio.get_running_loop()
+
+        def construct():
+            cls = self.core.fetch_function(cls_blob_key)
+            args, kwargs = protocol.unpack_args(args_blob, self._fetch_arg)
+            return cls(*args, **kwargs)
+
+        try:
+            instance = await loop.run_in_executor(self._task_pool, construct)
+        except BaseException as e:  # noqa: BLE001
+            logger.exception("actor construction failed")
+            return {"ok": False, "error": repr(e)}
+        self.actor = ActorRuntime(instance, max_concurrency)
+        self.actor_id = actor_id
+        return {"ok": True}
+
+    async def push_actor_task(self, spec: dict) -> dict:
+        if self.actor is None:
+            return {"results": [],
+                    "error": rexc.ActorDiedError(spec.get("actor_id") or "",
+                                                 "no actor on this worker")}
+        return await self.actor.submit(spec, self._execute_actor)
+
+    def _execute_actor(self, spec: dict, resolve_only: bool = False,
+                       coro_args=None):
+        name = f"{type(self.actor.instance).__name__}.{spec['method_name']}"
+        if coro_args is not None:
+            # Async path phase 2: returns an awaitable producing the reply.
+            async def run():
+                try:
+                    method = getattr(self.actor.instance,
+                                     spec["method_name"])
+                    result = await method(*coro_args[0], **coro_args[1])
+                    return {"results": self._store_results(spec, result),
+                            "error": None}
+                except BaseException as e:  # noqa: BLE001
+                    err = rexc.ActorError.from_exception(
+                        e, name, pid=os.getpid(), node_id=self.core.node_id)
+                    self._store_results(spec, err, is_error=True)
+                    return {"results": [], "error": err}
+
+            return run()
+        try:
+            args, kwargs = protocol.unpack_args(spec["args_blob"],
+                                                self._fetch_arg)
+        except BaseException as e:  # noqa: BLE001
+            err = rexc.TaskError.from_exception(e, name)
+            return {"results": [], "error": err}
+        if resolve_only:
+            return args, kwargs
+        try:
+            method = getattr(self.actor.instance, spec["method_name"])
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return {"results": self._store_results(spec, result),
+                    "error": None}
+        except BaseException as e:  # noqa: BLE001
+            err = rexc.ActorError.from_exception(
+                e, name, pid=os.getpid(), node_id=self.core.node_id)
+            try:
+                self._store_results(spec, err, is_error=True)
+            except Exception:  # noqa: BLE001
+                pass
+            return {"results": [], "error": err}
+
+    def ping(self) -> dict:
+        return {"ok": True, "pid": os.getpid(),
+                "actor_id": self.actor_id}
+
+
+def _mkref(oid: ObjectID):
+    from ray_tpu.core.object_ref import ObjectRef
+
+    return ObjectRef(oid, None, _skip_refcount=True)
+
+
+def run_worker(args) -> None:
+    # One event loop for ALL grpc.aio objects in this process (server and
+    # clients) — grpc-python's aio poller misbehaves across multiple loops.
+    from ray_tpu.core.distributed.rpc import EventLoopThread
+
+    loop_thread = EventLoopThread(name="worker-rpc")
+    server = RpcServer("127.0.0.1", 0)
+    loop_thread.run(server.start())
+    address = server.address
+
+    core = DistributedCoreWorker(
+        gcs_address=args.gcs_address,
+        node_id=args.node_id,
+        daemon_address=args.daemon_address,
+        store_dir=args.store_dir,
+        job_id="worker",
+        is_driver=False,
+        worker_address=address,
+        loop_thread=loop_thread,
+    )
+    # User code inside tasks talks to the same core worker.
+    from ray_tpu import api
+
+    api._set_global_worker(core)
+
+    service = WorkerService(core, args.worker_id)
+    server.add_service("Worker", service)
+
+    async def register():
+        daemon = AsyncRpcClient(args.daemon_address)
+        await daemon.call("NodeDaemon", "register_worker",
+                          worker_id=args.worker_id, address=address,
+                          pid=os.getpid(), timeout=30)
+        await daemon.close()
+
+    loop_thread.run(register())
+    logger.info("worker %s serving on %s", args.worker_id[:8], address)
+
+    # Fate-share with the daemon: if it stops answering pings, exit
+    # (ref: workers fate-share with their raylet).
+    failures = 0
+    while True:
+        threading.Event().wait(3.0)
+        try:
+            async def ping():
+                client = AsyncRpcClient(args.daemon_address)
+                try:
+                    await client.call("NodeDaemon", "ping", timeout=5)
+                finally:
+                    await client.close()
+
+            loop_thread.run(ping(), timeout=10)
+            failures = 0
+        except Exception:  # noqa: BLE001
+            failures += 1
+            if failures >= 3:
+                logger.warning("daemon unreachable; exiting (fate-share)")
+                os._exit(1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--daemon-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--store-dir", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {args.worker_id[:6]}] %(levelname)s %(message)s")
+    try:
+        run_worker(args)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -178,6 +178,7 @@ class LocalCoreWorker:
         self._lock = threading.RLock()
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._cancelled: set = set()
+        self._pgs: Dict[str, dict] = {}
         install_refcounter(self._ref_added, self._ref_removed)
 
     # ---- reference counting ----
@@ -448,6 +449,30 @@ class LocalCoreWorker:
         if a is None:
             return "DEAD"
         return "DEAD" if a.dead else "ALIVE"
+
+    # ---- placement groups (trivially satisfied on one node) ----
+    def create_placement_group(self, pg_id, bundles, strategy,
+                               name=None, detached=False) -> None:
+        with self._lock:
+            self._pgs[pg_id.hex()] = {
+                "pg_id": pg_id.hex(), "state": "CREATED",
+                "nodes": ["local"] * len(bundles), "bundles": bundles,
+                "strategy": strategy,
+            }
+
+    def get_placement_group(self, pg_id):
+        with self._lock:
+            return self._pgs.get(pg_id.hex())
+
+    def remove_placement_group(self, pg_id) -> None:
+        with self._lock:
+            pg = self._pgs.get(pg_id.hex())
+            if pg is not None:
+                pg["state"] = "REMOVED"
+
+    def list_placement_groups(self):
+        with self._lock:
+            return list(self._pgs.values())
 
     # ---- lifecycle ----
     def shutdown(self) -> None:

@@ -100,7 +100,10 @@ class _StoreState:
 
     def buffer_released(self, oid_binary: bytes):
         with self.lock:
-            if not self.closed:
+            # The handle stays valid until the last buffer releases (close()
+            # defers rts_disconnect), so the shared refcount must always be
+            # decremented — skipping it would pin the slot forever.
+            if self.handle:
                 get_lib().rts_release(self.handle, oid_binary)
             self.live_buffers -= 1
             if self.closed and self.live_buffers == 0 and self.handle:

@@ -18,16 +18,31 @@ _lock = threading.Lock()
 
 
 def library_path() -> str:
-    """Return the path to the built library, building if stale/missing."""
+    """Return the path to the built library, building if stale/missing.
+
+    Cross-process safe: concurrent workers serialize on an flock and use
+    per-pid temp names so a half-written .so is never published."""
     with _lock:
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            os.makedirs(_OUT_DIR, exist_ok=True)
-            tmp = _LIB + ".tmp"
-            cmd = [
-                "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
-                "-Wall", "-o", tmp, _SRC, "-lpthread",
-            ]
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-            os.replace(tmp, _LIB)
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        os.makedirs(_OUT_DIR, exist_ok=True)
+        import fcntl
+
+        with open(os.path.join(_OUT_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                # Re-check under the lock: another process may have built it.
+                if (not os.path.exists(_LIB)
+                        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                    tmp = f"{_LIB}.tmp.{os.getpid()}"
+                    cmd = [
+                        "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+                        "-Wall", "-o", tmp, _SRC, "-lpthread",
+                    ]
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   text=True)
+                    os.replace(tmp, _LIB)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
     return _LIB

@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -52,7 +53,12 @@ struct Slot {
   std::atomic<uint32_t> refcount;
   std::atomic<uint64_t> size;
   std::atomic<uint64_t> lru_tick;
+  std::atomic<uint64_t> create_ts;  // unix seconds; stale-kCreating reclaim
 };
+
+// A writer that died between create and seal/abort leaves kCreating forever;
+// reclaim such slots after this many seconds.
+constexpr uint64_t kStaleCreatingSecs = 300;
 
 struct IndexHeader {
   uint64_t magic;
@@ -171,12 +177,40 @@ void* rts_connect(const char* dir, uint64_t capacity, uint64_t num_slots) {
       delete s;
       return nullptr;
     }
-    // Wait for the creator to finish initialization (magic set last).
+    // Joiners must use the creator's num_slots (a mismatched caller value
+    // would map the wrong size and read past the mapping). Wait for init
+    // (magic set last), then read the header.
     struct stat st;
     for (int i = 0; i < 10000; i++) {
-      if (fstat(fd, &st) == 0 && (size_t)st.st_size >= s->index_bytes) break;
+      if (fstat(fd, &st) == 0 &&
+          (size_t)st.st_size >= sizeof(IndexHeader))
+        break;
       usleep(1000);
     }
+    void* hdr_mem = mmap(nullptr, sizeof(IndexHeader),
+                         PROT_READ, MAP_SHARED, fd, 0);
+    if (hdr_mem == MAP_FAILED) {
+      close(fd);
+      delete s;
+      return nullptr;
+    }
+    IndexHeader* hdr = reinterpret_cast<IndexHeader*>(hdr_mem);
+    bool ready = false;
+    for (int i = 0; i < 10000; i++) {
+      if (hdr->magic == kMagic) {
+        ready = true;
+        break;
+      }
+      usleep(1000);
+    }
+    num_slots = ready ? hdr->num_slots : 0;
+    munmap(hdr_mem, sizeof(IndexHeader));
+    if (!ready) {
+      close(fd);
+      delete s;
+      return nullptr;
+    }
+    s->index_bytes = sizeof(IndexHeader) + num_slots * sizeof(Slot);
   } else {
     if (ftruncate(fd, s->index_bytes) != 0) {
       close(fd);
@@ -247,6 +281,21 @@ uint64_t rts_evict(void* handle, uint64_t bytes_needed) {
   Store* s = static_cast<Store*>(handle);
   uint64_t freed = 0;
   if (LockIndex(s) != 0) return 0;
+  // Reclaim slots orphaned in kCreating by a crashed writer.
+  uint64_t now = (uint64_t)time(nullptr);
+  for (uint64_t i = 0; i < s->hdr->num_slots; i++) {
+    Slot* slot = &s->slots[i];
+    if (slot->state.load() == kCreating &&
+        now > slot->create_ts.load() + kStaleCreatingSecs) {
+      char path[4300];
+      ObjectPath(s, slot->id, /*building=*/true, path, sizeof(path));
+      unlink(path);
+      s->hdr->used.fetch_sub(slot->size.load());
+      s->hdr->num_objects.fetch_sub(1);
+      slot->state.store(kTombstone);
+      freed += slot->size.load();
+    }
+  }
   while (freed < bytes_needed) {
     Slot* victim = nullptr;
     uint64_t best_tick = UINT64_MAX;
@@ -306,6 +355,7 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size, int* fd_out) {
   slot->refcount.store(0);
   slot->size.store(size);
   slot->lru_tick.store(s->hdr->clock.fetch_add(1));
+  slot->create_ts.store((uint64_t)time(nullptr));
   slot->state.store(kCreating, std::memory_order_release);
   s->hdr->used.fetch_add(size);
   s->hdr->num_objects.fetch_add(1);

@@ -1,0 +1,15 @@
+"""Scheduling strategy classes (ref: python/ray/util/
+scheduling_strategies.py)."""
+from ray_tpu.core.task_spec import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "DefaultSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+    "SpreadSchedulingStrategy",
+]

@@ -1,0 +1,217 @@
+"""Distributed runtime tests against a real multi-process cluster.
+
+Model: the reference's core test suites driven by shared cluster fixtures
+(ref: python/ray/tests/conftest.py ray_start_regular :412) and the
+multi-raylet Cluster (cluster_utils.py:135).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as rexc
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=3)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    assert ray_tpu.get(mul.remote(6, 7)) == 42
+
+
+def test_put_get_and_refs_as_args(cluster):
+    x = ray_tpu.put(np.arange(1000))
+
+    @ray_tpu.remote
+    def total(arr):
+        return int(arr.sum())
+
+    assert ray_tpu.get(total.remote(x)) == 499500
+
+
+def test_nested_task_submission(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(3)) == 40
+
+
+def test_task_error_and_traceback(cluster):
+    @ray_tpu.remote
+    def broken():
+        return {}["missing"]
+
+    with pytest.raises(rexc.TaskError) as ei:
+        ray_tpu.get(broken.remote())
+    assert "KeyError" in str(ei.value)
+
+
+def test_num_returns_distributed(cluster):
+    @ray_tpu.remote(num_returns=2)
+    def pair():
+        return "a", "b"
+
+    a, b = pair.remote()
+    assert ray_tpu.get([a, b]) == ["a", "b"]
+
+
+def test_actor_state_and_ordering(cluster):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def snapshot(self):
+            return list(self.items)
+
+    a = Acc.remote()
+    for i in range(25):
+        a.add.remote(i)
+    assert ray_tpu.get(a.snapshot.remote()) == list(range(25))
+
+
+def test_named_actor_distributed(cluster):
+    @ray_tpu.remote
+    class Registry:
+        def whoami(self):
+            return "registry"
+
+    Registry.options(name="reg", lifetime="detached").remote()
+    h = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(h.whoami.remote()) == "registry"
+
+
+def test_actor_restart_after_crash(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def __init__(self):
+            self.count = 0
+
+        def incr(self):
+            self.count += 1
+            return self.count
+
+        def die(self):
+            os._exit(1)
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.incr.remote()) == 1
+    f.die.remote()
+    # After restart, state resets and calls succeed again.
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            v = ray_tpu.get(f.incr.remote(), timeout=30)
+            break
+        except (rexc.ActorUnavailableError, rexc.GetTimeoutError):
+            if time.monotonic() > deadline:
+                raise
+    assert v == 1
+
+
+def test_actor_dies_permanently_without_restarts(cluster):
+    @ray_tpu.remote
+    class OneShot:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    a = OneShot.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    a.die.remote()
+    with pytest.raises((rexc.ActorDiedError, rexc.ActorUnavailableError)):
+        for _ in range(50):
+            ray_tpu.get(a.ping.remote(), timeout=30)
+            time.sleep(0.2)
+
+
+def test_task_retry_on_worker_crash(cluster, tmp_path):
+    marker = str(tmp_path / "attempted")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)  # kill the worker on first attempt
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(), timeout=120) == "recovered"
+
+
+def test_async_actor_distributed(cluster):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def double(self, x):
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.double.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=60) == [i * 2 for i in range(8)]
+
+
+def test_runtime_context_in_task(cluster):
+    @ray_tpu.remote
+    def whereami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_node_id(), ctx.get_pid()
+
+    node_id, pid = ray_tpu.get(whereami.remote())
+    assert node_id and pid != os.getpid()
+
+
+def test_placement_group_single_node(cluster):
+    from ray_tpu.util import placement_group, remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0))
+    def pinned():
+        return "ran-in-pg"
+
+    assert ray_tpu.get(pinned.remote(), timeout=60) == "ran-in-pg"
+    remove_placement_group(pg)
+
+
+def test_wait_distributed(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+        return 2
+
+    q, s = quick.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([q, s], num_returns=1, timeout=8)
+    assert ready == [q] and pending == [s]

@@ -1,0 +1,94 @@
+"""Multi-node tests: N daemons on one host (SURVEY §4 fake-cluster model;
+ref: python/ray/tests/test_multi_node*.py over cluster_utils.Cluster)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    second = cluster.add_node(num_cpus=2, resources={"side": 1.0})
+    cluster.connect()
+    cluster.wait_for_nodes(2)
+    yield cluster, second
+    cluster.shutdown()
+
+
+def test_cluster_sees_both_nodes(two_node_cluster):
+    cluster, _ = two_node_cluster
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    assert res.get("side") == 1.0
+
+
+def test_task_runs_on_custom_resource_node(two_node_cluster):
+    cluster, second = two_node_cluster
+
+    @ray_tpu.remote(resources={"side": 0.5})
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    node_id = ray_tpu.get(where.remote(), timeout=120)
+    assert node_id == second.node_id
+
+
+def test_cross_node_object_transfer(two_node_cluster):
+    cluster, second = two_node_cluster
+    payload = np.arange(2_000_000, dtype=np.float64)  # 16 MB, chunked pull
+
+    @ray_tpu.remote(resources={"side": 0.5})
+    def produce():
+        return np.arange(2_000_000, dtype=np.float64)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    # Driver pulls from the remote node's store over the chunk stream.
+    arr = ray_tpu.get(ref, timeout=120)
+    np.testing.assert_array_equal(arr, payload)
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == payload.sum()
+
+
+def test_spread_placement_group_across_nodes(two_node_cluster):
+    from ray_tpu.util import placement_group, remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = ray_tpu.get([
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i)
+        ).remote()
+        for i in range(2)
+    ], timeout=180)
+    assert len(set(nodes)) == 2
+    remove_placement_group(pg)
+
+
+def test_node_failure_detected(two_node_cluster):
+    cluster, _ = two_node_cluster
+    third = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3)
+    cluster.remove_node(third)  # SIGKILL
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+        if len(alive) == 2:
+            return
+        time.sleep(0.5)
+    pytest.fail("dead node was not detected")
