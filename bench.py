@@ -1,0 +1,89 @@
+"""Benchmark: training tokens/sec/chip on the bench transformer.
+
+Runs a full sharded train step (fwd+bwd+Adam, bf16 compute, remat) on all
+local devices and reports throughput per chip.  The reference repo records
+no tokens/sec numbers (BASELINE.md: "No in-repo LLM tokens/sec numbers
+exist"), so `vs_baseline` is measured against a fixed reference point: 30%
+model FLOPs utilization of a v5e chip (197 bf16 TFLOP/s peak) on the same
+model — vs_baseline > 1.0 means we beat a 30%-MFU implementation.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+V5E_PEAK_FLOPS = 197e12
+BASELINE_MFU = 0.30
+
+
+def flops_per_token(cfg, seq_len: int) -> float:
+    """6*N matmul FLOPs per token (fwd+bwd) + causal attention term."""
+    n = cfg.num_params
+    attn = 6 * cfg.n_layers * cfg.d_model * seq_len  # 12*L*d*T/2 (causal)
+    return 6.0 * n + attn
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.training import default_optimizer, make_train_step
+    from ray_tpu.parallel import MeshConfig, build_mesh
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    on_tpu = backend not in ("cpu",)
+
+    if on_tpu:
+        cfg = configs.BENCH_350M
+        batch, seq, steps = 8, 2048, 20
+    else:  # local smoke path
+        cfg = configs.TINY
+        batch, seq, steps = 4, 128, 3
+
+    mesh = build_mesh(MeshConfig(fsdp=-1))
+    init_fn, step_fn = make_train_step(
+        cfg, mesh, optimizer=default_optimizer(3e-4, warmup=10, total_steps=1000))
+    state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch_data = {"tokens": tokens}
+
+    # warmup / compile.  Sync via host transfer: block_until_ready does not
+    # reliably fence execution through the remote-TPU tunnel.
+    state, m = step_fn(state, batch_data)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, batch_data)
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tps = steps * tokens_per_step / dt
+    tps_chip = tps / n_dev
+
+    fpt = flops_per_token(cfg, seq)
+    mfu = tps_chip * fpt / V5E_PEAK_FLOPS if on_tpu else float("nan")
+    baseline_tps_chip = BASELINE_MFU * V5E_PEAK_FLOPS / fpt
+
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_per_chip[{cfg.name}]",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps_chip / baseline_tps_chip, 3),
+        "extra": {
+            "backend": backend, "devices": n_dev, "batch": batch, "seq": seq,
+            "mfu": None if mfu != mfu else round(mfu, 4),
+            "loss": loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -259,10 +259,14 @@ class DistributedCoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
+        # Remote refs need a GCS directory lookup; back those off per-ref so
+        # a long wait() doesn't poll the control plane every loop tick.
+        gcs_next: Dict[bytes, float] = {}
+        gcs_interval: Dict[bytes, float] = {}
         while True:
             still = []
             for r in pending:
-                if self._is_ready(r):
+                if self._is_ready(r, gcs_next, gcs_interval):
                     ready.append(r)
                 else:
                     still.append(r)
@@ -275,15 +279,25 @@ class DistributedCoreWorker:
         ready = ready[:num_returns]
         return ready, [r for r in refs if r not in ready]
 
-    def _is_ready(self, ref: ObjectRef) -> bool:
+    def _is_ready(self, ref: ObjectRef,
+                  gcs_next: Optional[Dict[bytes, float]] = None,
+                  gcs_interval: Optional[Dict[bytes, float]] = None) -> bool:
         oid = ref.id()
         if oid in self._inline_cache or self.store.contains(oid):
             return True
         fut = self._pending_objects.get(oid)
         if fut is not None:
             return fut.done()
+        key = oid.binary()
+        now = time.monotonic()
+        if gcs_next is not None and now < gcs_next.get(key, 0.0):
+            return False
         info = self.gcs.call("ObjectDirectory", "get_locations",
                              object_id=oid.binary(), timeout=30)
+        if gcs_next is not None and gcs_interval is not None:
+            interval = min(gcs_interval.get(key, 0.025) * 2, 1.0)
+            gcs_interval[key] = interval
+            gcs_next[key] = now + interval
         return bool(info["nodes"])
 
     def as_future(self, ref: ObjectRef) -> Future:
@@ -565,13 +579,6 @@ class DistributedCoreWorker:
         t.start()
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
-    def _actor_client(self, address: str) -> SyncRpcClient:
-        client = self._actor_clients.get(address)
-        if client is None:
-            client = SyncRpcClient(address, self.loop_thread)
-            self._actor_clients[address] = client
-        return client
-
     def _run_actor_task(self, aid, spec, return_ids, fut, options):
         max_retries = max(0, options.max_task_retries)
         attempt = 0
@@ -580,7 +587,7 @@ class DistributedCoreWorker:
             try:
                 info = self._resolve_actor(aid)
                 used_address = info["worker_address"]
-                client = self._actor_client(used_address)
+                client = self._client(used_address)
                 reply = client.call("Worker", "push_actor_task", spec=spec,
                                     timeout=None)
                 if reply.get("error") is not None:
@@ -693,6 +700,8 @@ class DistributedCoreWorker:
             return
         self._shutdown = True
         uninstall_refcounter()
+        with self._lock:
+            self._flush_frees_locked()
         if self.is_driver:
             try:
                 self.gcs.call("JobManager", "finish_job", job_id=self.job_id,

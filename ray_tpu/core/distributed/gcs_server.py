@@ -256,7 +256,10 @@ class ActorManager:
     def report_actor_failure(self, actor_id: str, reason: str) -> dict:
         """Called by daemons when an actor's worker process exits."""
         rec = self.actors.get(actor_id)
-        if rec is None or rec.state == ACTOR_DEAD:
+        # RESTARTING means this incarnation's failure was already handled
+        # (e.g. node-death path); a second report must not burn another
+        # restart or double-enqueue the actor.
+        if rec is None or rec.state in (ACTOR_DEAD, ACTOR_RESTARTING):
             return {"ok": False}
         self._handle_failure(rec, reason)
         return {"ok": True}
@@ -278,6 +281,8 @@ class ActorManager:
         })
 
     def _handle_failure(self, rec: ActorRecord, reason: str) -> None:
+        if rec.state == ACTOR_RESTARTING:
+            return  # already queued for rescheduling
         if rec.restarts_used < rec.max_restarts or rec.max_restarts < 0:
             rec.restarts_used += 1
             rec.state = ACTOR_RESTARTING
@@ -305,7 +310,11 @@ class ActorManager:
         while True:
             actor_id = await self._pending.get()
             rec = self.actors.get(actor_id)
-            if rec is None or rec.state == ACTOR_DEAD:
+            # Only PENDING/RESTARTING actors may be scheduled; ALIVE means a
+            # duplicate queue entry (a second worker would leak), DEAD means
+            # the actor was killed while queued.
+            if rec is None or rec.state not in (ACTOR_PENDING,
+                                                ACTOR_RESTARTING):
                 continue
             try:
                 ok = await self._try_schedule(rec)
@@ -357,6 +366,16 @@ class ActorManager:
                 self._mark_dead(rec, f"creation failed: {err}")
                 return True
             return False
+        if rec.state == ACTOR_DEAD:
+            # Killed while the start_actor RPC was in flight: tear down the
+            # worker we just started instead of resurrecting the actor.
+            try:
+                await client.call("NodeDaemon", "kill_worker",
+                                  worker_address=reply["worker_address"],
+                                  timeout=5)
+            except Exception:  # noqa: BLE001
+                logger.warning("cleanup kill of %s failed", rec.actor_id[:8])
+            return True
         rec.node_id = node.node_id
         rec.worker_address = reply["worker_address"]
         rec.state = ACTOR_ALIVE
@@ -522,6 +541,18 @@ class PlacementGroupManager:
 
                 asyncio.ensure_future(requeue())
 
+    async def _return_bundles(self, pg_id: str,
+                              reserved: List[Tuple[str, int]]) -> None:
+        for rnid, ridx in reserved:
+            rclient = self._gcs.daemon_client(rnid)
+            if rclient is not None:
+                try:
+                    await rclient.call("NodeDaemon", "return_pg_bundle",
+                                       pg_id=pg_id, bundle_idx=ridx,
+                                       timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+
     async def _try_reserve(self, rec: PgRecord) -> bool:
         placement = place_bundles(self._gcs.nodes.view, rec.bundles,
                                   rec.strategy)
@@ -539,20 +570,16 @@ class PlacementGroupManager:
                     ok = reply.get("ok", False)
                 except Exception:  # noqa: BLE001
                     ok = False
+            if ok:
+                reserved.append((nid, idx))
             if not ok:
-                # rollback
-                for rnid, ridx in reserved:
-                    rclient = self._gcs.daemon_client(rnid)
-                    if rclient is not None:
-                        try:
-                            await rclient.call("NodeDaemon",
-                                               "return_pg_bundle",
-                                               pg_id=rec.pg_id,
-                                               bundle_idx=ridx, timeout=10)
-                        except Exception:  # noqa: BLE001
-                            pass
+                await self._return_bundles(rec.pg_id, reserved)
                 return False
-            reserved.append((nid, idx))
+        if rec.state == PG_REMOVED:
+            # remove_pg ran while we were reserving: it saw nodes=[] and
+            # made no return calls itself, so release everything here.
+            await self._return_bundles(rec.pg_id, reserved)
+            return True
         rec.nodes = placement
         rec.state = PG_CREATED
         self._gcs.pubsub.publish("pg", {"pg_id": rec.pg_id,

@@ -214,9 +214,31 @@ class NodeDaemon:
             raise RuntimeError("worker failed to register in time")
         return handle
 
+    def _reap_idle_workers(self) -> None:
+        """Enforce num_workers_soft_limit: idle task workers beyond the
+        limit that exceeded the idle-kill threshold are terminated
+        (ref: worker_pool idle eviction, worker_pool.h:156 pool semantics)."""
+        threshold = (get_config().idle_worker_killing_time_threshold_ms
+                     / 1000.0)
+        now = time.monotonic()
+        n_task_workers = sum(1 for h in self._workers.values()
+                             if h.actor_id is None)
+        while n_task_workers > self._soft_limit and self._idle:
+            handle = self._idle[0]
+            if now - handle.last_idle < threshold:
+                break  # deque is in idle order; newer ones won't qualify
+            self._idle.popleft()
+            self._workers.pop(handle.worker_id, None)
+            try:
+                handle.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+            n_task_workers -= 1
+
     async def _monitor_workers_loop(self):
         while True:
             await asyncio.sleep(0.25)
+            self._reap_idle_workers()
             for wid, handle in list(self._workers.items()):
                 if handle.proc.poll() is not None:
                     self._workers.pop(wid, None)
@@ -378,7 +400,8 @@ class NodeDaemon:
         if worker.proc.poll() is None and worker.actor_id is None:
             worker.busy = False
             worker.last_idle = time.monotonic()
-            self._idle.append(worker)
+            if worker not in self._idle:
+                self._idle.append(worker)
         self._pump_lease_queue()
 
     def _find_pg_bundle(self, pg_id: str, demand) -> Optional[int]:

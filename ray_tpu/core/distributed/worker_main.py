@@ -23,6 +23,7 @@ from ray_tpu import exceptions as rexc
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectExistsError
 from ray_tpu.core.distributed import protocol
 from ray_tpu.core.distributed.core_worker import DistributedCoreWorker
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
@@ -153,15 +154,26 @@ class WorkerService:
         for i, v in enumerate(values):
             oid = ObjectID.for_task_return(task_id, i + 1)
             payload = serialization.dumps(v, is_error=is_error)
+            inline = payload if len(payload) <= self._max_inline else None
             try:
                 self.core.store.put_raw(oid, payload)
-                self.core.gcs.call(
-                    "ObjectDirectory", "add_location",
-                    object_id=oid.binary(), node_id=self.core.node_id,
-                    size=len(payload), timeout=30)
-            except Exception:  # noqa: BLE001  (duplicate on retry)
-                pass
-            inline = payload if len(payload) <= self._max_inline else None
+            except ObjectExistsError:
+                pass  # same task retried on this node; contents identical
+            except Exception:
+                # Store failure (e.g. full) is only tolerable when the value
+                # travels inline in the reply; otherwise the caller's get()
+                # would hang on an object that exists nowhere.
+                if inline is None:
+                    raise
+            else:
+                try:
+                    self.core.gcs.call(
+                        "ObjectDirectory", "add_location",
+                        object_id=oid.binary(), node_id=self.core.node_id,
+                        size=len(payload), timeout=30)
+                except Exception:
+                    if inline is None:
+                        raise  # unregistered + not inline == unreachable
             out.append(protocol.TaskResult(oid=oid.binary(),
                                            size=len(payload),
                                            inline=inline,

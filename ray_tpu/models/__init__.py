@@ -1,0 +1,26 @@
+"""Model zoo: TPU-first transformer family.
+
+Pure-functional JAX models: parameters are plain pytrees with a parallel
+pytree of logical sharding axes (`ray_tpu.parallel.sharding`), layers are
+stacked and scanned (`lax.scan`) so compile time is O(1) in depth, compute
+is bfloat16 on the MXU with float32 master params.
+"""
+from ray_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    init_params,
+    param_logical_axes,
+    forward,
+    loss_fn,
+)
+from ray_tpu.models import configs
+
+__all__ = [
+    "Transformer",
+    "TransformerConfig",
+    "init_params",
+    "param_logical_axes",
+    "forward",
+    "loss_fn",
+    "configs",
+]

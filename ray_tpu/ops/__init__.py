@@ -1,0 +1,21 @@
+"""TPU compute ops: Pallas kernels with XLA fallbacks.
+
+The hot ops of the transformer stack.  Each op has (a) a Pallas TPU kernel
+used on TPU backends and (b) a pure-XLA reference implementation used on CPU
+(tests) and as the autodiff recompute path.  The reference framework has no
+kernel layer at all — it delegates compute to torch; this package is the
+greenfield part of the TPU build (SURVEY.md §2.4: SP/CP ring attention row).
+"""
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rotary import apply_rope, rope_frequencies
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

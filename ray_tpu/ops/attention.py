@@ -1,0 +1,176 @@
+"""Flash attention: Pallas TPU kernel + XLA reference.
+
+Online-softmax blockwise attention (Dao et al.) laid out for the MXU:
+queries stream through VMEM in `block_q` rows while key/value blocks of
+`block_kv` rows are swept in the innermost grid dimension, with the running
+max/denominator/accumulator held in VMEM scratch across the sweep.  Causal
+sweeps skip fully-masked kv blocks.
+
+Autodiff: the forward runs the kernel; the backward recomputes attention via
+the XLA reference implementation (flash backward kernel is a later-round
+optimization).  Gradients are exact.
+
+Reference framework has no attention op (compute is torch's problem there);
+this is greenfield per SURVEY.md §2.4.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable installs; guard for CPU wheels.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+_LANES = 128  # TPU lane width; scratch stats are replicated across lanes.
+
+
+def mha_reference(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
+                  kv_offset: int = 0):
+    """Plain-XLA multi-head attention, numerically stable softmax.
+
+    Shapes: q (B, Tq, H, D), k/v (B, Tkv, H, D).  `kv_offset` shifts kv
+    global positions for causal masking (used by ring attention where the
+    local kv block starts at a nonzero global index; q is assumed to start
+    at global index `kv_offset=0` frame of its caller).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = jnp.arange(tq)[:, None]
+        k_pos = jnp.arange(tk)[None, :] + kv_offset
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               sm_scale: float, causal: bool, block_q: int, block_kv: int,
+               num_kv_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: kv block is live iff its first row index <= q block's last row.
+    live = (qi + 1) * block_q > ki * block_kv if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)        # (block_kv, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                         # (block_q, block_kv)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[...]                      # (block_q, LANES)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)          # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)                     # (block_q, LANES)
+        p = jnp.exp(s - m_new[:, :1])                       # (block_q, block_kv)
+        l_new = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        o_ref[0, ...] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None):
+    """Fused attention.  q,k,v: (B, T, H, D) → (B, T, H, D).
+
+    Uses the Pallas TPU kernel on TPU, XLA reference elsewhere.  GQA/MQA:
+    callers repeat kv heads before the call (XLA folds the broadcast).
+    """
+    return _flash_fwd(q, k, v, causal, sm_scale)[0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    out = _dispatch(q, k, v, causal=causal, sm_scale=sm_scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal, sm_scale=sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _dispatch(q, k, v, *, causal, sm_scale):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = pltpu is not None and jax.default_backend() == "tpu"
+    b, t, h, d = q.shape
+    tkv = k.shape[1]
+    if not on_tpu or t < 128 or tkv < 128 or t % 128 or tkv % 128:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _flash_pallas(q, k, v, *, causal, sm_scale,
+                  block_q: int = 256, block_kv: int = 256):
+    b, t, h, d = q.shape
+    tkv = k.shape[1]
+    block_q = min(block_q, t)
+    block_kv = min(block_kv, tkv)
+    num_q = t // block_q
+    num_kv = tkv // block_kv
+
+    # (B, T, H, D) -> (B*H, T, D): heads become independent grid rows.
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    kernel = functools.partial(
+        _fa_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_kv_blocks=num_kv)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qf, kf, vf)
+
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

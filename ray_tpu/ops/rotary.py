@@ -1,0 +1,27 @@
+"""Rotary position embeddings (RoPE), half-rotation layout.
+
+Computed in float32 and cast back; `positions` is passed explicitly so
+sequence-parallel shards can feed their global offsets.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, *, theta: float = 10000.0):
+    """Inverse frequencies, shape (head_dim // 2,)."""
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x, positions, *, theta: float = 10000.0):
+    """x: (B, T, H, D); positions: (B, T) or (T,) int32 global positions."""
+    d = x.shape[-1]
+    inv_freq = rope_frequencies(d, theta=theta)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B, T, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,60 @@
+"""TPU-native parallelism substrate.
+
+Where the reference delegates intra-node parallelism to NCCL/torch.distributed
+(ray/python/ray/util/collective/collective.py, ray/python/ray/train/torch/config.py:112),
+this package expresses it the XLA way: a `jax.sharding.Mesh` over the slice,
+logical-axis sharding rules on parameter pytrees, and compiler-inserted
+collectives over ICI.  Host-level (out-of-graph, DCN) collectives live in
+`ray_tpu.util.collective`.
+"""
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+    mesh_shape_for,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    AXIS_SEQ,
+    AXIS_EXPERT,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_to_mesh,
+    shard_pytree,
+    with_logical_constraint,
+    param_shardings,
+)
+from ray_tpu.parallel.collectives import (
+    all_gather,
+    all_to_all,
+    pmean,
+    ppermute_ring,
+    psum,
+    psum_scatter,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "mesh_shape_for",
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_TENSOR",
+    "AXIS_SEQ",
+    "AXIS_EXPERT",
+    "LogicalRules",
+    "DEFAULT_RULES",
+    "logical_to_mesh",
+    "shard_pytree",
+    "with_logical_constraint",
+    "param_shardings",
+    "psum",
+    "pmean",
+    "all_gather",
+    "psum_scatter",
+    "all_to_all",
+    "ppermute_ring",
+]

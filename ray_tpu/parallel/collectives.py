@@ -1,0 +1,63 @@
+"""In-graph collective wrappers.
+
+The reference exposes host-level collectives over NCCL/GLOO
+(reference: python/ray/util/collective/collective.py:258 `allreduce`,
+:472 `reducescatter`, :531/:594 `send/recv`).  Inside an SPMD program the
+TPU-native equivalents are `jax.lax` collectives compiled onto ICI; these
+wrappers only add axis-name ergonomics and a ring-permute helper used by
+ring attention.  Host-level (out-of-graph) collectives are in
+`ray_tpu.util.collective`.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str | Sequence[str]):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str | Sequence[str]):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def psum_scatter(x, axis: str, *, dim: int = 0, tiled: bool = True):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=tiled)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int, tiled: bool = True):
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=tiled)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def ppermute_ring(x, axis: str, *, shift: int = 1):
+    """Rotate shards around the `axis` ring by `shift` (neighbor exchange on ICI).
+
+    perm[i] = (i + shift) % n: device i's value lands on device i+shift, i.e.
+    each device receives the value of its `-shift` neighbor.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def unshard(x):
+    """Gather a sharded global array to a host numpy array (debug/eval path)."""
+    import numpy as np
+    return np.asarray(jax.device_get(x))

@@ -1,0 +1,95 @@
+"""Device-mesh construction for TPU slices.
+
+The reference has no mesh concept — its parallelism topology is implicit in
+NCCL process-group ranks (reference: python/ray/train/torch/config.py:112
+`_setup_torch_process_group`).  On TPU the topology is explicit and physical:
+chips are wired in an ICI torus, and XLA lays collectives onto it.  We name
+five standard axes and build meshes with `mesh_utils.create_device_mesh` so
+that axis order maps contiguous ICI neighborhoods to the inner axes
+(tensor/seq), keeping the bandwidth-hungry collectives on ICI rather than DCN.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost (DCN-friendly) to innermost (ICI-hungry).
+AXIS_DATA = "dp"      # pure data parallel: gradient psum only
+AXIS_FSDP = "fsdp"    # data parallel with parameter sharding (ZeRO-3 / XLA SPMD)
+AXIS_EXPERT = "ep"    # MoE expert parallel: all_to_all token routing
+AXIS_SEQ = "sp"       # sequence/context parallel: ring attention ppermute
+AXIS_TENSOR = "tp"    # tensor (Megatron) parallel: activation all-reduce
+
+_CANONICAL_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each parallelism axis.  -1 on at most one axis means
+    "absorb all remaining devices" (like torch's device_mesh -1)."""
+
+    dp: int = 1
+    fsdp: int = -1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {AXIS_DATA: self.dp, AXIS_FSDP: self.fsdp,
+                 AXIS_EXPERT: self.ep, AXIS_SEQ: self.sp, AXIS_TENSOR: self.tp}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are available")
+        return sizes
+
+
+def mesh_shape_for(n_devices: int, config: MeshConfig | None = None) -> dict[str, int]:
+    return (config or MeshConfig()).resolve(n_devices)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis_order: Sequence[str] = _CANONICAL_ORDER,
+) -> Mesh:
+    """Build a Mesh whose trailing axes sit on contiguous ICI neighborhoods.
+
+    `mesh_utils.create_device_mesh` understands the physical TPU topology and
+    permutes devices so that the innermost mesh axes are nearest-neighbor on
+    the ICI torus — exactly where tp/sp collectives must live.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = mesh_shape_for(len(devices), config)
+    shape = tuple(sizes[a] for a in axis_order)
+    if devices[0].platform == "tpu":
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    else:
+        # CPU/GPU test path: topology is flat, plain reshape is fine.
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_order))
+
+
+def local_mesh(n: int | None = None) -> Mesh:
+    """A 1-D fsdp mesh over (the first n) local devices; the everyday default."""
+    devices = jax.devices()[: n or len(jax.devices())]
+    return build_mesh(MeshConfig(fsdp=-1), devices=devices)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Mapping[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
