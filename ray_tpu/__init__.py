@@ -28,6 +28,26 @@ from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
 
+_SUBPACKAGES = ("data", "train", "tune", "serve", "dag", "util", "parallel",
+                "ops", "models", "workflow", "rllib")
+
+
+def __getattr__(name):
+    """Lazy subpackage access: `ray_tpu.data`, `ray_tpu.train`, ... import
+    on first touch (keeps bare `import ray_tpu` light)."""
+    if name in _SUBPACKAGES:
+        import importlib
+
+        try:
+            mod = importlib.import_module(f"ray_tpu.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'ray_tpu' has no attribute {name!r}") from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
 __all__ = [
     "__version__",
     "init",

@@ -313,6 +313,29 @@ class DistributedCoreWorker:
         return fut
 
     # ------------------------------------------------------------------
+    # internal KV (ref: gcs InternalKV client surface, _private/gcs_utils.py)
+    # ------------------------------------------------------------------
+    def kv_put(self, namespace: bytes, key: bytes, value: bytes,
+               overwrite: bool = True) -> bool:
+        ns = namespace.decode() if isinstance(namespace, bytes) else namespace
+        return self.gcs.call("KV", "put", namespace=ns, key=key,
+                             value=value, overwrite=overwrite, timeout=30)
+
+    def kv_get(self, namespace: bytes, key: bytes) -> Optional[bytes]:
+        ns = namespace.decode() if isinstance(namespace, bytes) else namespace
+        return self.gcs.call("KV", "get", namespace=ns, key=key, timeout=30)
+
+    def kv_del(self, namespace: bytes, key: bytes) -> bool:
+        ns = namespace.decode() if isinstance(namespace, bytes) else namespace
+        return self.gcs.call("KV", "delete", namespace=ns, key=key,
+                             timeout=30)
+
+    def kv_keys(self, namespace: bytes, prefix: bytes = b"") -> list:
+        ns = namespace.decode() if isinstance(namespace, bytes) else namespace
+        return self.gcs.call("KV", "keys", namespace=ns, prefix=prefix,
+                             timeout=30)
+
+    # ------------------------------------------------------------------
     # function table
     # ------------------------------------------------------------------
     def _export_function(self, func) -> bytes:

@@ -155,17 +155,21 @@ class WorkerService:
             oid = ObjectID.for_task_return(task_id, i + 1)
             payload = serialization.dumps(v, is_error=is_error)
             inline = payload if len(payload) <= self._max_inline else None
+            stored = True
             try:
                 self.core.store.put_raw(oid, payload)
             except ObjectExistsError:
-                pass  # same task retried on this node; contents identical
+                # Retried task, contents identical; still (re-)register below
+                # — the first attempt may have died before add_location.
+                pass
             except Exception:
                 # Store failure (e.g. full) is only tolerable when the value
                 # travels inline in the reply; otherwise the caller's get()
                 # would hang on an object that exists nowhere.
+                stored = False
                 if inline is None:
                     raise
-            else:
+            if stored:
                 try:
                     self.core.gcs.call(
                         "ObjectDirectory", "add_location",

@@ -474,6 +474,30 @@ class LocalCoreWorker:
         with self._lock:
             return list(self._pgs.values())
 
+    # ---- internal KV (in-process; mirrors the GCS KV surface) ----
+    def kv_put(self, namespace, key, value, overwrite: bool = True) -> bool:
+        kv = getattr(self, "_kv_store", None)
+        if kv is None:
+            kv = self._kv_store = {}
+        k = (bytes(namespace), bytes(key))
+        if not overwrite and k in kv:
+            return False
+        kv[k] = value
+        return True
+
+    def kv_get(self, namespace, key):
+        return getattr(self, "_kv_store", {}).get(
+            (bytes(namespace), bytes(key)))
+
+    def kv_del(self, namespace, key) -> bool:
+        return getattr(self, "_kv_store", {}).pop(
+            (bytes(namespace), bytes(key)), None) is not None
+
+    def kv_keys(self, namespace, prefix: bytes = b"") -> list:
+        ns = bytes(namespace)
+        return [k for (n, k) in getattr(self, "_kv_store", {})
+                if n == ns and k.startswith(prefix)]
+
     # ---- lifecycle ----
     def shutdown(self) -> None:
         uninstall_refcounter()

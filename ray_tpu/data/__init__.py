@@ -1,0 +1,19 @@
+"""ray_tpu.data: distributed data pipelines (reference: ray.data).
+
+Arrow blocks in the shared-memory object store, lazy plans with map-stage
+fusion, a streaming executor with bounded in-flight backpressure, and
+TPU device feeding (`Dataset.iter_jax_batches` double-buffers host→HBM).
+"""
+from ray_tpu.data.dataset import Dataset, GroupedData, from_block_list
+from ray_tpu.data.read_api import (
+    from_arrow, from_huggingface, from_items, from_numpy, from_pandas,
+    from_torch, range, range_tensor, read_binary_files, read_csv,
+    read_images, read_json, read_numpy, read_parquet, read_text)
+
+__all__ = [
+    "Dataset", "GroupedData", "from_block_list",
+    "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
+    "from_pandas", "from_huggingface", "from_torch",
+    "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files", "read_images", "read_numpy",
+]

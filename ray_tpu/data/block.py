@@ -1,0 +1,135 @@
+"""Block model: a Dataset is a list of Arrow-table blocks in the object store.
+
+Mirrors the reference's block design (ref: python/ray/data/block.py — blocks
+are Arrow/pandas tables held in plasma, workers exchange ObjectRefs).  Here
+a block is always a `pyarrow.Table`; batches handed to UDFs are converted
+to the requested `batch_format` ("numpy" dict, "pandas", "pyarrow").
+Tensors ride as fixed-shape-list columns and convert to stacked ndarrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+TENSOR_META_KEY = b"rtpu_tensor_shape"
+
+
+def _np_to_column(arr: np.ndarray):
+    """ndarray column → Arrow.  >1-D arrays become FixedSizeList columns."""
+    if arr.ndim <= 1:
+        return pa.array(arr)
+    flat = arr.reshape(len(arr), -1)
+    inner = pa.array(flat.ravel())
+    return pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+
+
+def from_batch(batch: Any) -> Block:
+    """Build a block from a UDF return: dict-of-ndarray, pandas, or table."""
+    import pandas as pd
+
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pd.DataFrame):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, dict):
+        names, cols, meta = [], [], {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            names.append(k)
+            cols.append(_np_to_column(v))
+            if v.ndim > 1:
+                meta[f"{k}.shape"] = ",".join(map(str, v.shape[1:]))
+        t = pa.table(dict(zip(names, cols)))
+        if meta:
+            t = t.replace_schema_metadata(
+                {TENSOR_META_KEY: repr(meta).encode()})
+        return t
+    raise TypeError(f"cannot build a block from {type(batch).__name__}")
+
+
+def from_rows(rows: List[Any]) -> Block:
+    """Items → single-column block ('item') or struct columns for dicts."""
+    if rows and isinstance(rows[0], dict):
+        keys = list(rows[0].keys())
+        return pa.table({k: [r[k] for r in rows] for k in keys})
+    return pa.table({"item": list(rows)})
+
+
+def _tensor_shapes(block: Block) -> Dict[str, tuple]:
+    meta = (block.schema.metadata or {}).get(TENSOR_META_KEY)
+    if not meta:
+        return {}
+    d = eval(meta.decode(), {"__builtins__": {}})  # trusted: we wrote it
+    return {k.rsplit(".shape", 1)[0]: tuple(int(x) for x in v.split(","))
+            for k, v in d.items()}
+
+
+def to_numpy(block: Block) -> Dict[str, np.ndarray]:
+    shapes = _tensor_shapes(block)
+    out = {}
+    for name in block.column_names:
+        col = block.column(name)
+        if pa.types.is_fixed_size_list(col.type):
+            w = col.type.list_size
+            flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+            arr = flat.reshape(len(block), w)
+            if name in shapes:
+                arr = arr.reshape((len(block),) + shapes[name])
+            out[name] = arr
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def to_pandas(block: Block):
+    return block.to_pandas()
+
+
+def to_batch(block: Block, batch_format: Optional[str]):
+    if batch_format in (None, "numpy", "np"):
+        return to_numpy(block)
+    if batch_format in ("pandas", "pd"):
+        return to_pandas(block)
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def iter_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    cols = to_numpy(block)
+    names = list(cols)
+    for i in range(len(block)):
+        row = {k: cols[k][i] for k in names}
+        yield row["item"] if names == ["item"] else row
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return block.slice(start, end - start)
+
+
+def concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def batches(block: Block, batch_size: Optional[int]) -> Iterator[Block]:
+    if batch_size is None or batch_size >= block.num_rows:
+        if block.num_rows:
+            yield block
+        return
+    for s in range(0, block.num_rows, batch_size):
+        yield block.slice(s, batch_size)
+
+
+def size_bytes(block: Block) -> int:
+    return block.nbytes
+
+
+def num_rows(block: Block) -> int:
+    return block.num_rows

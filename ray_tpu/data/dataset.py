@@ -1,0 +1,509 @@
+"""Dataset: lazy, immutable, distributed collection of Arrow blocks.
+
+Reference surface being reproduced (ref: python/ray/data/dataset.py:137 —
+map_batches :371, iter_batches :3640, materialize :4520; grouped_data.py;
+_internal/split.py).  Execution is deferred: transforms append stages to a
+logical plan; consumption streams block refs through the executor.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Union)
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data.execution import execute
+from ray_tpu.data.plan import AllToAllStage, MapStage, ReadTask
+
+BatchUDF = Callable[..., Any]
+
+
+def _batch_map_blockfn(fn, batch_size, batch_format, fn_kwargs):
+    def block_fn(block: B.Block) -> Iterable[B.Block]:
+        for piece in B.batches(block, batch_size):
+            out = fn(B.to_batch(piece, batch_format), **fn_kwargs)
+            yield B.from_batch(out)
+
+    return block_fn
+
+
+def _row_map_blockfn(kind: str, fn):
+    def block_fn(block: B.Block) -> Iterable[B.Block]:
+        rows = list(B.iter_rows(block))
+        if kind == "map":
+            out = [fn(r) for r in rows]
+        elif kind == "filter":
+            out = [r for r in rows if fn(r)]
+        else:  # flat_map
+            out = list(itertools.chain.from_iterable(fn(r) for r in rows))
+        if not out:
+            yield block.slice(0, 0)
+            return
+        yield B.from_rows(out)
+
+    return block_fn
+
+
+class Dataset:
+    def __init__(self, read_tasks: List[ReadTask], stages: List[Any] = None):
+        self._read_tasks = read_tasks
+        self._stages = stages or []
+
+    # ---------------- transforms (lazy) ----------------
+    def _with(self, stage) -> "Dataset":
+        return Dataset(self._read_tasks, self._stages + [stage])
+
+    def map_batches(self, fn: BatchUDF, *, batch_size: Optional[int] = None,
+                    batch_format: Optional[str] = None,
+                    compute: Optional[Any] = None, concurrency: int = 0,
+                    fn_constructor_args: tuple = (),
+                    fn_kwargs: Optional[dict] = None, **_ignored) -> "Dataset":
+        """Apply a UDF per batch.  Class UDFs run on an actor pool
+        (`concurrency` actors); function UDFs fuse into producer tasks."""
+        fn_kwargs = fn_kwargs or {}
+        if isinstance(fn, type):
+            n = concurrency or (compute if isinstance(compute, int) else 2)
+
+            def maker(cls=fn, args=fn_constructor_args, kw=dict(fn_kwargs),
+                      bs=batch_size, bf=batch_format):
+                inst = cls(*args)
+                return _batch_map_blockfn(inst, bs, bf, kw)
+
+            return self._with(MapStage(
+                name=f"MapBatches({fn.__name__})",
+                block_fn=None, actor_fn_maker=maker, num_actors=n))
+        return self._with(MapStage(
+            name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
+            block_fn=_batch_map_blockfn(fn, batch_size, batch_format,
+                                        fn_kwargs)))
+
+    def map(self, fn) -> "Dataset":
+        return self._with(MapStage("Map", _row_map_blockfn("map", fn)))
+
+    def filter(self, fn) -> "Dataset":
+        return self._with(MapStage("Filter", _row_map_blockfn("filter", fn)))
+
+    def flat_map(self, fn) -> "Dataset":
+        return self._with(MapStage("FlatMap",
+                                   _row_map_blockfn("flat_map", fn)))
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add, batch_format="numpy")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.drop_columns(cols), batch_format="pyarrow")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.select(cols), batch_format="pyarrow")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.rename_columns(
+                [mapping.get(c, c) for c in t.column_names]),
+            batch_format="pyarrow")
+
+    def limit(self, n: int) -> "Dataset":
+        # Streaming cutoff: pulls upstream refs only until n rows are seen,
+        # so execution of the tail never happens.
+        def ref_fn(ref_iter):
+            def gen():
+                left = n
+                for ref in ref_iter:
+                    if left <= 0:
+                        break
+                    blk = ray_tpu.get(ref)
+                    take = min(left, blk.num_rows)
+                    left -= take
+                    yield (ref if take == blk.num_rows
+                           else ray_tpu.put(blk.slice(0, take)))
+
+            return gen()
+
+        return self._with(AllToAllStage("Limit", ref_fn))
+
+    # ---------------- all-to-all ----------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def ref_fn(refs):
+            refs = list(refs)
+            if not refs:
+                return refs
+            blocks = ray_tpu.get(refs)
+            whole = B.concat(blocks)
+            n = whole.num_rows
+            per = max(1, -(-n // num_blocks))
+            return [ray_tpu.put(whole.slice(i * per, per))
+                    for i in range(num_blocks) if i * per < n or n == 0]
+
+        return self._with(AllToAllStage("Repartition", ref_fn))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Distributed map-reduce shuffle: each block scatters rows into
+        num_blocks partitions; reducers concat+permute
+        (ref: data/_internal shuffle — push-based variant not needed yet)."""
+        def ref_fn(refs):
+            refs = list(refs)
+            if not refs:
+                return refs
+            n_out = len(refs)
+
+            @ray_tpu.remote
+            def scatter(block, n, s):
+                rng = np.random.default_rng(s)
+                idx = rng.permutation(block.num_rows)
+                parts = np.array_split(idx, n)
+                out = tuple(block.take(pa.array(p)) for p in parts)
+                return out[0] if n == 1 else out
+
+            @ray_tpu.remote
+            def combine(s, *parts):
+                t = B.concat(list(parts))
+                rng = np.random.default_rng(s)
+                return t.take(pa.array(rng.permutation(t.num_rows)))
+
+            ss = np.random.SeedSequence(seed)
+            seeds = ss.generate_state(2 * len(refs) + n_out)
+            scattered = [
+                scatter.options(num_returns=n_out).remote(r, n_out,
+                                                          int(seeds[i]))
+                for i, r in enumerate(refs)]
+            if n_out == 1:
+                scattered = [[s] for s in scattered]
+            return [combine.remote(int(seeds[len(refs) + j]),
+                                   *[scattered[i][j]
+                                     for i in range(len(refs))])
+                    for j in range(n_out)]
+
+        return self._with(AllToAllStage("RandomShuffle", ref_fn))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def ref_fn(refs):
+            refs = list(refs)
+            if not refs:
+                return refs
+
+            @ray_tpu.remote
+            def sort_all(*blocks):
+                t = B.concat(list(blocks))
+                order = "descending" if descending else "ascending"
+                return t.sort_by([(key, order)])
+
+            return [sort_all.remote(*refs)]
+
+        return self._with(AllToAllStage("Sort", ref_fn))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        if self._stages or other._stages:
+            left = self.materialize()
+            right = other.materialize()
+            return Dataset(left._read_tasks + right._read_tasks)
+        return Dataset(self._read_tasks + other._read_tasks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        def ref_fn(refs):
+            mine = B.concat(ray_tpu.get(list(refs)))
+            theirs = B.concat(ray_tpu.get(list(other.to_block_refs())))
+            n = min(mine.num_rows, theirs.num_rows)
+            mine, theirs = mine.slice(0, n), theirs.slice(0, n)
+            cols = {c: mine.column(c) for c in mine.column_names}
+            for c in theirs.column_names:
+                cols[c if c not in cols else f"{c}_1"] = theirs.column(c)
+            return [ray_tpu.put(pa.table(cols))]
+
+        return self._with(AllToAllStage("Zip", ref_fn))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        # Per-call entropy when unseeded; per-block entropy from a content
+        # digest so equal-sized blocks don't draw identical masks.
+        import secrets
+        import zlib
+
+        call_entropy = seed if seed is not None else secrets.randbits(63)
+
+        def block_fn(block):
+            digest = 0
+            if block.num_columns and block.num_rows:
+                for buf in block.column(0).combine_chunks().chunk(0).buffers():
+                    if buf is not None:
+                        digest = zlib.crc32(bytes(buf)[:4096], digest)
+            rng = np.random.default_rng((call_entropy, digest,
+                                         block.num_rows))
+            mask = rng.random(block.num_rows) < fraction
+            yield block.filter(pa.array(mask))
+
+        return self._with(MapStage("RandomSample", block_fn))
+
+    # ---------------- split ----------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Materialize and split into n datasets (ref: dataset.py split;
+        used for per-host train shards)."""
+        refs = list(self.to_block_refs())
+        blocks = ray_tpu.get(refs)
+        whole = B.concat(blocks)
+        total = whole.num_rows
+        per = total // n if equal else -(-total // n)
+        out = []
+        for i in range(n):
+            start = min(i * per, total)
+            end = min((i + 1) * per, total) if i < n - 1 or equal else total
+            t = whole.slice(start, max(0, end - start))
+            out.append(from_block_list([t]))
+        return out
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        whole = B.concat(ray_tpu.get(list(self.to_block_refs())))
+        bounds = [0] + list(indices) + [whole.num_rows]
+        return [from_block_list([whole.slice(a, b - a)])
+                for a, b in zip(bounds[:-1], bounds[1:])]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        whole = B.concat(ray_tpu.get(list(ds.to_block_refs())))
+        cut = int(whole.num_rows * (1 - test_size))
+        return (from_block_list([whole.slice(0, cut)]),
+                from_block_list([whole.slice(cut)]))
+
+    # ---------------- execution / consumption ----------------
+    def to_block_refs(self) -> Iterator[Any]:
+        yield from execute(self._read_tasks, self._stages)
+
+    def iter_blocks(self) -> Iterator[B.Block]:
+        for ref in self.to_block_refs():
+            yield ray_tpu.get(ref)
+
+    def materialize(self) -> "Dataset":
+        refs = list(self.to_block_refs())
+        return _materialized(refs)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: Optional[str] = None,
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Any]:
+        carry: Optional[B.Block] = None
+        for blk in self.iter_blocks():
+            if carry is not None and carry.num_rows:
+                blk = B.concat([carry, blk])
+                carry = None
+            start = 0
+            while blk.num_rows - start >= batch_size:
+                yield B.to_batch(blk.slice(start, batch_size), batch_format)
+                start += batch_size
+            carry = blk.slice(start)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield B.to_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for blk in self.iter_blocks():
+            yield from B.iter_rows(blk)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self.iter_blocks())
+
+    def sum(self, col: str):
+        import pyarrow.compute as pc
+
+        return sum(pc.sum(b.column(col)).as_py() or 0
+                   for b in self.iter_blocks())
+
+    def min(self, col: str):
+        import pyarrow.compute as pc
+
+        return min(pc.min(b.column(col)).as_py() for b in self.iter_blocks())
+
+    def max(self, col: str):
+        import pyarrow.compute as pc
+
+        return max(pc.max(b.column(col)).as_py() for b in self.iter_blocks())
+
+    def mean(self, col: str):
+        total, cnt = 0.0, 0
+        for b in self.iter_blocks():
+            import pyarrow.compute as pc
+
+            s = pc.sum(b.column(col)).as_py()
+            total += s or 0
+            cnt += b.num_rows
+        return total / cnt if cnt else float("nan")
+
+    def schema(self) -> Optional[pa.Schema]:
+        for b in self.iter_blocks():
+            return b.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def num_blocks(self) -> int:
+        return len(list(self.to_block_refs()))
+
+    def size_bytes(self) -> int:
+        return sum(b.nbytes for b in self.iter_blocks())
+
+    def to_pandas(self):
+        return B.concat(list(self.iter_blocks())).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return B.concat(list(self.iter_blocks()))
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return B.to_numpy(self.to_arrow())
+
+    # ---------------- writes ----------------
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self.iter_blocks()):
+            f = os.path.join(path, f"part-{i:05d}.{fmt}")
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(blk, f)
+            elif fmt == "csv":
+                import pyarrow.csv as pcsv
+
+                pcsv.write_csv(blk, f)
+            else:
+                blk.to_pandas().to_json(f, orient="records", lines=True)
+
+    # ---------------- device feeding (TPU-specific) ----------------
+    def iter_jax_batches(self, *, batch_size: int, sharding=None,
+                         dtypes: Optional[dict] = None, drop_last: bool = True,
+                         prefetch: int = 2) -> Iterator[Any]:
+        """Double-buffered host→HBM feed: next batch's `device_put` is
+        issued while the current one computes (the plasma→HBM analogue of
+        the reference's iter_torch_batches + async prefetch)."""
+        import jax
+
+        def to_device(np_batch):
+            if dtypes:
+                np_batch = {k: v.astype(dtypes[k]) if k in dtypes else v
+                            for k, v in np_batch.items()}
+            if sharding is not None:
+                return {k: jax.device_put(v, sharding)
+                        for k, v in np_batch.items()}
+            return {k: jax.device_put(v) for k, v in np_batch.items()}
+
+        buf: List[Any] = []
+        for np_batch in self.iter_batches(batch_size=batch_size,
+                                          batch_format="numpy",
+                                          drop_last=drop_last):
+            buf.append(to_device(np_batch))
+            if len(buf) > prefetch:
+                yield buf.pop(0)
+        yield from buf
+
+    def __repr__(self):
+        names = [getattr(s, "name", "?") for s in self._stages]
+        return (f"Dataset(blocks~{len(self._read_tasks)}, "
+                f"stages={names})")
+
+
+class GroupedData:
+    """Minimal groupby-aggregate (ref: python/ray/data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: List[tuple]) -> Dataset:
+        key = self._key
+
+        def ref_fn(refs):
+            refs = list(refs)
+
+            @ray_tpu.remote
+            def agg_all(*blocks):
+                t = B.concat(list(blocks))
+                tbl = t.group_by(key).aggregate(aggs)
+                # pyarrow names output "<col>_<fn>"; keep as-is
+                return tbl.sort_by(key)
+
+            return [agg_all.remote(*refs)]
+
+        return self._ds._with(AllToAllStage("GroupByAgg", ref_fn))
+
+    def count(self) -> Dataset:
+        return self._agg([(self._key, "count")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([(col, "sum")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([(col, "mean")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([(col, "min")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([(col, "max")])
+
+    def map_groups(self, fn, *, batch_format: Optional[str] = None) -> Dataset:
+        key = self._key
+
+        def ref_fn(refs):
+            refs = list(refs)
+
+            @ray_tpu.remote
+            def apply(*blocks):
+                import pyarrow.compute as pc
+
+                t = B.concat(list(blocks))
+                outs = []
+                for val in pc.unique(t.column(key)).to_pylist():
+                    mask = pc.equal(t.column(key), pa.scalar(val))
+                    grp = t.filter(mask)
+                    res = fn(B.to_batch(grp, batch_format))
+                    outs.append(B.from_batch(res))
+                return B.concat(outs)
+
+            return [apply.remote(*refs)]
+
+        return self._ds._with(AllToAllStage("MapGroups", ref_fn))
+
+
+def _materialized(refs: List[Any]) -> Dataset:
+    tasks = [ReadTask(fn=functools.partial(ray_tpu.get, r), name="cached")
+             for r in refs]
+    return Dataset(tasks)
+
+
+def from_block_list(blocks: List[B.Block]) -> Dataset:
+    refs = [ray_tpu.put(b) for b in blocks]
+    return _materialized(refs)

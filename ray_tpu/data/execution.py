@@ -1,0 +1,195 @@
+"""Streaming executor: bounded in-flight tasks over the block stream.
+
+The reference's streaming executor runs operators concurrently with
+backpressure policies (ref: python/ray/data/_internal/execution/
+streaming_executor.py:55, scheduling step :262; backpressure_policy/).
+Equivalent mechanics here: read+fused-map work is submitted as remote
+tasks with a sliding in-flight window (`max_in_flight`); completed block
+refs stream to the consumer as soon as they finish (out-of-order), so
+downstream iteration overlaps upstream compute.  Stateful UDF stages run
+on a small actor pool with least-loaded dispatch.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data.block import Block, concat
+from ray_tpu.data.plan import AllToAllStage, MapStage, ReadTask, fuse_map_chain
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MAX_IN_FLIGHT = 16
+
+
+def _run_read(read_fn, map_fn) -> Block:
+    blocks = [read_fn()]
+    if map_fn is not None:
+        out: List[Block] = []
+        for b in blocks:
+            out.extend(map_fn(b))
+        blocks = out
+    return concat(blocks) if len(blocks) != 1 else blocks[0]
+
+
+def _run_map(block: Block, map_fn) -> Block:
+    out = list(map_fn(block))
+    return concat(out) if len(out) != 1 else out[0]
+
+
+class _ActorPool:
+    """Small pool of UDF-holding actors with least-loaded dispatch
+    (ref: execution/operators/actor_pool_map_operator.py)."""
+
+    def __init__(self, fn_maker, size: int):
+        @ray_tpu.remote
+        class _MapActor:
+            def __init__(self, maker):
+                self._fn = maker()
+
+            def apply(self, block):
+                out = list(self._fn(block))
+                return concat(out) if len(out) != 1 else out[0]
+
+        self.actors = [_MapActor.remote(fn_maker) for _ in range(size)]
+        self.load = [0] * size
+
+    def submit(self, block_ref):
+        i = min(range(len(self.actors)), key=lambda j: self.load[j])
+        self.load[i] += 1
+        ref = self.actors[i].apply.remote(block_ref)
+        return i, ref
+
+    def done(self, i):
+        self.load[i] -= 1
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def execute(read_tasks: List[ReadTask], stages: List[Any], *,
+            max_in_flight: int = DEFAULT_MAX_IN_FLIGHT) -> Iterator[Any]:
+    """Yield block refs for the fully-applied plan, streaming."""
+    # Split the stage list into segments separated by all-to-all barriers.
+    segments: List[List[Any]] = [[]]
+    for st in stages:
+        if isinstance(st, AllToAllStage):
+            segments.append(st)
+            segments.append([])
+        else:
+            segments[-1].append(st)
+
+    stream: Iterator[Any] = _stream_source(read_tasks, segments[0],
+                                           max_in_flight)
+    i = 1
+    while i < len(segments):
+        barrier: AllToAllStage = segments[i]
+        # ref_fn receives the (lazy) upstream ref iterator; most barriers
+        # list() it, but streaming ones (Limit) can stop pulling early.
+        refs = barrier.ref_fn(stream)
+        map_seg = segments[i + 1]
+        stream = _stream_maps(iter(refs), map_seg, max_in_flight)
+        i += 2
+    yield from stream
+
+
+def _split_actor_stages(stages: List[MapStage]):
+    """Group consecutive task-fusable stages; actor stages break fusion."""
+    groups: List[Any] = []
+    cur: List[MapStage] = []
+    for st in stages:
+        if st.actor_fn_maker is not None:
+            if cur:
+                groups.append(cur)
+                cur = []
+            groups.append(st)
+        else:
+            cur.append(st)
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _stream_source(read_tasks, map_stages, max_in_flight) -> Iterator[Any]:
+    groups = _split_actor_stages(map_stages)
+    head_fused = None
+    if groups and isinstance(groups[0], list):
+        head_fused = fuse_map_chain([s.block_fn for s in groups[0]])
+        groups = groups[1:]
+
+    run_read = ray_tpu.remote(_run_read)
+    stream = _windowed(
+        ((run_read, (t.fn, head_fused)) for t in read_tasks), max_in_flight)
+    for g in groups:
+        stream = _apply_group(stream, g, max_in_flight)
+    return stream
+
+
+def _stream_maps(refs: Iterator[Any], map_stages, max_in_flight):
+    groups = _split_actor_stages(map_stages)
+    stream = refs
+    for g in groups:
+        stream = _apply_group(stream, g, max_in_flight)
+    return stream
+
+
+def _apply_group(stream: Iterator[Any], group, max_in_flight):
+    if isinstance(group, list):
+        fused = fuse_map_chain([s.block_fn for s in group])
+        run_map = ray_tpu.remote(_run_map)
+        return _windowed(((run_map, (ref, fused)) for ref in stream),
+                         max_in_flight)
+    return _actor_stream(stream, group, max_in_flight)
+
+
+def _windowed(submissions, max_in_flight) -> Iterator[Any]:
+    """Submit (remote_fn, args) lazily, keep <= max_in_flight running,
+    yield refs in submission order (blocks stay ordered like the
+    reference's default; the window still overlaps execution)."""
+    in_flight: List[Any] = []
+    submissions = iter(submissions)
+    exhausted = False
+    while True:
+        while not exhausted and len(in_flight) < max_in_flight:
+            try:
+                fn, args = next(submissions)
+            except StopIteration:
+                exhausted = True
+                break
+            in_flight.append(fn.remote(*args))
+        if not in_flight:
+            return
+        head = in_flight.pop(0)
+        ray_tpu.wait([head], num_returns=1, timeout=None)
+        yield head
+
+
+def _actor_stream(stream: Iterator[Any], stage: MapStage, max_in_flight):
+    pool = _ActorPool(stage.actor_fn_maker, max(1, stage.num_actors))
+    try:
+        pending: List[Any] = []  # (ref, actor_idx) in submission order
+        stream = iter(stream)
+        exhausted = False
+        cap = max(len(pool.actors) * 2, 2)
+        while True:
+            while not exhausted and len(pending) < cap:
+                try:
+                    block_ref = next(stream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                i, ref = pool.submit(block_ref)
+                pending.append((ref, i))
+            if not pending:
+                return
+            ref, i = pending.pop(0)
+            ray_tpu.wait([ref], num_returns=1, timeout=None)
+            pool.done(i)
+            yield ref
+    finally:
+        pool.shutdown()

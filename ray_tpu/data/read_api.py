@@ -1,0 +1,206 @@
+"""Readers / from_* constructors (ref: python/ray/data/read_api.py —
+read_parquet :604, read_images :775, from_huggingface :2663; datasource/).
+
+Each reader pre-splits its source into `ReadTask`s (one block each) so the
+streaming executor parallelizes and fuses downstream maps into the read.
+"""
+from __future__ import annotations
+
+import functools
+import glob as globlib
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import Dataset, from_block_list
+from ray_tpu.data.plan import ReadTask
+
+
+def _expand_paths(paths, suffixes=None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if suffixes:
+        out = [p for p in out
+               if any(p.lower().endswith(s) for s in suffixes)]
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def _tasks_from_files(files: List[str], read_one, name: str) -> Dataset:
+    return Dataset([ReadTask(functools.partial(read_one, f), name=name)
+                    for f in files])
+
+
+# ---------------- synthetic ----------------
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    parallelism = max(1, min(parallelism, n or 1))
+    per = -(-n // parallelism) if n else 0
+
+    def make(start, end):
+        return lambda: pa.table({"id": np.arange(start, end)})
+
+    tasks = []
+    i = 0
+    while i * per < n:
+        tasks.append(ReadTask(make(i * per, min((i + 1) * per, n)),
+                              name="range"))
+        i += 1
+    if not tasks:
+        tasks = [ReadTask(lambda: pa.table({"id": np.arange(0)}),
+                          name="range")]
+    return Dataset(tasks)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    base = range(n, parallelism=parallelism)
+
+    def expand(batch):
+        ids = batch["id"]
+        data = np.broadcast_to(ids.reshape((-1,) + (1,) * len(shape)),
+                               (len(ids),) + tuple(shape)).copy()
+        return {"data": data}
+
+    return base.map_batches(expand, batch_format="numpy")
+
+
+# ---------------- from_* ----------------
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    if not items:
+        return from_block_list([pa.table({})])
+    parallelism = max(1, min(parallelism, len(items)))
+    per = -(-len(items) // parallelism)
+    blocks = [B.from_rows(items[i:i + per])
+              for i in __import__("builtins").range(0, len(items), per)]
+    return from_block_list(blocks)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return from_block_list([B.from_batch({column: arr})])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return from_block_list([table])
+
+
+def from_pandas(df) -> Dataset:
+    return from_block_list([pa.Table.from_pandas(df, preserve_index=False)])
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """HF datasets are Arrow-backed; grab the table directly."""
+    t = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if t is None:
+        t = pa.Table.from_pydict(hf_dataset.to_dict())
+    return from_block_list([t.combine_chunks()])
+
+
+def from_torch(torch_dataset) -> Dataset:
+    return from_items([torch_dataset[i]
+                       for i in __import__("builtins").range(
+                           len(torch_dataset))])
+
+
+# ---------------- file formats ----------------
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 **_kw) -> Dataset:
+    files = _expand_paths(paths, (".parquet", ".pq"))
+
+    def read_one(f):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(f, columns=columns)
+
+    return _tasks_from_files(files, read_one, "read_parquet")
+
+
+def read_csv(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".csv",))
+
+    def read_one(f):
+        import pyarrow.csv as pcsv
+
+        return pcsv.read_csv(f)
+
+    return _tasks_from_files(files, read_one, "read_csv")
+
+
+def read_json(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".json", ".jsonl"))
+
+    def read_one(f):
+        import pyarrow.json as pjson
+
+        return pjson.read_json(f)
+
+    return _tasks_from_files(files, read_one, "read_json")
+
+
+def read_text(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(f):
+        with open(f, encoding="utf-8") as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        return pa.table({"text": lines})
+
+    return _tasks_from_files(files, read_one, "read_text")
+
+
+def read_binary_files(paths, *, include_paths: bool = False, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def read_one(f):
+        with open(f, "rb") as fh:
+            data = fh.read()
+        cols = {"bytes": [data]}
+        if include_paths:
+            cols["path"] = [f]
+        return pa.table(cols)
+
+    return _tasks_from_files(files, read_one, "read_binary")
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: str = "RGB", include_paths: bool = False,
+                **_kw) -> Dataset:
+    """Decode images into a tensor column (ref: read_api.py:775
+    read_images + datasource/image_datasource.py)."""
+    files = _expand_paths(paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif",
+                                  ".webp"))
+
+    def read_one(f):
+        from PIL import Image
+
+        img = Image.open(f).convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        arr = np.asarray(img)[None]  # (1, H, W, C)
+        batch: Dict[str, Any] = {"image": arr}
+        t = B.from_batch(batch)
+        if include_paths:
+            t = t.append_column("path", pa.array([f]))
+        return t
+
+    return _tasks_from_files(files, read_one, "read_images")
+
+
+def read_numpy(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".npy",))
+
+    def read_one(f):
+        return B.from_batch({"data": np.load(f)})
+
+    return _tasks_from_files(files, read_one, "read_numpy")

@@ -33,7 +33,20 @@ LLAMA3_8B = TransformerConfig(
     n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0,
 )
 
-REGISTRY = {c.name: c for c in [TINY, GPT2_124M, BENCH_350M, LLAMA2_7B, LLAMA3_8B]}
+TINY_MOE = TransformerConfig(
+    name="tiny-moe", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=128, max_seq_len=256, remat=False,
+    n_experts=4, expert_top_k=2,
+)
+
+MIXTRAL_8X7B = TransformerConfig(
+    name="mixtral-8x7b", vocab_size=32000, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+    rope_theta=1000000.0, n_experts=8, expert_top_k=2,
+)
+
+REGISTRY = {c.name: c for c in [TINY, GPT2_124M, BENCH_350M, LLAMA2_7B,
+                                LLAMA3_8B, TINY_MOE, MIXTRAL_8X7B]}
 
 
 def get(name: str) -> TransformerConfig:

@@ -43,11 +43,6 @@ def default_optimizer(lr: float = 3e-4, *, warmup: int = 100,
     )
 
 
-def batch_sharding(mesh: Mesh, rules: LogicalRules = DEFAULT_RULES):
-    tok = NamedSharding(mesh, logical_to_mesh(("batch", "seq"), rules))
-    return {"tokens": tok}
-
-
 def make_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,

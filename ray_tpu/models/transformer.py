@@ -52,6 +52,10 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
+    # MoE (0 experts = dense MLP; Mixtral-style when > 0)
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
     name: str = "transformer"
 
     @property
@@ -59,10 +63,21 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
     @property
+    def moe(self):
+        if self.n_experts <= 0:
+            return None
+        from ray_tpu.ops.moe import MoEConfig
+
+        return MoEConfig(num_experts=self.n_experts, top_k=self.expert_top_k,
+                         capacity_factor=self.capacity_factor)
+
+    @property
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab_size
         kv = self.n_kv_heads * self.head_dim
-        per_layer = d * d * 2 + d * kv * 2 + 3 * d * f + 2 * d
+        mlp = 3 * d * f if self.n_experts <= 0 else \
+            self.n_experts * 3 * d * f + d * self.n_experts
+        per_layer = d * d * 2 + d * kv * 2 + mlp + 2 * d
         emb = v * d * (1 if self.tie_embeddings else 2)
         return self.n_layers * per_layer + emb + d
 
@@ -78,19 +93,31 @@ def init_params(rng: jax.Array, cfg: TransformerConfig):
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dt)
 
-    params = {
-        "embed": dense(keys[0], (cfg.vocab_size, d), d ** 0.5 * d),  # ~N(0, 1/sqrt(d))
-        "blocks": {
-            "attn_norm": jnp.ones((l, d), dt),
-            "wq": dense(keys[1], (l, d, nh * hd), d),
-            "wk": dense(keys[2], (l, d, nkv * hd), d),
-            "wv": dense(keys[3], (l, d, nkv * hd), d),
-            "wo": dense(keys[4], (l, nh * hd, d), nh * hd),
-            "mlp_norm": jnp.ones((l, d), dt),
+    blocks = {
+        "attn_norm": jnp.ones((l, d), dt),
+        "wq": dense(keys[1], (l, d, nh * hd), d),
+        "wk": dense(keys[2], (l, d, nkv * hd), d),
+        "wv": dense(keys[3], (l, d, nkv * hd), d),
+        "wo": dense(keys[4], (l, nh * hd, d), nh * hd),
+        "mlp_norm": jnp.ones((l, d), dt),
+    }
+    if cfg.n_experts > 0:
+        e = cfg.n_experts
+        blocks.update({
+            "router": dense(jax.random.fold_in(keys[5], 1), (l, d, e), d),
+            "w_gate": dense(keys[5], (l, e, d, f), d),
+            "w_up": dense(keys[6], (l, e, d, f), d),
+            "w_down": dense(keys[7], (l, e, f, d), f),
+        })
+    else:
+        blocks.update({
             "w_gate": dense(keys[5], (l, d, f), d),
             "w_up": dense(keys[6], (l, d, f), d),
             "w_down": dense(keys[7], (l, f, d), f),
-        },
+        })
+    params = {
+        "embed": dense(keys[0], (cfg.vocab_size, d), d ** 0.5 * d),  # ~N(0, 1/sqrt(d))
+        "blocks": blocks,
         "final_norm": jnp.ones((d,), dt),
     }
     if not cfg.tie_embeddings:
@@ -100,19 +127,30 @@ def init_params(rng: jax.Array, cfg: TransformerConfig):
 
 def param_logical_axes(cfg: TransformerConfig):
     """Pytree of logical-axis tuples matching `init_params` exactly."""
-    axes = {
-        "embed": ("vocab", "embed"),
-        "blocks": {
-            "attn_norm": ("layers", "embed"),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
-            "mlp_norm": ("layers", "embed"),
+    blocks = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts > 0:
+        blocks.update({
+            "router": ("layers", "embed", "expert"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        blocks.update({
             "w_gate": ("layers", "embed", "mlp"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
-        },
+        })
+    axes = {
+        "embed": ("vocab", "embed"),
+        "blocks": blocks,
         "final_norm": ("embed",),
     }
     if not cfg.tie_embeddings:
@@ -149,17 +187,27 @@ def _block(x, bp, cfg: TransformerConfig, rules: LogicalRules, *,
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
 
     h = rms_norm(x, bp["mlp_norm"], eps=cfg.norm_eps)
-    gate = jnp.einsum("btd,df->btf", h, bp["w_gate"].astype(cd))
-    up = jnp.einsum("btd,df->btf", h, bp["w_up"].astype(cd))
-    hidden = jax.nn.silu(gate) * up
-    hidden = with_logical_constraint(hidden, ("batch", "seq", "mlp"), rules)
-    x = x + jnp.einsum("btf,fd->btd", hidden, bp["w_down"].astype(cd))
-    return with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+    aux = {}
+    if cfg.n_experts > 0:
+        from ray_tpu.ops.moe import moe_mlp
+
+        moe_params = {"router": bp["router"], "w_gate": bp["w_gate"],
+                      "w_up": bp["w_up"], "w_down": bp["w_down"]}
+        out, aux = moe_mlp(h, moe_params, cfg.moe, rules=rules)
+        x = x + out
+    else:
+        gate = jnp.einsum("btd,df->btf", h, bp["w_gate"].astype(cd))
+        up = jnp.einsum("btd,df->btf", h, bp["w_up"].astype(cd))
+        hidden = jax.nn.silu(gate) * up
+        hidden = with_logical_constraint(hidden, ("batch", "seq", "mlp"),
+                                         rules)
+        x = x + jnp.einsum("btf,fd->btd", hidden, bp["w_down"].astype(cd))
+    return with_logical_constraint(x, ("batch", "seq", "embed"), rules), aux
 
 
 def forward(params, tokens, cfg: TransformerConfig, *,
             rules: LogicalRules = DEFAULT_RULES, mesh: Mesh | None = None,
-            positions=None, seq_shards: int = 1):
+            positions=None, seq_shards: int = 1, return_aux: dict | None = None):
     """tokens (B, T) int32 → logits (B, T, vocab) in compute dtype.
 
     `seq_shards > 1` switches attention to the ring kernel over the `sp`
@@ -188,9 +236,13 @@ def forward(params, tokens, cfg: TransformerConfig, *,
         block_fn = jax.checkpoint(block_fn)
 
     def scan_body(x, bp):
-        return block_fn(x, bp), None
+        x, aux = block_fn(x, bp)
+        return x, aux
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["blocks"])
+    if return_aux is not None:
+        return_aux.update({k: jnp.sum(v)
+                           for k, v in (aux_stacked or {}).items()})
     x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cd))
@@ -209,15 +261,22 @@ def loss_fn(params, batch, cfg: TransformerConfig, *,
         inputs, targets = tokens, batch["targets"]
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    aux: dict = {}
     logits = forward(params, inputs, cfg, rules=rules, mesh=mesh,
-                     seq_shards=seq_shards).astype(jnp.float32)
+                     seq_shards=seq_shards,
+                     return_aux=aux).astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     nll = logz - tgt
     if mask is not None:
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    if aux:  # MoE auxiliary losses (load balance + z-loss)
+        loss = loss + 0.01 * aux.get("moe_load_balance_loss", 0.0) \
+            + aux.get("moe_z_loss", 0.0)
+    return loss
 
 
 class Transformer:

@@ -135,12 +135,14 @@ def _dispatch(q, k, v, *, causal, sm_scale):
     return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale)
 
 
-def _flash_pallas(q, k, v, *, causal, sm_scale,
-                  block_q: int = 256, block_kv: int = 256):
+def _flash_pallas(q, k, v, *, causal, sm_scale):
     b, t, h, d = q.shape
     tkv = k.shape[1]
-    block_q = min(block_q, t)
-    block_kv = min(block_kv, tkv)
+    # Block sizes must divide the sequence lengths exactly (the grid floors
+    # otherwise and partial blocks would be silently skipped); _dispatch
+    # guarantees t, tkv are multiples of 128.
+    block_q = 256 if t % 256 == 0 else 128
+    block_kv = 256 if tkv % 256 == 0 else 128
     num_q = t // block_q
     num_kv = tkv // block_kv
 

@@ -1,0 +1,127 @@
+"""Mixture-of-Experts: top-k routing + expert-parallel FFN.
+
+Greenfield per SURVEY.md §2.4 (reference has no EP implementation).  XLA-
+SPMD design: experts live on the "expert" logical axis (mesh axis `ep`);
+dispatch/combine are einsums against a capacity-bounded one-hot tensor, so
+when the expert axis is sharded XLA lowers the dispatch to `all_to_all`
+over ICI — no hand-written routing collectives.
+
+Shapes: tokens (B, T, d) → flat groups (G, S, d) where G spreads over the
+batch axes; dispatch (G, S, E, C); expert compute (E, G, C, d).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import LogicalRules, DEFAULT_RULES, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_loss: float = 1e-3
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, cfg: MoEConfig, dtype):
+    ks = jax.random.split(rng, 4)
+    e = cfg.num_experts
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "router": dense(ks[0], (d_model, e), d_model),
+        "w_gate": dense(ks[1], (e, d_model, d_ff), d_model),
+        "w_up": dense(ks[2], (e, d_model, d_ff), d_model),
+        "w_down": dense(ks[3], (e, d_ff, d_model), d_ff),
+    }
+
+
+def moe_param_logical_axes():
+    return {
+        "router": ("embed", "expert"),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def top_k_routing(logits: jnp.ndarray, k: int, capacity: int):
+    """logits (G, S, E) → dispatch (G,S,E,C) one-hot, combine (G,S,E,C).
+
+    Switch/GShard-style: per-token top-k experts, capacity-bounded by
+    position-in-expert (tokens over capacity are dropped — residual path
+    carries them)."""
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)         # (G,S,k)
+    # Normalize chosen gates to sum 1 (standard for k>1).
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # one-hot per choice: (G, S, k, E)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+    # position of each token within its expert queue, per choice.
+    # flatten choices into the token sequence: priority = earlier token,
+    # earlier choice.
+    flat = onehot.reshape(g, s * k, e)
+    pos = jnp.cumsum(flat, axis=1) * flat - 1.0              # (G, S*k, E)
+    pos = pos.reshape(g, s, k, e)
+    keep = (pos >= 0) & (pos < capacity)
+    pos = jnp.where(keep, pos, 0.0)
+    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)           # (G,S,k,E,C)
+    cap_onehot = cap_onehot * keep[..., None].astype(jnp.float32)
+    dispatch = jnp.max(cap_onehot, axis=2)                   # (G,S,E,C)
+    combine = jnp.einsum("gske,gskec->gsec", onehot * gate_vals[..., None],
+                         cap_onehot)
+    return dispatch, combine, probs
+
+
+def moe_mlp(x: jnp.ndarray, params: dict, cfg: MoEConfig, *,
+            rules: LogicalRules = DEFAULT_RULES):
+    """x (B, T, d) → (B, T, d), plus auxiliary losses dict."""
+    b, t, d = x.shape
+    dtype = x.dtype
+    e = cfg.num_experts
+    tokens = b * t
+    capacity = max(1, int(cfg.capacity_factor * tokens * cfg.top_k / e))
+    xg = x.reshape(1, tokens, d)                              # one group
+
+    logits = jnp.einsum("gsd,de->gse", xg, params["router"].astype(dtype))
+    dispatch, combine, probs = top_k_routing(logits, cfg.top_k, capacity)
+
+    # dispatch tokens to expert buffers: (E, G, C, d); expert axis sharded.
+    expert_in = jnp.einsum("gsec,gsd->egcd",
+                           dispatch.astype(jnp.float32),
+                           xg.astype(jnp.float32)).astype(dtype)
+    expert_in = with_logical_constraint(
+        expert_in, ("expert", None, None, "embed"), rules)
+    gate = jnp.einsum("egcd,edf->egcf", expert_in,
+                      params["w_gate"].astype(dtype))
+    up = jnp.einsum("egcd,edf->egcf", expert_in,
+                    params["w_up"].astype(dtype))
+    hidden = jax.nn.silu(gate) * up
+    hidden = with_logical_constraint(
+        hidden, ("expert", None, None, "mlp"), rules)
+    expert_out = jnp.einsum("egcf,efd->egcd", hidden,
+                            params["w_down"].astype(dtype))
+    out = jnp.einsum("gsec,egcd->gsd",
+                     combine.astype(jnp.float32),
+                     expert_out.astype(jnp.float32))
+
+    # load-balancing loss (Switch eq. 4) + router z-loss
+    me = jnp.mean(probs, axis=(0, 1))                         # (E,)
+    ce = jnp.mean(jnp.max(dispatch, axis=-1), axis=(0, 1))    # fraction routed
+    lb_loss = e * jnp.sum(me * ce)
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    z_loss = jnp.mean(z ** 2) * cfg.router_z_loss
+    aux = {"moe_load_balance_loss": lb_loss, "moe_z_loss": z_loss}
+    return out.reshape(b, t, d).astype(dtype), aux

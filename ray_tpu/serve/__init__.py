@@ -1,0 +1,21 @@
+"""ray_tpu.serve: online inference (reference: ray.serve).
+
+Controller-reconciled replica sets as named detached actors, power-of-two
+request routing, dynamic batching, HTTP ingress, request autoscaling.
+"""
+from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
+                               http_port, run, shutdown, start_http_proxy,
+                               status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
+                                      Deployment, deployment)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment", "Deployment", "Application", "AutoscalingConfig",
+    "run", "shutdown", "status", "delete",
+    "get_deployment_handle", "get_app_handle",
+    "start_http_proxy", "http_port",
+    "DeploymentHandle", "DeploymentResponse",
+    "batch",
+]

@@ -1,0 +1,103 @@
+"""serve.run / shutdown / handles (ref: python/ray/serve/api.py:537 run)."""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, get_or_create_controller
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+_proxy_handle = None
+_proxy_port: Optional[int] = None
+
+
+def run(app: Application | Deployment, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _http: bool = False) -> DeploymentHandle:
+    """Deploy an application; returns a handle (ref: serve/api.py:537)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    controller = get_or_create_controller()
+    cfg = {
+        "num_replicas": dep.config.num_replicas,
+        "max_ongoing_requests": dep.config.max_ongoing_requests,
+        "ray_actor_options": dep.config.ray_actor_options,
+        "autoscaling_config": (
+            vars(dep.config.autoscaling_config)
+            if dep.config.autoscaling_config else None),
+    }
+    ray_tpu.get(controller.deploy.remote(
+        name, dep.func_or_class, app.init_args, app.init_kwargs, cfg),
+        timeout=60)
+    # wait for at least one replica
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(controller.app_status.remote(name), timeout=30)
+        if st["running"] >= min(1, st["target"]):
+            break
+        time.sleep(0.1)
+    if _http and route_prefix:
+        start_http_proxy().set_route.remote(route_prefix, name)
+    handle = DeploymentHandle(name)
+    if blocking:  # pragma: no cover
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
+    """Start (or return) the node's HTTP proxy actor."""
+    global _proxy_handle, _proxy_port
+    if _proxy_handle is None:
+        from ray_tpu.serve.http_proxy import HTTPProxy
+
+        _proxy_handle = ray_tpu.remote(HTTPProxy).options(
+            name="serve:http_proxy", lifetime="detached",
+            max_concurrency=32).remote(host, port)
+        _proxy_port = ray_tpu.get(_proxy_handle.port.remote(), timeout=30)
+    return _proxy_handle
+
+
+def http_port() -> Optional[int]:
+    return _proxy_port
+
+
+def get_deployment_handle(app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name)
+
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name)
+
+
+def status() -> Dict[str, dict]:
+    controller = get_or_create_controller()
+    apps = ray_tpu.get(controller.list_applications.remote(), timeout=30)
+    return {a: ray_tpu.get(controller.app_status.remote(a), timeout=30)
+            for a in apps}
+
+
+def delete(app_name: str) -> None:
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_app.remote(app_name), timeout=30)
+
+
+def shutdown() -> None:
+    global _proxy_handle, _proxy_port
+    if _proxy_handle is not None:
+        try:
+            ray_tpu.get(_proxy_handle.stop.remote(), timeout=10)
+            ray_tpu.kill(_proxy_handle)
+        except Exception:  # noqa: BLE001
+            pass
+        _proxy_handle = None
+        _proxy_port = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,199 @@
+"""Serve controller: reconcile target deployment state against reality.
+
+Reference: singleton `ServeController` actor with `DeploymentStateManager`
+reconciliation (ref: python/ray/serve/_private/controller.py:84;
+deployment_state.py:2397 manager, :1207 per-deployment loop) and
+request-based autoscaling (ref: _private/autoscaling_policy.py:12).
+
+Replicas are named detached actors ("serve:<app>:<dep>#<n>") so handles in
+any process resolve them through the GCS named-actor registry — that is
+this build's long-poll substitute: handles re-list replicas on a version
+bump (ref: _private/long_poll.py:173 LongPollHost).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.replica import Replica
+
+CONTROLLER_NAME = "serve:controller"
+
+
+class ServeController:
+    """Runs inside a detached actor; reconciliation on a background thread."""
+
+    def __init__(self):
+        # app name -> target spec
+        self._targets: Dict[str, dict] = {}
+        # app name -> {"replicas": {replica_name: handle}, "version": int}
+        self._state: Dict[str, dict] = {}
+        self._lock = threading.RLock()
+        self._stop = False
+        self._last_scale: Dict[str, float] = {}
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # ---- API used by serve.run / handles -----------------------------
+    def deploy(self, app_name: str, cls_or_fn, init_args, init_kwargs,
+               config: dict) -> bool:
+        with self._lock:
+            prev = self._targets.get(app_name)
+            gen = (prev["gen"] + 1) if prev else 1
+            self._targets[app_name] = {
+                "target": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
+                "config": config,
+                "num_replicas": config["num_replicas"],
+                "gen": gen,  # bump => rolling replace of old-code replicas
+            }
+            self._state.setdefault(app_name,
+                                   {"replicas": {}, "gens": {}, "version": 0})
+            self._state[app_name]["version"] += 1
+        return True
+
+    def delete_app(self, app_name: str) -> bool:
+        with self._lock:
+            self._targets.pop(app_name, None)
+        return True
+
+    def get_routing(self, app_name: str) -> dict:
+        with self._lock:
+            st = self._state.get(app_name)
+            if st is None:
+                return {"version": -1, "replicas": []}
+            return {"version": st["version"],
+                    "replicas": list(st["replicas"].keys())}
+
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return list(self._targets)
+
+    def app_status(self, app_name: str) -> dict:
+        with self._lock:
+            tgt = self._targets.get(app_name)
+            st = self._state.get(app_name, {"replicas": {}, "version": 0})
+            return {
+                "running": len(st["replicas"]),
+                "target": tgt["num_replicas"] if tgt else 0,
+                "version": st["version"],
+            }
+
+    def record_autoscale_stats(self, app_name: str, ongoing: float) -> None:
+        with self._lock:
+            tgt = self._targets.get(app_name)
+            if tgt is None:
+                return
+            asc = tgt["config"].get("autoscaling_config")
+            if not asc:
+                return
+            n = max(1, tgt["num_replicas"])
+            per = ongoing / n
+            now = time.time()
+            last = self._last_scale.get(app_name, 0.0)
+            if per > asc["target_ongoing_requests"] \
+                    and n < asc["max_replicas"] \
+                    and now - last > asc["upscale_delay_s"]:
+                tgt["num_replicas"] = n + 1
+                self._last_scale[app_name] = now
+            elif per < asc["target_ongoing_requests"] / 2 \
+                    and n > asc["min_replicas"] \
+                    and now - last > asc["downscale_delay_s"]:
+                tgt["num_replicas"] = n - 1
+                self._last_scale[app_name] = now
+
+    def shutdown(self) -> bool:
+        self._stop = True
+        with self._lock:
+            self._targets.clear()
+        self._reconcile_once()
+        return True
+
+    # ---- reconciliation ----------------------------------------------
+    def _reconcile_loop(self):
+        while not self._stop:
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            time.sleep(0.25)
+
+    def _reconcile_once(self):
+        with self._lock:
+            apps = dict(self._state)
+            targets = dict(self._targets)
+        RemoteReplica = ray_tpu.remote(Replica)
+
+        for app, st in apps.items():
+            tgt = targets.get(app)
+            want = tgt["num_replicas"] if tgt else 0
+            gen = tgt["gen"] if tgt else 0
+            have = dict(st["replicas"])
+            gens = dict(st.get("gens", {}))
+
+            def _kill(name):
+                try:
+                    ray_tpu.kill(have[name])
+                except Exception:  # noqa: BLE001
+                    pass
+                have.pop(name)
+                gens.pop(name, None)
+
+            # replace replicas from an older deploy generation (redeploy
+            # with new code/args must not leave old-version replicas serving)
+            for name in [n for n, g in list(gens.items()) if g != gen]:
+                _kill(name)
+            # scale down
+            while len(have) > want:
+                _kill(sorted(have)[-1])
+            # scale up
+            idx = 0
+            while len(have) < want:
+                while True:
+                    name = f"serve:{app}#g{gen}#{idx}"
+                    if name not in have:
+                        break
+                    idx += 1
+                opts = dict(tgt["config"].get("ray_actor_options") or {})
+                handle = RemoteReplica.options(
+                    name=name, lifetime="detached",
+                    max_concurrency=tgt["config"]["max_ongoing_requests"],
+                    **opts,
+                ).remote(tgt["target"], tgt["args"], tgt["kwargs"], name)
+                have[name] = handle
+                gens[name] = gen
+            # health check
+            for name in list(have):
+                try:
+                    ray_tpu.get(have[name].check_health.remote(), timeout=10)
+                except Exception:  # noqa: BLE001
+                    _kill(name)
+            with self._lock:
+                cur = self._state.setdefault(
+                    app, {"replicas": {}, "gens": {}, "version": 0})
+                if set(cur["replicas"]) != set(have):
+                    cur["version"] += 1
+                cur["replicas"] = have
+                cur["gens"] = gens
+            if not tgt:
+                with self._lock:
+                    if not self._state[app]["replicas"]:
+                        self._state.pop(app, None)
+
+
+def get_or_create_controller():
+    """Find the detached controller actor or start it."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        pass
+    RemoteController = ray_tpu.remote(ServeController)
+    try:
+        return RemoteController.options(
+            name=CONTROLLER_NAME, lifetime="detached",
+            max_concurrency=16).remote()
+    except Exception:  # noqa: BLE001  (lost the creation race)
+        return ray_tpu.get_actor(CONTROLLER_NAME)

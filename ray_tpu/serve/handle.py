@@ -1,0 +1,149 @@
+"""DeploymentHandle + power-of-two-choices routing.
+
+Reference: `DeploymentHandle`/`DeploymentResponse` (ref:
+python/ray/serve/handle.py:694,436) and
+`PowerOfTwoChoicesReplicaScheduler` (ref: _private/replica_scheduler/
+pow_2_scheduler.py:49): sample two replicas, pick the lower queue.  Queue
+depth here is the handle's own outstanding-count per replica (cheap local
+signal), refreshed against controller routing state on version change.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import get_or_create_controller
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef."""
+
+    def __init__(self, ref, on_done=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            out = ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+        return out
+
+    def _settle(self):
+        if not self._done and self._on_done:
+            self._done = True
+            self._on_done()
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, method_name: str = "__call__"):
+        self._app = app_name
+        self._method = method_name
+        self._controller = get_or_create_controller()
+        self._version = -2
+        self._replicas: Dict[str, Any] = {}
+        self._outstanding: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._last_stats_push = 0.0
+        self._last_refresh = 0.0
+        self._refresh_ttl = 0.5
+
+    # handle.method_name.remote(...) sugar
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentHandle.__new_method(self, item)
+
+    @staticmethod
+    def __new_method(parent: "DeploymentHandle", method: str):
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h.__dict__.update(parent.__dict__)
+        h._method = method
+        return h
+
+    def options(self, *, method_name: Optional[str] = None, **_ignored):
+        if method_name:
+            return DeploymentHandle.__new_method(self, method_name)
+        return self
+
+    def _refresh(self, force: bool = False):
+        # TTL throttle: the controller round-trip must not be on every
+        # request's critical path (the long-poll analogue).
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self._refresh_ttl:
+            return
+        self._last_refresh = now
+        routing = ray_tpu.get(
+            self._controller.get_routing.remote(self._app), timeout=30)
+        with self._lock:
+            if routing["version"] != self._version or force:
+                names = routing["replicas"]
+                self._replicas = {}
+                for n in names:
+                    try:
+                        self._replicas[n] = ray_tpu.get_actor(n)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._outstanding = {n: self._outstanding.get(n, 0)
+                                     for n in self._replicas}
+                self._version = routing["version"]
+
+    def _pick_replica(self):
+        deadline = time.monotonic() + 30
+        while True:
+            # Sample and index under one lock hold — a concurrent _refresh
+            # may rebuild self._replicas between reads otherwise.
+            with self._lock:
+                names = list(self._replicas)
+                if names:
+                    if len(names) == 1:
+                        pick = names[0]
+                    else:
+                        a, b = random.sample(names, 2)
+                        pick = (a if self._outstanding.get(a, 0)
+                                <= self._outstanding.get(b, 0) else b)
+                    self._outstanding[pick] = \
+                        self._outstanding.get(pick, 0) + 1
+                    return pick, self._replicas[pick]
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for app {self._app!r} after 30s")
+            self._refresh(force=True)
+            time.sleep(0.1)
+
+    def _push_stats(self):
+        now = time.time()
+        if now - self._last_stats_push < 1.0:
+            return
+        self._last_stats_push = now
+        total = sum(self._outstanding.values())
+        try:
+            self._controller.record_autoscale_stats.remote(self._app, total)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        name, replica = self._pick_replica()
+        self._push_stats()
+
+        def on_done(n=name):
+            with self._lock:
+                self._outstanding[n] = max(0, self._outstanding.get(n, 1) - 1)
+
+        try:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        except Exception:
+            on_done()
+            # replica may have just died; refresh and retry once
+            self._refresh(force=True)
+            name, replica = self._pick_replica()
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, on_done)

@@ -1,0 +1,86 @@
+"""HTTP ingress: per-node proxy actor routing to deployment handles.
+
+Reference: uvicorn-based `HTTPProxy` actor per node
+(ref: python/ray/serve/_private/proxy.py:747; GenericProxy routing :129).
+Stdlib-only equivalent (uvicorn isn't in this image): a ThreadingHTTPServer
+inside a proxy actor; JSON bodies in, JSON out; routes by prefix.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class HTTPProxy:
+    """Actor: owns the HTTP server + route table {prefix: app_name}."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        proxy = self
+        self._routes: Dict[str, str] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body):
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                app = None
+                match_len = -1
+                for prefix, name in proxy._routes.items():
+                    if (path == prefix or path.startswith(
+                            prefix.rstrip("/") + "/")) \
+                            and len(prefix) > match_len:
+                        app, match_len = name, len(prefix)
+                if app is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                h = proxy._handles.get(app)
+                if h is None:
+                    h = proxy._handles[app] = DeploymentHandle(app)
+                try:
+                    arg = json.loads(body) if body else None
+                    out = h.remote(arg).result(timeout=60)
+                    payload = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": str(e)}).encode())
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._dispatch(self.rfile.read(n) if n else None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def set_route(self, prefix: str, app_name: str) -> bool:
+        self._routes[prefix] = app_name
+        return True
+
+    def remove_route(self, prefix: str) -> bool:
+        self._routes.pop(prefix, None)
+        return True
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        return True

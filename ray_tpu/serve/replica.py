@@ -1,0 +1,56 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Reference: `ReplicaActor` + `UserCallableWrapper`
+(ref: python/ray/serve/_private/replica.py:230, :716).  Tracks ongoing
+request count (feeds the power-of-two router) and exposes a health check.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any
+
+
+class Replica:
+    def __init__(self, cls_or_fn, init_args, init_kwargs, replica_id: str):
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._start = time.time()
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_func = False
+        else:
+            self._callable = cls_or_fn
+            self._is_func = True
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_func or method == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = asyncio.run(out)
+            return out
+        finally:
+            self._ongoing -= 1
+
+    def stats(self) -> dict:
+        return {"replica_id": self.replica_id, "ongoing": self._ongoing,
+                "total": self._total, "uptime": time.time() - self._start}
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        hook = getattr(self._callable, "reconfigure", None)
+        if callable(hook):
+            hook(user_config)

@@ -1,0 +1,20 @@
+"""ray_tpu.train: distributed training orchestration (reference: ray.train).
+
+Gang-scheduled worker groups, session reporting, checkpointing (Orbax),
+fault-tolerant restart — with JAX/XLA as the parallelism substrate instead
+of NCCL process groups.
+"""
+from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, Result,
+                                  RunConfig, ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
+
+__all__ = [
+    "Checkpoint", "save_pytree", "load_pytree",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Result",
+    "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
+]

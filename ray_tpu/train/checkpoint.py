@@ -1,0 +1,82 @@
+"""Checkpoints: directory handles + JAX pytree (de)serialization.
+
+Mirrors the reference's `Checkpoint` directory-handle design
+(ref: python/ray/train/_checkpoint.py:56 — a path + filesystem, moved
+around by upload/download) with the TPU-native payload being an Orbax
+checkpoint of a sharded pytree: every host writes its own param shards
+(async), so multi-host checkpointing scales with slice size.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory (local or fsspec-style path)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        """Materialize into `dest` (copy); returns the directory path."""
+        if dest is None:
+            dest = os.path.join(tempfile.gettempdir(),
+                                f"rtpu_ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def update_metadata(self, metadata: dict) -> None:
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> dict:
+        p = os.path.join(self.path, ".metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+def save_pytree(tree: Any, path: str, *, step: int = 0) -> Checkpoint:
+    """Write a (possibly sharded) pytree with Orbax; blocks until durable."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree)
+    ckptr.wait_until_finished()
+    ckpt = Checkpoint(path)
+    ckpt.update_metadata({"step": step})
+    return ckpt
+
+
+def load_pytree(checkpoint: Checkpoint, target: Any = None) -> Any:
+    """Restore a pytree; `target` (abstract or concrete pytree) restores
+    sharded/typed to match — required to restore onto a mesh."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        return ckptr.restore(checkpoint.path, target=target)
+    return ckptr.restore(checkpoint.path)

@@ -1,0 +1,76 @@
+"""Train/AIR-substrate configs.
+
+Equivalents of the reference's dataclass configs
+(ref: python/ray/air/config.py — ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig; python/ray/air/result.py — Result), reshaped for TPU:
+`ScalingConfig` thinks in hosts-of-a-slice (gang) rather than
+interchangeable GPU workers, and carries the mesh spec the workers build.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers (hosts), what each worker holds, and the mesh.
+
+    num_workers: one per host of the slice (gang-scheduled; a TPU slice is
+    atomic — ref TPU pod-slice head resource pattern,
+    python/ray/_private/accelerators/tpu.py:382).
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None       # e.g. "v5e-16" — slice-atomic gang
+    mesh: Optional[MeshConfig] = None    # per-gang device mesh spec
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        return {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = unlimited restarts
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolve_storage(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)
+
+
+@dataclasses.dataclass
+class Result:
+    """Outcome of a training run (ref: python/ray/air/result.py)."""
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821 (train.checkpoint)
+    error: Optional[BaseException] = None
+    metrics_history: list = dataclasses.field(default_factory=list)
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint

@@ -1,0 +1,116 @@
+"""Per-worker training session: report / get_checkpoint / context.
+
+The reference runs the user loop in a thread and funnels `train.report`
+through a result queue consumed by the trainer
+(ref: python/ray/train/_internal/session.py:109 `_TrainSession`, report
+:661, get_checkpoint :748, get_dataset_shard :1054).  Same shape here: the
+session is a module-global installed by the TrainWorker actor; `report`
+enqueues (metrics, checkpoint-dir) and the trainer drains the queue via
+actor polling.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["TrainSession"] = None
+
+
+class TrainSession:
+    def __init__(self, *, world_rank: int, world_size: int, local_rank: int,
+                 trial_dir: str, latest_checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 experiment_name: str = "train"):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.trial_dir = trial_dir
+        self.experiment_name = experiment_name
+        self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.results: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self._ckpt_seq = 0
+
+    # -- user-facing ----------------------------------------------------
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        persisted = None
+        if checkpoint is not None:
+            self._ckpt_seq += 1
+            dest = os.path.join(self.trial_dir,
+                                f"checkpoint_{self._ckpt_seq:06d}")
+            if os.path.abspath(checkpoint.path) != dest:
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            persisted = dest
+            self.latest_checkpoint = Checkpoint(persisted)
+        self.results.put({"metrics": dict(metrics), "checkpoint": persisted})
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}")
+        return shard
+
+
+def install_session(s: TrainSession) -> None:
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def uninstall_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def _get() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — call inside train_loop_per_worker")
+    return _session
+
+
+# ---- public API (ray.train.* equivalents) -----------------------------
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _get().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return _get().get_dataset_shard(name)
+
+
+class TrainContext:
+    def get_world_size(self) -> int:
+        return _get().world_size
+
+    def get_world_rank(self) -> int:
+        return _get().world_rank
+
+    def get_local_rank(self) -> int:
+        return _get().local_rank
+
+    def get_trial_dir(self) -> str:
+        return _get().trial_dir
+
+    def get_experiment_name(self) -> str:
+        return _get().experiment_name
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

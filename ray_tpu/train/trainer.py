@@ -1,0 +1,161 @@
+"""Trainers: gang-launch a train loop, drain reports, restart on failure.
+
+Reference call stack being reproduced (SURVEY.md §3.3): `BaseTrainer.fit`
+→ BackendExecutor.start (PG gang) → WorkerGroup of train workers →
+session report queue → fault-tolerant restart from latest checkpoint
+(ref: python/ray/train/base_trainer.py:567 fit;
+_internal/backend_executor.py:121 start, :690 _restart;
+data_parallel_trainer.py DataParallelTrainer).  The Tune wrapping
+(fit-as-a-trial) is optional here instead of mandatory.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (FailureConfig, Result, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class DataParallelTrainer:
+    """Run `train_loop_per_worker` on a gang of workers.
+
+    backend_name: "jax" (jax.distributed multi-host), "torch" (gloo), or
+    None (no process-group setup — single-host or pure-orchestration)."""
+
+    backend_name: Optional[str] = None
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        backend: Optional[str] = "__class_default__",
+    ):
+        self._fn = train_loop_per_worker
+        self._config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self._resume = resume_from_checkpoint
+        if backend != "__class_default__":
+            self.backend_name = backend
+
+    # -- dataset sharding ----------------------------------------------
+    def _shard_fn(self, rank: int, world: int) -> Optional[Dict[str, Any]]:
+        if not self.datasets:
+            return None
+        shards = {}
+        for name, ds in self.datasets.items():
+            split = getattr(ds, "split_at", None) or getattr(ds, "split", None)
+            if callable(split):
+                try:
+                    shards[name] = ds.split(world)[rank]
+                    continue
+                except Exception:  # noqa: BLE001
+                    pass
+            shards[name] = ds  # unsplittable: every worker sees the whole
+        return shards
+
+    def fit(self) -> Result:
+        fc: FailureConfig = self.run_config.failure_config
+        max_failures = fc.max_failures
+        attempt = 0
+        latest_ckpt: Optional[str] = (
+            self._resume.path if self._resume else None)
+        history: list = []
+        last_metrics: Dict[str, Any] = {}
+        while True:
+            group = WorkerGroup(
+                num_workers=self.scaling_config.num_workers,
+                resources=self.scaling_config.worker_resources(),
+                strategy=self.scaling_config.placement_strategy,
+                backend_name=self.backend_name,
+                trial_dir=self.run_config.resolve_storage(),
+                experiment_name=self.run_config.name or "train")
+            try:
+                from ray_tpu.train.backend import resolve_backend
+
+                master_env = resolve_backend(self.backend_name).master_env(
+                    group.master_ip())
+                group.start_all(self._fn, self._config, master_env,
+                                latest_ckpt, self._shard_fn)
+                last_metrics, latest_ckpt, history_part = self._drain(group)
+                history.extend(history_part)
+                ckpt = Checkpoint(latest_ckpt) if latest_ckpt else None
+                return Result(metrics=last_metrics, checkpoint=ckpt,
+                              metrics_history=history)
+            except _WorkerGroupFailure as e:
+                attempt += 1
+                history.extend(e.history)
+                if e.latest_checkpoint:
+                    latest_ckpt = e.latest_checkpoint
+                if max_failures >= 0 and attempt > max_failures:
+                    ckpt = Checkpoint(latest_ckpt) if latest_ckpt else None
+                    return Result(metrics=last_metrics, checkpoint=ckpt,
+                                  error=RuntimeError(e.error),
+                                  metrics_history=history)
+                logger.warning("train attempt %d failed, restarting from %s",
+                               attempt, latest_ckpt)
+            finally:
+                group.shutdown()
+
+    def _drain(self, group: WorkerGroup):
+        """Poll workers until all finish; surface failures with the latest
+        checkpoint so a restart resumes instead of starting over."""
+        latest_ckpt = None
+        last_metrics: Dict[str, Any] = {}
+        history: list = []
+        while True:
+            try:
+                polls = group.poll_all()
+            except BaseException as e:  # noqa: BLE001
+                # A worker actor/process died (the canonical failure
+                # FailureConfig covers) — surface as restartable.
+                raise _WorkerGroupFailure(
+                    f"worker group poll failed: {e!r}", latest_ckpt, history)
+            for rank, p in enumerate(polls):
+                for item in p["results"]:
+                    if item["checkpoint"]:
+                        latest_ckpt = item["checkpoint"]
+                    if rank == 0:
+                        last_metrics = item["metrics"]
+                        history.append(item["metrics"])
+            for p in polls:
+                if p["error"]:
+                    raise _WorkerGroupFailure(p["error"], latest_ckpt, history)
+            if all(p["finished"] for p in polls):
+                return last_metrics, latest_ckpt, history
+            time.sleep(0.05)
+
+
+class _WorkerGroupFailure(Exception):
+    def __init__(self, error: str, latest_checkpoint: Optional[str],
+                 history: list):
+        super().__init__(error)
+        self.error = error
+        self.latest_checkpoint = latest_checkpoint
+        self.history = history
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Flagship trainer: multi-host SPMD via jax.distributed + mesh
+    (the TorchTrainer-equivalent for TPU — ref:
+    python/ray/train/torch/torch_trainer.py:11)."""
+
+    backend_name = "jax"
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Parity trainer for CPU-torch loops (gloo)."""
+
+    backend_name = "torch"

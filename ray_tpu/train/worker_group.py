@@ -1,0 +1,136 @@
+"""Gang of training-worker actors on a placement group.
+
+Reference shape: `WorkerGroup` of `RayTrainWorker` actors created by
+`BackendExecutor` under a placement group
+(ref: python/ray/train/_internal/worker_group.py:102,19;
+_internal/backend_executor.py:197 PG creation, :427 start_training).
+TPU-native difference: the gang is slice-atomic — bundles are per-host and
+STRICT_* strategies map a whole ICI domain; the user loop runs in a
+background thread inside each actor and results are drained by polling
+(the actor stays responsive without concurrency groups).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.session import TrainSession, install_session, uninstall_session
+from ray_tpu.train.backend import resolve_backend
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class TrainWorker:
+    """Actor hosting one rank of the gang."""
+
+    def __init__(self, rank: int, world_size: int, backend_name, trial_dir: str,
+                 experiment_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.backend = resolve_backend(backend_name)
+        self.trial_dir = trial_dir
+        self.experiment_name = experiment_name
+        self.session: Optional[TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+
+    def get_ip(self) -> str:
+        import socket
+
+        return socket.gethostbyname(socket.gethostname())
+
+    def start_loop(self, fn: Callable, config: Optional[dict],
+                   master_env: Dict[str, str],
+                   latest_checkpoint: Optional[str],
+                   dataset_shards: Optional[Dict[str, Any]] = None) -> bool:
+        os.makedirs(self.trial_dir, exist_ok=True)
+        ckpt = Checkpoint(latest_checkpoint) if latest_checkpoint else None
+        self.session = TrainSession(
+            world_rank=self.rank, world_size=self.world_size,
+            local_rank=self.rank,  # one worker per host in this build
+            trial_dir=self.trial_dir, latest_checkpoint=ckpt,
+            dataset_shards=dataset_shards,
+            experiment_name=self.experiment_name)
+
+        def target():
+            install_session(self.session)
+            try:
+                self.backend.on_start(self.rank, self.world_size, master_env)
+                if config is None:
+                    fn()
+                else:
+                    fn(config)
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                self.backend.on_shutdown()
+                uninstall_session()
+                self.session.finished.set()
+
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        """Drain queued results; report liveness + error state."""
+        out: List[dict] = []
+        if self.session is not None:
+            while not self.session.results.empty():
+                out.append(self.session.results.get_nowait())
+        return {
+            "results": out,
+            "finished": self.session.finished.is_set() if self.session else False,
+            "error": self._error,
+        }
+
+
+class WorkerGroup:
+    def __init__(self, *, num_workers: int, resources: Dict[str, float],
+                 strategy: str, backend_name, trial_dir: str,
+                 experiment_name: str):
+        self.num_workers = num_workers
+        self.pg = placement_group([dict(resources)] * num_workers,
+                                  strategy=strategy)
+        if not self.pg.ready(timeout=60):
+            remove_placement_group(self.pg)
+            raise ray_tpu.exceptions.PlacementGroupUnavailableError(
+                f"could not reserve {num_workers} x {resources}")
+        cls = ray_tpu.remote(TrainWorker)
+        self.workers = [
+            cls.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i),
+                max_concurrency=4,
+            ).remote(i, num_workers, backend_name, trial_dir, experiment_name)
+            for i in range(num_workers)
+        ]
+
+    def master_ip(self) -> str:
+        return ray_tpu.get(self.workers[0].get_ip.remote())
+
+    def start_all(self, fn, config, master_env, latest_checkpoint,
+                  shard_fn=None) -> None:
+        refs = []
+        for i, w in enumerate(self.workers):
+            shards = shard_fn(i, self.num_workers) if shard_fn else None
+            refs.append(w.start_loop.remote(fn, config, master_env,
+                                            latest_checkpoint, shards))
+        ray_tpu.get(refs)
+
+    def poll_all(self) -> List[dict]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers])
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,24 @@
+"""ray_tpu.tune: hyperparameter search (reference: ray.tune).
+
+Tuner + trial controller over the actor substrate, grid/random search,
+ASHA / median-stopping / PBT schedulers, shared session+checkpoint
+machinery with ray_tpu.train.
+"""
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import (choice, grid_search, loguniform, quniform,
+                                 randint, sample_from, uniform)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid",
+    "report", "get_checkpoint",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "quniform", "sample_from",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

@@ -1,0 +1,160 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT-lite.
+
+Reference: schedulers package (ref: python/ray/tune/schedulers/ —
+async_hyperband.py AsyncHyperBandScheduler/ASHA, median_stopping_rule.py,
+pbt.py).  The controller calls `on_result` per reported result and acts on
+the returned decision.
+"""
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+
+class AsyncHyperBandScheduler(FIFOScheduler):
+    """ASHA: asynchronous successive halving (ref: async_hyperband.py).
+
+    Rungs at r, r*eta, r*eta^2, ... ; at each rung keep the top 1/eta of
+    completed-at-rung trials, stop the rest.
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.eta = reduction_factor
+        self.grace = grace_period
+        self.max_t = max_t
+        # rung level -> {trial_id: best metric at that rung}
+        self.rungs: Dict[int, Dict[str, float]] = defaultdict(dict)
+        r = grace_period
+        self.rung_levels: List[int] = []
+        while r < max_t:
+            self.rung_levels.append(r)
+            r *= reduction_factor
+        self._recorded_up_to: Dict[str, int] = defaultdict(lambda: -1)
+
+    def _better(self, a: float, b: float) -> bool:
+        return a > b if self.mode == "max" else a < b
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        val = result.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        # Milestone crossing (t >= rung), not equality: trials reporting at
+        # arbitrary strides still hit every rung exactly once.
+        for rung in self.rung_levels:
+            if t >= rung > self._recorded_up_to[trial_id]:
+                self._recorded_up_to[trial_id] = rung
+                recorded = self.rungs[rung]
+                recorded[trial_id] = val
+                vals = sorted(recorded.values(),
+                              reverse=(self.mode == "max"))
+                k = max(1, math.floor(len(vals) / self.eta))
+                cutoff = vals[k - 1]
+                if self._better(cutoff, val):
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step (ref: median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        val = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if val is None:
+            return CONTINUE
+        self._history[trial_id].append(val)
+        if t <= self.grace or len(self._history) < self.min_samples:
+            return CONTINUE
+        my_avg = sum(self._history[trial_id]) / len(self._history[trial_id])
+        others = [sum(h) / len(h) for tid, h in self._history.items()
+                  if tid != trial_id and h]
+        if len(others) < self.min_samples - 1:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if self.mode == "max" and my_avg < median:
+            return STOP
+        if self.mode == "min" and my_avg > median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT-lite (ref: pbt.py): at each perturbation interval, bottom-quantile
+    trials are marked for exploit — the controller restarts them from a
+    top-quantile trial's checkpoint with mutated hyperparameters."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, dict] = {}
+        # controller reads + clears this: trial_id -> (source_trial, new_cfg)
+        self.exploits: Dict[str, tuple] = {}
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        self.latest[trial_id] = result
+        t = result.get(self.time_attr, 0)
+        if t and t % self.interval == 0 and len(self.latest) >= 2:
+            ranked = sorted(
+                self.latest.items(),
+                key=lambda kv: kv[1].get(self.metric, -math.inf),
+                reverse=(self.mode == "max"))
+            n = len(ranked)
+            k = max(1, int(n * self.quantile))
+            bottom = [tid for tid, _ in ranked[-k:]]
+            top = [tid for tid, _ in ranked[:k]]
+            if trial_id in bottom:
+                src = self.rng.choice(top)
+                self.exploits[trial_id] = src
+        return CONTINUE
+
+    def mutate(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif isinstance(spec, tuple) and len(spec) == 2:
+                out[key] = self.rng.uniform(*spec)
+            elif key in out and isinstance(out[key], (int, float)):
+                out[key] = out[key] * self.rng.choice([0.8, 1.2])
+        return out

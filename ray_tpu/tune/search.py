@@ -1,0 +1,125 @@
+"""Search spaces + basic variant generation.
+
+Reference: `tune.grid_search/choice/uniform/...` sampling primitives and
+`BasicVariantGenerator` grid×random expansion
+(ref: python/ray/tune/search/sample.py, search/basic_variant.py).
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+# public constructors (tune.* names)
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def sample_from(fn: Callable[[dict], Any]):
+    class _SampleFrom(Domain):
+        def __init__(self):
+            self.fn = fn
+
+        def sample(self, rng):
+            return self.fn({})
+
+    return _SampleFrom()
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Cross-product of grid axes × num_samples random draws of the rest
+    (ref: basic_variant.py semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    for combo in itertools.product(*grid_values) if grid_keys else [()]:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

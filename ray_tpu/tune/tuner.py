@@ -1,0 +1,224 @@
+"""Tuner + trial controller.
+
+Reference call stack (SURVEY.md §3.3 step 1): `Tuner.fit`
+(ref: python/ray/tune/tuner.py:346 → impl/tuner_internal.py:473) drives an
+event loop over trial actors (ref: tune/execution/tune_controller.py:69,
+step :667).  Here each trial runs its function-trainable in a TrialActor
+(thread + result queue, same session machinery as ray_tpu.train); the
+controller polls, feeds the scheduler, kills/STOPs, and executes PBT
+exploit/explore restarts from checkpoints.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.train.session import TrainSession, install_session, uninstall_session
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[Any] = None
+    seed: Optional[int] = None
+
+
+class TrialActor:
+    """Runs one trial's function trainable (thread + queue)."""
+
+    def __init__(self, trial_id: str, trial_dir: str):
+        import threading
+
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self._threading = threading
+        self.session: Optional[TrainSession] = None
+        self._thread = None
+        self._error: Optional[str] = None
+
+    def start(self, fn: Callable, config: dict,
+              checkpoint_path: Optional[str]) -> bool:
+        os.makedirs(self.trial_dir, exist_ok=True)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self.session = TrainSession(
+            world_rank=0, world_size=1, local_rank=0,
+            trial_dir=self.trial_dir, latest_checkpoint=ckpt,
+            experiment_name=self.trial_id)
+
+        def target():
+            install_session(self.session)
+            try:
+                fn(config)
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                uninstall_session()
+                self.session.finished.set()
+
+        self._thread = self._threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        out = []
+        if self.session is not None:
+            while not self.session.results.empty():
+                out.append(self.session.results.get_nowait())
+        return {"results": out,
+                "finished": (self.session.finished.is_set()
+                             if self.session else False),
+                "error": self._error}
+
+
+@dataclasses.dataclass
+class _Trial:
+    trial_id: str
+    config: dict
+    actor: Any = None
+    state: str = "PENDING"      # PENDING/RUNNING/TERMINATED/ERROR/STOPPED
+    iteration: int = 0
+    last_metrics: dict = dataclasses.field(default_factory=dict)
+    history: list = dataclasses.field(default_factory=list)
+    checkpoint: Optional[str] = None
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], trials: List[_Trial]):
+        self._results = results
+        self._trials = trials
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str, mode: str = "max") -> Result:
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Dict[str, Any],
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._fn = trainable
+        self._space = param_space
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(self._space, tc.num_samples, tc.seed)
+        exp_dir = self.run_config.resolve_storage()
+        trials = [
+            _Trial(trial_id=f"trial_{i:04d}", config=cfg)
+            for i, cfg in enumerate(variants)]
+        pending = list(trials)
+        running: List[_Trial] = []
+        RemoteTrial = ray_tpu.remote(TrialActor)
+
+        def launch(trial: _Trial, checkpoint: Optional[str] = None):
+            trial.actor = RemoteTrial.options(max_concurrency=4).remote(
+                trial.trial_id, os.path.join(exp_dir, trial.trial_id))
+            ray_tpu.get(trial.actor.start.remote(
+                self._fn, trial.config, checkpoint or trial.checkpoint),
+                timeout=60)
+            trial.state = "RUNNING"
+            running.append(trial)
+
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                launch(pending.pop(0))
+            polls = ray_tpu.get(
+                [t.actor.poll.remote() for t in running], timeout=120)
+            done: List[_Trial] = []
+            for trial, p in zip(list(running), polls):
+                for item in p["results"]:
+                    m = item["metrics"]
+                    trial.iteration += 1
+                    m.setdefault("training_iteration", trial.iteration)
+                    trial.last_metrics = m
+                    trial.history.append(m)
+                    if item["checkpoint"]:
+                        trial.checkpoint = item["checkpoint"]
+                    decision = scheduler.on_result(trial.trial_id, m)
+                    if decision == STOP and trial.state == "RUNNING":
+                        trial.state = "STOPPED"
+                        done.append(trial)
+                        break
+                if trial.state == "RUNNING":
+                    if p["error"]:
+                        trial.state = "ERROR"
+                        trial.error = p["error"]
+                        done.append(trial)
+                    elif p["finished"]:
+                        trial.state = "TERMINATED"
+                        done.append(trial)
+            # PBT exploit/explore: restart bottom trials from a top trial.
+            if isinstance(scheduler, PopulationBasedTraining):
+                by_id = {t.trial_id: t for t in trials}
+                for victim_id, src_id in list(scheduler.exploits.items()):
+                    scheduler.exploits.pop(victim_id)
+                    victim = by_id.get(victim_id)
+                    src = by_id.get(src_id)
+                    if (victim is None or src is None
+                            or victim.state != "RUNNING"
+                            or not src.checkpoint):
+                        continue
+                    try:
+                        ray_tpu.kill(victim.actor)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    if victim in running:
+                        running.remove(victim)
+                    victim.config = scheduler.mutate(src.config)
+                    victim.iteration = 0
+                    launch(victim, checkpoint=src.checkpoint)
+            for trial in done:
+                if trial in running:
+                    running.remove(trial)
+                scheduler.on_trial_complete(trial.trial_id)
+                if trial.actor is not None:
+                    try:
+                        ray_tpu.kill(trial.actor)
+                    except Exception:  # noqa: BLE001
+                        pass
+            if running and not done:
+                time.sleep(0.05)
+
+        results = []
+        for t in trials:
+            err = RuntimeError(t.error) if t.error else None
+            ckpt = Checkpoint(t.checkpoint) if t.checkpoint else None
+            metrics = dict(t.last_metrics)
+            metrics["config"] = t.config
+            results.append(Result(metrics=metrics, checkpoint=ckpt,
+                                  error=err, metrics_history=t.history))
+        return ResultGrid(results, trials)

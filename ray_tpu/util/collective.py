@@ -1,0 +1,202 @@
+"""Host-level (out-of-graph) collectives.
+
+Reference: `ray.util.collective` — GroupManager (ref: python/ray/util/
+collective/collective.py:40), init_collective_group :120, allreduce :258,
+reducescatter :472, send/recv :531,594, NCCL/GLOO backends with a KV-store
+rendezvous (ref: collective_group/nccl_collective_group.py:28 Rendezvous).
+
+TPU-native split: the bandwidth-critical collectives live *inside* XLA
+programs (ICI); this module is the control-plane/DCN path — CPU arrays
+between hosts (gradient-of-metadata, rendezvous, eval aggregation).  The
+transport is the GCS KV store: rank r publishes its contribution under
+(group, seq, op, r), peers poll-read.  O(N²) bytes — right trade for small
+host payloads; in-graph collectives handle the big ones.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_groups: Dict[str, "CollectiveGroup"] = {}
+_lock = threading.Lock()
+
+
+def _kv():
+    from ray_tpu.api import _global_worker
+
+    return _global_worker()
+
+
+class CollectiveGroup:
+    def __init__(self, name: str, world_size: int, rank: int,
+                 incarnation: int = 0):
+        from collections import defaultdict
+
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        # Distinguishes restarted groups: a rerun with the same group name
+        # MUST bump `incarnation` (or use a fresh name) or it would read the
+        # previous run's payloads.  Keys embed it.
+        self.incarnation = incarnation
+        # Per-op-kind sequence numbers: ranks must issue the same sequence
+        # of *collective* ops (standard contract), while p2p pairs advance
+        # independently of collectives and of other pairs.
+        self._seqs = defaultdict(int)
+
+    def _next_seq(self, op: str) -> int:
+        s = self._seqs[op]
+        self._seqs[op] += 1
+        # Lazy GC: by the time any rank issues seq s, every rank has issued
+        # s-1 (it read all s-1 keys), hence finished reading s-2 — deleting
+        # our own s-2 key is safe and bounds KV growth to 2 generations.
+        if s >= 2:
+            _kv().kv_del(b"collective", self._key(op, s - 2, self.rank))
+        return s
+
+    # -- kv plumbing ----------------------------------------------------
+    def _key(self, op: str, seq: int, rank: int) -> bytes:
+        return (f"coll/{self.name}/i{self.incarnation}/{seq}/{op}/{rank}"
+                .encode())
+
+    def _put(self, op: str, seq: int, rank: int, payload: Any) -> None:
+        _kv().kv_put(b"collective", self._key(op, seq, rank),
+                     pickle.dumps(payload))
+
+    def _get(self, op: str, seq: int, rank: int, timeout: float) -> Any:
+        key = self._key(op, seq, rank)
+        deadline = time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            blob = _kv().kv_get(b"collective", key)
+            if blob is not None:
+                return pickle.loads(blob)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {op} seq={seq} rank={rank} timed out")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def _gather_all(self, op: str, value: Any, timeout: float) -> List[Any]:
+        seq = self._next_seq(op)
+        self._put(op, seq, self.rank, value)
+        return [self._get(op, seq, r, timeout)
+                for r in range(self.world_size)]
+
+    # -- collectives ----------------------------------------------------
+    def allgather(self, value, timeout: float = 60.0) -> List[Any]:
+        return self._gather_all("ag", value, timeout)
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  timeout: float = 60.0) -> np.ndarray:
+        parts = self._gather_all("ar", np.asarray(arr), timeout)
+        out = parts[0].copy()
+        for p in parts[1:]:
+            if op == "sum":
+                out = out + p
+            elif op == "max":
+                out = np.maximum(out, p)
+            elif op == "min":
+                out = np.minimum(out, p)
+            elif op == "prod":
+                out = out * p
+            else:
+                raise ValueError(f"unknown reduce op {op!r}")
+        return out
+
+    def reduce(self, arr, *, dst_rank: int = 0, op: str = "sum",
+               timeout: float = 60.0) -> Optional[np.ndarray]:
+        out = self.allreduce(arr, op=op, timeout=timeout)
+        return out if self.rank == dst_rank else None
+
+    def broadcast(self, arr, *, src_rank: int = 0,
+                  timeout: float = 60.0) -> np.ndarray:
+        seq = self._next_seq("bc")
+        if self.rank == src_rank:
+            self._put("bc", seq, src_rank, np.asarray(arr))
+            return np.asarray(arr)
+        return self._get("bc", seq, src_rank, timeout)
+
+    def reducescatter(self, arr, op: str = "sum",
+                      timeout: float = 60.0) -> np.ndarray:
+        full = self.allreduce(arr, op=op, timeout=timeout)
+        return np.array_split(full, self.world_size)[self.rank]
+
+    def barrier(self, timeout: float = 60.0) -> None:
+        self._gather_all("bar", 0, timeout)
+
+    def send(self, arr, dst_rank: int, timeout: float = 60.0) -> None:
+        op = f"p2p{self.rank}to{dst_rank}"
+        self._put(op, self._next_seq(op), self.rank, np.asarray(arr))
+
+    def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
+        op = f"p2p{src_rank}to{self.rank}"
+        return self._get(op, self._next_seq(op), src_rank, timeout)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "kv",
+                          group_name: str = "default",
+                          incarnation: int = 0) -> CollectiveGroup:
+    """ref: collective.py:120 — backend is always the KV transport here
+    (NCCL's role is taken by in-graph XLA collectives).  Restarted gangs
+    must pass a bumped `incarnation` (all ranks agree on it, e.g. the
+    trainer's attempt counter) or a fresh group_name."""
+    with _lock:
+        g = CollectiveGroup(group_name, world_size, rank,
+                            incarnation=incarnation)
+        _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(f"collective group {group_name!r} not initialized")
+    return g
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:  # best-effort KV cleanup of this group's keys
+        try:
+            w = _kv()
+            prefix = f"coll/{group_name}/i{g.incarnation}/".encode()
+            for k in w.kv_keys(b"collective", prefix):
+                w.kv_del(b"collective", k)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# module-level convenience (mirrors ray.util.collective free functions)
+def allreduce(arr, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(arr, op=op)
+
+
+def allgather(value, group_name: str = "default"):
+    return get_group(group_name).allgather(value)
+
+
+def broadcast(arr, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(arr, src_rank=src_rank)
+
+
+def reducescatter(arr, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(arr, op=op)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def send(arr, dst_rank: int, group_name: str = "default"):
+    get_group(group_name).send(arr, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return get_group(group_name).recv(src_rank)

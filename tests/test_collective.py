@@ -1,0 +1,70 @@
+"""Host-level collective ops across actor ranks (KV transport)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, rank, world):
+        from ray_tpu.util import collective
+
+        self.g = collective.init_collective_group(world, rank,
+                                                  group_name="test")
+
+    def do_allreduce(self, x):
+        return self.g.allreduce(np.asarray(x, dtype=np.float64))
+
+    def do_allgather(self, v):
+        return self.g.allgather(v)
+
+    def do_broadcast(self, v):
+        return self.g.broadcast(np.asarray(v), src_rank=0)
+
+    def do_reducescatter(self, x):
+        return self.g.reducescatter(np.asarray(x, dtype=np.float64))
+
+    def do_sendrecv(self, peer, value=None):
+        if value is not None:
+            self.g.send(np.asarray(value), peer)
+            return None
+        return self.g.recv(peer)
+
+
+def test_allreduce_and_allgather():
+    world = 3
+    ranks = [Rank.remote(r, world) for r in range(world)]
+    outs = ray_tpu.get([r.do_allreduce.remote([1.0 * (i + 1)] * 4)
+                        for i, r in enumerate(ranks)])
+    for out in outs:
+        np.testing.assert_allclose(out, [6.0] * 4)
+    gathered = ray_tpu.get([r.do_allgather.remote(i)
+                            for i, r in enumerate(ranks)])
+    assert all(g == [0, 1, 2] for g in gathered)
+
+
+def test_broadcast_and_reducescatter():
+    world = 2
+    ranks = [Rank.options(name=f"coll{r}").remote(r, world)
+             for r in range(world)]
+    outs = ray_tpu.get([actor.do_broadcast.remote([rank * 10, 1])
+                        for rank, actor in enumerate(ranks)])
+    np.testing.assert_allclose(outs[0], outs[1])
+    rs = ray_tpu.get([r.do_reducescatter.remote([1.0, 2.0, 3.0, 4.0])
+                      for r in ranks])
+    np.testing.assert_allclose(np.concatenate(rs), [2.0, 4.0, 6.0, 8.0])
+
+
+def test_send_recv():
+    ranks = [Rank.remote(r, 2) for r in range(2)]
+    recv_ref = ranks[1].do_sendrecv.remote(0)  # rank1 recv from rank0
+    ray_tpu.get(ranks[0].do_sendrecv.remote(1, value=[7, 8, 9]))
+    np.testing.assert_array_equal(ray_tpu.get(recv_ref), [7, 8, 9])

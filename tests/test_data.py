@@ -1,0 +1,176 @@
+"""Data library: blocks, transforms, shuffle/sort/groupby, io, iteration."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_fused_pipeline():
+    ds = (rd.range(64, parallelism=4)
+          .map_batches(lambda b: {"x": b["id"] * 2}, batch_format="numpy")
+          .map_batches(lambda b: {"x": b["x"] + 1}, batch_format="numpy"))
+    out = ds.to_numpy()["x"]
+    np.testing.assert_array_equal(np.sort(out), np.arange(64) * 2 + 1)
+
+
+def test_map_filter_flatmap():
+    ds = rd.from_items(list(range(10)))
+    assert sorted(ds.map(lambda x: x * 10).take_all()) == \
+        [i * 10 for i in range(10)]
+    assert sorted(ds.filter(lambda x: x % 2 == 0).take_all()) == \
+        [0, 2, 4, 6, 8]
+    assert sorted(ds.flat_map(lambda x: [x, x]).take_all()) == \
+        sorted(list(range(10)) * 2)
+
+
+def test_actor_pool_map_batches():
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(32, parallelism=4).map_batches(
+        AddConst, concurrency=2, fn_constructor_args=(100,),
+        batch_format="numpy")
+    out = sorted(ds.to_numpy()["id"].tolist())
+    assert out == list(range(100, 132))
+
+
+def test_limit_streaming_and_order():
+    ds = rd.range(1000, parallelism=10).limit(17)
+    assert ds.count() == 17
+    assert [r["id"] for r in ds.take_all()] == list(range(17))
+
+
+def test_repartition_and_num_blocks():
+    ds = rd.range(100, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+
+
+def test_random_shuffle_permutes():
+    ds = rd.range(200, parallelism=4).random_shuffle(seed=0)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(200))
+    assert vals != list(range(200))
+
+
+def test_sort_and_aggregates():
+    ds = rd.from_items([{"a": i % 5, "v": float(i)} for i in range(50)])
+    s = ds.sort("v", descending=True)
+    assert s.take(1)[0]["v"] == 49.0
+    assert ds.sum("v") == sum(range(50))
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 49.0
+    assert abs(ds.mean("v") - 24.5) < 1e-9
+
+
+def test_groupby_agg_and_map_groups():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    agg = ds.groupby("k").sum("v").take_all()
+    sums = {r["k"]: r["v_sum"] for r in agg}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+
+    mg = ds.groupby("k").map_groups(
+        lambda b: {"k": b["k"][:1], "n": np.array([len(b["v"])])},
+        batch_format="numpy")
+    counts = {r["k"]: r["n"] for r in mg.take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+
+
+def test_split_and_train_test_split():
+    parts = rd.range(100, parallelism=4).split(4)
+    assert [p.count() for p in parts] == [25, 25, 25, 25]
+    tr, te = rd.range(100).train_test_split(0.2)
+    assert tr.count() == 80 and te.count() == 20
+
+
+def test_union_zip_add_column():
+    a = rd.range(10)
+    b = rd.range(10)
+    assert a.union(b).count() == 20
+    z = a.zip(rd.range(10).map_batches(
+        lambda t: {"other": t["id"] * 2}, batch_format="numpy"))
+    rows = z.take_all()
+    assert all(r["other"] == 2 * r["id"] for r in rows)
+    wc = a.add_column("double", lambda b: b["id"] * 2)
+    assert all(r["double"] == 2 * r["id"] for r in wc.take_all())
+
+
+def test_tensor_columns_roundtrip():
+    arr = np.arange(24.0).reshape(6, 2, 2)
+    ds = rd.from_numpy(arr)
+    out = ds.map_batches(lambda b: {"data": b["data"] * 2},
+                         batch_format="numpy").to_numpy()["data"]
+    assert out.shape == (6, 2, 2)
+    np.testing.assert_allclose(out, arr * 2)
+
+
+def test_iter_batches_sizes():
+    ds = rd.range(25, parallelism=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=10,
+                                                   batch_format="numpy")]
+    assert sizes == [10, 10, 5]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=10,
+                                                   batch_format="numpy",
+                                                   drop_last=True)]
+    assert sizes == [10, 10]
+
+
+def test_file_io_roundtrip(tmp_path):
+    ds = rd.from_items([{"x": i, "y": str(i)} for i in range(30)])
+    for fmt, reader in [("parquet", rd.read_parquet), ("csv", rd.read_csv),
+                        ("json", rd.read_json)]:
+        path = str(tmp_path / fmt)
+        getattr(ds, f"write_{fmt}")(path)
+        back = reader(path)
+        assert back.count() == 30
+        assert sorted(r["x"] for r in back.take_all()) == list(range(30))
+
+
+def test_read_text_and_binary(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert ds.take_all() == [{"text": "hello"}, {"text": "world"}]
+    b = rd.read_binary_files(str(p), include_paths=True).take_all()[0]
+    assert b["bytes"] == b"hello\nworld\n" if isinstance(b, dict) else True
+
+
+def test_iter_jax_batches_device():
+    import jax
+
+    ds = rd.range(32).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)}, batch_format="numpy")
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_allclose(np.asarray(batches[0]["x"]),
+                               np.arange(8, dtype=np.float32))
+
+
+def test_from_pandas_arrow_hf():
+    import pandas as pd
+    import pyarrow as pa
+
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert rd.from_pandas(df).count() == 3
+    assert rd.from_arrow(pa.table({"a": [1, 2]})).count() == 2

@@ -1,0 +1,159 @@
+"""Serve: deploy, route, scale, batch, HTTP ingress."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment():
+    @serve.deployment
+    def hello(name="world"):
+        return f"hello {name}"
+
+    h = serve.run(hello.bind(), name="hello_app")
+    assert h.remote().result(timeout=30) == "hello world"
+    assert h.remote("tpu").result(timeout=30) == "hello tpu"
+    serve.delete("hello_app")
+
+
+def test_class_deployment_with_state_and_methods():
+    @serve.deployment(num_replicas=1)
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def __call__(self, x):
+            return x * self.scale
+
+        def describe(self):
+            return {"scale": self.scale}
+
+    h = serve.run(Model.bind(3), name="model_app")
+    assert h.remote(7).result(timeout=30) == 21
+    assert h.describe.remote().result(timeout=30) == {"scale": 3}
+    serve.delete("model_app")
+
+
+def test_multi_replica_routing():
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self):
+            import os
+
+            return os.getpid()
+
+    h = serve.run(WhoAmI.bind(), name="who_app")
+    pids = {h.remote().result(timeout=30) for _ in range(20)}
+    assert len(pids) == 2  # both replicas saw traffic
+    serve.delete("who_app")
+
+
+def test_status_and_reconfigure_scale():
+    @serve.deployment(num_replicas=1)
+    def f():
+        return 1
+
+    serve.run(f.bind(), name="scale_app")
+    st = serve.status()["scale_app"]
+    assert st["running"] == 1
+    # redeploy with more replicas; controller reconciles up
+    serve.run(f.options(num_replicas=3).bind(), name="scale_app")
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status()["scale_app"]
+        if st["running"] == 3:
+            break
+        time.sleep(0.2)
+    assert st["running"] == 3
+    serve.delete("scale_app")
+
+
+def test_redeploy_replaces_old_replicas():
+    @serve.deployment(num_replicas=1)
+    class V:
+        def __init__(self, v):
+            self.v = v
+
+        def __call__(self):
+            return self.v
+
+    h = serve.run(V.bind(1), name="redeploy_app")
+    assert h.remote().result(timeout=30) == 1
+    serve.run(V.bind(2), name="redeploy_app")
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if serve.get_app_handle("redeploy_app").remote().result(
+                timeout=30) == 2:
+            break
+        time.sleep(0.2)
+    # all replicas now serve the new version
+    h2 = serve.get_app_handle("redeploy_app")
+    assert all(h2.remote().result(timeout=30) == 2 for _ in range(5))
+    serve.delete("redeploy_app")
+
+
+def test_dynamic_batching():
+    seen_sizes = []
+
+    @serve.deployment
+    class Batcher:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            seen_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+    h = serve.run(Batcher.bind(), name="batch_app")
+    results = [None] * 8
+    threads = []
+
+    def call(i):
+        results[i] = h.remote(i).result(timeout=30)
+
+    for i in range(8):
+        t = threading.Thread(target=call, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    assert results == [i * 2 for i in range(8)]
+    serve.delete("batch_app")
+
+
+def test_http_ingress():
+    @serve.deployment
+    def echo(payload=None):
+        return {"got": payload}
+
+    serve.run(echo.bind(), name="http_app", route_prefix="/echo", _http=True)
+    port = serve.http_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo",
+        data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body == {"got": {"a": 1}}
+    # unknown route -> 404
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    serve.delete("http_app")

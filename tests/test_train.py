@@ -1,0 +1,162 @@
+"""Train library: session, worker gang, reporting, checkpoint, restart."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, FailureConfig, JaxTrainer, Result,
+                           RunConfig, ScalingConfig, TorchTrainer,
+                           DataParallelTrainer)
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_two_worker_loop_reports(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("exp"))
+
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(),
+                          "world": ctx.get_world_size()})
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="basic", storage_path=tmp),
+        backend=None)
+    result = trainer.fit()
+    assert isinstance(result, Result)
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["world"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_checkpoint_roundtrip_and_resume(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("exp2"))
+
+    def loop(config):
+        import json
+        import tempfile
+
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        for step in range(start, start + 2):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            train.report({"step": step}, checkpoint=Checkpoint(d))
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="ckpt", storage_path=tmp), backend=None)
+    r1 = trainer.fit()
+    assert r1.metrics["step"] == 1
+    assert r1.checkpoint is not None
+
+    trainer2 = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="ckpt", storage_path=tmp),
+        resume_from_checkpoint=r1.checkpoint, backend=None)
+    r2 = trainer2.fit()
+    assert r2.metrics["step"] == 3  # resumed from step 1
+
+
+def test_failure_restart_from_checkpoint(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("exp3"))
+    marker = os.path.join(tmp, "fail_once")
+
+    def loop(config):
+        import json
+        import tempfile
+
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            train.report({"step": step}, checkpoint=Checkpoint(d))
+            if step == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("injected failure")
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="ft", storage_path=tmp,
+                             failure_config=FailureConfig(max_failures=2)),
+        backend=None)
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+
+
+def test_failure_exhausts_budget(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("exp4"))
+
+    def loop(config):
+        raise ValueError("always fails")
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="fail", storage_path=tmp), backend=None)
+    result = trainer.fit()
+    assert result.error is not None
+    assert "always fails" in str(result.error)
+
+
+def test_torch_trainer_gloo_allreduce(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("exp5"))
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        t = torch.ones(2) * (dist.get_rank() + 1)
+        dist.all_reduce(t)
+        train.report({"sum": float(t[0])})
+
+    trainer = TorchTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="torch", storage_path=tmp))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["sum"] == 3.0  # 1 + 2
+
+
+def test_jax_pytree_checkpoint(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.train import load_pytree, save_pytree
+
+    tree = {"w": jnp.arange(8.0), "b": {"x": jnp.ones((2, 2))}}
+    ckpt = save_pytree(tree, str(tmp_path / "ck"), step=7)
+    assert ckpt.get_metadata()["step"] == 7
+    restored = load_pytree(ckpt, target=tree)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.asarray(tree["w"]))

@@ -1,0 +1,124 @@
+"""Tune: variants, schedulers, Tuner end-to-end, PBT exploit."""
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_times_samples():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1),
+             "c": "fixed"}
+    vs = generate_variants(space, num_samples=2, seed=0)
+    assert len(vs) == 6
+    assert sorted({v["a"] for v in vs}) == [1, 2, 3]
+    assert all(0 <= v["b"] <= 1 and v["c"] == "fixed" for v in vs)
+
+
+def test_asha_stops_bad_trials():
+    sched = tune.ASHAScheduler(metric="score", mode="max", grace_period=1,
+                               reduction_factor=2, max_t=16)
+    # two trials reach rung 1; the worse one should stop
+    assert sched.on_result("good", {"training_iteration": 1,
+                                    "score": 10}) == CONTINUE
+    assert sched.on_result("bad", {"training_iteration": 1,
+                                   "score": 1}) == STOP
+
+
+def test_asha_milestone_crossing_with_stride():
+    sched = tune.ASHAScheduler(metric="score", mode="max", grace_period=1,
+                               reduction_factor=3, max_t=16)
+    # trials report every 2 iterations: rungs 1, 3, 9 are crossed, not hit
+    assert sched.on_result("good", {"training_iteration": 2,
+                                    "score": 10}) == CONTINUE
+    assert sched.on_result("bad", {"training_iteration": 2,
+                                   "score": 1}) == STOP
+
+
+def test_tuner_end_to_end(tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 5, 10])},
+        tune_config=tune.TuneConfig(num_samples=1, max_concurrent_trials=3),
+        run_config=ray_tpu.train.RunConfig(name="t1", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result("score", "max")
+    assert best.metrics["score"] == 30
+    assert best.metrics["config"]["x"] == 10
+    df = grid.get_dataframe()
+    assert len(df) == 3
+
+
+def test_tuner_with_asha_and_errors(tmp_path):
+    def trainable(config):
+        if config["x"] == 99:
+            raise ValueError("boom")
+        for i in range(8):
+            tune.report({"loss": 1.0 / config["x"] + i * 0.0,
+                         "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 4, 99])},
+        tune_config=tune.TuneConfig(
+            num_samples=1, max_concurrent_trials=4,
+            scheduler=tune.ASHAScheduler(metric="loss", mode="min",
+                                         grace_period=2,
+                                         reduction_factor=2, max_t=8)),
+        run_config=ray_tpu.train.RunConfig(name="t2",
+                                           storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert len(grid.errors) == 1
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["config"]["x"] == 4
+
+
+def test_pbt_exploits_checkpoint(tmp_path):
+    def trainable(config):
+        import json
+        import os
+        import tempfile
+
+        ckpt = tune.get_checkpoint()
+        weight = 0.0
+        if ckpt:
+            with open(os.path.join(ckpt.path, "w.json")) as f:
+                weight = json.load(f)["w"]
+        for i in range(10):
+            weight += config["lr"]
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "w.json"), "w") as f:
+                json.dump({"w": weight}, f)
+            from ray_tpu.train import Checkpoint
+
+            tune.report({"score": weight, "training_iteration": i + 1},
+                        checkpoint=Checkpoint(d))
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, seed=0)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(num_samples=1, max_concurrent_trials=2,
+                                    scheduler=pbt),
+        run_config=ray_tpu.train.RunConfig(name="t3",
+                                           storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result("score", "max")
+    assert best.metrics["score"] >= 4.0  # lr=1.0 trial reaches >= 10*0.4
