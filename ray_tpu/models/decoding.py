@@ -1,0 +1,268 @@
+"""Autoregressive decoding: KV cache, compiled prefill/decode steps.
+
+The serving-side compute path (reference has none in-repo; BASELINE.json
+north-star names "Serve req/s + p50 TTFT" with continuous batching).
+Design for XLA: fixed-shape slot-batched KV cache — `prefill` fills one
+slot from a (padded) prompt, `decode_step` advances ALL active slots one
+token in a single fused program.  Shapes never depend on request count, so
+both functions compile once per (slot_count, bucket) and the continuous-
+batching engine (ray_tpu.serve.llm) swaps requests in and out of slots
+between steps.
+
+Cache layout: k/v (L, S, T_max, H_kv, D) with S = slots; per-slot lengths
+(S,) drive the attention mask.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rotary import apply_rope
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array          # (L, S, T, Hkv, D)
+    v: jax.Array
+    lengths: jax.Array    # (S,) int32 — tokens currently in each slot
+
+
+jax.tree_util.register_dataclass(KVCache, ["k", "v", "lengths"], [])
+
+
+def init_cache(cfg: TransformerConfig, num_slots: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or cfg.compute_dtype
+    shape = (cfg.n_layers, num_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((num_slots,), jnp.int32))
+
+
+def _qkv(bp, x, cfg, positions):
+    cd = cfg.compute_dtype
+    h = rms_norm(x, bp["attn_norm"], eps=cfg.norm_eps)
+    b, t = x.shape[:2]
+    q = jnp.einsum("btd,dh->bth", h, bp["wq"].astype(cd)).reshape(
+        b, t, cfg.n_heads, cfg.head_dim)
+    k = jnp.einsum("btd,dh->bth", h, bp["wk"].astype(cd)).reshape(
+        b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = jnp.einsum("btd,dh->bth", h, bp["wv"].astype(cd)).reshape(
+        b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, theta=cfg.rope_theta)
+    k = apply_rope(k, positions, theta=cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(bp, x, cfg):
+    cd = cfg.compute_dtype
+    h = rms_norm(x, bp["mlp_norm"], eps=cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from ray_tpu.ops.moe import moe_mlp
+
+        out, _ = moe_mlp(h, {"router": bp["router"], "w_gate": bp["w_gate"],
+                             "w_up": bp["w_up"], "w_down": bp["w_down"]},
+                         cfg.moe)
+        return out
+    gate = jnp.einsum("btd,df->btf", h, bp["w_gate"].astype(cd))
+    up = jnp.einsum("btd,df->btf", h, bp["w_up"].astype(cd))
+    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up,
+                      bp["w_down"].astype(cd))
+
+
+def _gqa(q, k, v, cfg):
+    if cfg.n_kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return q, k, v
+
+
+def _final_logits(params, x, cfg):
+    cd = cfg.compute_dtype
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    if cfg.tie_embeddings:
+        return jnp.einsum("btd,vd->btv", x, params["embed"].astype(cd))
+    return jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(cd))
+
+
+def prefill(params, cache: KVCache, tokens: jax.Array, slot: jax.Array,
+            length: jax.Array, cfg: TransformerConfig
+            ) -> Tuple[KVCache, jax.Array]:
+    """Run a (1, T_pad) prompt through the model, writing k/v into `slot`.
+
+    `length` is the true prompt length (<= T_pad); returns (cache, logits
+    of the last real token (vocab,))."""
+    cd = cfg.compute_dtype
+    _, t = tokens.shape
+    positions = jnp.arange(t, dtype=jnp.int32)
+    x = params["embed"].astype(cd)[tokens]
+    mask = (positions[:, None] >= positions[None, :]) \
+        & (positions[None, :] < length)
+
+    def layer(x, layer_params_and_idx):
+        bp, li = layer_params_and_idx
+        q, k, v = _qkv(bp, x, cfg, positions)
+        qh, kh, vh = _gqa(q, k, v, cfg)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(1, t, cfg.n_heads * cfg.head_dim).astype(cd)
+        x = x + jnp.einsum("bth,hd->btd", attn, bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k[0], v[0])  # (T, Hkv, D) for cache write
+
+    idx = jnp.arange(cfg.n_layers)
+    x, kv = jax.lax.scan(layer, x, (params["blocks"], idx))
+    k_new, v_new = kv  # (L, T, Hkv, D)
+    t_cache = cache.k.shape[2]
+    pad = t_cache - t
+    k_new = jnp.pad(k_new.astype(cache.k.dtype),
+                    ((0, 0), (0, pad), (0, 0), (0, 0)))
+    v_new = jnp.pad(v_new.astype(cache.v.dtype),
+                    ((0, 0), (0, pad), (0, 0), (0, 0)))
+    new_cache = KVCache(
+        k=jax.lax.dynamic_update_index_in_dim(cache.k, k_new, slot, 1),
+        v=jax.lax.dynamic_update_index_in_dim(cache.v, v_new, slot, 1),
+        lengths=cache.lengths.at[slot].set(length))
+    logits = _final_logits(params, x, cfg)[0]          # (T, vocab)
+    last = logits[length - 1]                           # (vocab,)
+    return new_cache, last
+
+
+def decode_step(params, cache: KVCache, tokens: jax.Array,
+                active: jax.Array, cfg: TransformerConfig
+                ) -> Tuple[KVCache, jax.Array]:
+    """One token for every slot: tokens (S,) int32 (last sampled token per
+    slot), active (S,) bool.  Returns (cache, logits (S, vocab)).
+
+    Inactive slots still flow through the matmuls (fixed shapes) but their
+    cache/lengths are left untouched."""
+    cd = cfg.compute_dtype
+    s_count = tokens.shape[0]
+    t_cache = cache.k.shape[2]
+    positions = cache.lengths                            # (S,) next index
+    x = params["embed"].astype(cd)[tokens][:, None]      # (S, 1, d)
+    pos_b = positions[:, None]                           # (S, 1)
+
+    kv_pos = jnp.arange(t_cache)
+    # slot s attends to cache[:len] plus its own new token at index len.
+    attn_mask = kv_pos[None, :] <= positions[:, None]    # (S, T)
+
+    def layer(carry, layer_in):
+        x = carry
+        bp, k_cache, v_cache = layer_in
+        q, k, v = _qkv(bp, x, cfg, pos_b)                # q (S,1,H,D)
+        k_cache = jax.vmap(
+            lambda kc, kn, p: jax.lax.dynamic_update_index_in_dim(
+                kc, kn.astype(kc.dtype), p, 0))(k_cache, k[:, 0], positions)
+        v_cache = jax.vmap(
+            lambda vc, vn, p: jax.lax.dynamic_update_index_in_dim(
+                vc, vn.astype(vc.dtype), p, 0))(v_cache, v[:, 0], positions)
+        kh, vh = k_cache, v_cache
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        s = jnp.einsum("sohd,sthd->soht", q.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(attn_mask[:, None, None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("soht,sthd->sohd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(s_count, 1, cfg.n_heads * cfg.head_dim)
+        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
+                           bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k_cache, v_cache)
+
+    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    new_k, new_v = new_kv
+    keep = active[None, :, None, None, None]
+    new_cache = KVCache(
+        k=jnp.where(keep, new_k, cache.k),
+        v=jnp.where(keep, new_v, cache.v),
+        lengths=jnp.where(active, cache.lengths + 1, cache.lengths))
+    logits = _final_logits(params, x, cfg)[:, 0]         # (S, vocab)
+    return new_cache, logits
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array, *,
+                  temperature: float = 1.0, top_k: int = 0) -> jax.Array:
+    """(S, vocab) → (S,) sampled token ids; temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_per_slot(logits: jax.Array, rng: jax.Array,
+                    temps: jax.Array, top_k: int = 0) -> jax.Array:
+    """(S, vocab) logits + per-slot temperature (0 = greedy) → (S,) ids."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def decode_and_sample(params, cache: KVCache, tokens, active, temps, rng,
+                      cfg: TransformerConfig):
+    """One fused device call per engine tick: decode + per-slot sampling.
+    Returns (cache, next_tokens (S,), rng').  Keeps the host↔device
+    traffic to (S,) int32 per tick — the tunnel RTT, not the transfer,
+    bounds tick rate."""
+    cache, logits = decode_step(params, cache, tokens, active, cfg)
+    rng, sub = jax.random.split(rng)
+    return cache, sample_per_slot(logits, sub, temps), rng
+
+
+def prefill_and_sample(params, cache: KVCache, tokens, slot, length, temp,
+                       rng, cfg: TransformerConfig):
+    cache, last_logits = prefill(params, cache, tokens, slot, length, cfg)
+    rng, sub = jax.random.split(rng)
+    tok = sample_per_slot(last_logits[None], sub, temp[None])[0]
+    return cache, tok, rng
+
+
+def decode_burst(params, cache: KVCache, tokens, active, temps, rng,
+                 cfg: TransformerConfig, n_steps: int):
+    """`n_steps` fused decode+sample ticks in ONE device call (lax.scan) —
+    amortizes host↔device round-trip latency (dominant through the remote
+    tunnel; also wins on real hardware at small models).  Returns
+    (cache, token_matrix (n_steps, S), rng)."""
+
+    def tick(carry, _):
+        cache, toks, rng = carry
+        cache, nxt, rng = decode_and_sample(params, cache, toks, active,
+                                            temps, rng, cfg)
+        return (cache, nxt, rng), nxt
+
+    (cache, _, rng), toks = jax.lax.scan(
+        tick, (cache, tokens, rng), None, length=n_steps)
+    return cache, toks, rng
+
+
+def make_engine_fns(cfg: TransformerConfig, *, num_slots: int,
+                    max_len: int, donate: bool = True):
+    """Jitted (prefill_fn, burst_decode_fn) with cache donation.  The
+    decode fn takes a static `n_steps` (one compile per distinct burst)."""
+    pf = functools.partial(prefill_and_sample, cfg=cfg)
+    df = functools.partial(decode_burst, cfg=cfg)
+    prefill_jit = jax.jit(pf, donate_argnums=(1,) if donate else ())
+    decode_jit = jax.jit(df, static_argnames=("n_steps",),
+                         donate_argnums=(1,) if donate else ())
+    return prefill_jit, decode_jit

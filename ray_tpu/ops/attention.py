@@ -6,9 +6,10 @@ queries stream through VMEM in `block_q` rows while key/value blocks of
 max/denominator/accumulator held in VMEM scratch across the sweep.  Causal
 sweeps skip fully-masked kv blocks.
 
-Autodiff: the forward runs the kernel; the backward recomputes attention via
-the XLA reference implementation (flash backward kernel is a later-round
-optimization).  Gradients are exact.
+Autodiff: the forward kernel also emits per-row logsumexp; the backward is
+two more Pallas kernels (Dao-style): dq accumulates over kv blocks, dk/dv
+accumulate over q blocks, with delta = rowsum(do*o) precomputed.  On
+non-TPU backends both directions fall back to the XLA reference.
 
 Reference framework has no attention op (compute is torch's problem there);
 this is greenfield per SURVEY.md §2.4.
@@ -53,7 +54,7 @@ def mha_reference(q, k, v, *, causal: bool = True, sm_scale: float | None = None
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                sm_scale: float, causal: bool, block_q: int, block_kv: int,
                num_kv_blocks: int):
     qi = pl.program_id(1)
@@ -70,8 +71,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)        # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)        # (block_kv, d)
+        q = q_ref[0]                             # native dtype -> MXU
+        k = k_ref[0]
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -89,13 +90,101 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_new = alpha * l_prev + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = m_new
         l_scr[...] = l_new
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
         o_ref[0, ...] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+        # logsumexp per row, lane-replicated (TPU tiling wants a 128 lane
+        # dim — same layout as the in-tree pallas flash attention)
+        lse_ref[0, ...] = m_scr[...] + jnp.log(l_scr[...])
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, sm_scale: float, causal: bool,
+                   block_q: int, block_kv: int, num_kv_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (qi + 1) * block_q > ki * block_kv if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                          # (block_q, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, ...] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale: float,
+                    causal: bool, block_q: int, block_kv: int,
+                    num_q_blocks: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (qi + 1) * block_q > ki * block_kv if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                              # (bq, bkv)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # p^T @ do
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # ds^T @ q
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, ...] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -109,12 +198,21 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale):
-    out = _dispatch(q, k, v, causal=causal, sm_scale=sm_scale)
-    return out, (q, k, v)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _pallas_eligible(q, k):
+        out, lse = _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale)
+        return out, (q, k, v, out, lse)
+    out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, sm_scale, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        return _flash_bwd_pallas(q, k, v, out, lse, g, causal=causal,
+                                 sm_scale=sm_scale
+                                 or 1.0 / math.sqrt(q.shape[-1]))
     _, vjp = jax.vjp(
         lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal, sm_scale=sm_scale),
         q, k, v)
@@ -124,39 +222,47 @@ def _flash_bwd(causal, sm_scale, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pallas_eligible(q, k) -> bool:
+    on_tpu = pltpu is not None and jax.default_backend() == "tpu"
+    t, tkv = q.shape[1], k.shape[1]
+    return (on_tpu and t >= 128 and tkv >= 128
+            and t % 128 == 0 and tkv % 128 == 0)
+
+
 def _dispatch(q, k, v, *, causal, sm_scale):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    on_tpu = pltpu is not None and jax.default_backend() == "tpu"
-    b, t, h, d = q.shape
-    tkv = k.shape[1]
-    if not on_tpu or t < 128 or tkv < 128 or t % 128 or tkv % 128:
+    if not _pallas_eligible(q, k):
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale)[0]
+
+
+def _blocks_for(t: int, tkv: int) -> tuple[int, int]:
+    # Block sizes must divide the sequence lengths exactly (the grid floors
+    # otherwise and partial blocks would be silently skipped); callers
+    # guarantee t, tkv are multiples of 128.
+    return (256 if t % 256 == 0 else 128), (256 if tkv % 256 == 0 else 128)
+
+
+def _fold(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
 
 def _flash_pallas(q, k, v, *, causal, sm_scale):
     b, t, h, d = q.shape
     tkv = k.shape[1]
-    # Block sizes must divide the sequence lengths exactly (the grid floors
-    # otherwise and partial blocks would be silently skipped); _dispatch
-    # guarantees t, tkv are multiples of 128.
-    block_q = 256 if t % 256 == 0 else 128
-    block_kv = 256 if tkv % 256 == 0 else 128
+    block_q, block_kv = _blocks_for(t, tkv)
     num_q = t // block_q
     num_kv = tkv // block_kv
 
-    # (B, T, H, D) -> (B*H, T, D): heads become independent grid rows.
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], x.shape[1], d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
 
     kernel = functools.partial(
         _fa_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_kv=block_kv, num_kv_blocks=num_kv)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
@@ -164,8 +270,15 @@ def _flash_pallas(q, k, v, *, causal, sm_scale):
             pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -175,4 +288,84 @@ def _flash_pallas(q, k, v, *, causal, sm_scale):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf)
 
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    def unfold(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return unfold(out), lse
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, *, causal, sm_scale):
+    """Dao-style backward: one kernel accumulating dq over kv blocks, one
+    accumulating dk/dv over q blocks.  delta = rowsum(do * o)."""
+    b, t, h, d = q.shape
+    tkv = k.shape[1]
+    block_q, block_kv = _blocks_for(t, tkv)
+    num_q, num_kv = t // block_q, tkv // block_kv
+    bh = b * h
+
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof, of = _fold(g), _fold(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)                               # (BH, T)
+    delta = jnp.broadcast_to(delta[..., None], (bh, t, _LANES))
+
+    common_in = [qf, kf, vf, dof, lse, delta]
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_kv_blocks=num_kv)
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(*common_in)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_q_blocks=num_q)
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tkv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tkv, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(*common_in)
+
+    def unfold(x, tt):
+        return x.reshape(b, h, tt, d).transpose(0, 2, 1, 3)
+
+    return unfold(dqf, t), unfold(dkf, tkv), unfold(dvf, tkv)

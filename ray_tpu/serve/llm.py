@@ -1,0 +1,236 @@
+"""Continuous-batching LLM engine for TPU serving.
+
+The north-star Serve workload (BASELINE.json: "Serve req/s + p50 TTFT",
+continuous batching).  Requests share a fixed pool of KV-cache slots:
+prefill admits one request into a free slot (bucketed prompt padding keeps
+the compile set small); every engine tick advances ALL active slots one
+token with a single fused `decode_step`.  Admission interleaves with
+decoding — new requests don't wait for the batch to drain (continuous, not
+static, batching).
+
+Use standalone (`LLMEngine`) or as a Serve deployment (`LLMDeployment`) —
+replicas each own an engine; the pow-2 router spreads requests.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("prompt", "max_tokens", "temperature", "out_tokens",
+                 "done", "error", "slot", "submitted_at", "first_token_at")
+
+    def __init__(self, prompt, max_tokens, temperature):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.out_tokens: List[int] = []
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.slot = -1
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+
+
+class LLMEngine:
+    def __init__(self, cfg, params, *, num_slots: int = 8,
+                 max_len: int = 1024, prefill_buckets=(64, 128, 256, 512),
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 max_burst: int = 8):
+        import jax
+
+        from ray_tpu.models.decoding import init_cache, make_engine_fns
+
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.buckets = tuple(b for b in sorted(prefill_buckets)
+                             if b <= max_len)
+        self.eos_id = eos_id
+        # Burst size: decode ticks fused per device call.  EOS is only
+        # checked between bursts, so with an eos_id short bursts trade
+        # throughput for less overshoot; without one there is no waste.
+        self.max_burst = max(1, max_burst if eos_id is None else
+                             min(max_burst, 4))
+        self._jax = jax
+        self._rng = jax.random.key(seed)
+        self.cache = init_cache(cfg, num_slots, max_len)
+        self._prefill, self._decode = make_engine_fns(
+            cfg, num_slots=num_slots, max_len=max_len)
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._slots: List[Optional[_Request]] = [None] * num_slots
+        self._last_tokens = np.zeros((num_slots,), np.int32)
+        self._work = threading.Event()
+        self._stop = False
+        self._lock = threading.Lock()
+        self.stats = {"requests": 0, "tokens_generated": 0,
+                      "ttft_sum": 0.0, "completed": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- public ---------------------------------------------------------
+    def generate(self, prompt_tokens: List[int], *, max_tokens: int = 64,
+                 temperature: float = 0.0,
+                 timeout: Optional[float] = 300) -> List[int]:
+        if len(prompt_tokens) >= self.max_len:
+            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
+        req = _Request(list(prompt_tokens), max_tokens, temperature)
+        self.stats["requests"] += 1
+        self._pending.put(req)
+        self._work.set()
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.out_tokens
+
+    def engine_stats(self) -> Dict[str, Any]:
+        s = dict(self.stats)
+        s["p_ttft_mean"] = (s["ttft_sum"] / s["completed"]
+                            if s["completed"] else None)
+        return s
+
+    def shutdown(self):
+        self._stop = True
+        self._work.set()
+
+    # -- engine loop ----------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_len
+
+    def _free_slot(self) -> int:
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return -1
+
+    def _admit(self) -> bool:
+        import jax.numpy as jnp
+
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        try:
+            req = self._pending.get_nowait()
+        except queue.Empty:
+            return False
+        try:
+            n = len(req.prompt)
+            bucket = self._bucket_for(n)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = req.prompt
+            self.cache, tok, self._rng = self._prefill(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.int32(slot), jnp.int32(n),
+                jnp.float32(req.temperature), self._rng)
+            req.first_token_at = time.perf_counter()
+            req.out_tokens.append(int(tok))
+            req.slot = slot
+            self._slots[slot] = req
+            self._last_tokens[slot] = int(tok)
+            self._maybe_finish(slot)
+        except BaseException as e:  # noqa: BLE001
+            req.error = e
+            req.done.set()
+        return True
+
+    def _maybe_finish(self, slot: int) -> None:
+        req = self._slots[slot]
+        if req is None:
+            return
+        tok = req.out_tokens[-1] if req.out_tokens else None
+        hit_eos = self.eos_id is not None and tok == self.eos_id
+        # Margin of one burst below max_len so a fixed-size burst can never
+        # run the cache past its capacity.
+        full = (len(req.prompt) + len(req.out_tokens)
+                >= self.max_len - 1 - self.max_burst)
+        if hit_eos or full or len(req.out_tokens) >= req.max_tokens:
+            self.stats["completed"] += 1
+            self.stats["ttft_sum"] += (req.first_token_at
+                                       - req.submitted_at)
+            self._slots[slot] = None
+            req.done.set()
+
+    def _loop(self):
+        import jax.numpy as jnp
+
+        while not self._stop:
+            admitted = self._admit()
+            active_mask = np.array([r is not None for r in self._slots])
+            if not active_mask.any():
+                if not admitted:
+                    self._work.wait(timeout=0.05)
+                    self._work.clear()
+                continue
+            try:
+                temps = np.array(
+                    [r.temperature if r else 0.0 for r in self._slots],
+                    np.float32)
+                # Fixed burst size: exactly ONE decode executable (compiles
+                # are expensive, especially via remote-compile).  Slots that
+                # hit max_tokens mid-burst over-generate and are trimmed;
+                # cache overflow is prevented by _maybe_finish's margin.
+                burst = self.max_burst
+                self.cache, tok_mat, self._rng = self._decode(
+                    self.params, self.cache,
+                    jnp.asarray(self._last_tokens),
+                    jnp.asarray(active_mask), jnp.asarray(temps), self._rng,
+                    n_steps=burst)
+                tok_mat = np.asarray(tok_mat)          # (burst, S)
+                for i, req in enumerate(self._slots):
+                    if req is None:
+                        continue
+                    for step in range(burst):
+                        tok = int(tok_mat[step, i])
+                        if len(req.out_tokens) >= req.max_tokens:
+                            break  # over-generated tail: trim
+                        req.out_tokens.append(tok)
+                        self._last_tokens[i] = tok
+                        self.stats["tokens_generated"] += 1
+                        if (self.eos_id is not None
+                                and tok == self.eos_id):
+                            break
+                    self._maybe_finish(i)
+            except BaseException as e:  # noqa: BLE001
+                for i, req in enumerate(self._slots):
+                    if req is not None:
+                        req.error = e
+                        req.done.set()
+                        self._slots[i] = None
+
+
+class LLMDeployment:
+    """Serve-deployable wrapper: __call__({"tokens": [...], ...}) →
+    {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...)."""
+
+    def __init__(self, cfg_name: str, *, num_slots: int = 8,
+                 max_len: int = 512, seed: int = 0,
+                 params_loader: Optional[Callable] = None):
+        import jax
+
+        from ray_tpu.models import configs, init_params
+
+        cfg = configs.get(cfg_name)
+        params = (params_loader() if params_loader
+                  else init_params(jax.random.key(seed), cfg))
+        self.engine = LLMEngine(cfg, params, num_slots=num_slots,
+                                max_len=max_len)
+
+    def __call__(self, request: dict) -> dict:
+        toks = self.engine.generate(
+            request["tokens"],
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)))
+        return {"tokens": toks}
+
+    def stats(self) -> dict:
+        return self.engine.engine_stats()
