@@ -10,14 +10,46 @@ taken from a datasheet — the tunnel TPU delivers a fraction of nominal
 peak, and normalizing to measured peak keeps the ratio meaningful across
 rounds.  vs_baseline > 1.0 beats a 30%-MFU trainer on this hardware.
 
+Robustness (round-1 postmortem: rc=1, no number landed): TPU backend
+availability is probed in a time-boxed subprocess with retries/backoff —
+backend init can HANG (not error) when the TPU tunnel is down.  If the
+probe fails, the bench falls back to the CPU platform so a JSON line
+always lands, with diagnostics in "extra".  Exit code is always 0.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+import traceback
 
 BASELINE_MFU = 0.30
+
+PROBE_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_PROBE_TIMEOUT_S", "120"))
+PROBE_RETRIES = int(os.environ.get("RAY_TPU_BENCH_PROBE_RETRIES", "2"))
+PROBE_BACKOFF_S = float(os.environ.get("RAY_TPU_BENCH_PROBE_BACKOFF_S", "15"))
+
+
+def probe_tpu() -> tuple[bool, str]:
+    """Check TPU backend health in a throwaway subprocess (it may hang).
+
+    TPU-available means actual tpu/axon devices enumerated AND a tiny
+    computation succeeded — a CPU-only jax must not pass, or the big
+    bench config would grind on CPU for hours.
+    """
+    from ray_tpu.core.distributed.resources import run_tpu_probe
+
+    last = ""
+    for attempt in range(PROBE_RETRIES):
+        if attempt:
+            time.sleep(PROBE_BACKOFF_S)
+        count, last = run_tpu_probe(PROBE_TIMEOUT_S, compute=True)
+        if count > 0:
+            return True, last
+    return False, last
 
 
 def flops_per_token(cfg, seq_len: int) -> float:
@@ -51,7 +83,7 @@ def measured_peak_flops() -> float:
     return 8 * 2 * n ** 3 / dt
 
 
-def main() -> None:
+def run_bench(on_tpu: bool, diagnostics: str) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -61,7 +93,6 @@ def main() -> None:
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
-    on_tpu = backend not in ("cpu",)
 
     if on_tpu:
         cfg = configs.BENCH_350M
@@ -100,7 +131,7 @@ def main() -> None:
     baseline_tps_chip = (BASELINE_MFU * peak / fpt if on_tpu
                          else tps_chip)  # smoke: ratio 1
 
-    print(json.dumps({
+    return {
         "metric": f"train_tokens_per_sec_per_chip[{cfg.name}]",
         "value": round(tps_chip, 1),
         "unit": "tokens/s/chip",
@@ -111,9 +142,65 @@ def main() -> None:
                                      else round(peak / 1e12, 1)),
             "mfu_vs_measured_peak": None if mfu != mfu else round(mfu, 4),
             "loss": loss,
+            "tpu_unavailable": None if on_tpu else diagnostics,
         },
-    }))
+    }
+
+
+def force_cpu_platform() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # The container sitecustomize pins jax_platforms to the TPU plugin via
+    # the config API (which beats env vars); override it back. If a backend
+    # was already initialized (mid-run salvage), the cache must be cleared
+    # or the config change has no effect.
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+
+def main() -> None:
+    want_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    if want_cpu:
+        on_tpu, diag = False, "JAX_PLATFORMS=cpu requested"
+    else:
+        on_tpu, diag = probe_tpu()
+    if not on_tpu:
+        force_cpu_platform()
+    try:
+        result = run_bench(on_tpu, diag)
+    except Exception:
+        err = traceback.format_exc()
+        if on_tpu:
+            # TPU path died mid-run (tunnel flake?) — salvage a CPU number.
+            try:
+                force_cpu_platform()
+                result = run_bench(False, f"tpu run failed: {err[-800:]}")
+            except Exception:
+                result = None
+        else:
+            result = None
+        if result is None:
+            result = {
+                "metric": "train_tokens_per_sec_per_chip[failed]",
+                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                "extra": {"error": err[-1500:]},
+            }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    # Contract: one JSON line always lands and rc is always 0 — even if
+    # the probe/platform prologue itself blows up.
+    try:
+        main()
+    except BaseException:  # noqa: BLE001
+        print(json.dumps({
+            "metric": "train_tokens_per_sec_per_chip[failed]",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"error": traceback.format_exc()[-1500:]},
+        }))
+    sys.exit(0)

@@ -1,0 +1,184 @@
+"""TPU accelerator manager: topology detection + fractional-host env setup.
+
+Behavior parity with the reference's TPUAcceleratorManager
+(ref: python/ray/_private/accelerators/tpu.py:75):
+
+- pod type from GKE env (``TPU_ACCELERATOR_TYPE``) or the GCE metadata
+  server (``accelerator-type`` key), validated as ``v{gen}-{count}``
+  (ref: tpu.py:123-141);
+- slice name (``TPU_NAME`` / metadata ``instance-id``) and worker index
+  (``TPU_WORKER_ID`` / metadata ``agent-worker-number``, ref: tpu.py:242-272);
+- per-node extra resources: ``{tpu_name: 1}`` on every host of a slice and
+  ``TPU-{pod_type}-head: 1`` on worker 0 only, so gang jobs can target the
+  slice atomically (ref: tpu.py:336-397);
+- fractional-host chip visibility: exporting ``TPU_VISIBLE_CHIPS`` +
+  ``TPU_CHIPS_PER_HOST_BOUNDS`` + ``TPU_HOST_BOUNDS`` for 1- or 2-chip
+  requests (ref: tpu.py:157-197); valid per-task chip counts {1, 2, 4}
+  (ref: tpu.py:13).
+
+Detection never blocks: env vars are read directly; the metadata server is
+only consulted when ``TPU_SKIP_MDS_QUERY`` is unset, with a short socket
+timeout (this container is zero-egress, so the query is skipped).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+TPU_RESOURCE_NAME = "TPU"
+TPU_VALID_CHIP_COUNTS = (1, 2, 4)
+TPU_CHIPS_PER_HOST = 4
+# v2/v3/v4 pod types count tensorcores (2/chip); v5e+ count chips.
+TPU_VERSIONS_COUNTING_CORES = {"v2", "v3", "v4"}
+
+GKE_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"
+GKE_WORKER_ID_ENV = "TPU_WORKER_ID"
+GKE_TPU_NAME_ENV = "TPU_NAME"
+VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+HOST_BOUNDS_ENV = "TPU_HOST_BOUNDS"
+NOSET_VISIBLE_CHIPS_ENV = "RAY_TPU_NOSET_TPU_VISIBLE_CHIPS"
+
+_POD_TYPE_RE = re.compile(r"^v\d+[a-zA-Z]*-\d+$")
+
+_MDS_URL = "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+
+
+_metadata_cache: Dict[str, Optional[str]] = {}
+
+
+def _metadata(key: str) -> Optional[str]:
+    """GCE instance-metadata lookup: opt-in, bounded, cached.
+
+    Off by default — only a GCE VM has the metadata server, and on any
+    other network the DNS resolution alone can stall daemon startup (the
+    urlopen timeout does not bound it). Enable with
+    ``RAY_TPU_MDS_QUERY=1`` on real GCE TPU VMs; GKE deployments use the
+    env vars and never need it. ``TPU_SKIP_MDS_QUERY`` force-disables.
+    """
+    if os.environ.get("TPU_SKIP_MDS_QUERY"):
+        return None
+    if os.environ.get("RAY_TPU_MDS_QUERY", "").lower() not in ("1", "true"):
+        return None
+    if key in _metadata_cache:
+        return _metadata_cache[key]
+    value = None
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            _MDS_URL + key, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            if resp.status == 200:
+                value = resp.read().decode()
+    except Exception as e:  # noqa: BLE001 — metadata absent off-GCE
+        logger.debug("TPU metadata query %s failed: %s", key, e)
+    _metadata_cache[key] = value
+    return value
+
+
+def is_valid_pod_type(pod_type: str) -> bool:
+    return bool(_POD_TYPE_RE.match(pod_type))
+
+
+def get_pod_type() -> Optional[str]:
+    """Slice pod type, e.g. ``v5e-16`` (GKE env, else GCE metadata)."""
+    pt = os.environ.get(GKE_ACCELERATOR_TYPE_ENV) or _metadata("accelerator-type")
+    if pt and is_valid_pod_type(pt):
+        return pt
+    return None
+
+
+def get_tpu_name() -> Optional[str]:
+    return os.environ.get(GKE_TPU_NAME_ENV) or _metadata("instance-id")
+
+
+def get_worker_id() -> Optional[int]:
+    raw = os.environ.get(GKE_WORKER_ID_ENV) or _metadata("agent-worker-number")
+    try:
+        return int(raw) if raw is not None and raw != "" else None
+    except ValueError:
+        return None
+
+
+def num_hosts_in_pod(pod_type: Optional[str] = None) -> Optional[int]:
+    """Host count of the slice this node belongs to (v4-16 → 2, v5e-16 → 4)."""
+    pod_type = pod_type or get_pod_type()
+    if not pod_type:
+        return None
+    version, _, count = pod_type.partition("-")
+    n = int(count)
+    if version in TPU_VERSIONS_COUNTING_CORES:
+        return max(1, n // (TPU_CHIPS_PER_HOST * 2))
+    return max(1, n // TPU_CHIPS_PER_HOST)
+
+
+def accelerator_version(pod_type: Optional[str] = None) -> Optional[str]:
+    """``TPU-V5E``-style generation label (ref: tpu.py:289-334)."""
+    pod_type = pod_type or get_pod_type()
+    if not pod_type:
+        return None
+    return "TPU-" + pod_type.split("-")[0].upper()
+
+
+def head_resource_name(pod_type: str) -> str:
+    return f"TPU-{pod_type}-head"
+
+
+def tpu_extra_resources(num_chips: int) -> Dict[str, float]:
+    """Slice-gang custom resources for this node (ref: tpu.py:336-397).
+
+    Every host of slice ``my-tpu`` (a v5e-16, say) carries ``{"my-tpu": 1}``;
+    worker 0 additionally carries ``{"TPU-v5e-16-head": 1}``. A gang driver
+    task targets the head resource, discovers the slice name + host count,
+    then fans per-host tasks onto ``{tpu_name: 1, TPU: 4}``.
+    """
+    res: Dict[str, float] = {}
+    pod_type = get_pod_type()
+    name = get_tpu_name()
+    worker_id = get_worker_id()
+    ver = accelerator_version(pod_type)
+    if ver:
+        res[f"accelerator_type:{ver}"] = 1.0
+    if name and pod_type and worker_id is not None:
+        res[name] = 1.0
+        if worker_id == 0:
+            res[head_resource_name(pod_type)] = 1.0
+    return res
+
+
+def validate_chip_request(quantity: float) -> Tuple[bool, Optional[str]]:
+    """Per-task/actor TPU chip counts must tile a host (ref: tpu.py:144-155)."""
+    if quantity in TPU_VALID_CHIP_COUNTS:
+        return True, None
+    return False, (
+        f"Requested TPU={quantity}, which is not a supported per-host chip "
+        f"configuration; supported: {TPU_VALID_CHIP_COUNTS}")
+
+
+def visible_chip_env(chip_ids: List[int]) -> Dict[str, str]:
+    """Env vars that scope a worker process to a subset of the host's chips
+    (ref: tpu.py:157-197). Empty dict when all 4 chips are granted (the
+    runtime's defaults already see the whole host)."""
+    n = len(chip_ids)
+    if n >= TPU_CHIPS_PER_HOST:
+        return {}
+    env = {VISIBLE_CHIPS_ENV: ",".join(str(i) for i in chip_ids)}
+    if n == 1:
+        env[CHIPS_PER_HOST_BOUNDS_ENV] = "1,1,1"
+        env[HOST_BOUNDS_ENV] = "1,1,1"
+    elif n == 2:
+        env[CHIPS_PER_HOST_BOUNDS_ENV] = "1,2,1"
+        env[HOST_BOUNDS_ENV] = "1,1,1"
+    return env
+
+
+def apply_visible_chips(chip_ids: List[int]) -> None:
+    if os.environ.get(NOSET_VISIBLE_CHIPS_ENV):
+        return
+    for k, v in visible_chip_env(chip_ids).items():
+        os.environ[k] = v

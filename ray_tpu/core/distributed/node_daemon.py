@@ -29,7 +29,8 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import ObjectStore
 from ray_tpu.core.distributed import resources as rs
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
-from ray_tpu.core.distributed.scheduler import ClusterView, NodeView, pick_node
+from ray_tpu.core.distributed.scheduler import (
+    ClusterView, NodeView, pick_feasible_node, pick_node)
 
 logger = logging.getLogger(__name__)
 
@@ -143,18 +144,20 @@ class NodeDaemon:
                 logger.debug("heartbeat failed: %s", e)
             await asyncio.sleep(period)
 
+    async def _refresh_view_once(self) -> None:
+        nodes = await self.gcs.call("NodeInfo", "list_nodes", timeout=10)
+        view = ClusterView()
+        for n in nodes:
+            view.nodes[n["node_id"]] = NodeView(
+                node_id=n["node_id"], address=n["address"],
+                total=n["total"], available=n["available"],
+                alive=n["alive"], store_dir=n["store_dir"])
+        self._view = view
+
     async def _refresh_view_loop(self):
         while True:
             try:
-                nodes = await self.gcs.call("NodeInfo", "list_nodes",
-                                            timeout=10)
-                view = ClusterView()
-                for n in nodes:
-                    view.nodes[n["node_id"]] = NodeView(
-                        node_id=n["node_id"], address=n["address"],
-                        total=n["total"], available=n["available"],
-                        alive=n["alive"], store_dir=n["store_dir"])
-                self._view = view
+                await self._refresh_view_once()
             except Exception:  # noqa: BLE001
                 pass
             await asyncio.sleep(1.0)
@@ -307,12 +310,30 @@ class NodeDaemon:
                             "error": f"node {affinity[:8]} not available"}
 
         if not rs.feasible(self.total, demand):
-            # Never runnable here: spill to a feasible node.
-            node = pick_node(self._view, demand, strategy="hybrid")
-            if node is not None and node.node_id != self.node_id:
-                return {"spill_to": node.address}
-            return {"granted": False,
-                    "error": f"no node can satisfy {demand}"}
+            # Never runnable here: spill to a feasible node. If none is in
+            # view yet, wait for one — the cluster may still be forming or
+            # scaling up; the reference queues infeasible tasks rather than
+            # failing them (ref: cluster_task_manager.h:42 infeasible queue).
+            # The wait must end strictly before the client's lease RPC
+            # timeout (same knob) or the error below could never be seen;
+            # the background view refresher (1 Hz) supplies fresh state, so
+            # this loop only re-reads self._view.
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 0.6 * cfg.worker_lease_timeout_ms / 1000.0
+            while True:
+                # A feasible-by-total node takes the request even when busy
+                # right now — its daemon queues the lease until capacity
+                # frees, like the reference's infeasible/waiting queues.
+                node = pick_feasible_node(self._view, demand,
+                                          exclude=self.node_id)
+                if node is not None:
+                    return {"spill_to": node.address}
+                if rs.feasible(self.total, demand):
+                    break  # dynamic resources appeared locally
+                if loop.time() >= deadline:
+                    return {"granted": False,
+                            "error": f"no node can satisfy {demand}"}
+                await asyncio.sleep(0.25)
 
         if rs.fits(self.available, demand):
             rs.subtract(self.available, demand)

@@ -55,11 +55,83 @@ def utilization(total: ResourceSet, available: ResourceSet,
     return worst
 
 
+_tpu_probe_cache: Optional[int] = None
+
+
+def run_tpu_probe(timeout_s: float, compute: bool = False
+                  ) -> "tuple[int, str]":
+    """Time-boxed subprocess probe: (tpu_chip_count, diagnostics).
+
+    Shared by node-resource detection and bench.py. `compute=True` also
+    runs a tiny jit'd add so a wedged-but-enumerable backend is caught.
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "n = sum(1 for d in jax.devices() if d.platform in ('tpu','axon'))\n"
+    )
+    if compute:
+        code += ("import jax.numpy as jnp\n"
+                 "assert float(jnp.ones(()) + 1) == 2.0\n")
+    code += "print('TPUCOUNT=%d' % n)\n"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s)
+        for line in out.stdout.splitlines():
+            if line.startswith("TPUCOUNT="):
+                return int(line.split("=", 1)[1]), out.stdout.strip()
+        return 0, (out.stderr or out.stdout).strip()[-500:]
+    except subprocess.TimeoutExpired:
+        return 0, f"probe timed out after {timeout_s}s (backend init hang)"
+    except (OSError, ValueError) as e:
+        return 0, f"probe failed: {e}"
+
+
+def probe_tpu_count(timeout_s: Optional[float] = None) -> int:
+    """Count local TPU chips WITHOUT ever blocking the caller.
+
+    The reference autodetects chips from GCE metadata / GKE env vars
+    (ref: _private/accelerators/tpu.py:52-230) — a bounded read. Our
+    equivalent has to go through jax backend init, which can hang
+    indefinitely when the TPU runtime/tunnel is unhealthy, so the probe
+    runs `jax.devices()` in a *time-boxed subprocess*: on timeout or
+    error the answer is 0 and the control plane stays alive (a daemon
+    that deadlocks on accelerator detection is not shippable).
+
+    Overrides (checked in order):
+      - RAY_TPU_NUM_TPUS: trust the operator, skip probing.
+      - RAY_TPU_DISABLE_TPU_DETECTION=1: always 0.
+      - JAX_PLATFORMS=cpu in our env: always 0 (test/CI mode).
+    """
+    global _tpu_probe_cache
+    import os
+
+    forced = os.environ.get("RAY_TPU_NUM_TPUS")
+    if forced is not None:
+        return int(float(forced))
+    if os.environ.get("RAY_TPU_DISABLE_TPU_DETECTION", "").lower() in (
+            "1", "true", "yes"):
+        return 0
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return 0
+    if _tpu_probe_cache is not None:
+        return _tpu_probe_cache
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("RAY_TPU_TPU_DETECT_TIMEOUT_S", "30"))
+
+    count, _ = run_tpu_probe(timeout_s)
+    _tpu_probe_cache = count
+    return count
+
+
 def detect_node_resources(num_cpus: Optional[float] = None,
                           num_tpus: Optional[float] = None,
                           memory: Optional[int] = None,
                           custom: Optional[ResourceSet] = None) -> ResourceSet:
-    """Autodetect this host's resources (TPU chips via jax when present —
+    """Autodetect this host's resources (TPU chips via a time-boxed probe —
     the analogue of the reference's TPUAcceleratorManager autodetection,
     ref: _private/accelerators/tpu.py:52-230 which reads GCE/GKE metadata)."""
     import os
@@ -67,15 +139,17 @@ def detect_node_resources(num_cpus: Optional[float] = None,
     res: ResourceSet = {}
     res["CPU"] = float(num_cpus if num_cpus is not None
                        else (os.cpu_count() or 1))
-    if num_tpus is not None:
-        res["TPU"] = float(num_tpus)
-    else:
+    n = float(num_tpus) if num_tpus is not None else float(probe_tpu_count())
+    if n > 0:
+        res["TPU"] = n
+        # Slice-gang resources (TPU-{pod_type}-head etc.) attach whenever
+        # the node has chips — explicit counts included, so operators who
+        # pass --num-tpus on a GKE slice still get gang scheduling.
         try:
-            import jax
+            from ray_tpu.core.distributed.accelerators import (
+                tpu_extra_resources)
 
-            tpus = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
-            if tpus:
-                res["TPU"] = float(len(tpus))
+            res.update(tpu_extra_resources(int(n)))
         except Exception:
             pass
     if memory is None:

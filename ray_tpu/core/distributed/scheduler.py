@@ -98,6 +98,22 @@ def pick_node(
     return rng.choice(fitting[:k])
 
 
+def pick_feasible_node(view: ClusterView, demand: rs.ResourceSet,
+                       exclude: Optional[str] = None) -> Optional[NodeView]:
+    """A node whose TOTAL resources could ever satisfy `demand`, preferring
+    one that fits right now. Used to forward never-runnable-here requests to
+    a node where they can queue (ref: the reference parks infeasible tasks
+    in the owning raylet's queue, cluster_task_manager.h:42)."""
+    candidates = [n for n in view.alive_nodes()
+                  if n.node_id != exclude and rs.feasible(n.total, demand)]
+    if not candidates:
+        return None
+    now = [n for n in candidates if rs.fits(n.available, demand)]
+    pool = now or candidates
+    pool.sort(key=lambda n: rs.utilization(n.total, n.available, demand))
+    return pool[0]
+
+
 # ---------------------------------------------------------------------------
 # Placement group bundle placement (ref: policy/bundle_scheduling_policy.h)
 # ---------------------------------------------------------------------------

@@ -223,7 +223,9 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _pallas_eligible(q, k) -> bool:
-    on_tpu = pltpu is not None and jax.default_backend() == "tpu"
+    # The tunneled-TPU PJRT plugin may report its platform as "axon";
+    # jax canonicalizes it to tpu for lowering, so both count as TPU here.
+    on_tpu = pltpu is not None and jax.default_backend() in ("tpu", "axon")
     t, tkv = q.shape[1], k.shape[1]
     return (on_tpu and t >= 128 and tkv >= 128
             and t % 128 == 0 and tkv % 128 == 0)

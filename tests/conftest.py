@@ -10,6 +10,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Subprocesses (GCS server, node daemons, workers) re-run the container's
+# sitecustomize, which re-registers the real-TPU plugin and OVERRIDES
+# JAX_PLATFORMS via jax.config — any jax.devices() in a child then hangs
+# forever when the TPU tunnel is down. Dropping the trigger env var makes
+# children honor JAX_PLATFORMS=cpu. (Round-1 postmortem: 52 tests hung here.)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 # The container's sitecustomize pre-imports jax._src with JAX_PLATFORMS=axon
 # (real-TPU tunnel) already captured; override via the config API too.
