@@ -8,6 +8,7 @@ Tensors ride as fixed-shape-list columns and convert to stacked ndarrays.
 """
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
@@ -46,7 +47,7 @@ def from_batch(batch: Any) -> Block:
         t = pa.table(dict(zip(names, cols)))
         if meta:
             t = t.replace_schema_metadata(
-                {TENSOR_META_KEY: repr(meta).encode()})
+                {TENSOR_META_KEY: json.dumps(meta).encode()})
         return t
     raise TypeError(f"cannot build a block from {type(batch).__name__}")
 
@@ -60,12 +61,31 @@ def from_rows(rows: List[Any]) -> Block:
 
 
 def _tensor_shapes(block: Block) -> Dict[str, tuple]:
+    # Schema metadata survives round-trips through external files
+    # (read_parquet preserves it), so it is attacker-controlled input:
+    # strict JSON only, never eval.
     meta = (block.schema.metadata or {}).get(TENSOR_META_KEY)
     if not meta:
         return {}
-    d = eval(meta.decode(), {"__builtins__": {}})  # trusted: we wrote it
-    return {k.rsplit(".shape", 1)[0]: tuple(int(x) for x in v.split(","))
-            for k, v in d.items()}
+    try:
+        d = json.loads(meta.decode())
+    except (ValueError, UnicodeDecodeError):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "unparseable %s metadata (%.60r...): tensor columns will come "
+            "back flat", TENSOR_META_KEY.decode(), meta)
+        return {}
+    if not isinstance(d, dict):
+        return {}
+    out = {}
+    for k, v in d.items():
+        try:
+            out[str(k).rsplit(".shape", 1)[0]] = tuple(
+                int(x) for x in str(v).split(","))
+        except ValueError:
+            continue
+    return out
 
 
 def to_numpy(block: Block) -> Dict[str, np.ndarray]:

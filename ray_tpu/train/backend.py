@@ -10,22 +10,18 @@ in-graph with no framework involvement.
 """
 from __future__ import annotations
 
-import socket
 from typing import Dict, Optional
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 class Backend:
-    """Hook interface (ref: train/backend.py BackendConfig/Backend split)."""
+    """Hook interface (ref: train/backend.py BackendConfig/Backend split).
 
-    def master_env(self, master_ip: str) -> Dict[str, str]:
+    `master_env` receives rank-0's (ip, port) with the port probed on
+    rank-0's own host (WorkerGroup.master_addr) — a port free on the
+    driver may be taken on the worker's host.
+    """
+
+    def master_env(self, master_ip: str, master_port: int) -> Dict[str, str]:
         return {}
 
     def on_start(self, rank: int, world_size: int,
@@ -39,8 +35,8 @@ class Backend:
 class JaxBackend(Backend):
     """jax.distributed coordination across gang workers (multi-host SPMD)."""
 
-    def master_env(self, master_ip: str) -> Dict[str, str]:
-        return {"RTPU_JAX_COORDINATOR": f"{master_ip}:{_free_port()}"}
+    def master_env(self, master_ip: str, master_port: int) -> Dict[str, str]:
+        return {"RTPU_JAX_COORDINATOR": f"{master_ip}:{master_port}"}
 
     def on_start(self, rank, world_size, master_env) -> None:
         if world_size <= 1:
@@ -66,8 +62,8 @@ class TorchBackend(Backend):
     (ref: train/torch/config.py:156-162 backend choice; TPU path has no
     NCCL — torch here is for CPU-side preprocessing / baselines)."""
 
-    def master_env(self, master_ip: str) -> Dict[str, str]:
-        return {"MASTER_ADDR": master_ip, "MASTER_PORT": str(_free_port())}
+    def master_env(self, master_ip: str, master_port: int) -> Dict[str, str]:
+        return {"MASTER_ADDR": master_ip, "MASTER_PORT": str(master_port)}
 
     def on_start(self, rank, world_size, master_env) -> None:
         import os

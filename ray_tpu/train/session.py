@@ -36,7 +36,25 @@ class TrainSession:
         self.dataset_shards = dataset_shards or {}
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
-        self._ckpt_seq = 0
+        # Seed past any checkpoints a previous (failed) attempt persisted:
+        # restarting from 0 would re-target checkpoint_000001... and mix
+        # stale files into — or clobber — the dir we may be restoring from.
+        self._ckpt_seq = self._existing_ckpt_max()
+
+    def _existing_ckpt_max(self) -> int:
+        try:
+            names = os.listdir(self.trial_dir)
+        except OSError:
+            return 0
+        best = 0
+        for name in names:
+            if not name.startswith("checkpoint_"):
+                continue
+            try:
+                best = max(best, int(name.rsplit("_", 1)[1]))
+            except ValueError:
+                continue  # stray entry (tmp dirs etc.) — skip, don't reset
+        return best
 
     # -- user-facing ----------------------------------------------------
     def report(self, metrics: Dict[str, Any],
@@ -47,7 +65,10 @@ class TrainSession:
             dest = os.path.join(self.trial_dir,
                                 f"checkpoint_{self._ckpt_seq:06d}")
             if os.path.abspath(checkpoint.path) != dest:
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                # Fresh dir: copytree(dirs_exist_ok=True) would only
+                # overwrite same-named files, leaving stale orbax leftovers.
+                shutil.rmtree(dest, ignore_errors=True)
+                shutil.copytree(checkpoint.path, dest)
             persisted = dest
             self.latest_checkpoint = Checkpoint(persisted)
         self.results.put({"metrics": dict(metrics), "checkpoint": persisted})

@@ -56,14 +56,18 @@ class DataParallelTrainer:
             return None
         shards = {}
         for name, ds in self.datasets.items():
-            split = getattr(ds, "split_at", None) or getattr(ds, "split", None)
+            split = getattr(ds, "split", None)
             if callable(split):
-                try:
-                    shards[name] = ds.split(world)[rank]
-                    continue
-                except Exception:  # noqa: BLE001
-                    pass
-            shards[name] = ds  # unsplittable: every worker sees the whole
+                # No silent fallback: a failed split would hand every
+                # DP worker the FULL dataset — duplicated data quietly
+                # changes effective epochs/statistics. Fail loudly.
+                shards[name] = split(world)[rank]
+            else:
+                logger.warning(
+                    "dataset %r has no split(); replicating it to all %d "
+                    "workers (data-parallel ranks will see duplicate data)",
+                    name, world)
+                shards[name] = ds
         return shards
 
     def fit(self) -> Result:
@@ -86,7 +90,7 @@ class DataParallelTrainer:
                 from ray_tpu.train.backend import resolve_backend
 
                 master_env = resolve_backend(self.backend_name).master_env(
-                    group.master_ip())
+                    *group.master_addr())
                 group.start_all(self._fn, self._config, master_env,
                                 latest_ckpt, self._shard_fn)
                 last_metrics, latest_ckpt, history_part = self._drain(group)

@@ -43,6 +43,18 @@ class TrainWorker:
 
         return socket.gethostbyname(socket.gethostname())
 
+    def get_address_and_port(self) -> "tuple[str, int]":
+        """IP + a free port, probed ON this worker's host — a port free on
+        the driver may be taken on rank-0's host (reference pattern:
+        get_address_and_port runs on the worker)."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return socket.gethostbyname(socket.gethostname()), port
+
     def start_loop(self, fn: Callable, config: Optional[dict],
                    master_env: Dict[str, str],
                    latest_checkpoint: Optional[str],
@@ -111,6 +123,11 @@ class WorkerGroup:
 
     def master_ip(self) -> str:
         return ray_tpu.get(self.workers[0].get_ip.remote())
+
+    def master_addr(self) -> "tuple[str, int]":
+        """Rank-0's (ip, free-port), probed on rank-0's own host."""
+        return tuple(ray_tpu.get(
+            self.workers[0].get_address_and_port.remote()))
 
     def start_all(self, fn, config, master_env, latest_checkpoint,
                   shard_fn=None) -> None:

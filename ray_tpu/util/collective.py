@@ -47,15 +47,12 @@ class CollectiveGroup:
         # of *collective* ops (standard contract), while p2p pairs advance
         # independently of collectives and of other pairs.
         self._seqs = defaultdict(int)
+        # Per-op GC watermark: lowest seq whose payload is not yet reclaimed.
+        self._gc_marks: Dict[str, int] = {}
 
     def _next_seq(self, op: str) -> int:
         s = self._seqs[op]
         self._seqs[op] += 1
-        # Lazy GC: by the time any rank issues seq s, every rank has issued
-        # s-1 (it read all s-1 keys), hence finished reading s-2 — deleting
-        # our own s-2 key is safe and bounds KV growth to 2 generations.
-        if s >= 2:
-            _kv().kv_del(b"collective", self._key(op, s - 2, self.rank))
         return s
 
     # -- kv plumbing ----------------------------------------------------
@@ -84,8 +81,16 @@ class CollectiveGroup:
     def _gather_all(self, op: str, value: Any, timeout: float) -> List[Any]:
         seq = self._next_seq(op)
         self._put(op, seq, self.rank, value)
-        return [self._get(op, seq, r, timeout)
-                for r in range(self.world_size)]
+        out = [self._get(op, seq, r, timeout)
+               for r in range(self.world_size)]
+        # Lazy GC — sound ONLY for gather-style ops, where issuing seq s
+        # proves the issuer finished reading s-1: having read all seq-s
+        # keys, every peer must have published s, hence finished reading
+        # s-1, so deleting our own s-1 key is safe. (broadcast/send have
+        # no such barrier; they clean up differently below.)
+        if seq >= 1:
+            _kv().kv_del(b"collective", self._key(op, seq - 1, self.rank))
+        return out
 
     # -- collectives ----------------------------------------------------
     def allgather(self, value, timeout: float = 60.0) -> List[Any]:
@@ -115,11 +120,42 @@ class CollectiveGroup:
 
     def broadcast(self, arr, *, src_rank: int = 0,
                   timeout: float = 60.0) -> np.ndarray:
+        # The source never waits for receivers, so it may NOT delete old
+        # payloads on a fixed lag — a burst of broadcasts would outrun a
+        # slow receiver and strand it polling a deleted key. Receivers ack
+        # each read; the source reclaims a payload only once every peer's
+        # ack for it is present.
         seq = self._next_seq("bc")
         if self.rank == src_rank:
             self._put("bc", seq, src_rank, np.asarray(arr))
+            self._gc_acked("bc", seq)
             return np.asarray(arr)
-        return self._get("bc", seq, src_rank, timeout)
+        value = self._get("bc", seq, src_rank, timeout)
+        self._put("bc_ack", seq, self.rank, True)
+        return value
+
+    def _gc_acked(self, op: str, cur_seq: int) -> None:
+        """Source-side cleanup: delete payloads whose acks are complete.
+
+        A watermark (lowest un-collected seq) advances monotonically, so
+        every seq is eventually revisited — no leak behind a laggard —
+        and the common case (all caught up) costs world_size kv_gets for
+        exactly one seq, not a window scan.
+        """
+        kv = _kv()
+        mark = self._gc_marks.get(op, 0)
+        while mark < cur_seq:
+            acked = all(
+                kv.kv_get(b"collective", self._key(f"{op}_ack", mark, r))
+                is not None
+                for r in range(self.world_size) if r != self.rank)
+            if not acked:
+                break  # retry from here on the next broadcast
+            kv.kv_del(b"collective", self._key(op, mark, self.rank))
+            for r in range(self.world_size):
+                kv.kv_del(b"collective", self._key(f"{op}_ack", mark, r))
+            mark += 1
+        self._gc_marks[op] = mark
 
     def reducescatter(self, arr, op: str = "sum",
                       timeout: float = 60.0) -> np.ndarray:
@@ -134,8 +170,12 @@ class CollectiveGroup:
         self._put(op, self._next_seq(op), self.rank, np.asarray(arr))
 
     def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
+        # Single consumer: the receiver deletes the key it just read.
         op = f"p2p{src_rank}to{self.rank}"
-        return self._get(op, self._next_seq(op), src_rank, timeout)
+        seq = self._next_seq(op)
+        value = self._get(op, seq, src_rank, timeout)
+        _kv().kv_del(b"collective", self._key(op, seq, src_rank))
+        return value
 
 
 def init_collective_group(world_size: int, rank: int,

@@ -14,11 +14,11 @@ def ray_cluster():
 
 @ray_tpu.remote
 class Rank:
-    def __init__(self, rank, world):
+    def __init__(self, rank, world, group_name="test"):
         from ray_tpu.util import collective
 
         self.g = collective.init_collective_group(world, rank,
-                                                  group_name="test")
+                                                  group_name=group_name)
 
     def do_allreduce(self, x):
         return self.g.allreduce(np.asarray(x, dtype=np.float64))
@@ -37,6 +37,23 @@ class Rank:
             self.g.send(np.asarray(value), peer)
             return None
         return self.g.recv(peer)
+
+    def do_broadcast_burst(self, n):
+        return [self.g.broadcast(np.asarray([i]), src_rank=0)[0]
+                for i in range(n)]
+
+    def do_send_burst(self, peer, n):
+        for i in range(n):
+            self.g.send(np.asarray([i]), peer)
+
+    def do_recv_burst(self, peer, n, delay=0.0):
+        import time
+
+        out = []
+        for _ in range(n):
+            time.sleep(delay)
+            out.append(self.g.recv(peer)[0])
+        return out
 
 
 def test_allreduce_and_allgather():
@@ -68,3 +85,26 @@ def test_send_recv():
     recv_ref = ranks[1].do_sendrecv.remote(0)  # rank1 recv from rank0
     ray_tpu.get(ranks[0].do_sendrecv.remote(1, value=[7, 8, 9]))
     np.testing.assert_array_equal(ray_tpu.get(recv_ref), [7, 8, 9])
+
+
+def test_broadcast_burst_slow_receiver():
+    """Regression: a source issuing many broadcasts back-to-back must not
+    GC payloads a slow receiver hasn't read yet (round-1 advisor finding:
+    lazy seq-2 deletion lost messages for non-blocking ops)."""
+    world, n = 2, 8
+    ranks = [Rank.options(name=f"bb{r}").remote(r, world, "bburst")
+             for r in range(world)]
+    src = ranks[0].do_broadcast_burst.remote(n)  # fires all n immediately
+    slow = ranks[1].do_broadcast_burst.remote(n)
+    assert ray_tpu.get(src) == list(range(n))
+    assert ray_tpu.get(slow) == list(range(n))
+
+
+def test_send_burst_slow_receiver():
+    world, n = 2, 8
+    ranks = [Rank.options(name=f"sb{r}").remote(r, world, "sburst")
+             for r in range(world)]
+    send = ranks[0].do_send_burst.remote(1, n)
+    recv = ranks[1].do_recv_burst.remote(0, n, 0.05)
+    ray_tpu.get(send)
+    assert ray_tpu.get(recv) == list(range(n))
