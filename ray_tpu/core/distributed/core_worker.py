@@ -90,6 +90,14 @@ class DistributedCoreWorker:
         # ---- pending tasks (futures resolve when reply arrives) ----
         self._pending_objects: Dict[ObjectID, Future] = {}
 
+        # ---- lineage: task specs retained for owned task returns so a
+        # lost object can be recomputed by resubmitting its creating task
+        # (ref: task_manager.h:208 TaskResubmissionInterface,
+        # object_recovery_manager.h:41). FIFO-capped like the reference's
+        # lineage byte cap (ray_config_def.h:158).
+        self._lineage: Dict[ObjectID, dict] = {}
+        self._lineage_order: List[ObjectID] = []
+
         # ---- function table cache ----
         self._exported_fns: set = set()
         self._fn_cache: Dict[bytes, Any] = {}
@@ -120,6 +128,7 @@ class DistributedCoreWorker:
                 return
             if n <= 1:
                 del self._refcounts[ref.id()]
+                self._lineage.pop(ref.id(), None)
                 if ref.id() in self._owned:
                     self._owned.discard(ref.id())
                     self._inline_cache.pop(ref.id(), None)
@@ -210,15 +219,19 @@ class DistributedCoreWorker:
                     raise rexc.GetTimeoutError(ref.hex()) from None
                 continue
             # 4) remote fetch via directory
-            payload = self._try_pull_remote(oid)
-            if payload is not None:
+            pulled, num_locations = self._try_pull_remote(oid)
+            if pulled:
                 continue  # now in local store
+            # 5) object lost (no copies anywhere): lineage reconstruction
+            if num_locations == 0 and self._maybe_reconstruct(oid):
+                continue
             if deadline is not None and time.monotonic() >= deadline:
                 raise rexc.GetTimeoutError(ref.hex())
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.05)
 
-    def _try_pull_remote(self, oid: ObjectID) -> Optional[bool]:
+    def _try_pull_remote(self, oid: ObjectID) -> Tuple[bool, int]:
+        """Returns (pulled_into_local_store, directory_location_count)."""
         info = self.gcs.call("ObjectDirectory", "get_locations",
                              object_id=oid.binary(), timeout=30)
         for node in info["nodes"]:
@@ -234,8 +247,77 @@ class DistributedCoreWorker:
                     self.store.put_raw(oid, data)
                 except Exception:  # noqa: BLE001 already raced in
                     pass
-                return True
-        return None
+                return True, len(info["nodes"])
+        return False, len(info["nodes"])
+
+    # ------------------------------------------------------------------
+    # lineage reconstruction (ref: object_recovery_manager.h:41 — the owner
+    # resubmits the creating task when all copies of an object are lost)
+    # ------------------------------------------------------------------
+    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
+        """Resubmit the creating task of a lost owned object. Returns True
+        if a reconstruction ran (caller should re-check the store)."""
+        with self._lock:
+            entry = self._lineage.get(oid)
+            if entry is None:
+                return False
+            fut = entry["fut"]
+            if fut is None:
+                if entry["attempts"] >= entry["max_attempts"]:
+                    raise rexc.ObjectReconstructionFailedError(
+                        f"object {oid.hex()[:8]} lost and reconstruction "
+                        f"failed after {entry['attempts']} attempts")
+                entry["attempts"] += 1
+                entry["fut"] = fut = Future()
+                is_runner = True
+            else:
+                is_runner = False
+        if not is_runner:
+            fut.result()  # piggyback on the in-flight reconstruction
+            return True
+        logger.info("reconstructing lost object %s (attempt %d)",
+                    oid.hex()[:8], entry["attempts"])
+        try:
+            self._reconstruct_entry(entry)
+            fut.set_result(None)
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+            # Surface the failure to THIS caller; other waiters get it
+            # via the future. Next get() retries with a fresh attempt.
+            raise
+        finally:
+            with self._lock:
+                entry["fut"] = None
+        return True
+
+    def _reconstruct_entry(self, entry: dict) -> None:
+        # Recursively restore missing dependencies first (depth-first, like
+        # the reference's recursive recovery of task args).
+        for dep in entry["deps"]:
+            dep_oid = ObjectID(dep)
+            if self.store.contains(dep_oid):
+                continue
+            payload = self._inline_cache.get(dep_oid)
+            if payload is not None:
+                # Owner still holds the bytes: re-seed the store/directory.
+                try:
+                    self.store.put_raw(dep_oid, payload)
+                    self.gcs.call("ObjectDirectory", "add_location",
+                                  object_id=dep, node_id=self.node_id,
+                                  size=len(payload), timeout=30)
+                    continue
+                except Exception:  # noqa: BLE001
+                    pass
+            info = self.gcs.call("ObjectDirectory", "get_locations",
+                                 object_id=dep, timeout=30)
+            if not info["nodes"]:
+                self._maybe_reconstruct(dep_oid)
+        spec = entry["spec"]
+        spec["attempt"] = spec.get("attempt", 0) + 1
+        reply = self._lease_and_push(spec, entry["demand"], entry["sched"])
+        for r in reply["results"]:
+            if r.inline is not None:
+                self._cache_inline(ObjectID(r.oid), r.inline)
 
     def _pull_from(self, address: str, oid: ObjectID) -> Optional[bytes]:
         async def pull():
@@ -420,6 +502,19 @@ class DistributedCoreWorker:
                      "name": options.name
                      or getattr(func, "__qualname__", "task")},
         )
+
+        if options.max_retries > 0:
+            with self._lock:
+                entry = {"spec": spec, "demand": demand, "sched": sched,
+                         "deps": deps, "attempts": 0, "fut": None,
+                         "max_attempts": max(1, options.max_retries),
+                         "return_ids": list(return_ids)}
+                for oid in return_ids:
+                    self._lineage[oid] = entry
+                    self._lineage_order.append(oid)
+                while len(self._lineage_order) > 20000:
+                    old = self._lineage_order.pop(0)
+                    self._lineage.pop(old, None)
 
         t = threading.Thread(
             target=self._run_task_to_completion,

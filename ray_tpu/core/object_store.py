@@ -149,8 +149,34 @@ class SharedBuffer:
             pass
 
 
+class FileBuffer:
+    """Read-only view over a spilled object file (API-compatible subset of
+    SharedBuffer). The OS page cache makes repeat reads cheap."""
+
+    def __init__(self, mm: mmap.mmap, size: int):
+        self._mm = mm
+        self.size = size
+        self.view = memoryview(mm)[:size]
+
+    def release(self) -> None:
+        try:
+            self.view.release()
+            self._mm.close()
+        except BufferError:
+            pass  # numpy views still alive; mmap closes when they drop
+
+
 class ObjectStore:
-    """One connection to the node-local shm store."""
+    """One connection to the node-local shm store.
+
+    When the shm arena is full even after LRU eviction (everything pinned),
+    puts overflow to per-object files under `<directory>/spill/` — the
+    plasma fallback-allocation/spill equivalent (ref: src/ray/raylet/
+    local_object_manager.h:41 spill/restore, plasma fallback allocator).
+    Reads fall back to the spill directory transparently; since every
+    process on a node shares `directory`, spilled objects stay visible to
+    the daemon's transfer path and to co-located workers.
+    """
 
     def __init__(self, directory: str, capacity: int = 0,
                  num_slots: int = 65536):
@@ -160,11 +186,54 @@ class ObjectStore:
             capacity = int(psutil.virtual_memory().total * 0.3)
         self.directory = directory
         self.capacity = capacity
+        self.spill_dir = os.path.join(directory, "spill")
         handle = get_lib().rts_connect(directory.encode(), capacity, num_slots)
         if not handle:
             raise RuntimeError(f"Failed to connect to object store at "
                                f"{directory}")
         self._state = _StoreState(handle)
+
+    # -- spill plumbing -------------------------------------------------
+    def _spill_path(self, oid: ObjectID) -> str:
+        return os.path.join(self.spill_dir, oid.hex())
+
+    def _spill_write(self, oid: ObjectID, write_fn, size: int) -> int:
+        """Atomically create a spill file via tmp+rename (rename is the seal:
+        readers never observe a partial object)."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = self._spill_path(oid)
+        if os.path.exists(path):
+            raise ObjectExistsError(oid.hex())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w+b") as f:
+                if size:
+                    f.truncate(size)
+                    with mmap.mmap(f.fileno(), size) as mm:
+                        view = memoryview(mm)
+                        write_fn(view)
+                        view.release()
+            os.rename(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return size
+
+    def _spill_read(self, oid: ObjectID) -> Optional[FileBuffer]:
+        path = self._spill_path(oid)
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return None
+        with f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                return FileBuffer(mmap.mmap(-1, 1), 0)
+            mm = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        return FileBuffer(mm, size)
 
     @property
     def _handle(self):
@@ -179,12 +248,17 @@ class ObjectStore:
         fd = ctypes.c_int(-1)
         rc = lib.rts_create(self._handle, oid.binary(), size,
                             ctypes.byref(fd))
+        if rc == RTS_ERR_FULL:
+            lib.rts_evict(self._handle, size)
+            rc = lib.rts_create(self._handle, oid.binary(), size,
+                                ctypes.byref(fd))
         if rc == RTS_ERR_EXISTS:
             raise ObjectExistsError(oid.hex())
         if rc == RTS_ERR_FULL:
-            raise ObjectStoreFullError(
-                f"object of {size} bytes does not fit "
-                f"(used {self.used}/{self.capacity})")
+            # Everything in shm is pinned: overflow this object to disk.
+            return self._spill_write(
+                oid, lambda view: serialization.write_to(view, meta, buffers),
+                size)
         if rc != RTS_OK:
             raise RuntimeError(f"rts_create failed: {rc}")
         try:
@@ -214,10 +288,17 @@ class ObjectStore:
         size = len(data)
         rc = lib.rts_create(self._handle, oid.binary(), size,
                             ctypes.byref(fd))
+        if rc == RTS_ERR_FULL:
+            lib.rts_evict(self._handle, size)
+            rc = lib.rts_create(self._handle, oid.binary(), size,
+                                ctypes.byref(fd))
         if rc == RTS_ERR_EXISTS:
             raise ObjectExistsError(oid.hex())
         if rc == RTS_ERR_FULL:
-            raise ObjectStoreFullError(str(size))
+            def copy(view):
+                view[:size] = data
+
+            return self._spill_write(oid, copy, size)
         if rc != RTS_OK:
             raise RuntimeError(f"rts_create failed: {rc}")
         try:
@@ -243,7 +324,7 @@ class ObjectStore:
         rc = lib.rts_get(self._handle, oid.binary(), ctypes.byref(size),
                          ctypes.byref(fd))
         if rc == RTS_ERR_NOT_FOUND:
-            return None
+            return self._spill_read(oid)
         if rc != RTS_OK:
             raise RuntimeError(f"rts_get failed: {rc}")
         try:
@@ -263,11 +344,28 @@ class ObjectStore:
 
     # -- management -----------------------------------------------------
     def contains(self, oid: ObjectID) -> bool:
-        return bool(get_lib().rts_contains(self._handle, oid.binary()))
+        if get_lib().rts_contains(self._handle, oid.binary()):
+            return True
+        return os.path.exists(self._spill_path(oid))
 
     def delete(self, oid: ObjectID, force: bool = False) -> bool:
-        return get_lib().rts_delete(self._handle, oid.binary(),
-                                    1 if force else 0) == RTS_OK
+        ok = get_lib().rts_delete(self._handle, oid.binary(),
+                                  1 if force else 0) == RTS_OK
+        try:
+            os.unlink(self._spill_path(oid))
+            ok = True
+        except OSError:
+            pass
+        return ok
+
+    @property
+    def spilled_bytes(self) -> int:
+        try:
+            with os.scandir(self.spill_dir) as it:
+                return sum(e.stat().st_size for e in it
+                           if e.is_file() and ".tmp." not in e.name)
+        except FileNotFoundError:
+            return 0
 
     def evict(self, nbytes: int) -> int:
         return get_lib().rts_evict(self._handle, nbytes)

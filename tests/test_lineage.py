@@ -1,0 +1,164 @@
+"""Lineage reconstruction + object spilling.
+
+Round-2 VERDICT item 2. Reference semantics: the owner resubmits the
+creating task when all copies of an object are lost (ref:
+src/ray/core_worker/task_manager.h:208 TaskResubmissionInterface,
+object_recovery_manager.h:41); plasma spills to disk when the shm arena
+fills (ref: src/ray/raylet/local_object_manager.h:41).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectStore
+
+
+# ---------------------------------------------------------------------------
+# spilling (no cluster needed)
+# ---------------------------------------------------------------------------
+
+def test_put_burst_past_capacity_spills_and_restores(tmp_path):
+    store = ObjectStore(str(tmp_path / "store"), capacity=1 << 20)  # 1 MiB
+    blob = os.urandom(300 * 1024)
+    oids, pins = [], []
+    for _ in range(8):  # 2.4 MB of pinned objects into a 1 MiB arena
+        oid = ObjectID.from_random()
+        store.put_raw(oid, blob)
+        pins.append(store.get_buffer(oid))  # pin: LRU eviction can't help
+        oids.append(oid)
+    assert store.spilled_bytes > 0
+    # Every object — shm-resident or spilled — reads back intact.
+    for oid in oids:
+        assert store.contains(oid)
+        buf = store.get_buffer(oid)
+        assert bytes(buf.view) == blob
+        buf.release()
+    for b in pins:
+        b.release()
+    for oid in oids:
+        assert store.delete(oid, force=True)
+    assert store.spilled_bytes == 0
+    store.disconnect()
+
+
+def test_spilled_empty_and_serialized_objects(tmp_path):
+    store = ObjectStore(str(tmp_path / "store2"), capacity=1 << 20)
+    filler = ObjectID.from_random()
+    store.put_raw(filler, os.urandom(900 * 1024))
+    pin = store.get_buffer(filler)
+    # serialize path (numpy out-of-band buffers) through the spill branch
+    arr = np.arange(100_000, dtype=np.float64)
+    oid = ObjectID.from_random()
+    store.put(oid, {"x": arr, "tag": "spilled"})
+    assert store.spilled_bytes > 0
+    value, buf = store.get(oid)
+    np.testing.assert_array_equal(value["x"], arr)
+    assert value["tag"] == "spilled"
+    buf.release()
+    pin.release()
+    store.disconnect()
+
+
+# ---------------------------------------------------------------------------
+# lineage reconstruction (fake two-node cluster)
+# ---------------------------------------------------------------------------
+
+_FAST_FAILURE_ENV = {
+    "RAY_TPU_HEALTH_CHECK_INITIAL_DELAY_MS": "500",
+    "RAY_TPU_HEALTH_CHECK_PERIOD_MS": "300",
+    "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD": "3",
+}
+
+
+@pytest.fixture()
+def recon_cluster():
+    saved = {k: os.environ.get(k) for k in _FAST_FAILURE_ENV}
+    os.environ.update(_FAST_FAILURE_ENV)
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    second = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    cluster.wait_for_nodes(2)
+    yield cluster, second
+    cluster.shutdown()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _wait_single_alive(timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1:
+            return
+        time.sleep(0.2)
+    raise TimeoutError("node death not detected")
+
+
+def test_lost_object_is_reconstructed(recon_cluster):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster, second = recon_cluster
+    on_second = NodeAffinitySchedulingStrategy(second.node_id, soft=True)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def produce():
+        # > max_inline_object_size (100 KiB): lives only in node 2's store.
+        return np.full(300_000, 7.0)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def peek(arr):
+        return float(arr[0])
+
+    ref = produce.remote()
+    # Verify on node 2 itself so the driver never caches a local copy.
+    assert ray_tpu.get(peek.remote(ref), timeout=120) == 7.0
+
+    cluster.remove_node(second)
+    _wait_single_alive()
+
+    # The only copy died with node 2 — get() must resubmit produce()
+    # (soft affinity falls back to the surviving node).
+    arr = ray_tpu.get(ref, timeout=120)
+    np.testing.assert_array_equal(arr, np.full(300_000, 7.0))
+
+
+def test_recursive_dependency_reconstruction(recon_cluster):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster, second = recon_cluster
+    on_second = NodeAffinitySchedulingStrategy(second.node_id, soft=True)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def base():
+        return np.arange(200_000, dtype=np.float64)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def double(arr):
+        return arr * 2.0
+
+    b = base.remote()
+    d = double.remote(b)
+    # Force materialization on node 2 (both outputs live only there).
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def peek(arr):
+        return float(arr[1])
+
+    assert ray_tpu.get(peek.remote(d), timeout=120) == 2.0
+
+    cluster.remove_node(second)
+    _wait_single_alive()
+
+    # Recovering `d` requires first recovering its lost dependency `b`.
+    out = ray_tpu.get(d, timeout=120)
+    np.testing.assert_array_equal(out, np.arange(200_000) * 2.0)

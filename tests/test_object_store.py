@@ -8,11 +8,7 @@ import numpy as np
 import pytest
 
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.object_store import (
-    ObjectExistsError,
-    ObjectStore,
-    ObjectStoreFullError,
-)
+from ray_tpu.core.object_store import ObjectExistsError, ObjectStore
 
 
 @pytest.fixture
@@ -86,15 +82,21 @@ def test_pinned_objects_not_evicted(store):
     buf.release()
 
 
-def test_store_full_when_all_pinned(store):
+def test_store_full_when_all_pinned_spills(store):
+    # Round 2: a put that can't fit even after eviction overflows to the
+    # spill directory instead of failing (ref: local_object_manager.h:41).
     big = np.zeros(30 * 1024 * 1024, dtype=np.uint8)
     bufs = []
     for _ in range(2):
         oid = ObjectID.from_random()
         store.put(oid, big)
         bufs.append(store.get(oid)[1])
-    with pytest.raises(ObjectStoreFullError):
-        store.put(ObjectID.from_random(), big)
+    overflow = ObjectID.from_random()
+    store.put(overflow, big)
+    assert store.spilled_bytes >= big.nbytes
+    value, buf = store.get(overflow)
+    np.testing.assert_array_equal(value, big)
+    buf.release()
     for b in bufs:
         b.release()
 
