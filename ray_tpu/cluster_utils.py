@@ -42,12 +42,17 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
-                 object_store_memory: int = 256 * 1024 * 1024) -> NodeHandle:
+                 object_store_memory: int = 256 * 1024 * 1024,
+                 env: Optional[Dict[str, str]] = None) -> NodeHandle:
+        """`env` seeds the daemon's environment — e.g. TPU_ACCELERATOR_TYPE/
+        TPU_NAME/TPU_WORKER_ID to fake a host of a TPU slice (the reference
+        fakes slices the same way in tpu accelerator tests)."""
         proc, info = start_node_daemon_process(
             self.gcs_address, num_cpus=num_cpus,
             num_tpus=num_tpus if num_tpus else 0,
             resources=resources,
-            object_store_memory=object_store_memory)
+            object_store_memory=object_store_memory,
+            extra_env=env)
         handle = NodeHandle(proc, info)
         self.nodes.append(handle)
         return handle

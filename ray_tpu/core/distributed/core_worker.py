@@ -93,10 +93,13 @@ class DistributedCoreWorker:
         # ---- lineage: task specs retained for owned task returns so a
         # lost object can be recomputed by resubmitting its creating task
         # (ref: task_manager.h:208 TaskResubmissionInterface,
-        # object_recovery_manager.h:41). FIFO-capped like the reference's
-        # lineage byte cap (ray_config_def.h:158).
+        # object_recovery_manager.h:41). Entries are pinned while any
+        # downstream lineage entry depends on them (ref: lineage pinning,
+        # ray_config_def.h:145) and byte-capped FIFO (:158).
         self._lineage: Dict[ObjectID, dict] = {}
         self._lineage_order: List[ObjectID] = []
+        self._lineage_pins: Dict[ObjectID, int] = {}
+        self._lineage_bytes = 0
 
         # ---- function table cache ----
         self._exported_fns: set = set()
@@ -128,7 +131,7 @@ class DistributedCoreWorker:
                 return
             if n <= 1:
                 del self._refcounts[ref.id()]
-                self._lineage.pop(ref.id(), None)
+                self._drop_lineage_locked(ref.id())
                 if ref.id() in self._owned:
                     self._owned.discard(ref.id())
                     self._inline_cache.pop(ref.id(), None)
@@ -223,7 +226,7 @@ class DistributedCoreWorker:
             if pulled:
                 continue  # now in local store
             # 5) object lost (no copies anywhere): lineage reconstruction
-            if num_locations == 0 and self._maybe_reconstruct(oid):
+            if num_locations == 0 and self._maybe_reconstruct(oid, deadline):
                 continue
             if deadline is not None and time.monotonic() >= deadline:
                 raise rexc.GetTimeoutError(ref.hex())
@@ -231,32 +234,81 @@ class DistributedCoreWorker:
             backoff = min(backoff * 2, 0.05)
 
     def _try_pull_remote(self, oid: ObjectID) -> Tuple[bool, int]:
-        """Returns (pulled_into_local_store, directory_location_count)."""
+        """Returns (pulled_into_local_store, usable_location_count).
+
+        A node that explicitly answers "missing" evicted its copy without
+        telling the directory — such stale locations are removed so an
+        object whose every copy was LRU-evicted counts as lost (and
+        becomes reconstructable) rather than polling forever. Unreachable
+        nodes still count: they may come back."""
         info = self.gcs.call("ObjectDirectory", "get_locations",
                              object_id=oid.binary(), timeout=30)
+        stale = 0
         for node in info["nodes"]:
             if node["node_id"] == self.node_id:
-                continue  # local store already checked
+                if self.store.contains(oid):
+                    continue  # caller re-checks; raced back in
+                # Directory lists this node but the store evicted the copy.
+                stale += 1
+                self._remove_stale_location(oid, node["node_id"])
+                continue
             try:
                 data = self._pull_from(node["address"], oid)
             except Exception as e:  # noqa: BLE001
                 logger.debug("pull from %s failed: %s", node["address"], e)
                 continue
-            if data is not None:
-                try:
-                    self.store.put_raw(oid, data)
-                except Exception:  # noqa: BLE001 already raced in
-                    pass
-                return True, len(info["nodes"])
-        return False, len(info["nodes"])
+            if data is None:
+                stale += 1
+                self._remove_stale_location(oid, node["node_id"])
+                continue
+            try:
+                self.store.put_raw(oid, data)
+            except Exception:  # noqa: BLE001 already raced in
+                pass
+            return True, len(info["nodes"])
+        return False, len(info["nodes"]) - stale
+
+    def _remove_stale_location(self, oid: ObjectID, node_id: str) -> None:
+        try:
+            self.gcs.call("ObjectDirectory", "remove_location",
+                          object_id=oid.binary(), node_id=node_id,
+                          timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------------------
     # lineage reconstruction (ref: object_recovery_manager.h:41 — the owner
     # resubmits the creating task when all copies of an object are lost)
     # ------------------------------------------------------------------
-    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
-        """Resubmit the creating task of a lost owned object. Returns True
-        if a reconstruction ran (caller should re-check the store)."""
+    def _drop_lineage_locked(self, oid: ObjectID, force: bool = False
+                             ) -> None:
+        """Drop `oid`'s lineage entry unless downstream lineage pins it;
+        when an entry's last output is dropped, unpin (and maybe cascade-
+        drop) its dependencies. Caller holds self._lock."""
+        if not force and self._lineage_pins.get(oid, 0) > 0:
+            return
+        entry = self._lineage.pop(oid, None)
+        if entry is None:
+            return
+        entry["live"] -= 1
+        if entry["live"] > 0:
+            return
+        self._lineage_bytes -= entry["nbytes"]
+        for dep in entry["deps"]:
+            d = ObjectID(dep)
+            n = self._lineage_pins.get(d, 0) - 1
+            if n > 0:
+                self._lineage_pins[d] = n
+            else:
+                self._lineage_pins.pop(d, None)
+                if d not in self._refcounts:
+                    self._drop_lineage_locked(d)
+
+    def _maybe_reconstruct(self, oid: ObjectID,
+                           deadline: Optional[float] = None) -> bool:
+        """Resubmit the creating task of a lost owned object (on a worker
+        thread) and wait for it, honoring the caller's deadline. Returns
+        True if a reconstruction completed (caller re-checks the store)."""
         with self._lock:
             entry = self._lineage.get(oid)
             if entry is None:
@@ -269,26 +321,28 @@ class DistributedCoreWorker:
                         f"failed after {entry['attempts']} attempts")
                 entry["attempts"] += 1
                 entry["fut"] = fut = Future()
-                is_runner = True
-            else:
-                is_runner = False
-        if not is_runner:
-            fut.result()  # piggyback on the in-flight reconstruction
-            return True
-        logger.info("reconstructing lost object %s (attempt %d)",
-                    oid.hex()[:8], entry["attempts"])
+                logger.info("reconstructing lost object %s (attempt %d)",
+                            oid.hex()[:8], entry["attempts"])
+                threading.Thread(target=self._run_reconstruction,
+                                 args=(entry, fut), daemon=True).start()
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+            raise rexc.GetTimeoutError(oid.hex())
+        try:
+            fut.result(timeout=remaining)
+        except TimeoutError:
+            raise rexc.GetTimeoutError(oid.hex()) from None
+        return True
+
+    def _run_reconstruction(self, entry: dict, fut: Future) -> None:
         try:
             self._reconstruct_entry(entry)
             fut.set_result(None)
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
-            # Surface the failure to THIS caller; other waiters get it
-            # via the future. Next get() retries with a fresh attempt.
-            raise
         finally:
             with self._lock:
                 entry["fut"] = None
-        return True
 
     def _reconstruct_entry(self, entry: dict) -> None:
         # Recursively restore missing dependencies first (depth-first, like
@@ -503,18 +557,26 @@ class DistributedCoreWorker:
                      or getattr(func, "__qualname__", "task")},
         )
 
-        if options.max_retries > 0:
+        if options.max_retries > 0 and get_config().lineage_pinning_enabled:
             with self._lock:
                 entry = {"spec": spec, "demand": demand, "sched": sched,
                          "deps": deps, "attempts": 0, "fut": None,
                          "max_attempts": max(1, options.max_retries),
-                         "return_ids": list(return_ids)}
+                         "live": len(return_ids),
+                         "nbytes": len(args_blob)}
                 for oid in return_ids:
                     self._lineage[oid] = entry
                     self._lineage_order.append(oid)
-                while len(self._lineage_order) > 20000:
+                for dep in deps:
+                    d = ObjectID(dep)
+                    self._lineage_pins[d] = self._lineage_pins.get(d, 0) + 1
+                self._lineage_bytes += entry["nbytes"]
+                cap = get_config().max_lineage_bytes
+                while self._lineage_order and (
+                        len(self._lineage_order) > 20000
+                        or self._lineage_bytes > cap):
                     old = self._lineage_order.pop(0)
-                    self._lineage.pop(old, None)
+                    self._drop_lineage_locked(old, force=True)
 
         t = threading.Thread(
             target=self._run_task_to_completion,

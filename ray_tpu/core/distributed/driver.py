@@ -89,6 +89,7 @@ def start_node_daemon_process(
     store_dir: Optional[str] = None,
     object_store_memory: int = 0,
     node_id: Optional[str] = None,
+    extra_env: Optional[dict] = None,
 ) -> tuple:
     import json
 
@@ -105,8 +106,11 @@ def start_node_daemon_process(
         cmd += ["--object-store-memory", str(object_store_memory)]
     if node_id:
         cmd += ["--node-id", node_id]
+    env = child_env()
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
-                            env=child_env())
+                            env=env)
     info = _read_handshake(
         proc,
         r"DAEMON_PORT=(?P<port>\d+) NODE_ID=(?P<node_id>\w+) "

@@ -451,7 +451,18 @@ class NodeDaemon:
         if target is None or target == self.node_id:
             return None
         node = self._view.nodes.get(target)
-        return node.address if node is not None and node.alive else None
+        if node is not None and node.alive:
+            return node.address
+        # The 1 Hz view refresher may not have learned the target node yet
+        # (races cluster formation); the GCS registry is authoritative.
+        try:
+            for n in await self.gcs.call("NodeInfo", "list_nodes",
+                                         timeout=10):
+                if n["node_id"] == target and n["alive"]:
+                    return n["address"]
+        except Exception:  # noqa: BLE001
+            pass
+        return None
 
     # ------------------------------------------------------------------
     # placement groups (ref: placement_group_resource_manager.h)

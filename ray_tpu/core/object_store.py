@@ -248,14 +248,11 @@ class ObjectStore:
         fd = ctypes.c_int(-1)
         rc = lib.rts_create(self._handle, oid.binary(), size,
                             ctypes.byref(fd))
-        if rc == RTS_ERR_FULL:
-            lib.rts_evict(self._handle, size)
-            rc = lib.rts_create(self._handle, oid.binary(), size,
-                                ctypes.byref(fd))
         if rc == RTS_ERR_EXISTS:
             raise ObjectExistsError(oid.hex())
         if rc == RTS_ERR_FULL:
-            # Everything in shm is pinned: overflow this object to disk.
+            # rts_create already ran LRU eviction internally; everything
+            # left in shm is pinned — overflow this object to disk.
             return self._spill_write(
                 oid, lambda view: serialization.write_to(view, meta, buffers),
                 size)
@@ -288,10 +285,6 @@ class ObjectStore:
         size = len(data)
         rc = lib.rts_create(self._handle, oid.binary(), size,
                             ctypes.byref(fd))
-        if rc == RTS_ERR_FULL:
-            lib.rts_evict(self._handle, size)
-            rc = lib.rts_create(self._handle, oid.binary(), size,
-                                ctypes.byref(fd))
         if rc == RTS_ERR_EXISTS:
             raise ObjectExistsError(oid.hex())
         if rc == RTS_ERR_FULL:

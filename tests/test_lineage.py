@@ -162,3 +162,37 @@ def test_recursive_dependency_reconstruction(recon_cluster):
     # Recovering `d` requires first recovering its lost dependency `b`.
     out = ray_tpu.get(d, timeout=120)
     np.testing.assert_array_equal(out, np.arange(200_000) * 2.0)
+
+
+def test_dropped_intermediate_ref_still_reconstructs(recon_cluster):
+    """Lineage pinning: dropping the intermediate ObjectRef must not break
+    the chain — the downstream entry pins its dependency's lineage
+    (ref: ray_config_def.h:145 lineage_pinning_enabled)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster, second = recon_cluster
+    on_second = NodeAffinitySchedulingStrategy(second.node_id, soft=True)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def base():
+        return np.full(200_000, 3.0)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def double(arr):
+        return arr * 2.0
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=on_second)
+    def peek(arr):
+        return float(arr[0])
+
+    # The inner ref is dropped as soon as double() is submitted.
+    d = double.remote(base.remote())
+    assert ray_tpu.get(peek.remote(d), timeout=120) == 6.0
+
+    cluster.remove_node(second)
+    _wait_single_alive()
+
+    out = ray_tpu.get(d, timeout=120)
+    np.testing.assert_array_equal(out, np.full(200_000, 6.0))
