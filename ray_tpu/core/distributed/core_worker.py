@@ -21,6 +21,7 @@ import threading
 import time
 from collections import defaultdict
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -100,6 +101,10 @@ class DistributedCoreWorker:
         self._lineage_order: List[ObjectID] = []
         self._lineage_pins: Dict[ObjectID, int] = {}
         self._lineage_bytes = 0
+        # Oids whose PINNED lineage was cap-evicted: marked so a later
+        # reconstruction attempt fails fast instead of hanging (the
+        # reference marks such objects unreconstructable).
+        self._lineage_evicted: set = set()
 
         # ---- function table cache ----
         self._exported_fns: set = set()
@@ -218,7 +223,7 @@ class DistributedCoreWorker:
                     raise rexc.GetTimeoutError(ref.hex())
                 try:
                     fut.result(timeout=remaining)
-                except TimeoutError:
+                except (TimeoutError, FutureTimeoutError):
                     raise rexc.GetTimeoutError(ref.hex()) from None
                 continue
             # 4) remote fetch via directory
@@ -265,6 +270,14 @@ class DistributedCoreWorker:
                 self.store.put_raw(oid, data)
             except Exception:  # noqa: BLE001 already raced in
                 pass
+            # This node now genuinely holds a copy — register it so other
+            # processes (e.g. a worker fetching task args) can find it.
+            try:
+                self.gcs.call("ObjectDirectory", "add_location",
+                              object_id=oid.binary(), node_id=self.node_id,
+                              size=len(data), timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
             return True, len(info["nodes"])
         return False, len(info["nodes"]) - stale
 
@@ -285,8 +298,16 @@ class DistributedCoreWorker:
         """Drop `oid`'s lineage entry unless downstream lineage pins it;
         when an entry's last output is dropped, unpin (and maybe cascade-
         drop) its dependencies. Caller holds self._lock."""
-        if not force and self._lineage_pins.get(oid, 0) > 0:
-            return
+        if self._lineage_pins.get(oid, 0) > 0:
+            if not force:
+                return
+            if oid in self._lineage:
+                logger.warning(
+                    "lineage cap evicted pinned entry for %s — downstream "
+                    "objects depending on it are no longer reconstructable",
+                    oid.hex()[:8])
+                if len(self._lineage_evicted) < 100_000:
+                    self._lineage_evicted.add(oid)
         entry = self._lineage.pop(oid, None)
         if entry is None:
             return
@@ -312,6 +333,11 @@ class DistributedCoreWorker:
         with self._lock:
             entry = self._lineage.get(oid)
             if entry is None:
+                if oid in self._lineage_evicted:
+                    raise rexc.ObjectReconstructionFailedError(
+                        f"object {oid.hex()[:8]} lost; its lineage was "
+                        f"evicted by the lineage cap "
+                        f"(RAY_TPU_MAX_LINEAGE_BYTES)")
                 return False
             fut = entry["fut"]
             if fut is None:
@@ -330,7 +356,8 @@ class DistributedCoreWorker:
             raise rexc.GetTimeoutError(oid.hex())
         try:
             fut.result(timeout=remaining)
-        except TimeoutError:
+        except (TimeoutError, FutureTimeoutError):
+            # (both spelled out: they only became aliases in Python 3.11)
             raise rexc.GetTimeoutError(oid.hex()) from None
         return True
 
@@ -362,10 +389,15 @@ class DistributedCoreWorker:
                     continue
                 except Exception:  # noqa: BLE001
                     pass
-            info = self.gcs.call("ObjectDirectory", "get_locations",
-                                 object_id=dep, timeout=30)
-            if not info["nodes"]:
-                self._maybe_reconstruct(dep_oid)
+            # Stale-aware availability check (prunes directory entries for
+            # evicted copies); reconstruct when no usable copy remains.
+            pulled, usable = self._try_pull_remote(dep_oid)
+            if pulled or usable > 0:
+                continue
+            if not self._maybe_reconstruct(dep_oid):
+                raise rexc.ObjectReconstructionFailedError(
+                    f"dependency {dep_oid.hex()[:8]} is lost and has no "
+                    f"retained lineage — cannot reconstruct")
         spec = entry["spec"]
         spec["attempt"] = spec.get("attempt", 0) + 1
         reply = self._lease_and_push(spec, entry["demand"], entry["sched"])

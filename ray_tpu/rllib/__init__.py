@@ -1,0 +1,27 @@
+"""RLlib-equivalent: RL training on the ray_tpu runtime, JAX/TPU-first.
+
+Reference surface (ref: rllib/algorithms/algorithm.py:196 Algorithm,
+algorithm_config.py AlgorithmConfig, core/learner/learner.py:107 Learner,
+evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
+
+- **RolloutWorkers** are CPU actors stepping vectorized numpy envs with a
+  jitted policy (sampling is branchy/host-bound: wrong shape for the MXU).
+- **The Learner** is one jitted SPMD program: GAE, minibatch permutation,
+  and all SGD epochs run inside a single `jax.jit` with `lax.scan` —
+  no per-minibatch dispatch — shardable over a mesh `dp` axis with
+  `NamedSharding` (the reference reaches the same goal with DDP-wrapped
+  torch modules, core/learner/torch/torch_learner.py:52).
+- Weight broadcast worker<-learner is a host-level actor call (DCN), the
+  analogue of LearnerGroup weight sync (core/learner/learner_group.py:60).
+"""
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.env import register_env
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "register_env",
+]

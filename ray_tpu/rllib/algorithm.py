@@ -1,0 +1,207 @@
+"""Algorithm + AlgorithmConfig: the RL training driver.
+
+ref: rllib/algorithms/algorithm.py:196 (Algorithm, a Tune Trainable),
+algorithm_config.py (builder-style config). The Algorithm owns N rollout
+workers (local objects or ray_tpu actors) and one Learner; `train()` runs
+one iteration and returns a metrics dict, so a function trainable can wrap
+it for Tune directly (`lambda cfg: PPOConfig()...build().train()`).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class=None):
+        self.algo_class = algo_class
+        self.env: Union[str, Callable, None] = None
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 128
+        self.num_cpus_per_env_runner = 1.0
+        self.seed = 0
+        self.model_hidden: Tuple[int, ...] = (64, 64)
+        self.learner_mesh = None  # jax Mesh with a "dp" axis, or None
+
+    # builder surface (each returns self, ref: algorithm_config.py)
+    def environment(self, env: Union[str, Callable]) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    num_cpus_per_env_runner: Optional[float] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def framework(self, _framework: str = "jax") -> "AlgorithmConfig":
+        return self  # jax is the only framework
+
+    def resources(self, *, learner_mesh=None, **_ignored
+                  ) -> "AlgorithmConfig":
+        if learner_mesh is not None:
+            self.learner_mesh = learner_mesh
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def rl_module(self, *, model_hidden: Optional[Tuple[int, ...]] = None
+                  ) -> "AlgorithmConfig":
+        if model_hidden is not None:
+            self.model_hidden = tuple(model_hidden)
+        return self
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("AlgorithmConfig has no algo_class; use a "
+                             "concrete config (e.g. PPOConfig)")
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """One learner + N rollout workers; subclasses provide
+    `_setup_learner` and `training_step` (ref: algorithm.py:1490)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        self.config = config
+        self._iteration = 0
+        self._remote = config.num_env_runners > 0
+
+        gamma = getattr(config, "gamma", 0.99)
+        if self._remote:
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(ignore_reinit_error=True)
+            cls = ray_tpu.remote(
+                num_cpus=config.num_cpus_per_env_runner)(RolloutWorker)
+            self.workers = [
+                cls.remote(config.env,
+                           num_envs=config.num_envs_per_env_runner,
+                           seed=config.seed + 1000 * (i + 1),
+                           bootstrap_gamma=gamma)
+                for i in range(config.num_env_runners)
+            ]
+            self._spaces = ray_tpu.get(self.workers[0].get_spaces.remote())
+        else:
+            self.workers = [RolloutWorker(
+                config.env, num_envs=config.num_envs_per_env_runner,
+                seed=config.seed, bootstrap_gamma=gamma)]
+            self._spaces = self.workers[0].get_spaces()
+
+        obs_dim, num_actions = self._spaces
+        self.learner = self._setup_learner(obs_dim, num_actions)
+        self._broadcast_weights()
+
+    # -- subclass hooks -----------------------------------------------------
+    def _setup_learner(self, obs_dim: int, num_actions: int):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    # -- shared machinery ---------------------------------------------------
+    def _broadcast_weights(self) -> None:
+        weights = self.learner.get_weights()
+        if self._remote:
+            import ray_tpu
+
+            # put() once; workers resolve the shared ref (serialize the
+            # pytree once per iteration, not once per worker).
+            ref = ray_tpu.put(weights)
+            ray_tpu.get([w.set_weights.remote(ref) for w in self.workers])
+        else:
+            self.workers[0].set_weights(weights)
+
+    def _sample_rollouts(self) -> Tuple[Dict[str, np.ndarray], List[float]]:
+        T = self.config.rollout_fragment_length
+        if self._remote:
+            import ray_tpu
+
+            outs = ray_tpu.get(
+                [w.sample.remote(T) for w in self.workers], timeout=600)
+        else:
+            outs = [self.workers[0].sample(T)]
+        batch = {
+            k: np.concatenate([o["batch"][k] for o in outs], axis=0)
+            for k in outs[0]["batch"]
+        }
+        episode_returns: List[float] = []
+        for o in outs:
+            episode_returns.extend(o["episode_returns"])
+        return batch, episode_returns
+
+    # -- public surface (ref: Algorithm.train/save/restore/stop) ------------
+    def train(self) -> Dict[str, float]:
+        self._iteration += 1
+        metrics = self.training_step()
+        metrics["training_iteration"] = float(self._iteration)
+        return metrics
+
+    def get_weights(self) -> Any:
+        return self.learner.get_weights()
+
+    def set_weights(self, weights: Any) -> None:
+        self.learner.set_weights(weights)
+        self._broadcast_weights()
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="rllib_ckpt_")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        learner_state = (self.learner.get_state()
+                         if hasattr(self.learner, "get_state")
+                         else {"params": self.learner.get_weights()})
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "wb") as f:
+            pickle.dump({"learner_state": learner_state,
+                         "iteration": self._iteration}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._iteration = state["iteration"]
+        if hasattr(self.learner, "set_state"):
+            self.learner.set_state(state["learner_state"])
+        else:
+            self.learner.set_weights(state["learner_state"]["params"])
+        self._broadcast_weights()
+
+    def stop(self) -> None:
+        if self._remote:
+            import ray_tpu
+
+            for w in self.workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+        self.workers = []

@@ -1,0 +1,46 @@
+"""Policy/value networks as pure-JAX pytrees (the RLModule analogue,
+ref: rllib/core/rl_module/). Kept framework-free like the rest of
+ray_tpu/models: params are nested dicts, apply is a pure function —
+trivially shardable/donatable under jit."""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_mlp_policy(rng: jax.Array, obs_dim: int, num_actions: int,
+                    hidden: Sequence[int] = (64, 64)) -> Params:
+    """Separate pi/v MLP towers (shared trunks hurt small-control tasks)."""
+    params: Params = {}
+    for tower, out_dim in (("pi", num_actions), ("v", 1)):
+        sizes = [obs_dim, *hidden, out_dim]
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            rng, key = jax.random.split(rng)
+            scale = jnp.sqrt(2.0 / fan_in)
+            if i == len(sizes) - 2:  # small final layer: near-uniform policy
+                scale = scale * 0.01
+            params[f"{tower}_w{i}"] = (
+                jax.random.normal(key, (fan_in, fan_out)) * scale)
+            params[f"{tower}_b{i}"] = jnp.zeros((fan_out,))
+    return params
+
+
+def apply_mlp_policy(params: Params, obs: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    def tower(prefix: str, x: jnp.ndarray) -> jnp.ndarray:
+        i = 0
+        while f"{prefix}_w{i}" in params:
+            x = x @ params[f"{prefix}_w{i}"] + params[f"{prefix}_b{i}"]
+            if f"{prefix}_w{i + 1}" in params:
+                x = jnp.tanh(x)
+            i += 1
+        return x
+
+    logits = tower("pi", obs)
+    value = tower("v", obs)[..., 0]
+    return logits, value

@@ -1,0 +1,101 @@
+"""RolloutWorker: CPU-side experience collection with a jitted policy.
+
+ref: rllib/evaluation/rollout_worker.py:159. Runs as a plain object
+(local mode) or a ray_tpu actor; steps a numpy VectorEnv in lockstep and
+batches every policy forward through one jitted call — sampling stays on
+CPU where the branchy env code lives, the learner stays on the mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.models import apply_mlp_policy
+
+
+@jax.jit
+def _policy_step(params, obs, key):
+    logits, value = apply_mlp_policy(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+    return actions, logp, value
+
+
+@jax.jit
+def _value_only(params, obs):
+    return apply_mlp_policy(params, obs)[1]
+
+
+class RolloutWorker:
+    def __init__(self, env: Union[str, Callable[..., VectorEnv]],
+                 num_envs: int = 8, seed: int = 0,
+                 bootstrap_gamma: float = 0.99):
+        if callable(env):
+            self.env = env(num_envs=num_envs, seed=seed)
+        else:
+            self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.obs_dim = self.env.obs_dim
+        self.num_actions = self.env.num_actions
+        self._obs = self.env.reset()
+        self._params = None
+        self._rng = jax.random.PRNGKey(seed + 1)
+        # Time-limit cuts bootstrap the truncated state's value into the
+        # reward (done=1 with no bootstrap would bias V targets low).
+        self._gamma = bootstrap_gamma
+
+    def get_spaces(self) -> Tuple[int, int]:
+        return self.obs_dim, self.num_actions
+
+    def set_weights(self, params: Any) -> None:
+        self._params = jax.device_put(params)
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        """Collect `num_steps` per env; returns batch arrays [E, T, ...] +
+        the bootstrap value and finished-episode returns."""
+        assert self._params is not None, "set_weights() before sample()"
+        E = self.env.num_envs
+        obs_buf = np.empty((E, num_steps, self.obs_dim), np.float32)
+        act_buf = np.empty((E, num_steps), np.int32)
+        logp_buf = np.empty((E, num_steps), np.float32)
+        rew_buf = np.empty((E, num_steps), np.float32)
+        done_buf = np.empty((E, num_steps), np.float32)
+        val_buf = np.empty((E, num_steps), np.float32)
+        episode_returns: List[float] = []
+
+        obs = self._obs
+        for t in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            actions, logp, value = _policy_step(self._params, obs, key)
+            actions = np.asarray(actions)
+            obs_buf[:, t] = obs
+            act_buf[:, t] = actions
+            logp_buf[:, t] = np.asarray(logp)
+            val_buf[:, t] = np.asarray(value)
+            obs, rewards, dones, ep_ret = self.env.step(actions)
+            trunc = getattr(self.env, "truncateds", None)
+            if trunc is not None and trunc.any():
+                # Full-batch value call keeps the jit shape static.
+                vals = np.asarray(_value_only(
+                    self._params, self.env.final_obs), np.float32)
+                rewards = rewards.copy()
+                rewards[trunc] += self._gamma * vals[trunc]
+            rew_buf[:, t] = rewards
+            done_buf[:, t] = dones
+            finished = ~np.isnan(ep_ret)
+            if finished.any():
+                episode_returns.extend(ep_ret[finished].tolist())
+        self._obs = obs
+        final_value = np.asarray(_value_only(self._params, obs), np.float32)
+        return {
+            "batch": {
+                "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "rewards": rew_buf, "dones": done_buf, "values": val_buf,
+                "final_value": final_value,
+            },
+            "episode_returns": episode_returns,
+        }

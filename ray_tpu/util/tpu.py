@@ -38,13 +38,22 @@ def get_current_pod_worker_count() -> Optional[int]:
 
 
 def get_num_tpu_chips_on_node() -> int:
+    """TPU chips on THIS host (ref: tpu.py get_current_node_tpu_chips)."""
     import ray_tpu
 
     try:
-        res = ray_tpu.cluster_resources()
-    except Exception:  # noqa: BLE001 — not connected
+        node_id = ray_tpu.get_runtime_context().get_node_id()
+        for n in ray_tpu.nodes():
+            if n["NodeID"] == node_id:
+                return int(n["Resources"].get("TPU", 0))
+    except Exception:  # noqa: BLE001 — not connected; probe locally
+        pass
+    try:
+        from ray_tpu.core.distributed.resources import probe_tpu_count
+
+        return int(probe_tpu_count())
+    except Exception:  # noqa: BLE001
         return 0
-    return int(res.get("TPU", 0))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""Durable workflows: DAG execution with storage-backed step memoization.
+
+Analogue of the reference workflow library (ref: python/ray/workflow/ —
+workflow_executor.py drives a DAG, workflow_storage.py persists each
+step's output so a crashed/resumed run skips completed steps). Scope-
+minimal but real: `run()` executes a ray_tpu DAG checkpointing every
+node's result under `<storage>/<workflow_id>/`; `resume()` re-runs the
+same DAG and loads any step whose result is already durable, re-executing
+only the missing suffix.
+"""
+from ray_tpu.workflow.api import (
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = ["run", "run_async", "resume", "get_output", "get_status",
+           "list_all"]

@@ -1,0 +1,207 @@
+"""Workflow execution + storage.
+
+ref: python/ray/workflow/workflow_executor.py (driver loop),
+workflow_storage.py (durable step results), api.py (run/resume surface).
+Step identity is structural: the DAG's deterministic topological position
+plus the step's function name — a resumed run must rebuild the same DAG
+(the reference has the same contract for workflows built from DAGs).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_DEFAULT_STORAGE = os.environ.get(
+    "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu_workflows")
+
+
+def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
+    return os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+
+
+def _step_key(node: DAGNode, topo_index: int) -> str:
+    name = "node"
+    if isinstance(node, FunctionNode):
+        fn = getattr(node._rf, "_function", None)
+        name = getattr(fn, "__name__", "fn")
+    elif isinstance(node, InputNode):
+        name = "input"
+    elif isinstance(node, MultiOutputNode):
+        name = "output"
+    return f"{topo_index:04d}_{name}"
+
+
+def _topo_order(root: DAGNode) -> Dict[int, int]:
+    """Deterministic post-order numbering of the DAG by structure."""
+    order: Dict[int, int] = {}
+
+    def visit(node: DAGNode) -> None:
+        if id(node) in order:
+            return
+        for child in node._children():
+            visit(child)
+        order[id(node)] = len(order)
+
+    visit(root)
+    return order
+
+
+class _WorkflowRun:
+    def __init__(self, dag: DAGNode, workflow_id: str, storage: str):
+        self.dag = dag
+        self.workflow_id = workflow_id
+        self.dir = storage
+        self.steps_dir = os.path.join(storage, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+        self.order = _topo_order(dag)
+
+    # -- storage -----------------------------------------------------------
+    def _step_path(self, node: DAGNode) -> str:
+        return os.path.join(self.steps_dir,
+                            _step_key(node, self.order[id(node)]) + ".pkl")
+
+    def _load_step(self, node: DAGNode):
+        path = self._step_path(node)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def _save_step(self, node: DAGNode, value: Any) -> None:
+        path = self._step_path(node)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.rename(tmp, path)
+
+    def _set_status(self, status: str, error: Optional[str] = None) -> None:
+        with open(os.path.join(self.dir, "status.json"), "w") as f:
+            json.dump({"workflow_id": self.workflow_id, "status": status,
+                       "error": error, "ts": time.time()}, f)
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, *input_args, **input_kwargs) -> Any:
+        import ray_tpu
+
+        self._set_status("RUNNING")
+        cache: Dict[int, Any] = {}
+
+        def run_node(node: DAGNode) -> Any:
+            key = id(node)
+            if key in cache:
+                return cache[key]
+            if isinstance(node, InputNode):
+                value = input_args[node._index]
+            elif isinstance(node, MultiOutputNode):
+                value = [run_node(c) for c in node._bound_args]
+            else:
+                stored = self._load_step(node)
+                if stored is not None:
+                    value = stored["value"]
+                else:
+                    args = [run_node(a) if isinstance(a, DAGNode) else a
+                            for a in node._bound_args]
+                    kwargs = {k: (run_node(v) if isinstance(v, DAGNode)
+                                  else v)
+                              for k, v in node._bound_kwargs.items()}
+                    if isinstance(node, FunctionNode):
+                        ref = node._rf.remote(*args, **kwargs)
+                        value = ray_tpu.get(ref)
+                    else:
+                        raise TypeError(
+                            f"workflows support function DAGs; got "
+                            f"{type(node).__name__} (actor steps need "
+                            f"virtual-actor support)")
+                    self._save_step(node, {"value": value})
+            cache[key] = value
+            return value
+
+        try:
+            result = run_node(self.dag)
+        except BaseException as e:  # noqa: BLE001
+            self._set_status("FAILED", error=repr(e))
+            raise
+        with open(os.path.join(self.dir, "result.pkl"), "wb") as f:
+            pickle.dump(result, f)
+        self._set_status("SUCCESSFUL")
+        return result
+
+
+_live_runs: Dict[str, Future] = {}
+_lock = threading.Lock()
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None, **kwargs) -> Any:
+    """Execute a DAG durably; completed steps are checkpointed so a crashed
+    run resumes where it stopped (ref: workflow/api.py run)."""
+    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000)}"
+    wf = _WorkflowRun(dag, workflow_id, _wf_dir(workflow_id, storage))
+    return wf.execute(*args, **kwargs)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None, **kwargs) -> Future:
+    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000)}"
+    fut: Future = Future()
+
+    def runner():
+        try:
+            fut.set_result(run(dag, *args, workflow_id=workflow_id,
+                               storage=storage, **kwargs))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    with _lock:
+        _live_runs[workflow_id] = fut
+    threading.Thread(target=runner, daemon=True).start()
+    return fut
+
+
+def resume(workflow_id: str, dag: DAGNode, *args,
+           storage: Optional[str] = None, **kwargs) -> Any:
+    """Re-run `workflow_id` with the same DAG: durable steps are loaded,
+    only missing ones execute (ref: workflow resume semantics)."""
+    wf = _WorkflowRun(dag, workflow_id, _wf_dir(workflow_id, storage))
+    return wf.execute(*args, **kwargs)
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None
+               ) -> Optional[str]:
+    path = os.path.join(_wf_dir(workflow_id, storage), "status.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)["status"]
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    path = os.path.join(_wf_dir(workflow_id, storage), "result.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored result")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all(storage: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wid in sorted(os.listdir(root)):
+        status = get_status(wid, storage=root)
+        if status is not None:
+            out.append({"workflow_id": wid, "status": status})
+    return out

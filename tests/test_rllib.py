@@ -1,0 +1,102 @@
+"""RLlib-min tests (VERDICT r1 item 4): PPO solves CartPole on CPU; the
+learner's train step jit-compiles and runs on the virtual device mesh."""
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import PPO, PPOConfig
+from ray_tpu.rllib.env import CartPoleVecEnv
+from ray_tpu.rllib.ppo import PPOHyperparams, PPOLearner
+
+
+def test_cartpole_vec_env_basics():
+    env = CartPoleVecEnv(num_envs=4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 4)
+    for _ in range(10):
+        obs, rew, dones, ep = env.step(np.zeros(4, dtype=np.int64))
+        assert obs.shape == (4, 4)
+        assert (rew == 1.0).all()
+    # Constant-left policy falls over well before 500 steps.
+    finished = 0
+    for _ in range(300):
+        _, _, dones, ep = env.step(np.zeros(4, dtype=np.int64))
+        finished += int((~np.isnan(ep)).sum())
+    assert finished > 0
+
+
+def test_learner_step_runs_on_mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest forces an 8-device CPU mesh"
+    mesh = jax.sharding.Mesh(np.array(devices), ("dp",))
+    learner = PPOLearner(obs_dim=4, num_actions=2,
+                         hp=PPOHyperparams(minibatch_size=64),
+                         mesh=mesh)
+    E, T = 16, 32  # E divides the 8-way dp axis
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(E, T, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(E, T)).astype(np.int32),
+        "logp": np.full((E, T), -0.693, np.float32),
+        "rewards": np.ones((E, T), np.float32),
+        "dones": np.zeros((E, T), np.float32),
+        "values": np.zeros((E, T), np.float32),
+        "final_value": np.zeros((E,), np.float32),
+    }
+    m1 = learner.update(batch)
+    m2 = learner.update(batch)
+    for m in (m1, m2):
+        for k in ("policy_loss", "vf_loss", "entropy", "kl"):
+            assert np.isfinite(m[k]), (k, m)
+
+
+def test_ppo_learns_cartpole_local():
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                     rollout_fragment_length=128)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=4,
+                  entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = 0.0
+    first = None
+    for i in range(40):
+        metrics = algo.train()
+        ret = metrics.get("episode_return_mean")
+        if ret is not None:
+            if first is None:
+                first = ret
+            best = max(best, ret)
+            if best >= 150.0:
+                break
+    assert first is not None
+    assert best >= 150.0, (
+        f"PPO failed to learn CartPole: first={first} best={best}")
+
+
+def test_ppo_remote_workers(local_ray):
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .training(minibatch_size=64, num_epochs=2)
+    )
+    algo = config.build()
+    m = algo.train()
+    assert m["num_env_steps_sampled"] == 2 * 4 * 32
+    m = algo.train()
+    assert m["training_iteration"] == 2.0
+    # save/restore round-trips weights
+    ckpt = algo.save()
+    w_before = jax.tree_util.tree_map(np.asarray, algo.get_weights())
+    algo.train()
+    algo.restore(ckpt)
+    w_after = jax.tree_util.tree_map(np.asarray, algo.get_weights())
+    for a, b in zip(jax.tree_util.tree_leaves(w_before),
+                    jax.tree_util.tree_leaves(w_after)):
+        np.testing.assert_array_equal(a, b)
+    algo.stop()
