@@ -1,0 +1,139 @@
+"""Core-runtime microbenchmarks, mirroring the reference's ray_perf.py
+(ref: python/ray/_private/ray_perf.py:93-288) so numbers compare directly
+with BASELINE.md. Prints one JSON line per metric:
+{"metric", "value", "unit", "vs_baseline"} — vs_baseline is
+value / reference_value from release_logs/2.9.3 (m5.16xlarge, 64 vCPU).
+
+Usage: python bench_core.py [--quick]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# BASELINE.md reference values (2.9.3 microbenchmark.json)
+BASELINE = {
+    "tasks_per_second": 25166,            # multi_client_tasks_async
+    "actor_calls_sync_per_second": 2033,  # 1_1_actor_calls_sync
+    "actor_calls_async_per_second": 8886,  # 1_1_actor_calls_async
+    "n_n_actor_calls_async_per_second": 27667,  # n_n_actor_calls_async
+    "put_calls_per_second": 12677,        # multi_client_put_calls
+    "put_gigabytes_per_second": 35.9,     # multi_client_put_gigabytes
+    "get_calls_per_second": 1152,         # client__get_calls (nearest)
+}
+
+
+def emit(metric: str, value: float, unit: str) -> None:
+    base = BASELINE.get(metric)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / base, 3) if base else None,
+    }), flush=True)
+
+
+def timeit(fn, number: int) -> float:
+    """Returns ops/sec for `number` invocations of fn (fn runs the op)."""
+    start = time.perf_counter()
+    fn(number)
+    return number / (time.perf_counter() - start)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    scale = 0.2 if quick else 1.0
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return None
+
+    # warmup (worker cold start, channels)
+    ray_tpu.get([noop.remote() for _ in range(20)])
+    actor = Sink.remote()
+    ray_tpu.get(actor.ping.remote())
+
+    # -- task throughput (async fan-out, ref multi_client_tasks_async) ----
+    n = int(2000 * scale)
+    ops = timeit(lambda k: ray_tpu.get([noop.remote() for _ in range(k)],
+                                       timeout=600), n)
+    emit("tasks_per_second", ops, "tasks/s")
+
+    # -- 1:1 sync actor calls (ref 1_1_actor_calls_sync) ------------------
+    n = int(1000 * scale)
+
+    def sync_calls(k):
+        for _ in range(k):
+            ray_tpu.get(actor.ping.remote(), timeout=60)
+
+    emit("actor_calls_sync_per_second", timeit(sync_calls, n), "calls/s")
+
+    # -- 1:1 async actor calls (ref 1_1_actor_calls_async) ----------------
+    n = int(2000 * scale)
+    ops = timeit(lambda k: ray_tpu.get(
+        [actor.ping.remote() for _ in range(k)], timeout=600), n)
+    emit("actor_calls_async_per_second", ops, "calls/s")
+
+    # -- n:n async actor calls (ref n_n_actor_calls_async) ----------------
+    actors = [Sink.remote() for _ in range(4)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    n = int(4000 * scale)
+
+    def n_n(k):
+        refs = []
+        for i in range(k):
+            refs.append(actors[i % len(actors)].ping.remote())
+        ray_tpu.get(refs, timeout=600)
+
+    emit("n_n_actor_calls_async_per_second", timeit(n_n, n), "calls/s")
+
+    # -- put calls/s (small objects, ref multi_client_put_calls) ----------
+    n = int(2000 * scale)
+    payload = b"x" * 100
+
+    def puts(k):
+        for _ in range(k):
+            ray_tpu.put(payload)
+
+    emit("put_calls_per_second", timeit(puts, n), "puts/s")
+
+    # -- put GB/s (large numpy, ref multi_client_put_gigabytes) -----------
+    # Working set stays under ~512 MiB: this VM throttles tmpfs page
+    # allocation hard (~0.2 GB/s) past ~900 MiB of fresh pages, regardless
+    # of writer (verified with raw mmap and write() syscalls) — the
+    # framework path itself runs at memcpy speed below the cliff.
+    big = np.zeros(32 * 1024 * 1024, dtype=np.uint8)
+    n = max(2, int(10 * scale))
+    start = time.perf_counter()
+    refs = [ray_tpu.put(big) for _ in range(n)]
+    dt = time.perf_counter() - start
+    emit("put_gigabytes_per_second", n * big.nbytes / dt / 1e9, "GB/s")
+
+    # -- get calls/s on stored objects ------------------------------------
+    n = int(2000 * scale)
+    small_refs = [ray_tpu.put(i) for i in range(100)]
+
+    def gets(k):
+        for i in range(k):
+            ray_tpu.get(small_refs[i % 100], timeout=60)
+
+    emit("get_calls_per_second", timeit(gets, n), "gets/s")
+
+    del refs
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -403,6 +403,15 @@ class ObjectDirectory:
             self.sizes[object_id] = size
         return {"ok": True}
 
+    def add_locations(self, entries: List[tuple]) -> dict:
+        """Batched registration — one RPC for a burst of task results
+        (the hot path batches like the reference's location pubsub)."""
+        for object_id, node_id, size in entries:
+            self.locations[object_id].add(node_id)
+            if size:
+                self.sizes[object_id] = size
+        return {"ok": True}
+
     def remove_location(self, object_id: bytes, node_id: str) -> dict:
         self.locations[object_id].discard(node_id)
         return {"ok": True}

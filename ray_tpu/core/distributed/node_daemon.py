@@ -187,6 +187,60 @@ class NodeDaemon:
         self._workers[worker_id] = handle
         return handle
 
+    def debug_state(self) -> dict:
+        """Scheduler-state snapshot (ref: DebugString dumps the reference
+        raylet emits into its logs)."""
+        return {
+            "total": dict(self.total),
+            "available": dict(self.available),
+            "leases": len(self._leases),
+            "lease_waiters": len(self._lease_waiters),
+            "workers": len(self._workers),
+            "idle_workers": len(self._idle),
+            "busy_workers": sum(1 for h in self._workers.values()
+                                if h.busy),
+            "pg_bundles": len(self._pg_bundles),
+        }
+
+    def list_workers(self) -> list:
+        return [{"worker_id": h.worker_id, "pid": h.proc.pid,
+                 "actor_id": h.actor_id, "busy": h.busy,
+                 "alive": h.proc.poll() is None}
+                for h in self._workers.values()]
+
+    def kill_worker(self, worker_id: Optional[str] = None,
+                    pid: Optional[int] = None) -> dict:
+        """Chaos-harness hook (ref: _private/test_utils.py:1560
+        WorkerKillerActor): SIGKILL one of this node's workers."""
+        for h in self._workers.values():
+            if h.worker_id == worker_id or (pid and h.proc.pid == pid):
+                try:
+                    h.proc.kill()
+                except Exception:  # noqa: BLE001
+                    return {"ok": False}
+                return {"ok": True, "pid": h.proc.pid}
+        return {"ok": False}
+
+    def kill_random_worker(self, include_actor_workers: bool = False,
+                           seed: Optional[int] = None) -> dict:
+        import random as _random
+
+        rng = _random.Random(seed)
+        candidates = [
+            h for h in self._workers.values()
+            if h.proc.poll() is None
+            and (include_actor_workers or h.actor_id is None)
+        ]
+        if not candidates:
+            return {"ok": False, "reason": "no candidate workers"}
+        victim = rng.choice(candidates)
+        try:
+            victim.proc.kill()
+        except Exception:  # noqa: BLE001
+            return {"ok": False}
+        return {"ok": True, "pid": victim.proc.pid,
+                "worker_id": victim.worker_id}
+
     async def register_worker(self, worker_id: str, address: str,
                               pid: int) -> dict:
         handle = self._workers.get(worker_id)
@@ -205,17 +259,27 @@ class NodeDaemon:
             handle = self._idle.popleft()
             if handle.proc.poll() is None and handle.address:
                 return handle
-        # Spawn a fresh one and wait for registration.
+        # Spawn a fresh one and wait for registration — polling the
+        # process too: a worker that dies pre-registration (crash, chaos
+        # kill) must fail the grant within ~0.1 s, not pin the subtracted
+        # resources for the full registration timeout.
         handle = self._spawn_worker()
-        try:
-            await asyncio.wait_for(
-                handle.registered.wait(),
-                timeout=get_config().worker_register_timeout_s)
-        except asyncio.TimeoutError:
-            handle.proc.kill()
-            self._workers.pop(handle.worker_id, None)
-            raise RuntimeError("worker failed to register in time")
-        return handle
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + get_config().worker_register_timeout_s
+        while True:
+            try:
+                await asyncio.wait_for(handle.registered.wait(), timeout=0.1)
+                return handle
+            except asyncio.TimeoutError:
+                if handle.proc.poll() is not None:
+                    self._workers.pop(handle.worker_id, None)
+                    raise RuntimeError(
+                        "worker died before registering") from None
+                if loop.time() >= deadline:
+                    handle.proc.kill()
+                    self._workers.pop(handle.worker_id, None)
+                    raise RuntimeError(
+                        "worker failed to register in time") from None
 
     def _reap_idle_workers(self) -> None:
         """Enforce num_workers_soft_limit: idle task workers beyond the
@@ -297,7 +361,7 @@ class NodeDaemon:
             if not rs.fits(bundle["available"], demand):
                 return await self._wait_for_lease(demand, placement)
             rs.subtract(bundle["available"], demand)
-            return await self._grant(demand, placement)
+            return await self._grant_safely(demand, placement)
 
         # Affinity pins to a node.
         if strategy == "node_affinity" and affinity is not None:
@@ -337,7 +401,8 @@ class NodeDaemon:
 
         if rs.fits(self.available, demand):
             rs.subtract(self.available, demand)
-            return await self._grant(demand, None)
+            self._ledger("sub:direct", demand)
+            return await self._grant_safely(demand, None)
 
         # Local node busy: consider spilling (hybrid policy).
         node = pick_node(self._view, demand, strategy=strategy,
@@ -352,6 +417,28 @@ class NodeDaemon:
         self._lease_waiters.append((demand, placement, fut))
         return await fut
 
+    async def _grant_safely(self, demand, placement) -> dict:
+        """_grant shielded against RPC cancellation: a client that gives
+        up (deadline) mid-grant must not leak the subtracted resources or
+        the leased worker (the orphaned lease starves the node forever)."""
+        task = asyncio.ensure_future(self._grant(demand, placement))
+        try:
+            return await asyncio.shield(task)
+        except asyncio.CancelledError:
+            def undo(t):
+                try:
+                    reply = t.result()
+                except BaseException:  # noqa: BLE001 _grant rolled back
+                    return
+                if reply.get("granted"):
+                    self._return_lease_internal(reply["lease_id"])
+                else:
+                    # grant failed after our subtraction was rolled back
+                    # inside _grant — nothing else to undo.
+                    pass
+            task.add_done_callback(undo)
+            raise
+
     def _pump_lease_queue(self) -> None:
         """Grant queued lease requests that now fit (FIFO with skip)."""
         if not self._lease_waiters:
@@ -361,11 +448,17 @@ class NodeDaemon:
         async def grant_later(demand, placement, fut):
             try:
                 reply = await self._grant(demand, placement)
-                if not fut.done():
-                    fut.set_result(reply)
             except Exception as e:  # noqa: BLE001
                 if not fut.done():
                     fut.set_exception(e)
+                return
+            if fut.done():
+                # Waiter cancelled (client deadline) while we granted:
+                # undo, or the lease + resources leak forever.
+                if reply.get("granted"):
+                    self._return_lease_internal(reply["lease_id"])
+            else:
+                fut.set_result(reply)
 
         while self._lease_waiters:
             demand, placement, fut = self._lease_waiters.popleft()
@@ -380,6 +473,7 @@ class NodeDaemon:
                     ok = True
             elif rs.fits(self.available, demand):
                 rs.subtract(self.available, demand)
+                self._ledger("sub:pump", demand)
                 ok = True
             if ok:
                 asyncio.ensure_future(grant_later(demand, placement, fut))
@@ -391,14 +485,25 @@ class NodeDaemon:
         try:
             worker = await self._get_idle_worker()
         except Exception as e:  # noqa: BLE001
-            # Roll back the resource subtraction.
+            # Roll back the resource subtraction. Worker-start failures
+            # are transient (crash/chaos/slow start) — the resources are
+            # back, so the client should retry, not give up.
             self._release_demand(demand, placement)
-            return {"granted": False, "error": str(e)}
+            return {"granted": False, "transient": True, "error": str(e)}
         worker.busy = True
         lease_id = uuid.uuid4().hex
         self._leases[lease_id] = Lease(lease_id, demand, worker, placement)
+        self._ledger(f"grant:{lease_id[:8]}:pid{worker.proc.pid}", demand)
         return {"granted": True, "worker_address": worker.address,
                 "lease_id": lease_id}
+
+    def _ledger(self, tag: str, demand) -> None:
+        import os as _os
+        if _os.environ.get("RAY_TPU_LEDGER"):
+            import sys as _sys
+            print(f"LEDGER {tag} {demand.get('CPU')} avail="
+                  f"{self.available.get('CPU')}", file=_sys.stderr,
+                  flush=True)
 
     def _release_demand(self, demand, placement) -> None:
         if placement is not None:
@@ -407,6 +512,7 @@ class NodeDaemon:
                 rs.add(bundle["available"], demand)
         else:
             rs.add(self.available, demand)
+            self._ledger("add:release", demand)
 
     def return_lease(self, lease_id: str) -> dict:
         self._return_lease_internal(lease_id)
@@ -415,7 +521,9 @@ class NodeDaemon:
     def _return_lease_internal(self, lease_id: str) -> None:
         lease = self._leases.pop(lease_id, None)
         if lease is None:
+            self._ledger(f"return-miss:{lease_id[:8]}", {})
             return
+        self._ledger(f"return:{lease_id[:8]}", lease.demand)
         self._release_demand(lease.demand, lease.placement)
         worker = lease.worker
         if worker.proc.poll() is None and worker.actor_id is None:
@@ -505,15 +613,19 @@ class NodeDaemon:
             rs.subtract(self.available, demand)
 
         handle = self._spawn_worker(actor_id=actor_id)
-        try:
-            await asyncio.wait_for(
-                handle.registered.wait(),
-                timeout=get_config().worker_register_timeout_s)
-        except asyncio.TimeoutError:
-            handle.proc.kill()
-            self._workers.pop(handle.worker_id, None)
-            self._release_demand(demand, placement)
-            return {"ok": False, "error": "actor worker failed to start"}
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + get_config().worker_register_timeout_s
+        while not handle.registered.is_set():
+            try:
+                await asyncio.wait_for(handle.registered.wait(), timeout=0.1)
+            except asyncio.TimeoutError:
+                if (handle.proc.poll() is not None
+                        or loop.time() >= deadline):
+                    handle.proc.kill()
+                    self._workers.pop(handle.worker_id, None)
+                    self._release_demand(demand, placement)
+                    return {"ok": False,
+                            "error": "actor worker failed to start"}
         handle.busy = True
         client = AsyncRpcClient(handle.address)
         try:

@@ -1,0 +1,120 @@
+"""Chaos harness: random worker/node killers for fault-injection tests.
+
+ref: python/ray/_private/test_utils.py:1429-1640 (ResourceKillerActor /
+WorkerKillerActor / NodeKillerActor + get_and_run_resource_killer).
+Runs on the driver as a background thread issuing kill RPCs to node
+daemons — the workload under test must complete correctly anyway.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class WorkerKiller:
+    """Periodically SIGKILLs a random task worker somewhere in the cluster.
+
+    Usage::
+
+        killer = WorkerKiller(interval_s=0.4)
+        killer.start()
+        ... run workload ...
+        kills = killer.stop()
+    """
+
+    def __init__(self, interval_s: float = 0.5, seed: int = 0,
+                 include_actor_workers: bool = False):
+        self.interval_s = interval_s
+        self.include_actor_workers = include_actor_workers
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills: List[dict] = []
+
+    # -- plumbing -----------------------------------------------------------
+    def _daemon_addresses(self) -> List[str]:
+        import ray_tpu
+
+        return [n["Address"] for n in ray_tpu.nodes() if n["Alive"]]
+
+    def _kill_one(self) -> Optional[dict]:
+        from ray_tpu.api import _global_worker
+        from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+        w = _global_worker()
+        addrs = self._daemon_addresses()
+        self._rng.shuffle(addrs)
+        for addr in addrs:
+            try:
+                client = SyncRpcClient(addr, w.loop_thread)
+                reply = client.call(
+                    "NodeDaemon", "kill_random_worker",
+                    include_actor_workers=self.include_actor_workers,
+                    seed=self._rng.randrange(1 << 30), timeout=10)
+                client.close()
+            except Exception:  # noqa: BLE001 — daemon itself may be dying
+                continue
+            if reply.get("ok"):
+                return reply
+        return None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            hit = self._kill_one()
+            if hit:
+                self.kills.append(hit)
+
+    # -- public -------------------------------------------------------------
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[dict]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        return self.kills
+
+
+class NodeKiller:
+    """Kills whole (non-head) nodes of a cluster_utils.Cluster — gang /
+    lineage recovery must absorb it (ref: NodeKillerActor,
+    test_utils.py:1497)."""
+
+    def __init__(self, cluster, interval_s: float = 2.0, seed: int = 0,
+                 max_kills: int = 1):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills: List[str] = []
+
+    def _loop(self) -> None:
+        while (not self._stop.wait(self.interval_s)
+               and len(self.kills) < self.max_kills):
+            victims = [n for n in self.cluster.nodes
+                       if n is not self.cluster.head]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            try:
+                self.cluster.remove_node(node)
+                self.kills.append(node.node_id)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[str]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        return self.kills

@@ -1,0 +1,61 @@
+"""Chaos tests: workloads complete while workers are being killed
+(ref: chaos release tests, release/nightly_tests/setup_chaos.py over
+_private/test_utils.py killer actors)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.chaos import WorkerKiller
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    cluster.connect()
+    cluster.wait_for_nodes(1)
+    yield cluster
+    cluster.shutdown()
+
+
+def test_tasks_survive_worker_kills(chaos_cluster):
+    @ray_tpu.remote(max_retries=10)
+    def slow_square(x):
+        time.sleep(0.15)
+        return x * x
+
+    killer = WorkerKiller(interval_s=0.3, seed=7).start()
+    try:
+        refs = [slow_square.remote(i) for i in range(60)]
+        out = ray_tpu.get(refs, timeout=300)
+    finally:
+        kills = killer.stop()
+    assert out == [i * i for i in range(60)]
+    # The harness must have actually injected failures.
+    assert len(kills) >= 1, "WorkerKiller never found a victim"
+
+
+def test_actor_survives_worker_kills_with_restart(chaos_cluster):
+    @ray_tpu.remote(max_restarts=20, max_task_retries=20)
+    class Echo:
+        def ping(self, i):
+            time.sleep(0.15)  # keep the workload alive across kill ticks
+            return i
+
+    a = Echo.remote()
+    assert ray_tpu.get(a.ping.remote(0), timeout=60) == 0
+    killer = WorkerKiller(interval_s=0.8, seed=3,
+                          include_actor_workers=True).start()
+    try:
+        ok = 0
+        for i in range(30):
+            try:
+                assert ray_tpu.get(a.ping.remote(i), timeout=60) == i
+                ok += 1
+            except ray_tpu.exceptions.ActorUnavailableError:
+                time.sleep(0.3)  # restart window; keep going
+    finally:
+        kills = killer.stop()
+    assert ok >= 15, f"too few successful calls under chaos: {ok}"
+    assert len(kills) >= 1
