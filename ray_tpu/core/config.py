@@ -79,6 +79,16 @@ class Config:
     task_max_retries: int = 3
     actor_max_restarts: int = 0
 
+    # ---- observability ----
+    # Prometheus text endpoint on each node daemon (0 = disabled);
+    # RAY_TPU_METRICS_EXPORT_PORT=8090 enables :8090/metrics.
+    metrics_export_port: int = 0
+    # Task events flushed to the GCS sink for the state API/timeline.
+    task_events_enabled: bool = True
+    task_events_flush_ms: int = 500
+    # Worker-side unflushed-event backstop when the GCS is unreachable.
+    task_events_max_buffer: int = 10000
+
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout
     rpc_connect_timeout_s: int = 30
@@ -90,11 +100,6 @@ class Config:
     # "TPU-{pod_type}-head" (ref: tpu.py:382).
     tpu_resource_name: str = "TPU"
     tpu_head_resource_format: str = "TPU-{pod_type}-head"
-
-    # ---- observability ----
-    metrics_export_port: int = 0
-    event_log_enabled: bool = True
-    task_events_max_buffer: int = 100000
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

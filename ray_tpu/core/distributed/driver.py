@@ -178,6 +178,19 @@ def connect_or_start_cluster(
         is_driver=True,
     )
     worker._spawned_processes = spawned
+    # Breadcrumb for the CLI (`ray-tpu status` with no --address), like
+    # the reference's /tmp/ray/ray_current_cluster. Per-uid dir with 0700
+    # so another local user can't plant an address the CLI would trust.
+    try:
+        import json
+
+        bc_dir = f"/tmp/ray_tpu_{os.getuid()}"
+        os.makedirs(bc_dir, mode=0o700, exist_ok=True)
+        with open(os.path.join(bc_dir, "last_cluster.json"), "w") as f:
+            json.dump({"gcs_address": gcs_address,
+                       "ts": time.time()}, f)
+    except OSError:
+        pass
     worker.gcs.call("JobManager", "register_job", job_id=job_id,
                     driver_address=worker.address, timeout=30)
     return worker

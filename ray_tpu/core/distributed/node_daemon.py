@@ -93,6 +93,7 @@ class NodeDaemon:
         self._tasks: List[asyncio.Task] = []
         self._soft_limit = int(get_config().num_workers_soft_limit
                                or self.total.get("CPU", 1))
+        self._init_metrics()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -110,12 +111,16 @@ class NodeDaemon:
             asyncio.ensure_future(self._monitor_workers_loop()),
             asyncio.ensure_future(self._refresh_view_loop()),
         ]
+        self._start_metrics_http()
         logger.info("node daemon %s on %s (resources=%s store=%s)",
                     self.node_id[:8], self.server.address, self.total,
                     self.store_dir)
         return port
 
     async def stop(self):
+        srv = getattr(self, "_metrics_http", None)
+        if srv is not None:
+            srv.shutdown()
         for t in self._tasks:
             t.cancel()
         for w in list(self._workers.values()):
@@ -182,10 +187,99 @@ class NodeDaemon:
         proc = subprocess.Popen(cmd, env=env,
                                 stdout=subprocess.DEVNULL,
                                 stderr=None)
+        self._m_spawned.inc()
         handle = WorkerHandle(proc, worker_id)
         handle.actor_id = actor_id
         self._workers[worker_id] = handle
         return handle
+
+    # ------------------------------------------------------------------
+    # metrics (ref: src/ray/stats/metric_defs.cc 43 DEFINE_stats; exported
+    # to Prometheus via the per-node metrics agent in the reference)
+    # ------------------------------------------------------------------
+    def _init_metrics(self) -> None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        tags = {"node_id": self.node_id[:12]}
+        self._m_leases = Counter(
+            "raytpu_leases_granted_total",
+            "Worker leases granted by this daemon").set_default_tags(tags)
+        self._m_spawned = Counter(
+            "raytpu_workers_spawned_total",
+            "Worker processes spawned").set_default_tags(tags)
+        self._m_workers = Gauge(
+            "raytpu_workers", "Live worker processes").set_default_tags(tags)
+        self._m_busy = Gauge(
+            "raytpu_workers_busy", "Busy workers").set_default_tags(tags)
+        self._m_waiters = Gauge(
+            "raytpu_lease_waiters",
+            "Lease requests queued on resources").set_default_tags(tags)
+        self._m_store_used = Gauge(
+            "raytpu_object_store_used_bytes",
+            "Shm store bytes in use").set_default_tags(tags)
+        self._m_store_objects = Gauge(
+            "raytpu_object_store_objects",
+            "Objects in the shm store").set_default_tags(tags)
+        self._m_spilled = Gauge(
+            "raytpu_object_store_spilled_bytes",
+            "Bytes spilled to disk").set_default_tags(tags)
+        self._m_lease_wait = Histogram(
+            "raytpu_lease_grant_seconds",
+            "Lease request to grant latency",
+            boundaries=(0.001, 0.01, 0.1, 1, 10)).set_default_tags(tags)
+
+    def get_metrics(self) -> str:
+        """Prometheus exposition text; also served over HTTP when
+        RAY_TPU_METRICS_EXPORT_PORT is set (ref: metrics agent scrape
+        endpoint, dashboard/modules/metrics)."""
+        from ray_tpu.util.metrics import get_registry
+
+        # Called from HTTP handler threads too: iterate over snapshots,
+        # never live dicts the event loop mutates.
+        workers = list(self._workers.values())
+        self._m_workers.set(
+            sum(1 for h in workers if h.proc.poll() is None))
+        self._m_busy.set(sum(1 for h in workers if h.busy))
+        self._m_waiters.set(len(self._lease_waiters))
+        self._m_store_used.set(self.store.used)
+        self._m_store_objects.set(self.store.num_objects)
+        self._m_spilled.set(self.store.spilled_bytes)
+        return get_registry().prometheus_text()
+
+    def _start_metrics_http(self) -> None:
+        port = get_config().metrics_export_port
+        if not port:
+            return
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = daemon.get_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        try:
+            srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        except OSError as e:
+            logger.warning("metrics HTTP port %d unavailable: %s", port, e)
+            return
+        self._metrics_http = srv
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        logger.info("metrics exported on :%d/metrics", srv.server_address[1])
 
     def debug_state(self) -> dict:
         """Scheduler-state snapshot (ref: DebugString dumps the reference
@@ -414,7 +508,8 @@ class NodeDaemon:
 
     async def _wait_for_lease(self, demand, placement) -> dict:
         fut = asyncio.get_running_loop().create_future()
-        self._lease_waiters.append((demand, placement, fut))
+        self._lease_waiters.append((demand, placement, fut,
+                                    time.monotonic()))
         return await fut
 
     async def _grant_safely(self, demand, placement) -> dict:
@@ -461,7 +556,7 @@ class NodeDaemon:
                 fut.set_result(reply)
 
         while self._lease_waiters:
-            demand, placement, fut = self._lease_waiters.popleft()
+            demand, placement, fut, queued_at = self._lease_waiters.popleft()
             if fut.done():
                 continue
             ok = False
@@ -476,9 +571,10 @@ class NodeDaemon:
                 self._ledger("sub:pump", demand)
                 ok = True
             if ok:
+                self._m_lease_wait.observe(time.monotonic() - queued_at)
                 asyncio.ensure_future(grant_later(demand, placement, fut))
             else:
-                remaining.append((demand, placement, fut))
+                remaining.append((demand, placement, fut, queued_at))
         self._lease_waiters = remaining
 
     async def _grant(self, demand, placement) -> dict:
@@ -493,6 +589,7 @@ class NodeDaemon:
         worker.busy = True
         lease_id = uuid.uuid4().hex
         self._leases[lease_id] = Lease(lease_id, demand, worker, placement)
+        self._m_leases.inc()
         self._ledger(f"grant:{lease_id[:8]}:pid{worker.proc.pid}", demand)
         return {"granted": True, "worker_address": worker.address,
                 "lease_id": lease_id}

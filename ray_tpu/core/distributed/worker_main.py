@@ -144,6 +144,56 @@ class WorkerService:
         self._task_pool = ThreadPoolExecutor(max_workers=4,
                                              thread_name_prefix="exec")
         self._max_inline = get_config().max_inline_object_size
+        # Task-event sink (ref: gcs_task_manager.h — powers `ray-tpu list
+        # tasks` and the chrome-trace timeline). Batched like locations.
+        self._events: List[dict] = []
+        self._events_lock = threading.Lock()
+        if get_config().task_events_enabled:
+            self._start_event_flusher()
+
+    def _start_event_flusher(self) -> None:
+        period = get_config().task_events_flush_ms / 1000
+
+        async def flush_loop():
+            import asyncio as _a
+
+            while True:
+                await _a.sleep(period)
+                with self._events_lock:
+                    batch, self._events = self._events, []
+                if not batch:
+                    continue
+                try:
+                    gcs = await self.core._aget_gcs()
+                    await gcs.call("TaskEvents", "add_events",
+                                   events=batch, timeout=10)
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("task event flush failed: %s", e)
+
+        self.core.loop_thread.submit(flush_loop())
+
+    def _record_event(self, spec: dict, state: str, start_ts: float,
+                      end_ts: float, error: Optional[str] = None) -> None:
+        if not get_config().task_events_enabled:
+            return
+        with self._events_lock:
+            self._events.append({
+                "task_id": spec["task_id"].hex(),
+                "name": spec["options"].get("name", "task"),
+                "job_id": spec.get("job_id"),
+                "actor_id": spec.get("actor_id"),
+                "attempt": spec.get("attempt", 0),
+                "node_id": self.core.node_id,
+                "worker_id": self.worker_id,
+                "pid": os.getpid(),
+                "state": state,
+                "start_ts": start_ts,
+                "end_ts": end_ts,
+                "error": error,
+            })
+            cap = get_config().task_events_max_buffer
+            if len(self._events) > cap:  # backstop vs a dead GCS
+                del self._events[:cap // 2]
 
     # ---- helpers ------------------------------------------------------
     def _fetch_arg(self, oid: ObjectID) -> Any:
@@ -244,6 +294,9 @@ class WorkerService:
                     except BaseException as e:  # noqa: BLE001 the payload
                         err = e
                 return {"results": prior, "error": err}
+        import time as _time
+
+        start_ts = _time.time()
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
@@ -251,8 +304,10 @@ class WorkerService:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            return {"results": self._store_results(spec, result),
-                    "error": None}
+            reply = {"results": self._store_results(spec, result),
+                     "error": None}
+            self._record_event(spec, "FINISHED", start_ts, _time.time())
+            return reply
         except BaseException as e:  # noqa: BLE001
             err = (e if isinstance(e, rexc.RayTpuError)
                    else rexc.TaskError.from_exception(
@@ -262,6 +317,8 @@ class WorkerService:
                 self._store_results(spec, err, is_error=True)
             except Exception:  # noqa: BLE001
                 pass
+            self._record_event(spec, "FAILED", start_ts, _time.time(),
+                               error=repr(e))
             return {"results": [], "error": err}
 
     # ---- RPC surface --------------------------------------------------
@@ -324,19 +381,27 @@ class WorkerService:
     def _execute_actor(self, spec: dict, resolve_only: bool = False,
                        coro_args=None):
         name = f"{type(self.actor.instance).__name__}.{spec['method_name']}"
+        import time as _time
+
         if coro_args is not None:
             # Async path phase 2: returns an awaitable producing the reply.
             async def run():
+                start_ts = _time.time()
                 try:
                     method = getattr(self.actor.instance,
                                      spec["method_name"])
                     result = await method(*coro_args[0], **coro_args[1])
-                    return {"results": self._store_results(spec, result),
-                            "error": None}
+                    reply = {"results": self._store_results(spec, result),
+                             "error": None}
+                    self._record_event(spec, "FINISHED", start_ts,
+                                       _time.time())
+                    return reply
                 except BaseException as e:  # noqa: BLE001
                     err = rexc.ActorError.from_exception(
                         e, name, pid=os.getpid(), node_id=self.core.node_id)
                     self._store_results(spec, err, is_error=True)
+                    self._record_event(spec, "FAILED", start_ts,
+                                       _time.time(), error=repr(e))
                     return {"results": [], "error": err}
 
             return run()
@@ -348,13 +413,16 @@ class WorkerService:
             return {"results": [], "error": err}
         if resolve_only:
             return args, kwargs
+        start_ts = _time.time()
         try:
             method = getattr(self.actor.instance, spec["method_name"])
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            return {"results": self._store_results(spec, result),
-                    "error": None}
+            reply = {"results": self._store_results(spec, result),
+                     "error": None}
+            self._record_event(spec, "FINISHED", start_ts, _time.time())
+            return reply
         except BaseException as e:  # noqa: BLE001
             err = rexc.ActorError.from_exception(
                 e, name, pid=os.getpid(), node_id=self.core.node_id)
@@ -362,6 +430,8 @@ class WorkerService:
                 self._store_results(spec, err, is_error=True)
             except Exception:  # noqa: BLE001
                 pass
+            self._record_event(spec, "FAILED", start_ts, _time.time(),
+                               error=repr(e))
             return {"results": [], "error": err}
 
     def ping(self) -> dict:

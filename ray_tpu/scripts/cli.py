@@ -1,0 +1,244 @@
+"""`ray-tpu` operator CLI.
+
+ref: python/ray/scripts/scripts.py (click group :59 — ray start/status/
+timeline/...) + the state CLI (python/ray/util/state/state_cli.py —
+`ray list tasks|actors|nodes`). Subcommands talk straight to the GCS over
+the pickle-codec RPC; the address comes from --address, RAY_TPU_ADDRESS,
+or the breadcrumb the last local driver wrote.
+
+Usage:
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli list nodes|actors|tasks|jobs|pgs|workers
+    python -m ray_tpu.scripts.cli timeline --out trace.json
+    python -m ray_tpu.scripts.cli metrics [--node <id-prefix>]
+    python -m ray_tpu.scripts.cli start --head [--num-cpus N ...]
+    python -m ray_tpu.scripts.cli start --address <gcs> [--num-cpus N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+BREADCRUMB = f"/tmp/ray_tpu_{os.getuid()}/last_cluster.json"
+
+
+def _resolve_address(args) -> str:
+    if args.address:
+        return args.address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(BREADCRUMB) as f:
+            return json.load(f)["gcs_address"]
+    except (OSError, KeyError, ValueError):
+        pass
+    sys.exit("error: no cluster address (use --address, RAY_TPU_ADDRESS, "
+             "or run a driver on this host first)")
+
+
+class _Gcs:
+    def __init__(self, address: str):
+        from ray_tpu.core.distributed.rpc import (
+            EventLoopThread,
+            SyncRpcClient,
+        )
+
+        self._loop = EventLoopThread("cli")
+        self.client = SyncRpcClient(address, self._loop)
+        self.address = address
+
+    def call(self, service, method, **kw):
+        return self.client.call(service, method, timeout=15, **kw)
+
+    def daemon(self, address: str):
+        from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+        return SyncRpcClient(address, self._loop)
+
+
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [len(h) for h in headers]
+    for r in rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(str(c)))
+    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    out = [line, "-" * len(line)]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+def cmd_status(gcs: _Gcs, args) -> None:
+    nodes = gcs.call("NodeInfo", "list_nodes")
+    alive = [n for n in nodes if n["alive"]]
+    total: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in n["total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in n["available"].items():
+            avail[k] = avail.get(k, 0) + v
+    actors = gcs.call("ActorManager", "list_actors")
+    jobs = gcs.call("JobManager", "list_jobs")
+    pgs = gcs.call("PlacementGroups", "list_pgs")
+    print(f"cluster @ {gcs.address}")
+    print(f"  nodes: {len(alive)} alive / {len(nodes)} total")
+    for k in sorted(total):
+        if k == "memory":
+            print(f"  memory: {avail.get(k, 0) / 1e9:.1f}/"
+                  f"{total[k] / 1e9:.1f} GB free")
+        else:
+            print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} free")
+    states = {}
+    for a in actors:
+        states[a["state"]] = states.get(a["state"], 0) + 1
+    print(f"  actors: {len(actors)} "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(states.items()))})"
+          if actors else "  actors: 0")
+    print(f"  placement groups: {len(pgs)}")
+    running = [j for j in jobs if not j.get("finished")]
+    print(f"  jobs: {len(running)} running / {len(jobs)} total")
+
+
+def cmd_list(gcs: _Gcs, args) -> None:
+    kind = args.kind
+    if kind == "nodes":
+        rows = [[n["node_id"][:12], "ALIVE" if n["alive"] else "DEAD",
+                 n["address"],
+                 " ".join(f"{k}={v:g}" for k, v in sorted(
+                     n["total"].items()) if k != "memory")]
+                for n in gcs.call("NodeInfo", "list_nodes")]
+        print(_fmt_table(rows, ["NODE_ID", "STATE", "ADDRESS", "RESOURCES"]))
+    elif kind == "actors":
+        rows = [[a["actor_id"][:12], a.get("cls_name", ""), a["state"],
+                 a.get("name") or "", (a.get("node_id") or "")[:12]]
+                for a in gcs.call("ActorManager", "list_actors")]
+        print(_fmt_table(rows, ["ACTOR_ID", "CLASS", "STATE", "NAME",
+                                "NODE"]))
+    elif kind == "tasks":
+        events = gcs.call("TaskEvents", "list_events", limit=args.limit)
+        rows = [[e["task_id"][:12], e.get("name", ""), e.get("state", ""),
+                 f"{(e.get('end_ts', 0) - e.get('start_ts', 0)) * 1000:.1f}",
+                 (e.get("node_id") or "")[:12], e.get("error") or ""]
+                for e in events]
+        print(_fmt_table(rows, ["TASK_ID", "NAME", "STATE", "MS", "NODE",
+                                "ERROR"]))
+    elif kind == "jobs":
+        rows = [[j["job_id"], "FINISHED" if j.get("finished") else "RUNNING",
+                 time.strftime("%H:%M:%S",
+                               time.localtime(j.get("start_time", 0)))]
+                for j in gcs.call("JobManager", "list_jobs")]
+        print(_fmt_table(rows, ["JOB_ID", "STATE", "STARTED"]))
+    elif kind == "pgs":
+        rows = [[p["pg_id"][:12], p["state"], p["strategy"],
+                 str(len(p.get("bundles", [])))]
+                for p in gcs.call("PlacementGroups", "list_pgs")]
+        print(_fmt_table(rows, ["PG_ID", "STATE", "STRATEGY", "BUNDLES"]))
+    elif kind == "workers":
+        rows = []
+        for n in gcs.call("NodeInfo", "list_nodes"):
+            if not n["alive"]:
+                continue
+            try:
+                for w in gcs.daemon(n["address"]).call(
+                        "NodeDaemon", "list_workers", timeout=10):
+                    rows.append([n["node_id"][:12], w["worker_id"][:12],
+                                 w["pid"],
+                                 "actor" if w["actor_id"] else "task",
+                                 "busy" if w["busy"] else "idle"])
+            except Exception as e:  # noqa: BLE001
+                rows.append([n["node_id"][:12], f"<unreachable: {e}>",
+                             "", "", ""])
+        print(_fmt_table(rows, ["NODE", "WORKER_ID", "PID", "KIND",
+                                "STATE"]))
+
+
+def cmd_timeline(gcs: _Gcs, args) -> None:
+    from ray_tpu.util.timeline import chrome_trace
+
+    events = gcs.call("TaskEvents", "list_events", limit=args.limit)
+    with open(args.out, "w") as f:
+        json.dump(chrome_trace(events), f)
+    print(f"wrote {len(events)} events to {args.out} "
+          f"(open in chrome://tracing)")
+
+
+def cmd_metrics(gcs: _Gcs, args) -> None:
+    for n in gcs.call("NodeInfo", "list_nodes"):
+        if not n["alive"]:
+            continue
+        if args.node and not n["node_id"].startswith(args.node):
+            continue
+        print(f"# node {n['node_id'][:12]} @ {n['address']}")
+        try:
+            print(gcs.daemon(n["address"]).call("NodeDaemon", "get_metrics",
+                                                timeout=10))
+        except Exception as e:  # noqa: BLE001
+            print(f"# unreachable: {e}")
+
+
+def cmd_start(args) -> None:
+    """Start a head (GCS + daemon) or join a worker daemon to a cluster
+    (ref: `ray start --head` / `ray start --address=...`)."""
+    from ray_tpu.core.distributed.driver import (
+        start_gcs_process,
+        start_node_daemon_process,
+    )
+
+    if args.head:
+        gcs_proc, gcs_address = start_gcs_process()
+        print(f"GCS started at {gcs_address}")
+        os.makedirs(os.path.dirname(BREADCRUMB), mode=0o700, exist_ok=True)
+        with open(BREADCRUMB, "w") as f:
+            json.dump({"gcs_address": gcs_address, "ts": time.time()}, f)
+    else:
+        if not args.address:
+            sys.exit("error: worker start needs --address <gcs>")
+        gcs_address = args.address
+    proc, info = start_node_daemon_process(
+        gcs_address, num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    print(f"node daemon {info['node_id'][:12]} at {info['address']} "
+          f"(store {info['store_dir']})")
+    print(f"join more nodes with: ray-tpu start --address {gcs_address}")
+    print("processes run until killed (Ctrl-C detaches, does not stop them)")
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    p.add_argument("--address", help="GCS address host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    lp = sub.add_parser("list")
+    lp.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs",
+                                     "pgs", "workers"])
+    lp.add_argument("--limit", type=int, default=200)
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--out", default="timeline.json")
+    tp.add_argument("--limit", type=int, default=10000)
+    mp = sub.add_parser("metrics")
+    mp.add_argument("--node", help="node id prefix filter")
+    sp = sub.add_parser("start")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    args = p.parse_args(argv)
+
+    if args.cmd == "start":
+        cmd_start(args)
+        return
+    gcs = _Gcs(_resolve_address(args))
+    {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
+     "metrics": cmd_metrics}[args.cmd](gcs, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,209 @@
+"""Metrics: Counter/Gauge/Histogram + process registry + Prometheus text.
+
+User-facing API mirrors the reference (ref: python/ray/util/metrics.py:19
+Counter, :137 Gauge/Histogram); the process-wide registry and text
+exposition replace the reference's OpenCensus->metrics-agent->Prometheus
+pipeline (ref: src/ray/stats/metric_defs.cc) with a single in-process
+registry each daemon/worker exposes directly.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_TagKey = Tuple[Tuple[str, str], ...]
+
+
+def _tagkey(tags: Optional[Dict[str, str]]) -> _TagKey:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name.replace("_", "a").replace(":", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        get_registry().register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> _TagKey:
+        if self._default_tags:
+            merged = dict(self._default_tags)
+            merged.update(tags or {})
+            return _tagkey(merged)
+        return _tagkey(tags)
+
+    # exposition
+    def kind(self) -> str:
+        raise NotImplementedError
+
+    def samples(self) -> List[Tuple[_TagKey, float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing value (ref: util/metrics.py:19)."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[_TagKey, float] = defaultdict(float)
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc() takes a non-negative value")
+        with self._lock:
+            self._values[self._merged(tags)] += value
+
+    def kind(self) -> str:
+        return "counter"
+
+    def samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Gauge(Metric):
+    """Last-set value (ref: util/metrics.py Gauge)."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[_TagKey, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._merged(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None) -> None:
+        with self._lock:
+            k = self._merged(tags)
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None) -> None:
+        self.inc(-value, tags)
+
+    def kind(self) -> str:
+        return "gauge"
+
+    def samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Histogram(Metric):
+    """Bucketed observations (ref: util/metrics.py Histogram)."""
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        if not boundaries:
+            boundaries = (0.001, 0.01, 0.1, 1, 10, 100, 1000)
+        self.boundaries = tuple(sorted(boundaries))
+        self._counts: Dict[_TagKey, List[int]] = {}
+        self._sums: Dict[_TagKey, float] = defaultdict(float)
+        self._totals: Dict[_TagKey, int] = defaultdict(int)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._merged(tags)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.boundaries) + 1)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def kind(self) -> str:
+        return "histogram"
+
+    def samples(self):
+        # Flattened as cumulative-bucket samples in prometheus_text().
+        with self._lock:
+            return [(k, float(t)) for k, t in self._totals.items()]
+
+    def snapshot(self):
+        with self._lock:
+            return ({k: list(v) for k, v in self._counts.items()},
+                    dict(self._sums), dict(self._totals))
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (text/plain; version 0.0.4)."""
+        out: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            desc = m.description.replace("\\", "\\\\").replace("\n", "\\n")
+            out.append(f"# HELP {m.name} {desc}")
+            out.append(f"# TYPE {m.name} {m.kind()}")
+            if isinstance(m, Histogram):
+                counts, sums, totals = m.snapshot()
+                for key, buckets in counts.items():
+                    base = _fmt_tags(key)
+                    cum = 0
+                    for b, c in zip(m.boundaries, buckets):
+                        cum += c
+                        out.append(
+                            f"{m.name}_bucket{_fmt_tags(key, le=b)} {cum}")
+                    cum += buckets[-1]
+                    out.append(
+                        f"{m.name}_bucket{_fmt_tags(key, le='+Inf')} {cum}")
+                    out.append(f"{m.name}_sum{base} {sums[key]}")
+                    out.append(f"{m.name}_count{base} {totals[key]}")
+            else:
+                for key, value in m.samples():
+                    out.append(f"{m.name}{_fmt_tags(key)} {value}")
+        return "\n".join(out) + "\n"
+
+
+def _esc(value: str) -> str:
+    """Escape per the Prometheus exposition format: \\, \", newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_tags(key: _TagKey, le=None) -> str:
+    parts = [f'{k}="{_esc(v)}"' for k, v in key]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = MetricsRegistry()
+        return _registry

@@ -1,0 +1,51 @@
+"""Chrome-trace timeline export from the GCS task-event sink.
+
+ref: `ray timeline` (python/ray/_private/state.py:917 chrome_tracing_dump
+over profile events, _private/profiling.py). Open the output in
+chrome://tracing or https://ui.perfetto.dev.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def fetch_task_events(limit: int = 10000) -> List[dict]:
+    from ray_tpu.api import _global_worker
+
+    return _global_worker().gcs.call("TaskEvents", "list_events",
+                                     limit=limit, timeout=30)
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+    """Convert task events to chrome-trace 'X' (complete) events."""
+    if events is None:
+        events = fetch_task_events()
+    trace = []
+    for e in events:
+        start, end = e.get("start_ts"), e.get("end_ts")
+        if start is None or end is None:
+            continue
+        trace.append({
+            "name": e.get("name", "task"),
+            "cat": "actor_task" if e.get("actor_id") else "task",
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": max(0.0, (end - start)) * 1e6,
+            "pid": f"node:{(e.get('node_id') or '?')[:8]}",
+            "tid": f"worker:{e.get('pid', '?')}",
+            "args": {
+                "task_id": e.get("task_id"),
+                "state": e.get("state"),
+                "attempt": e.get("attempt"),
+                "error": e.get("error"),
+            },
+        })
+    return trace
+
+
+def timeline(filename: str = "timeline.json") -> str:
+    """Dump the cluster's task timeline as a chrome trace; returns path."""
+    with open(filename, "w") as f:
+        json.dump(chrome_trace(), f)
+    return filename

@@ -1,0 +1,136 @@
+"""Observability floor tests: metrics registry/exposition, task events ->
+state list + chrome trace, CLI surfaces (VERDICT r1 item 7; ref:
+src/ray/stats/metric_defs.cc, python/ray/util/state/state_cli.py,
+_private/profiling.py timeline)."""
+import io
+import json
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, get_registry
+
+
+# ---------------------------------------------------------------------------
+# metrics unit tests
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_exposition():
+    c = Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = Gauge("test_inflight", "in flight")
+    g.set(5)
+    g.dec()
+    h = Histogram("test_latency_seconds", "lat", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    text = get_registry().prometheus_text()
+    assert 'test_requests_total{route="/a"} 2.0' in text
+    assert 'test_requests_total{route="/b"} 1.0' in text
+    assert "test_inflight 4.0" in text
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_latency_seconds_count 3" in text
+    assert "# TYPE test_requests_total counter" in text
+
+
+def test_counter_rejects_negative():
+    c = Counter("test_neg_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+# ---------------------------------------------------------------------------
+# cluster: task events, daemon metrics, timeline, CLI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    import ray_tpu as rt
+
+    rt.init(num_cpus=2, ignore_reinit_error=True)
+    yield rt
+    rt.shutdown()
+
+
+def test_task_events_and_timeline(obs_cluster, tmp_path):
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("intentional")
+
+    assert ray_tpu.get([traced.remote(i) for i in range(5)],
+                       timeout=120) == [1, 2, 3, 4, 5]
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote(), timeout=120)
+
+    # Events are flushed on a short period; poll the sink.
+    from ray_tpu.api import _global_worker
+
+    w = _global_worker()
+    deadline = time.monotonic() + 20
+    events = []
+    while time.monotonic() < deadline:
+        events = w.gcs.call("TaskEvents", "list_events", timeout=15)
+        names = " ".join(e["name"] for e in events)
+        if "traced" in names and "boom" in names:
+            break
+        time.sleep(0.3)
+    assert any("traced" in e["name"] and e["state"] == "FINISHED"
+               for e in events)
+    failed = [e for e in events if "boom" in e["name"]]
+    assert failed and failed[0]["state"] == "FAILED"
+    assert "intentional" in failed[0]["error"]
+
+    from ray_tpu.util.timeline import timeline
+
+    out = timeline(str(tmp_path / "trace.json"))
+    trace = json.load(open(out))
+    assert any("traced" in ev["name"] and ev["ph"] == "X" for ev in trace)
+
+
+def test_daemon_metrics_endpoint(obs_cluster):
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    w = _global_worker()
+    node = [n for n in ray_tpu.nodes() if n["Alive"]][0]
+    text = SyncRpcClient(node["Address"], w.loop_thread).call(
+        "NodeDaemon", "get_metrics", timeout=15)
+    assert "raytpu_leases_granted_total" in text
+    assert "raytpu_workers" in text
+    assert "raytpu_object_store_used_bytes" in text
+    assert "# TYPE raytpu_leases_granted_total counter" in text
+
+
+def test_cli_status_and_lists(obs_cluster):
+    from ray_tpu.api import _global_worker
+    from ray_tpu.scripts import cli
+
+    addr = _global_worker().gcs_address
+
+    def run(*argv):
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli.main(["--address", addr, *argv])
+        return buf.getvalue()
+
+    out = run("status")
+    assert "nodes: 1 alive" in out
+    assert "CPU:" in out
+    out = run("list", "nodes")
+    assert "ALIVE" in out
+    out = run("list", "tasks")
+    assert "traced" in out
+    out = run("list", "jobs")
+    assert "RUNNING" in out
+    out = run("metrics")
+    assert "raytpu_workers" in out
