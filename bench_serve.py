@@ -1,0 +1,119 @@
+"""Serve benchmark: req/s + p50/p95 TTFT for the continuous-batching LLM
+deployment over the async HTTP proxy with chunked token streaming.
+
+North-star metrics from BASELINE.json ("Serve req/s + p50 TTFT") — no
+reference numbers exist in-repo (BASELINE.md: "must be established by our
+own runs"), so vs_baseline is null. Prints one JSON line per metric.
+
+Usage: python bench_serve.py [--model tiny] [--requests 64]
+       [--concurrency 16] [--max-tokens 32]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import threading
+import time
+import urllib.request
+
+
+def emit(metric: str, value: float, unit: str) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit, "vs_baseline": None}), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--num-slots", type=int, default=8)
+    args = ap.parse_args()
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Child workers re-run sitecustomize, which re-registers the real
+        # TPU plugin and overrides JAX_PLATFORMS — any jax call in a
+        # replica then hangs when the TPU tunnel is down. Dropping the
+        # trigger env makes children honor the requested CPU platform
+        # (same guard as tests/conftest.py; bench.py probes instead).
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMDeployment
+
+    ray_tpu.init(num_cpus=4)
+    serve.run(
+        serve.deployment(LLMDeployment).bind(
+            args.model, num_slots=args.num_slots, max_len=256),
+        name="llm", _http=True, route_prefix="/llm")
+    port = serve.http_port()
+    url = f"http://127.0.0.1:{port}/llm?stream=1&method=stream"
+
+    # Warmup: trigger prefill/decode compiles before timing.
+    def one_request(prompt_len: int = 16):
+        body = json.dumps({"tokens": list(range(1, prompt_len + 1)),
+                           "max_tokens": args.max_tokens}).encode()
+        t0 = time.perf_counter()
+        resp = urllib.request.urlopen(
+            urllib.request.Request(url, data=body), timeout=600)
+        first = resp.readline()
+        ttft = time.perf_counter() - t0
+        ntok = 1 + sum(1 for _ in resp)
+        total = time.perf_counter() - t0
+        return ttft, total, ntok
+
+    one_request()
+    one_request(64)
+
+    ttfts: list = []
+    totals: list = []
+    tokens = [0]
+    lock = threading.Lock()
+    errors = [0]
+
+    def worker(n):
+        for _ in range(n):
+            try:
+                ttft, total, ntok = one_request()
+            except Exception:  # noqa: BLE001
+                with lock:
+                    errors[0] += 1
+                continue
+            with lock:
+                ttfts.append(ttft)
+                totals.append(total)
+                tokens[0] += ntok
+
+    per = max(1, args.requests // args.concurrency)
+    threads = [threading.Thread(target=worker, args=(per,))
+               for _ in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    n = len(ttfts)
+    if n == 0:
+        raise SystemExit("all requests failed")
+    ttfts.sort()
+    emit("serve_requests_per_second", n / wall, "req/s")
+    emit("serve_ttft_p50_ms", 1000 * ttfts[n // 2], "ms")
+    emit("serve_ttft_p95_ms", 1000 * ttfts[min(n - 1, int(n * 0.95))], "ms")
+    emit("serve_latency_mean_ms", 1000 * statistics.mean(totals), "ms")
+    emit("serve_tokens_per_second", tokens[0] / wall, "tokens/s")
+    if errors[0]:
+        emit("serve_errors", errors[0], "count")
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

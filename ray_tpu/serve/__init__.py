@@ -9,13 +9,16 @@ from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
                                       Deployment, deployment)
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
+                                  StreamingResponse)
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "shutdown", "status", "delete",
     "get_deployment_handle", "get_app_handle",
     "start_http_proxy", "http_port",
-    "DeploymentHandle", "DeploymentResponse",
+    "DeploymentHandle", "DeploymentResponse", "StreamingResponse",
+    "multiplexed", "get_multiplexed_model_id",
     "batch",
 ]

@@ -40,7 +40,10 @@ def run(app: Application | Deployment, *, name: str = "default",
             break
         time.sleep(0.1)
     if _http and route_prefix:
-        start_http_proxy().set_route.remote(route_prefix, name)
+        # Await route installation: a request racing a fire-and-forget
+        # set_route would 404.
+        ray_tpu.get(start_http_proxy().set_route.remote(route_prefix, name),
+                    timeout=30)
     handle = DeploymentHandle(name)
     if blocking:  # pragma: no cover
         while True:
@@ -51,6 +54,15 @@ def run(app: Application | Deployment, *, name: str = "default",
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
     """Start (or return) the node's HTTP proxy actor."""
     global _proxy_handle, _proxy_port
+    if _proxy_handle is not None:
+        # The cached handle may belong to a previous cluster (driver
+        # shut down without serve.shutdown()); validate before reuse.
+        try:
+            _proxy_port = ray_tpu.get(_proxy_handle.port.remote(),
+                                      timeout=5)
+        except Exception:  # noqa: BLE001
+            _proxy_handle = None
+            _proxy_port = None
     if _proxy_handle is None:
         from ray_tpu.serve.http_proxy import HTTPProxy
 

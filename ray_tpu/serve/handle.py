@@ -19,16 +19,32 @@ from ray_tpu.serve.controller import get_or_create_controller
 
 
 class DeploymentResponse:
-    """Future-like wrapper over the underlying ObjectRef."""
+    """Future-like wrapper over the underlying ObjectRef.
 
-    def __init__(self, ref, on_done=None):
+    Replica death between routing and completion is retried through the
+    handle (refresh + re-pick), like the reference router's transparent
+    replica-failure retries (ref: _private/router.py)."""
+
+    def __init__(self, ref, on_done=None, retry_fn=None):
         self._ref = ref
         self._on_done = on_done
+        self._retry_fn = retry_fn
         self._done = False
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        import ray_tpu.exceptions as rexc
+
         try:
-            out = ray_tpu.get(self._ref, timeout=timeout)
+            for attempt in range(3):
+                try:
+                    out = ray_tpu.get(self._ref, timeout=timeout)
+                    break
+                except (rexc.ActorDiedError,
+                        rexc.ActorUnavailableError):
+                    if self._retry_fn is None or attempt == 2:
+                        raise
+                    time.sleep(0.2 * (attempt + 1))
+                    self._ref = self._retry_fn()
         finally:
             self._settle()
         return out
@@ -54,6 +70,11 @@ class DeploymentHandle:
         self._last_stats_push = 0.0
         self._last_refresh = 0.0
         self._refresh_ttl = 0.5
+        self._model_id: Optional[str] = None
+        self._stream = False
+        # model_id -> replica name that recently served it (multiplexed
+        # locality, ref: pow_2_scheduler.py multiplex-aware candidates).
+        self._model_affinity: Dict[str, str] = {}
 
     # handle.method_name.remote(...) sugar
     def __getattr__(self, item):
@@ -68,10 +89,20 @@ class DeploymentHandle:
         h._method = method
         return h
 
-    def options(self, *, method_name: Optional[str] = None, **_ignored):
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None, **_ignored):
+        h = self
         if method_name:
-            return DeploymentHandle.__new_method(self, method_name)
-        return self
+            h = DeploymentHandle.__new_method(h, method_name)
+        if multiplexed_model_id is not None or stream is not None:
+            if h is self:
+                h = DeploymentHandle.__new_method(self, self._method)
+            if multiplexed_model_id is not None:
+                h._model_id = multiplexed_model_id
+            if stream is not None:
+                h._stream = stream
+        return h
 
     def _refresh(self, force: bool = False):
         # TTL throttle: the controller round-trip must not be on every
@@ -103,12 +134,30 @@ class DeploymentHandle:
             with self._lock:
                 names = list(self._replicas)
                 if names:
-                    if len(names) == 1:
-                        pick = names[0]
-                    else:
-                        a, b = random.sample(names, 2)
-                        pick = (a if self._outstanding.get(a, 0)
-                                <= self._outstanding.get(b, 0) else b)
+                    pick = None
+                    # Multiplexed locality: prefer the replica that already
+                    # holds this model (avoids a reload), unless it is
+                    # clearly the most loaded one.
+                    if self._model_id:
+                        cand = self._model_affinity.get(self._model_id)
+                        if cand in self._replicas:
+                            load = self._outstanding.get(cand, 0)
+                            if load <= 2 + min(
+                                    (self._outstanding.get(n, 0)
+                                     for n in names), default=0):
+                                pick = cand
+                    if pick is None:
+                        if len(names) == 1:
+                            pick = names[0]
+                        else:
+                            a, b = random.sample(names, 2)
+                            pick = (a if self._outstanding.get(a, 0)
+                                    <= self._outstanding.get(b, 0) else b)
+                        if self._model_id:
+                            self._model_affinity[self._model_id] = pick
+                            while len(self._model_affinity) > 1024:
+                                self._model_affinity.pop(
+                                    next(iter(self._model_affinity)))
                     self._outstanding[pick] = \
                         self._outstanding.get(pick, 0) + 1
                     return pick, self._replicas[pick]
@@ -129,7 +178,41 @@ class DeploymentHandle:
         except Exception:  # noqa: BLE001
             pass
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return self.remote_streaming(*args, **kwargs)
+        self._refresh()
+        name, replica = self._pick_replica()
+        self._push_stats()
+        # Mutable cell: retries re-route to a new replica; on_done must
+        # decrement whichever replica CURRENTLY carries the request.
+        holder = {"name": name}
+
+        def on_done():
+            with self._lock:
+                n = holder["name"]
+                self._outstanding[n] = max(0, self._outstanding.get(n, 1) - 1)
+
+        def retry():
+            on_done()  # release the failed pick before re-picking
+            self._refresh(force=True)
+            name2, replica2 = self._pick_replica()
+            holder["name"] = name2
+            return replica2.handle_request.remote(
+                self._method, args, kwargs, model_id=self._model_id)
+
+        try:
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs, model_id=self._model_id)
+        except Exception:
+            # replica may have just died; refresh and retry once
+            ref = retry()
+        return DeploymentResponse(ref, on_done, retry_fn=retry)
+
+    def remote_streaming(self, *args, **kwargs) -> "StreamingResponse":
+        """Streaming call: the replica runs a generator; items arrive in
+        pulled batches (ref: streaming ObjectRefGenerator replies,
+        proxy.py:747 streaming responses)."""
         self._refresh()
         name, replica = self._pick_replica()
         self._push_stats()
@@ -138,12 +221,47 @@ class DeploymentHandle:
             with self._lock:
                 self._outstanding[n] = max(0, self._outstanding.get(n, 1) - 1)
 
+        sid_ref = replica.handle_request_streaming.remote(
+            self._method, args, kwargs, model_id=self._model_id)
+        return StreamingResponse(replica, sid_ref, on_done)
+
+
+class StreamingResponse:
+    """Iterator over a replica-side stream; batches pulls to amortize the
+    per-call RPC cost."""
+
+    def __init__(self, replica, sid_ref, on_done, max_items: int = 32):
+        self._replica = replica
+        self._sid_ref = sid_ref
+        self._sid = None
+        self._on_done = on_done
+        self._max_items = max_items
+        self._settled = False
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            if self._on_done:
+                self._on_done()
+
+    def cancel(self):
+        if self._sid is not None:
+            try:
+                self._replica.cancel_stream.remote(self._sid)
+            except Exception:  # noqa: BLE001
+                pass
+        self._settle()
+
+    def __iter__(self):
         try:
-            ref = replica.handle_request.remote(self._method, args, kwargs)
-        except Exception:
-            on_done()
-            # replica may have just died; refresh and retry once
-            self._refresh(force=True)
-            name, replica = self._pick_replica()
-            ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, on_done)
+            self._sid = ray_tpu.get(self._sid_ref, timeout=120)
+            while True:
+                batch = ray_tpu.get(
+                    self._replica.stream_next.remote(
+                        self._sid, max_items=self._max_items),
+                    timeout=120)
+                yield from batch["items"]
+                if batch["done"]:
+                    return
+        finally:
+            self._settle()

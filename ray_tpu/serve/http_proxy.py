@@ -1,77 +1,157 @@
-"""HTTP ingress: per-node proxy actor routing to deployment handles.
+"""HTTP ingress: async per-node proxy actor routing to deployment handles.
 
-Reference: uvicorn-based `HTTPProxy` actor per node
-(ref: python/ray/serve/_private/proxy.py:747; GenericProxy routing :129).
-Stdlib-only equivalent (uvicorn isn't in this image): a ThreadingHTTPServer
-inside a proxy actor; JSON bodies in, JSON out; routes by prefix.
+Reference: uvicorn-based `HTTPProxy` actor per node with streaming
+responses (ref: python/ray/serve/_private/proxy.py:747; GenericProxy
+routing :129). aiohttp replaces uvicorn here: requests are served on the
+proxy's own asyncio loop; handle calls (which block on the runtime) run
+on an executor pool; streaming deployments answer with chunked JSONL —
+one line per yielded item — so token streams reach the client as they
+are generated (TTFT == first chunk).
+
+Routes: POST/GET <prefix>            -> unary   {"...": ...}
+        POST/GET <prefix>?stream=1   -> chunked JSONL stream
+Headers: X-Model-Id (or body {"model_id": ...}) -> multiplexed routing.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
 
 
 class HTTPProxy:
-    """Actor: owns the HTTP server + route table {prefix: app_name}."""
+    """Actor: owns the aiohttp server + route table {prefix: app_name}."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        proxy = self
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 executor_threads: int = 64):
         self._routes: Dict[str, str] = {}
-        self._handles: Dict[str, DeploymentHandle] = {}
+        self._handles: Dict[str, object] = {}
+        self._executor = ThreadPoolExecutor(max_workers=executor_threads,
+                                            thread_name_prefix="proxy")
+        self._port: Optional[int] = None
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._host = host
+        self._want_port = port
+        threading.Thread(target=self._serve_thread, daemon=True).start()
+        if not self._started.wait(30):
+            raise RuntimeError("HTTP proxy failed to start")
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+    # -- aiohttp server on a dedicated loop -----------------------------
+    def _serve_thread(self) -> None:
+        from aiohttp import web
 
-            def _dispatch(self, body):
-                path = self.path.split("?")[0].rstrip("/") or "/"
-                app = None
-                match_len = -1
-                for prefix, name in proxy._routes.items():
-                    if (path == prefix or path.startswith(
-                            prefix.rstrip("/") + "/")) \
-                            and len(prefix) > match_len:
-                        app, match_len = name, len(prefix)
-                if app is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no route"}')
-                    return
-                h = proxy._handles.get(app)
-                if h is None:
-                    h = proxy._handles[app] = DeploymentHandle(app)
-                try:
-                    arg = json.loads(body) if body else None
-                    out = h.remote(arg).result(timeout=60)
-                    payload = json.dumps(out).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(payload)
-                except Exception as e:  # noqa: BLE001
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(json.dumps(
-                        {"error": str(e)}).encode())
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
 
-            def do_GET(self):
-                self._dispatch(None)
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
 
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                self._dispatch(self.rfile.read(n) if n else None)
+        async def start():
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._want_port)
+            await site.start()
+            self._port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        loop.run_until_complete(start())
+        loop.run_forever()
 
+    def _match_route(self, path: str) -> Optional[str]:
+        path = path.rstrip("/") or "/"
+        app, match_len = None, -1
+        for prefix, name in self._routes.items():
+            if (path == prefix
+                    or path.startswith(prefix.rstrip("/") + "/")):
+                if len(prefix) > match_len:
+                    app, match_len = name, len(prefix)
+        return app
+
+    def _handle_for(self, app_name: str):
+        h = self._handles.get(app_name)
+        if h is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            h = self._handles[app_name] = DeploymentHandle(app_name)
+        return h
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+
+        app_name = self._match_route(request.path)
+        if app_name is None:
+            return web.json_response({"error": "no route"}, status=404)
+        body = await request.read()
+        try:
+            arg = json.loads(body) if body else None
+        except ValueError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model_id = request.headers.get("X-Model-Id") or (
+            arg.get("model_id") if isinstance(arg, dict) else None)
+        stream = (request.query.get("stream") in ("1", "true")
+                  or (isinstance(arg, dict) and arg.get("stream")))
+
+        handle = self._handle_for(app_name)
+        method = request.query.get("method") or (
+            arg.get("method") if isinstance(arg, dict) else None)
+        if model_id or method:
+            handle = handle.options(
+                multiplexed_model_id=model_id,
+                method_name=method)
+        loop = asyncio.get_running_loop()
+
+        if not stream:
+            try:
+                out = await loop.run_in_executor(
+                    self._executor,
+                    lambda: handle.remote(arg).result(timeout=120))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(out)
+
+        # Streaming: chunked JSONL, one line per yielded item. Routing
+        # happens BEFORE headers go out so routing failures are clean
+        # 500s, not truncated 200s.
+        try:
+            stream_resp = await loop.run_in_executor(
+                self._executor, lambda: handle.remote_streaming(arg))
+            it = iter(stream_resp)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "application/jsonl; charset=utf-8"})
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+
+        def pull_next():
+            try:
+                return next(it), False
+            except StopIteration:
+                return None, True
+
+        try:
+            while True:
+                item, done = await loop.run_in_executor(
+                    self._executor, pull_next)
+                if done:
+                    break
+                await resp.write(
+                    (json.dumps(item) + "\n").encode())
+        except Exception as e:  # noqa: BLE001
+            await resp.write(
+                (json.dumps({"error": str(e)}) + "\n").encode())
+            stream_resp.cancel()
+        await resp.write_eof()
+        return resp
+
+    # -- actor RPC surface ----------------------------------------------
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._port
 
     def set_route(self, prefix: str, app_name: str) -> bool:
         self._routes[prefix] = app_name
@@ -82,5 +162,6 @@ class HTTPProxy:
         return True
 
     def stop(self) -> bool:
-        self._server.shutdown()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
         return True

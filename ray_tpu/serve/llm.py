@@ -23,9 +23,10 @@ import numpy as np
 
 class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "out_tokens",
-                 "done", "error", "slot", "submitted_at", "first_token_at")
+                 "done", "error", "slot", "submitted_at", "first_token_at",
+                 "token_q")
 
-    def __init__(self, prompt, max_tokens, temperature):
+    def __init__(self, prompt, max_tokens, temperature, stream=False):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
@@ -35,6 +36,14 @@ class _Request:
         self.slot = -1
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
+        # Streaming consumers read tokens as the engine emits them.
+        self.token_q: Optional["queue.Queue"] = (
+            queue.Queue() if stream else None)
+
+    def emit(self, tok: int) -> None:
+        self.out_tokens.append(tok)
+        if self.token_q is not None:
+            self.token_q.put(tok)
 
 
 class LLMEngine:
@@ -90,6 +99,34 @@ class LLMEngine:
             raise req.error
         return req.out_tokens
 
+    def generate_stream(self, prompt_tokens: List[int], *,
+                        max_tokens: int = 64, temperature: float = 0.0,
+                        timeout: Optional[float] = 300):
+        """Yield tokens as the engine produces them (TTFT = first yield;
+        the continuous-batching loop keeps decoding other slots while the
+        consumer reads)."""
+        if len(prompt_tokens) >= self.max_len:
+            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
+        req = _Request(list(prompt_tokens), max_tokens, temperature,
+                       stream=True)
+        self.stats["requests"] += 1
+        self._pending.put(req)
+        self._work.set()
+        deadline = time.monotonic() + (timeout or 300)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("generation timed out")
+            try:
+                tok = req.token_q.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError("generation timed out") from None
+            if tok is None:
+                if req.error is not None:
+                    raise req.error
+                return
+            yield tok
+
     def engine_stats(self) -> Dict[str, Any]:
         s = dict(self.stats)
         s["p_ttft_mean"] = (s["ttft_sum"] / s["completed"]
@@ -133,13 +170,15 @@ class LLMEngine:
                 jnp.int32(slot), jnp.int32(n),
                 jnp.float32(req.temperature), self._rng)
             req.first_token_at = time.perf_counter()
-            req.out_tokens.append(int(tok))
+            req.emit(int(tok))
             req.slot = slot
             self._slots[slot] = req
             self._last_tokens[slot] = int(tok)
             self._maybe_finish(slot)
         except BaseException as e:  # noqa: BLE001
             req.error = e
+            if req.token_q is not None:
+                req.token_q.put(None)
             req.done.set()
         return True
 
@@ -158,6 +197,8 @@ class LLMEngine:
             self.stats["ttft_sum"] += (req.first_token_at
                                        - req.submitted_at)
             self._slots[slot] = None
+            if req.token_q is not None:
+                req.token_q.put(None)  # stream sentinel
             req.done.set()
 
     def _loop(self):
@@ -193,7 +234,7 @@ class LLMEngine:
                         tok = int(tok_mat[step, i])
                         if len(req.out_tokens) >= req.max_tokens:
                             break  # over-generated tail: trim
-                        req.out_tokens.append(tok)
+                        req.emit(tok)
                         self._last_tokens[i] = tok
                         self.stats["tokens_generated"] += 1
                         if (self.eos_id is not None
@@ -204,6 +245,8 @@ class LLMEngine:
                 for i, req in enumerate(self._slots):
                     if req is not None:
                         req.error = e
+                        if req.token_q is not None:
+                            req.token_q.put(None)
                         req.done.set()
                         self._slots[i] = None
 
@@ -231,6 +274,15 @@ class LLMDeployment:
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)))
         return {"tokens": toks}
+
+    def stream(self, request: dict):
+        """Streaming entry: yields {"token": t} dicts (served over
+        chunked HTTP by the proxy; call via handle.remote_streaming)."""
+        for tok in self.engine.generate_stream(
+                request["tokens"],
+                max_tokens=int(request.get("max_tokens", 32)),
+                temperature=float(request.get("temperature", 0.0))):
+            yield {"token": tok}
 
     def stats(self) -> dict:
         return self.engine.engine_stats()

@@ -2,14 +2,79 @@
 
 Reference: `ReplicaActor` + `UserCallableWrapper`
 (ref: python/ray/serve/_private/replica.py:230, :716).  Tracks ongoing
-request count (feeds the power-of-two router) and exposes a health check.
+request count (feeds the power-of-two router), exposes a health check,
+serves STREAMING responses (generator results pulled in batches — the
+analogue of the reference's streaming ObjectRefGenerator replies,
+_raylet.pyx:272), and carries the multiplexed-model-id request context
+(ref: serve/multiplex.py).
 """
 from __future__ import annotations
 
 import asyncio
 import inspect
+import queue
+import threading
 import time
-from typing import Any
+import uuid
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.multiplex import _model_id_ctx
+
+
+class _Stream:
+    """Background puller: drains the user generator into a queue so the
+    actor thread never blocks inside user iteration code. The request's
+    multiplexed-model-id context is re-established in the puller thread
+    (generator bodies run HERE, not where the generator was created)."""
+
+    def __init__(self, iterator, model_id: Optional[str] = None):
+        self.q: "queue.Queue" = queue.Queue(maxsize=256)
+        self.error: Optional[BaseException] = None
+        self.finished = threading.Event()
+        self.cancelled = threading.Event()
+        self.last_touch = time.monotonic()
+
+        def pull():
+            if model_id:
+                _model_id_ctx.set(model_id)
+            try:
+                for item in iterator:
+                    while True:
+                        if self.cancelled.is_set():
+                            close = getattr(iterator, "close", None)
+                            if callable(close):
+                                close()
+                            return
+                        try:
+                            self.q.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:  # noqa: BLE001
+                self.error = e
+            finally:
+                self.finished.set()
+
+        threading.Thread(target=pull, daemon=True).start()
+
+    def next_batch(self, max_items: int, timeout_s: float) -> dict:
+        self.last_touch = time.monotonic()
+        items = []
+        deadline = time.monotonic() + timeout_s
+        while len(items) < max_items:
+            try:
+                remaining = max(0.0, deadline - time.monotonic())
+                items.append(self.q.get(
+                    timeout=remaining if not items else 0.0))
+            except queue.Empty:
+                if items or self.finished.is_set():
+                    break
+                if time.monotonic() >= deadline:
+                    break
+        done = (self.finished.is_set() and self.q.empty())
+        if done and self.error is not None:
+            raise self.error
+        return {"items": items, "done": done}
 
 
 class Replica:
@@ -18,6 +83,7 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._start = time.time()
+        self._streams: Dict[str, _Stream] = {}
         if inspect.isclass(cls_or_fn):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_func = False
@@ -25,20 +91,77 @@ class Replica:
             self._callable = cls_or_fn
             self._is_func = True
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
-        self._ongoing += 1
-        self._total += 1
+    def _resolve(self, method: str):
+        if self._is_func or method == "__call__":
+            return self._callable
+        return getattr(self._callable, method)
+
+    def _invoke(self, method: str, args: tuple, kwargs: dict,
+                model_id: Optional[str]) -> Any:
+        token = _model_id_ctx.set(model_id) if model_id else None
         try:
-            if self._is_func or method == "__call__":
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method)
-            out = fn(*args, **kwargs)
+            out = self._resolve(method)(*args, **kwargs)
             if inspect.iscoroutine(out):
                 out = asyncio.run(out)
             return out
         finally:
+            if token is not None:
+                _model_id_ctx.reset(token)
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       model_id: Optional[str] = None) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            return self._invoke(method, args, kwargs, model_id)
+        finally:
             self._ongoing -= 1
+
+    # -- streaming ------------------------------------------------------
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict,
+                                 model_id: Optional[str] = None) -> str:
+        """Start a streaming call; returns a stream id the caller pulls
+        with stream_next()."""
+        self._total += 1
+        out = self._invoke(method, args, kwargs, model_id)
+        if not hasattr(out, "__next__"):
+            out = iter(out if hasattr(out, "__iter__") else [out])
+        sid = uuid.uuid4().hex
+        self._gc_streams()
+        self._streams[sid] = _Stream(out, model_id=model_id)
+        self._ongoing += 1
+        return sid
+
+    def stream_next(self, stream_id: str, max_items: int = 32,
+                    timeout_s: float = 1.0) -> dict:
+        st = self._streams.get(stream_id)
+        if st is None:
+            return {"items": [], "done": True}
+        try:
+            batch = st.next_batch(max_items, timeout_s)
+        except BaseException:
+            self._drop_stream(stream_id)
+            raise
+        if batch["done"]:
+            self._drop_stream(stream_id)
+        return batch
+
+    def cancel_stream(self, stream_id: str) -> bool:
+        self._drop_stream(stream_id)
+        return True
+
+    def _drop_stream(self, stream_id: str) -> None:
+        st = self._streams.pop(stream_id, None)
+        if st is not None:
+            st.cancelled.set()  # unblocks + closes the puller's generator
+            self._ongoing = max(0, self._ongoing - 1)
+
+    def _gc_streams(self, idle_s: float = 300.0) -> None:
+        now = time.monotonic()
+        for sid, st in list(self._streams.items()):
+            if now - st.last_touch > idle_s:
+                self._drop_stream(sid)
 
     def stats(self) -> dict:
         return {"replica_id": self.replica_id, "ongoing": self._ongoing,

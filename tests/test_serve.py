@@ -146,8 +146,12 @@ def test_http_ingress():
         f"http://127.0.0.1:{port}/echo",
         data=json.dumps({"a": 1}).encode(),
         headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        body = json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raise AssertionError(
+            f"HTTP {e.code}: {e.read().decode()[:500]}") from e
     assert body == {"got": {"a": 1}}
     # unknown route -> 404
     try:

@@ -1,0 +1,131 @@
+"""Serve round-2 surfaces: async HTTP proxy, streaming responses, model
+multiplexing (VERDICT r1 item 8; ref: serve/_private/proxy.py:747
+streaming, multiplex.py)."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_streaming_handle(serve_cluster):
+    @serve.deployment
+    class Tokens:
+        def stream(self, n):
+            for i in range(n):
+                yield {"token": i}
+
+        def __call__(self, n):
+            return {"count": n}
+
+    handle = serve.run(Tokens.bind(), name="tokens")
+    # unary still works
+    assert handle.remote(3).result(timeout=60) == {"count": 3}
+    # streaming yields items in order
+    items = list(handle.options(method_name="stream")
+                 .remote_streaming(5))
+    assert items == [{"token": i} for i in range(5)]
+    serve.delete("tokens")
+
+
+def test_http_proxy_unary_and_streaming(serve_cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body}
+
+        def stream(self, body):
+            for i in range(int(body.get("n", 3))):
+                yield {"i": i}
+
+    serve.run(Echo.bind(), name="echo", _http=True, route_prefix="/echo")
+    port = serve.http_port()
+
+    # unary
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo", data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out == {"echo": {"x": 1}}
+
+    # 404 elsewhere
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    serve.delete("echo")
+
+
+def test_http_streaming_chunks(serve_cluster):
+    @serve.deployment
+    class Slow:
+        def stream(self, body):
+            for i in range(4):
+                time.sleep(0.2)
+                yield {"i": i}
+
+        def __call__(self, body):
+            return {}
+
+    serve.run(Slow.bind(), name="slow", _http=True, route_prefix="/slow")
+    port = serve.http_port()
+    # Route streaming through the `stream` method via the body flag.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/slow?stream=1&method=stream",
+        data=json.dumps({"stream": True}).encode())
+    t0 = time.monotonic()
+    try:
+        resp = urllib.request.urlopen(req, timeout=120)
+    except urllib.error.HTTPError:
+        time.sleep(1.0)  # transient replica/proxy churn under full suite
+        t0 = time.monotonic()
+        resp = urllib.request.urlopen(req, timeout=120)
+    first_line = resp.readline()
+    ttfb = time.monotonic() - t0
+    rest = resp.read().decode().strip().splitlines()
+    lines = [json.loads(first_line)] + [json.loads(x) for x in rest]
+    # items streamed (not buffered until the end): first arrives well
+    # before all four 0.2 s sleeps complete.
+    assert lines == [{"i": i} for i in range(4)]
+    assert ttfb < 1.0, f"first chunk too late: {ttfb:.2f}s"
+    serve.delete("slow")
+
+
+def test_multiplexed_models(serve_cluster):
+    @serve.deployment
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        def __call__(self, body):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return {"model": model["id"], "y": body["x"] * model["scale"]}
+
+    handle = serve.run(Multi.bind(), name="multi")
+    h1 = handle.options(multiplexed_model_id="m2")
+    h3 = handle.options(multiplexed_model_id="m3")
+    assert h1.remote({"x": 10}).result(timeout=60) == {"model": "m2",
+                                                      "y": 20}
+    assert h3.remote({"x": 10}).result(timeout=60) == {"model": "m3",
+                                                      "y": 30}
+    # Same model again: served from the replica's LRU (no reload) and the
+    # handle routes it back to the same replica.
+    assert h1.remote({"x": 5}).result(timeout=60) == {"model": "m2",
+                                                     "y": 10}
+    serve.delete("multi")
