@@ -81,6 +81,11 @@ def init(
                 namespace=namespace,
                 object_store_memory=object_store_memory,
             )
+        if runtime_env and hasattr(_worker, "job_runtime_env"):
+            # Job-level default env: tasks/actors without an explicit
+            # runtime_env inherit it (ref: job-level runtime_env in
+            # ray.init; per-call specs override wholesale).
+            _worker.job_runtime_env = dict(runtime_env)
         return _worker
 
 

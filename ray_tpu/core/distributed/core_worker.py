@@ -146,6 +146,7 @@ class _TaskLane:
                 "NodeDaemon", "request_lease", demand=self.demand,
                 strategy=sched["strategy"], affinity=sched["affinity"],
                 soft=sched["soft"], placement=sched["placement"],
+                runtime_env=sched.get("runtime_env"),
                 timeout=cfg.worker_lease_timeout_ms / 1000)
             if grant.get("spill_to"):
                 daemon_addr = grant["spill_to"]
@@ -278,8 +279,12 @@ class DistributedCoreWorker:
         self._push_flushing: Dict[str, bool] = {}
         # Submissions parked while their actor resolves (FIFO per actor).
         self._actor_pending: Dict[str, "deque"] = {}
-        # Lease reuse lanes keyed by (demand, sched).
+        # Lease reuse lanes keyed by (demand, sched, runtime_env).
         self._lanes: Dict[tuple, "_TaskLane"] = {}
+        # Raw runtime_env json -> normalized (pkg:// uploaded) spec.
+        self._norm_env_cache: Dict[str, Optional[dict]] = {}
+        # Job-level default runtime env (init(runtime_env=...)).
+        self.job_runtime_env: Optional[dict] = None
 
         self._shutdown = False
         install_refcounter(self._ref_added, self._ref_removed)
@@ -771,6 +776,21 @@ class DistributedCoreWorker:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _normalized_env(self, options: TaskOptions) -> Optional[dict]:
+        """Normalize the task/actor runtime env (falls back to the job's;
+        packaging uploads are cached per distinct raw spec)."""
+        import json as _json
+
+        raw = options.runtime_env or self.job_runtime_env
+        if not raw:
+            return None
+        key = _json.dumps(raw, sort_keys=True, default=str)
+        if key not in self._norm_env_cache:
+            from ray_tpu import runtime_env as renv
+
+            self._norm_env_cache[key] = renv.normalize(raw, self.kv_put)
+        return self._norm_env_cache[key]
+
     def _scheduling_fields(self, options: TaskOptions) -> dict:
         strategy = "hybrid"
         affinity = None
@@ -787,7 +807,8 @@ class DistributedCoreWorker:
             pg = st.placement_group
             placement = (pg.id.hex(), st.placement_group_bundle_index)
         return {"strategy": strategy, "affinity": affinity, "soft": soft,
-                "placement": placement}
+                "placement": placement,
+                "runtime_env": self._normalized_env(options)}
 
     def submit_task(self, func, args, kwargs, options: TaskOptions
                     ) -> List[ObjectRef]:
@@ -844,9 +865,12 @@ class DistributedCoreWorker:
         """Fast path: enqueue straight onto the lane (one future + one
         callback per task, no asyncio.Task). Failures fall back to the
         retrying coroutine."""
+        from ray_tpu.runtime_env import env_hash
+
         key = (tuple(sorted(demand.items())), sched["strategy"],
                sched["affinity"], sched["soft"],
-               tuple(sched["placement"]) if sched["placement"] else None)
+               tuple(sched["placement"]) if sched["placement"] else None,
+               env_hash(sched.get("runtime_env")))
         lane = self._lanes.get(key)
         if lane is None:
             lane = self._lanes[key] = _TaskLane(self, demand, sched)
@@ -930,9 +954,12 @@ class DistributedCoreWorker:
             self._lease_and_push_async(spec, demand, sched))
 
     async def _lease_and_push_async(self, spec, demand, sched) -> dict:
+        from ray_tpu.runtime_env import env_hash
+
         key = (tuple(sorted(demand.items())), sched["strategy"],
                sched["affinity"], sched["soft"],
-               tuple(sched["placement"]) if sched["placement"] else None)
+               tuple(sched["placement"]) if sched["placement"] else None,
+               env_hash(sched.get("runtime_env")))
         lane = self._lanes.get(key)
         if lane is None:
             lane = self._lanes[key] = _TaskLane(self, demand, sched)
@@ -990,6 +1017,7 @@ class DistributedCoreWorker:
                 "owner_job": self.job_id,
                 "max_concurrency": options.max_concurrency,
                 "placement": sched["placement"],
+                "runtime_env": sched["runtime_env"],
             }, timeout=60)
         return actor_id
 

@@ -189,6 +189,7 @@ class ActorRecord:
     death_reason: str = ""
     max_concurrency: int = 1
     placement: Optional[Tuple[str, int]] = None  # (pg_id, bundle_idx)
+    runtime_env: Optional[dict] = None           # normalized spec
 
 
 class ActorManager:
@@ -352,6 +353,7 @@ class ActorManager:
                 cls_blob_key=rec.cls_blob_key,
                 args_blob=rec.args_blob,
                 demand=rec.demand,
+                runtime_env=rec.runtime_env,
                 max_concurrency=rec.max_concurrency,
                 placement=rec.placement,
                 timeout=get_config().actor_creation_timeout_s)

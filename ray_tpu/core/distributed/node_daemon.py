@@ -36,12 +36,14 @@ logger = logging.getLogger(__name__)
 
 
 class WorkerHandle:
-    def __init__(self, proc: subprocess.Popen, worker_id: str):
+    def __init__(self, proc: subprocess.Popen, worker_id: str,
+                 env_key: str = ""):
         self.proc = proc
         self.worker_id = worker_id
         self.address: Optional[str] = None      # set on register
         self.busy = False
         self.actor_id: Optional[str] = None
+        self.env_key = env_key        # runtime-env identity of this worker
         self.last_idle = time.monotonic()
         self.registered = asyncio.Event()
 
@@ -93,6 +95,7 @@ class NodeDaemon:
         self._tasks: List[asyncio.Task] = []
         self._soft_limit = int(get_config().num_workers_soft_limit
                                or self.total.get("CPU", 1))
+        self._env_builder = None  # RuntimeEnvBuilder, lazy (needs gcs)
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -170,25 +173,47 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # worker pool (ref: worker_pool.h:156)
     # ------------------------------------------------------------------
-    def _spawn_worker(self, actor_id: Optional[str] = None) -> WorkerHandle:
+    async def _built_env(self, runtime_env: Optional[dict]):
+        """Build (or fetch cached) node-local runtime env artifacts."""
+        if not runtime_env:
+            return None
+        if self._env_builder is None:
+            from ray_tpu.core.distributed.runtime_env_agent import (
+                RuntimeEnvBuilder)
+
+            self._env_builder = RuntimeEnvBuilder(self.gcs)
+        return await self._env_builder.ensure_env(runtime_env)
+
+    def _spawn_worker(self, actor_id: Optional[str] = None,
+                      built_env=None, env_key: str = "") -> WorkerHandle:
         from ray_tpu.core.distributed.driver import child_env
 
         worker_id = uuid.uuid4().hex
         env = child_env()
         env["RAY_TPU_WORKER_ID"] = worker_id
+        python = sys.executable
+        cwd = None
+        if built_env is not None:
+            env.update(built_env.env_vars)
+            if built_env.pythonpath:
+                env["PYTHONPATH"] = ":".join(
+                    built_env.pythonpath
+                    + [p for p in env.get("PYTHONPATH", "").split(":") if p])
+            python = built_env.python
+            cwd = built_env.cwd
         cmd = [
-            sys.executable, "-m", "ray_tpu.core.distributed.worker_main",
+            python, "-m", "ray_tpu.core.distributed.worker_main",
             "--gcs-address", self.gcs_address,
             "--daemon-address", self.server.address,
             "--node-id", self.node_id,
             "--store-dir", self.store_dir,
             "--worker-id", worker_id,
         ]
-        proc = subprocess.Popen(cmd, env=env,
+        proc = subprocess.Popen(cmd, env=env, cwd=cwd,
                                 stdout=subprocess.DEVNULL,
                                 stderr=None)
         self._m_spawned.inc()
-        handle = WorkerHandle(proc, worker_id)
+        handle = WorkerHandle(proc, worker_id, env_key=env_key)
         handle.actor_id = actor_id
         self._workers[worker_id] = handle
         return handle
@@ -348,16 +373,29 @@ class NodeDaemon:
             self._pump_lease_queue()
         return {"ok": True}
 
-    async def _get_idle_worker(self) -> WorkerHandle:
+    async def _get_idle_worker(self, runtime_env: Optional[dict] = None
+                               ) -> WorkerHandle:
+        from ray_tpu.runtime_env import env_hash
+
+        env_key = env_hash(runtime_env)
+        kept = []
+        found = None
         while self._idle:
             handle = self._idle.popleft()
             if handle.proc.poll() is None and handle.address:
-                return handle
+                if handle.env_key == env_key:
+                    found = handle
+                    break
+                kept.append(handle)
+        self._idle.extend(kept)  # other-env idlers stay pooled
+        if found is not None:
+            return found
+        built = await self._built_env(runtime_env)
         # Spawn a fresh one and wait for registration — polling the
         # process too: a worker that dies pre-registration (crash, chaos
         # kill) must fail the grant within ~0.1 s, not pin the subtracted
         # resources for the full registration timeout.
-        handle = self._spawn_worker()
+        handle = self._spawn_worker(built_env=built, env_key=env_key)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + get_config().worker_register_timeout_s
         while True:
@@ -428,7 +466,8 @@ class NodeDaemon:
                             strategy: str = "hybrid",
                             affinity: Optional[str] = None,
                             soft: bool = False,
-                            placement: Optional[Tuple[str, int]] = None
+                            placement: Optional[Tuple[str, int]] = None,
+                            runtime_env: Optional[dict] = None
                             ) -> dict:
         cfg = get_config()
         # Placement-group leases draw from the reserved bundle.
@@ -453,9 +492,10 @@ class NodeDaemon:
                         "error": f"bundle {pg_id[:8]}:{bundle_idx} not "
                                  f"reserved on this node"}
             if not rs.fits(bundle["available"], demand):
-                return await self._wait_for_lease(demand, placement)
+                return await self._wait_for_lease(demand, placement,
+                                                  runtime_env)
             rs.subtract(bundle["available"], demand)
-            return await self._grant_safely(demand, placement)
+            return await self._grant_safely(demand, placement, runtime_env)
 
         # Affinity pins to a node.
         if strategy == "node_affinity" and affinity is not None:
@@ -496,7 +536,7 @@ class NodeDaemon:
         if rs.fits(self.available, demand):
             rs.subtract(self.available, demand)
             self._ledger("sub:direct", demand)
-            return await self._grant_safely(demand, None)
+            return await self._grant_safely(demand, None, runtime_env)
 
         # Local node busy: consider spilling (hybrid policy).
         node = pick_node(self._view, demand, strategy=strategy,
@@ -504,19 +544,22 @@ class NodeDaemon:
                          spread_threshold=cfg.scheduler_spread_threshold)
         if node is not None and node.node_id != self.node_id:
             return {"spill_to": node.address}
-        return await self._wait_for_lease(demand, None)
+        return await self._wait_for_lease(demand, None, runtime_env)
 
-    async def _wait_for_lease(self, demand, placement) -> dict:
+    async def _wait_for_lease(self, demand, placement,
+                              runtime_env=None) -> dict:
         fut = asyncio.get_running_loop().create_future()
         self._lease_waiters.append((demand, placement, fut,
-                                    time.monotonic()))
+                                    time.monotonic(), runtime_env))
         return await fut
 
-    async def _grant_safely(self, demand, placement) -> dict:
+    async def _grant_safely(self, demand, placement,
+                            runtime_env=None) -> dict:
         """_grant shielded against RPC cancellation: a client that gives
         up (deadline) mid-grant must not leak the subtracted resources or
         the leased worker (the orphaned lease starves the node forever)."""
-        task = asyncio.ensure_future(self._grant(demand, placement))
+        task = asyncio.ensure_future(
+            self._grant(demand, placement, runtime_env))
         try:
             return await asyncio.shield(task)
         except asyncio.CancelledError:
@@ -540,9 +583,9 @@ class NodeDaemon:
             return
         remaining = deque()
 
-        async def grant_later(demand, placement, fut):
+        async def grant_later(demand, placement, fut, runtime_env):
             try:
-                reply = await self._grant(demand, placement)
+                reply = await self._grant(demand, placement, runtime_env)
             except Exception as e:  # noqa: BLE001
                 if not fut.done():
                     fut.set_exception(e)
@@ -556,7 +599,8 @@ class NodeDaemon:
                 fut.set_result(reply)
 
         while self._lease_waiters:
-            demand, placement, fut, queued_at = self._lease_waiters.popleft()
+            (demand, placement, fut, queued_at,
+             runtime_env) = self._lease_waiters.popleft()
             if fut.done():
                 continue
             ok = False
@@ -572,14 +616,24 @@ class NodeDaemon:
                 ok = True
             if ok:
                 self._m_lease_wait.observe(time.monotonic() - queued_at)
-                asyncio.ensure_future(grant_later(demand, placement, fut))
+                asyncio.ensure_future(
+                    grant_later(demand, placement, fut, runtime_env))
             else:
-                remaining.append((demand, placement, fut, queued_at))
+                remaining.append((demand, placement, fut, queued_at,
+                                  runtime_env))
         self._lease_waiters = remaining
 
-    async def _grant(self, demand, placement) -> dict:
+    async def _grant(self, demand, placement, runtime_env=None) -> dict:
+        from ray_tpu.core.distributed.runtime_env_agent import (
+            RuntimeEnvBuildError)
+
         try:
-            worker = await self._get_idle_worker()
+            worker = await self._get_idle_worker(runtime_env)
+        except RuntimeEnvBuildError as e:
+            # Definitive: a broken runtime_env spec will not fix itself —
+            # the client must fail fast, not retry-rebuild for minutes.
+            self._release_demand(demand, placement)
+            return {"granted": False, "transient": False, "error": str(e)}
         except Exception as e:  # noqa: BLE001
             # Roll back the resource subtraction. Worker-start failures
             # are transient (crash/chaos/slow start) — the resources are
@@ -695,6 +749,7 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     async def start_actor(self, actor_id: str, cls_blob_key: bytes,
                           args_blob: bytes, demand: Dict[str, float],
+                          runtime_env: Optional[dict] = None,
                           max_concurrency: int = 1,
                           placement: Optional[Tuple[str, int]] = None
                           ) -> dict:
@@ -709,7 +764,17 @@ class NodeDaemon:
                 return {"ok": False, "error": "insufficient resources"}
             rs.subtract(self.available, demand)
 
-        handle = self._spawn_worker(actor_id=actor_id)
+        try:
+            built = await self._built_env(runtime_env)
+        except BaseException as e:  # noqa: BLE001
+            self._release_demand(demand, placement)
+            return {"ok": False,
+                    "error": f"runtime_env build failed: {e}",
+                    "creation_error": True}
+        from ray_tpu.runtime_env import env_hash
+
+        handle = self._spawn_worker(actor_id=actor_id, built_env=built,
+                                    env_key=env_hash(runtime_env))
         loop = asyncio.get_running_loop()
         deadline = loop.time() + get_config().worker_register_timeout_s
         while not handle.registered.is_set():

@@ -1,0 +1,195 @@
+"""Node-side runtime-env builder (the runtime-env agent's job in the
+reference, which fate-shares with the raylet — here it lives inside the
+node daemon; ref: python/ray/runtime_env/ARCHITECTURE.md,
+_private/runtime_env/{pip.py,working_dir.py,uri_cache.py}).
+
+Builds are cached by spec hash under `<base>/<hash>/`:
+    pkg/<uri-digest>/  extracted working_dir / py_modules archives
+    venv/              --system-site-packages venv when pip reqs exist
+    READY              marker: build completed
+
+`ensure_env` returns everything `_spawn_worker` needs: env vars, the
+python executable, sys.path prepends, and the worker cwd.
+"""
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.runtime_env import PKG_NAMESPACE, env_hash
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BASE = "/tmp/ray_tpu_runtime_envs"
+
+
+class RuntimeEnvBuildError(Exception):
+    """Definitive build failure (bad pip spec, missing package): callers
+    must fail fast, not retry-rebuild."""
+
+
+class BuiltEnv:
+    def __init__(self, env_vars: Dict[str, str], python: str,
+                 pythonpath: List[str], cwd: Optional[str]):
+        self.env_vars = env_vars
+        self.python = python
+        self.pythonpath = pythonpath
+        self.cwd = cwd
+
+
+class RuntimeEnvBuilder:
+    FAILURE_TTL_S = 120.0
+
+    def __init__(self, gcs_client, base_dir: str = DEFAULT_BASE):
+        self._gcs = gcs_client
+        self._base = base_dir
+        self._built: Dict[str, BuiltEnv] = {}
+        self._building: Dict[str, asyncio.Future] = {}
+        # Negative cache: a failed build is not retried for FAILURE_TTL_S
+        # (each attempt can cost a full venv + pip run).
+        self._failed: Dict[str, Tuple[float, str]] = {}
+
+    async def ensure_env(self, env: Optional[dict]) -> Optional[BuiltEnv]:
+        import time
+
+        if not env:
+            return None
+        key = env_hash(env)
+        cached = self._built.get(key)
+        if cached is not None:
+            return cached
+        failed = self._failed.get(key)
+        if failed is not None:
+            ts, msg = failed
+            if time.monotonic() - ts < self.FAILURE_TTL_S:
+                raise RuntimeEnvBuildError(msg)
+            del self._failed[key]
+        fut = self._building.get(key)
+        if fut is not None:
+            return await fut  # someone else is building it
+        fut = asyncio.get_running_loop().create_future()
+        self._building[key] = fut
+        try:
+            built = await self._build(key, env)
+            self._built[key] = built
+            fut.set_result(built)
+            return built
+        except BaseException as e:  # noqa: BLE001
+            msg = f"runtime_env build failed: {e}"
+            self._failed[key] = (time.monotonic(), msg)
+            err = RuntimeEnvBuildError(msg)
+            fut.set_exception(err)
+            # Consume the exception for waiters that never came.
+            fut.exception()
+            raise err from e
+        finally:
+            del self._building[key]
+
+    # -- build steps ---------------------------------------------------
+    async def _fetch_pkg(self, uri: str, dest: str) -> str:
+        """Extract pkg://<digest> from the GCS KV into dest (cached)."""
+        target = os.path.join(dest, uri.split("://", 1)[1])
+        if os.path.isdir(target):
+            return target
+        blob = await self._gcs.call("KV", "get",
+                                    namespace=PKG_NAMESPACE,
+                                    key=uri.encode(), timeout=60)
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {uri} not found in GCS")
+        tmp = target + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        os.rename(tmp, target)
+        return target
+
+    async def _build(self, key: str, env: dict) -> BuiltEnv:
+        root = os.path.join(self._base, key)
+        os.makedirs(root, exist_ok=True)
+        # Cross-process exclusion: multiple daemons on one host share the
+        # cache dir; concurrent extracts/venv builds of the same key would
+        # corrupt each other. flock taken in a thread (it blocks).
+        import fcntl
+
+        lockf = open(os.path.join(self._base, f".{key}.lock"), "w")
+        await asyncio.get_running_loop().run_in_executor(
+            None, fcntl.flock, lockf, fcntl.LOCK_EX)
+        try:
+            return await self._build_locked(root, env)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+            lockf.close()
+
+    async def _build_locked(self, root: str, env: dict) -> BuiltEnv:
+        env_vars = dict(env.get("env_vars") or {})
+        pythonpath: List[str] = []
+        cwd: Optional[str] = None
+        python = sys.executable
+
+        pkg_dir = os.path.join(root, "pkg")
+        os.makedirs(pkg_dir, exist_ok=True)
+        wd = env.get("working_dir")
+        if wd:
+            cwd = await self._fetch_pkg(wd, pkg_dir)
+            pythonpath.append(cwd)
+        for uri in env.get("py_modules") or ():
+            mod_dir = await self._fetch_pkg(uri, pkg_dir)
+            pythonpath.append(mod_dir)
+
+        reqs = env.get("pip")
+        if reqs:
+            python = await self._build_venv(root, reqs)
+        return BuiltEnv(env_vars, python, pythonpath, cwd)
+
+    async def _build_venv(self, root: str, reqs: List[str]) -> str:
+        """--system-site-packages venv + pip install (ref: pip.py builds
+        a virtualenv per requirements hash). Runs in a thread; serialized
+        per env by ensure_env's in-flight future."""
+        venv_dir = os.path.join(root, "venv")
+        python = os.path.join(venv_dir, "bin", "python")
+        ready = os.path.join(root, "READY")
+        if os.path.exists(ready) and os.path.exists(python):
+            return python
+
+        def build():
+            shutil.rmtree(venv_dir, ignore_errors=True)
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 venv_dir],
+                check=True, capture_output=True, timeout=300)
+            # When this process itself runs inside a venv,
+            # --system-site-packages exposes the BASE python's packages,
+            # not ours (jax/grpc/setuptools live in the parent venv). A
+            # .pth makes the parent's site-packages visible too; venv-local
+            # installs still take precedence on sys.path.
+            import site
+
+            parent_sites = [p for p in site.getsitepackages()
+                            if os.path.isdir(p)]
+            vsite = os.path.join(
+                venv_dir, "lib",
+                f"python{sys.version_info.major}.{sys.version_info.minor}",
+                "site-packages")
+            with open(os.path.join(vsite, "_raytpu_parent.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+            out = subprocess.run(
+                [python, "-m", "pip", "install", "--no-input",
+                 "--disable-pip-version-check", "--no-build-isolation",
+                 *reqs],
+                capture_output=True, text=True, timeout=600)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"pip install failed: {out.stderr[-2000:]}")
+            with open(ready, "w") as f:
+                f.write("ok")
+
+        await asyncio.get_running_loop().run_in_executor(None, build)
+        return python

@@ -1,0 +1,124 @@
+"""Runtime environments: per-task/actor/job execution environments.
+
+Reference: python/ray/runtime_env/ARCHITECTURE.md + _private/runtime_env/
+(plugins pip.py, working_dir.py, py_modules.py; URI cache uri_cache.py).
+Same split here: the DRIVER normalizes the spec (packs local dirs into
+content-addressed archives in the GCS KV), each NODE DAEMON builds envs
+on demand into a local cache keyed by the spec hash, and workers are
+spawned inside the built env (env vars, cwd, sys.path, venv python).
+
+Supported fields:
+    env_vars:    {"NAME": "value"}                  (applied at spawn)
+    working_dir: "/local/dir"  -> packed, extracted as the worker's cwd
+                 (also first on sys.path)
+    py_modules:  ["/local/pkg_dir_or_file.py", ...] -> packed, on sys.path
+    pip:         ["requests==...", "/local/pkg"]    -> venv with
+                 --system-site-packages + pip install (offline-capable
+                 only for local paths in a zero-egress cluster)
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip")
+PKG_NAMESPACE = "pkg"
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env spec (ref: runtime_env/runtime_env.py)."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[List[str]] = None, **extra):
+        unknown = set(extra) - set(_SUPPORTED)
+        if unknown:
+            raise ValueError(f"unsupported runtime_env fields: {unknown}")
+        super().__init__()
+        if env_vars:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        if pip:
+            self["pip"] = list(pip)
+
+
+def _zip_path(path: str) -> bytes:
+    """Deterministic zip of a file or directory tree."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isfile(path):
+            z.write(path, os.path.basename(path))
+        else:
+            base = os.path.abspath(path)
+            for root, dirs, files in os.walk(base):
+                dirs.sort()
+                if "__pycache__" in dirs:
+                    dirs.remove("__pycache__")
+                for f in sorted(files):
+                    full = os.path.join(root, f)
+                    z.write(full, os.path.relpath(full, base))
+    return buf.getvalue()
+
+
+def _upload_pkg(kv_put, data: bytes) -> str:
+    digest = hashlib.sha256(data).hexdigest()[:32]
+    uri = f"pkg://{digest}"
+    kv_put(PKG_NAMESPACE.encode(), uri.encode(), data)
+    return uri
+
+
+def normalize(env: Optional[Dict[str, Any]], kv_put) -> Optional[dict]:
+    """Driver-side: validate + replace local paths with content-addressed
+    pkg:// URIs stored in the GCS KV (ref: working_dir upload to GCS,
+    _private/runtime_env/packaging.py). Idempotent on normalized specs."""
+    if not env:
+        return None
+    unknown = set(env) - set(_SUPPORTED)
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env fields: {sorted(unknown)} "
+            f"(supported: {_SUPPORTED})")
+    out: dict = {}
+    if env.get("env_vars"):
+        out["env_vars"] = dict(env["env_vars"])
+    wd = env.get("working_dir")
+    if wd:
+        if wd.startswith("pkg://"):
+            out["working_dir"] = wd
+        else:
+            if not os.path.isdir(wd):
+                raise ValueError(f"working_dir {wd!r} is not a directory")
+            out["working_dir"] = _upload_pkg(kv_put, _zip_path(wd))
+    mods = env.get("py_modules")
+    if mods:
+        uris = []
+        for m in mods:
+            if m.startswith("pkg://"):
+                uris.append(m)
+            else:
+                if not os.path.exists(m):
+                    raise ValueError(f"py_module {m!r} does not exist")
+                uris.append(_upload_pkg(kv_put, _zip_path(m)))
+        out["py_modules"] = uris
+    if env.get("pip"):
+        out["pip"] = [str(r) for r in env["pip"]]
+    return out or None
+
+
+def env_hash(env: Optional[dict]) -> str:
+    """Stable identity of a normalized spec (daemon cache key)."""
+    if not env:
+        return ""
+    blob = json.dumps(env, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]

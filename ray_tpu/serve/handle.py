@@ -245,6 +245,8 @@ class StreamingResponse:
                 self._on_done()
 
     def cancel(self):
+        if self._settled:
+            return  # already finished or cancelled
         if self._sid is not None:
             try:
                 self._replica.cancel_stream.remote(self._sid)

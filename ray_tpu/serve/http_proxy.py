@@ -30,6 +30,10 @@ class HTTPProxy:
         self._handles: Dict[str, object] = {}
         self._executor = ThreadPoolExecutor(max_workers=executor_threads,
                                             thread_name_prefix="proxy")
+        # Separate pool for stream pulls: long-running unary calls must
+        # not starve in-flight token streams.
+        self._stream_executor = ThreadPoolExecutor(
+            max_workers=executor_threads, thread_name_prefix="proxy-stream")
         self._port: Optional[int] = None
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -118,7 +122,7 @@ class HTTPProxy:
         # 500s, not truncated 200s.
         try:
             stream_resp = await loop.run_in_executor(
-                self._executor, lambda: handle.remote_streaming(arg))
+                self._stream_executor, lambda: handle.remote_streaming(arg))
             it = iter(stream_resp)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
@@ -137,16 +141,25 @@ class HTTPProxy:
         try:
             while True:
                 item, done = await loop.run_in_executor(
-                    self._executor, pull_next)
+                    self._stream_executor, pull_next)
                 if done:
                     break
                 await resp.write(
                     (json.dumps(item) + "\n").encode())
         except Exception as e:  # noqa: BLE001
-            await resp.write(
-                (json.dumps({"error": str(e)}) + "\n").encode())
-            stream_resp.cancel()
-        await resp.write_eof()
+            # Best-effort error line — the socket may already be gone
+            # (client disconnect); the finally still cancels the stream.
+            try:
+                await resp.write(
+                    (json.dumps({"error": str(e)}) + "\n").encode())
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            stream_resp.cancel()  # idempotent; frees the replica stream
+        try:
+            await resp.write_eof()
+        except Exception:  # noqa: BLE001
+            pass
         return resp
 
     # -- actor RPC surface ----------------------------------------------

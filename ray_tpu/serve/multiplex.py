@@ -52,26 +52,38 @@ def multiplexed(max_num_models_per_replica: int = 3):
         @functools.wraps(load_fn)
         def wrapper(*args, **kwargs):
             st = _state_for(state_key)
-            cache, lock = st["cache"], st["lock"]
+            cache, lock, loading = st["cache"], st["lock"], st["loading"]
             # Supports methods (self, model_id) and functions (model_id,),
             # positionally or as model_id=... .
             model_id = kwargs.get("model_id", args[-1] if args else "")
-            with lock:
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache[model_id]
-            model = load_fn(*args, **kwargs)
-            with lock:
-                cache[model_id] = model
-                while len(cache) > max_num_models_per_replica:
-                    _, evicted = cache.popitem(last=False)
-                    unload = getattr(evicted, "unload", None)
-                    if callable(unload):
-                        try:
-                            unload()
-                        except Exception:  # noqa: BLE001
-                            pass
-            return model
+            while True:
+                with lock:
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    ev = loading.get(model_id)
+                    if ev is None:
+                        loading[model_id] = ev = threading.Event()
+                        break  # this thread loads
+                # Another request is loading this model: wait, re-check.
+                ev.wait(timeout=600)
+            try:
+                model = load_fn(*args, **kwargs)
+                with lock:
+                    cache[model_id] = model
+                    while len(cache) > max_num_models_per_replica:
+                        _, evicted = cache.popitem(last=False)
+                        unload = getattr(evicted, "unload", None)
+                        if callable(unload):
+                            try:
+                                unload()
+                            except Exception:  # noqa: BLE001
+                                pass
+                return model
+            finally:
+                with lock:
+                    loading.pop(model_id, None)
+                ev.set()
 
         wrapper._is_multiplexed = True
         return wrapper
@@ -88,5 +100,6 @@ def _state_for(key: str) -> dict:
         st = _states.get(key)
         if st is None:
             st = _states[key] = {"cache": OrderedDict(),
-                                 "lock": threading.Lock()}
+                                 "lock": threading.Lock(),
+                                 "loading": {}}
         return st

@@ -1,0 +1,137 @@
+"""Runtime environments (VERDICT r1 item 9; ref: python/ray/runtime_env/
+ARCHITECTURE.md, _private/runtime_env/{working_dir,pip,uri_cache}.py).
+
+A task/actor runs inside an environment the driver does NOT have:
+env vars it never exported, a working_dir/py_module it can't import.
+"""
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv, env_hash, normalize
+
+
+def test_runtime_env_validation():
+    env = RuntimeEnv(env_vars={"A": "1"}, pip=["x"])
+    assert env == {"env_vars": {"A": "1"}, "pip": ["x"]}
+    with pytest.raises(ValueError):
+        RuntimeEnv(conda="nope")
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    assert env_hash(None) == ""
+    assert env_hash({"env_vars": {"A": "1"}}) != ""
+
+
+@pytest.fixture(scope="module")
+def env_cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_reach_task_and_actor(env_cluster):
+    marker = "RAY_TPU_TEST_RUNTIME_ENV_FLAG"
+    assert marker not in os.environ  # driver does NOT have it
+
+    @ray_tpu.remote(runtime_env={"env_vars": {marker: "on"}})
+    def read_env():
+        return os.environ.get(marker)
+
+    assert ray_tpu.get(read_env.remote(), timeout=120) == "on"
+
+    # Plain tasks still run in clean workers.
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get(marker)
+
+    assert ray_tpu.get(read_plain.remote(), timeout=120) is None
+
+    @ray_tpu.remote(runtime_env={"env_vars": {marker: "actor"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get(marker)
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=120) == "actor"
+
+
+def test_working_dir_ships_code_and_data(env_cluster, tmp_path):
+    # A module + data file that exist ONLY in the packed working_dir.
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "my_rt_module.py").write_text(textwrap.dedent("""
+        SECRET = 41
+
+        def bump(x):
+            return x + 1
+    """))
+    (wd / "data.txt").write_text("hello-from-working-dir")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def use_module():
+        import my_rt_module  # importable only via the working_dir
+
+        with open("data.txt") as f:  # cwd == working_dir
+            data = f.read()
+        return my_rt_module.bump(my_rt_module.SECRET), data
+
+    out = ray_tpu.get(use_module.remote(), timeout=180)
+    assert out == (42, "hello-from-working-dir")
+
+    # The driver itself truly can't import it.
+    with pytest.raises(ImportError):
+        import my_rt_module  # noqa: F401
+
+
+def test_py_modules(env_cluster, tmp_path):
+    pkg = tmp_path / "extra_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("VALUE = 'shipped'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def use_pkg():
+        import extra_pkg
+
+        return extra_pkg.VALUE
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=180) == "shipped"
+
+
+def test_pip_env_installs_local_package(env_cluster, tmp_path):
+    # Offline-capable pip: install a LOCAL package into the cached venv;
+    # the task imports a module the driver doesn't have.
+    pkg = tmp_path / "localdep"
+    pkg.mkdir()
+    (pkg / "setup.py").write_text(textwrap.dedent("""
+        from setuptools import setup
+        setup(name="rt_localdep", version="0.1",
+              py_modules=["rt_localdep_mod"])
+    """))
+    (pkg / "rt_localdep_mod.py").write_text("ANSWER = 99\n")
+
+    @ray_tpu.remote(runtime_env={"pip": [str(pkg)]})
+    def use_dep():
+        import rt_localdep_mod
+
+        return rt_localdep_mod.ANSWER
+
+    assert ray_tpu.get(use_dep.remote(), timeout=300) == 99
+    with pytest.raises(ImportError):
+        import rt_localdep_mod  # noqa: F401
+
+
+def test_normalize_uploads_and_is_stable(env_cluster, tmp_path):
+    from ray_tpu.api import _global_worker
+
+    wd = tmp_path / "norm"
+    wd.mkdir()
+    (wd / "f.txt").write_text("x")
+    w = _global_worker()
+    n1 = normalize({"working_dir": str(wd)}, w.kv_put)
+    n2 = normalize({"working_dir": str(wd)}, w.kv_put)
+    assert n1 == n2
+    assert n1["working_dir"].startswith("pkg://")
+    assert env_hash(n1) == env_hash(n2)
