@@ -29,7 +29,8 @@ from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
 
 _SUBPACKAGES = ("data", "train", "tune", "serve", "dag", "util", "parallel",
-                "ops", "models", "workflow", "rllib")
+                "ops", "models", "workflow", "rllib", "autoscaler",
+                "job_submission")
 
 
 def __getattr__(name):

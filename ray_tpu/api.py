@@ -59,6 +59,13 @@ def init(
     - ``local_mode=True``: run everything in-process (debugging).
     """
     global _worker
+    if address is None:
+        # Submitted jobs / child drivers join the ambient cluster, like
+        # the reference's RAY_ADDRESS (ref: dashboard/modules/job —
+        # the supervisor exports it before running the entrypoint).
+        import os
+
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     with _worker_lock:
         if _worker is not None:
             if ignore_reinit_error:

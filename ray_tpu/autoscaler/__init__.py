@@ -1,0 +1,36 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+TPU-native analogue of the reference autoscaler v2
+(ref: python/ray/autoscaler/v2/ — instance_manager/, scheduler.py — driven
+by the GCS AutoscalerStateService, src/ray/protobuf/autoscaler.proto:315).
+Design split:
+
+  NodeProvider        — cloud abstraction: create/terminate/list instances
+                        (ref: autoscaler/node_provider.py:13)
+  plan_scaling        — pure bin-packing of pending demand onto existing +
+                        to-be-launched capacity (ref: v2/scheduler.py)
+  StandardAutoscaler  — one reconciliation pass: read GCS autoscaler state,
+                        launch what's missing, retire idle nodes
+                        (ref: _private/autoscaler.py:172 StandardAutoscaler)
+  AutoscalerMonitor   — the background loop (ref: _private/monitor.py)
+  AutoscalingCluster  — local test harness over FakeMultiNodeProvider
+                        (ref: cluster_utils.AutoscalingCluster:26)
+
+On TPU fleets the unit of scaling is a *slice* (hosts joined by ICI): a
+node type models one slice host, and gang demand (placement groups with
+`TPU-{pod_type}-head` bundles) scales whole slices at once.
+"""
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.binpack import plan_scaling  # noqa: F401
+from ray_tpu.autoscaler.monitor import (  # noqa: F401
+    AutoscalerMonitor,
+    AutoscalingCluster,
+)
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401

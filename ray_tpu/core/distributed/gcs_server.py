@@ -111,13 +111,15 @@ class NodeInfo:
                      "store_dir": store_dir})
         return {"node_id": node_id}
 
-    def heartbeat(self, node_id: str, available: Dict[str, float]) -> dict:
+    def heartbeat(self, node_id: str, available: Dict[str, float],
+                  queued_demand: Optional[List[Dict[str, float]]] = None
+                  ) -> dict:
         n = self.view.nodes.get(node_id)
         if n is None:
             return {"registered": False}  # ask the node to re-register
         if not n.alive:
             return {"registered": False}
-        self.view.update(node_id, available)
+        self.view.update(node_id, available, queued=queued_demand)
         return {"registered": True}
 
     def list_nodes(self) -> List[dict]:
@@ -650,6 +652,59 @@ class TaskEvents:
         return out
 
 
+class AutoscalerStateManager:
+    """Autoscaler-facing cluster state (ref: GcsAutoscalerStateManager,
+    src/ray/gcs/gcs_server/gcs_autoscaler_state_manager.h + the
+    AutoscalerStateService in src/ray/protobuf/autoscaler.proto:315).
+
+    Aggregates everything the autoscaler needs into one RPC:
+      - per-node capacity / availability / queued task demand / idle time,
+      - pending (unschedulable) actors and placement groups,
+      - explicit `request_resources` targets (sdk parity).
+    """
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self._resource_requests: List[Dict[str, float]] = []
+
+    def request_resources(self, bundles: List[Dict[str, float]]) -> dict:
+        """Set (replace) the explicit min-capacity target, like
+        ray.autoscaler.sdk.request_resources — the cluster should scale so
+        these bundles *could* be placed; [] clears the request."""
+        self._resource_requests = [dict(b) for b in bundles]
+        return {"ok": True}
+
+    def get_cluster_status(self) -> dict:
+        now = time.monotonic()
+        nodes = []
+        for n in self._gcs.nodes.view.nodes.values():
+            nodes.append({
+                "node_id": n.node_id,
+                "alive": n.alive,
+                "total": dict(n.total),
+                "available": dict(n.available),
+                "queued_demand": [dict(d) for d in n.queued],
+                "idle_s": max(0.0, now - n.last_busy) if n.alive else 0.0,
+                "labels": dict(n.labels),
+            })
+        pending_actors = [
+            dict(rec.demand) for rec in self._gcs.actors.actors.values()
+            if rec.state in (ACTOR_PENDING, ACTOR_RESTARTING)
+        ]
+        pending_pgs = [
+            {"bundles": [dict(b) for b in rec.bundles],
+             "strategy": rec.strategy}
+            for rec in self._gcs.placement_groups.groups.values()
+            if rec.state == PG_PENDING
+        ]
+        return {
+            "nodes": nodes,
+            "pending_actors": pending_actors,
+            "pending_pgs": pending_pgs,
+            "resource_requests": [dict(b) for b in self._resource_requests],
+        }
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.pubsub = Pubsub()
@@ -660,6 +715,7 @@ class GcsServer:
         self.placement_groups = PlacementGroupManager(self)
         self.jobs = JobManager(self)
         self.task_events = TaskEvents()
+        self.autoscaler_state = AutoscalerStateManager(self)
         self.server = RpcServer(host, port)
         self._daemon_clients: Dict[str, AsyncRpcClient] = {}
         self._tasks: List[asyncio.Task] = []
@@ -680,6 +736,7 @@ class GcsServer:
             ("ActorManager", self.actors), ("ObjectDirectory", self.objects),
             ("PlacementGroups", self.placement_groups),
             ("JobManager", self.jobs), ("TaskEvents", self.task_events),
+            ("AutoscalerState", self.autoscaler_state),
             ("Pubsub", self.pubsub),
         ]:
             self.server.add_service(name, svc)

@@ -91,6 +91,8 @@ class NodeDaemon:
         self._leases: Dict[str, Lease] = {}
         self._pg_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._lease_waiters: deque = deque()             # asyncio futures
+        self._infeasible_waits: Dict[int, rs.ResourceSet] = {}
+        self._infeasible_seq = 0
         self._view = ClusterView()
         self._tasks: List[asyncio.Task] = []
         self._soft_limit = int(get_config().num_workers_soft_limit
@@ -139,9 +141,17 @@ class NodeDaemon:
         period = get_config().health_check_period_ms / 1000 / 2
         while True:
             try:
+                # Queued demand feeds the autoscaler (ref: the raylet's
+                # resource-load report through the syncer): leases waiting
+                # on busy local resources plus infeasible-here demands
+                # still waiting for a capable node to join the cluster.
+                queued = [dict(d) for (d, *_rest) in self._lease_waiters]
+                queued.extend(dict(d)
+                              for d in self._infeasible_waits.values())
                 reply = await self.gcs.call(
                     "NodeInfo", "heartbeat", node_id=self.node_id,
-                    available=dict(self.available), timeout=10)
+                    available=dict(self.available),
+                    queued_demand=queued, timeout=10)
                 if not reply.get("registered"):
                     await self.gcs.call(
                         "NodeInfo", "register_node", node_id=self.node_id,
@@ -387,7 +397,10 @@ class NodeDaemon:
                     found = handle
                     break
                 kept.append(handle)
-        self._idle.extend(kept)  # other-env idlers stay pooled
+        # Other-env idlers go back to the FRONT in their original order:
+        # _reap_idle_workers assumes self._idle[0] is the longest-idle
+        # worker, and these were popped from the front.
+        self._idle.extendleft(reversed(kept))
         if found is not None:
             return found
         built = await self._built_env(runtime_env)
@@ -518,20 +531,28 @@ class NodeDaemon:
             # this loop only re-reads self._view.
             loop = asyncio.get_running_loop()
             deadline = loop.time() + 0.6 * cfg.worker_lease_timeout_ms / 1000.0
-            while True:
-                # A feasible-by-total node takes the request even when busy
-                # right now — its daemon queues the lease until capacity
-                # frees, like the reference's infeasible/waiting queues.
-                node = pick_feasible_node(self._view, demand,
-                                          exclude=self.node_id)
-                if node is not None:
-                    return {"spill_to": node.address}
-                if rs.feasible(self.total, demand):
-                    break  # dynamic resources appeared locally
-                if loop.time() >= deadline:
-                    return {"granted": False,
-                            "error": f"no node can satisfy {demand}"}
-                await asyncio.sleep(0.25)
+            self._infeasible_seq += 1
+            wait_key = self._infeasible_seq
+            # Visible to the autoscaler via heartbeats while we wait: this
+            # demand is what should trigger a scale-up.
+            self._infeasible_waits[wait_key] = demand
+            try:
+                while True:
+                    # A feasible-by-total node takes the request even when
+                    # busy right now — its daemon queues the lease until
+                    # capacity frees, like the reference's waiting queues.
+                    node = pick_feasible_node(self._view, demand,
+                                              exclude=self.node_id)
+                    if node is not None:
+                        return {"spill_to": node.address}
+                    if rs.feasible(self.total, demand):
+                        break  # dynamic resources appeared locally
+                    if loop.time() >= deadline:
+                        return {"granted": False,
+                                "error": f"no node can satisfy {demand}"}
+                    await asyncio.sleep(0.25)
+            finally:
+                self._infeasible_waits.pop(wait_key, None)
 
         if rs.fits(self.available, demand):
             rs.subtract(self.available, demand)
@@ -766,7 +787,12 @@ class NodeDaemon:
 
         try:
             built = await self._built_env(runtime_env)
-        except BaseException as e:  # noqa: BLE001
+        except asyncio.CancelledError:
+            # Client deadline mid-build: roll back and let cancellation
+            # propagate — it is not a creation verdict.
+            self._release_demand(demand, placement)
+            raise
+        except Exception as e:  # noqa: BLE001
             self._release_demand(demand, placement)
             return {"ok": False,
                     "error": f"runtime_env build failed: {e}",

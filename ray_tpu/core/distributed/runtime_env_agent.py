@@ -81,7 +81,12 @@ class RuntimeEnvBuilder:
             self._built[key] = built
             fut.set_result(built)
             return built
-        except BaseException as e:  # noqa: BLE001
+        except asyncio.CancelledError:
+            # RPC deadline/cancellation mid-build is NOT a build verdict:
+            # don't poison the negative cache for a valid (just slow) env.
+            fut.cancel()
+            raise
+        except Exception as e:  # noqa: BLE001
             msg = f"runtime_env build failed: {e}"
             self._failed[key] = (time.monotonic(), msg)
             err = RuntimeEnvBuildError(msg)
@@ -103,12 +108,17 @@ class RuntimeEnvBuilder:
                                     key=uri.encode(), timeout=60)
         if blob is None:
             raise RuntimeError(f"runtime_env package {uri} not found in GCS")
-        tmp = target + ".tmp"
-        shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(tmp, exist_ok=True)
-        with zipfile.ZipFile(io.BytesIO(blob)) as z:
-            z.extractall(tmp)
-        os.rename(tmp, target)
+        def extract():
+            # Off-loop: a large archive would otherwise stall heartbeats
+            # and lease granting for the whole decompression.
+            tmp = target + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as z:
+                z.extractall(tmp)
+            os.rename(tmp, target)
+
+        await asyncio.get_running_loop().run_in_executor(None, extract)
         return target
 
     async def _build(self, key: str, env: dict) -> BuiltEnv:

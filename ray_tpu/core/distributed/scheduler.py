@@ -27,6 +27,12 @@ class NodeView:
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     store_dir: str = ""
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+    # Autoscaler inputs (ref: the raylet reports resource load + idle time
+    # through the syncer to the GCS autoscaler state,
+    # gcs_autoscaler_state_manager.h): demands queued on this node's
+    # daemon and the last moment it was observed busy.
+    queued: List[rs.ResourceSet] = dataclasses.field(default_factory=list)
+    last_busy: float = dataclasses.field(default_factory=time.monotonic)
 
 
 class ClusterView:
@@ -36,11 +42,16 @@ class ClusterView:
     def alive_nodes(self) -> List[NodeView]:
         return [n for n in self.nodes.values() if n.alive]
 
-    def update(self, node_id: str, available: rs.ResourceSet) -> None:
+    def update(self, node_id: str, available: rs.ResourceSet,
+               queued: Optional[List[rs.ResourceSet]] = None) -> None:
         n = self.nodes.get(node_id)
         if n is not None:
             n.available = available
+            if queued is not None:
+                n.queued = queued
             n.last_heartbeat = time.monotonic()
+            if n.queued or rs.utilization(n.total, n.available) > rs.EPS:
+                n.last_busy = n.last_heartbeat
 
 
 def pick_node(

@@ -54,11 +54,21 @@ class RuntimeEnv(dict):
 
 
 def _zip_path(path: str) -> bytes:
-    """Deterministic zip of a file or directory tree."""
+    """Deterministic zip of a file or directory tree: fixed timestamps so
+    the sha256 digest depends on CONTENT only (a fresh checkout or a
+    `touch` must not defeat the content-addressed cache)."""
     buf = io.BytesIO()
+
+    def add(z: zipfile.ZipFile, full: str, arcname: str) -> None:
+        zi = zipfile.ZipInfo(arcname, date_time=(1980, 1, 1, 0, 0, 0))
+        zi.compress_type = zipfile.ZIP_DEFLATED
+        zi.external_attr = (os.stat(full).st_mode & 0o777) << 16
+        with open(full, "rb") as f:
+            z.writestr(zi, f.read())
+
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         if os.path.isfile(path):
-            z.write(path, os.path.basename(path))
+            add(z, path, os.path.basename(path))
         else:
             base = os.path.abspath(path)
             for root, dirs, files in os.walk(base):
@@ -67,7 +77,7 @@ def _zip_path(path: str) -> bytes:
                     dirs.remove("__pycache__")
                 for f in sorted(files):
                     full = os.path.join(root, f)
-                    z.write(full, os.path.relpath(full, base))
+                    add(z, full, os.path.relpath(full, base))
     return buf.getvalue()
 
 

@@ -186,6 +186,47 @@ def cmd_metrics(gcs: _Gcs, args) -> None:
             print(f"# unreachable: {e}")
 
 
+def cmd_job(args) -> None:
+    """Job submission commands (ref: `ray job submit/status/logs/stop/list`,
+    dashboard/modules/job/cli.py). Uses the direct-to-cluster client."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        import shlex
+
+        words = args.entrypoint
+        if words and words[0] == "--":
+            words = words[1:]
+        # shlex.join keeps argument boundaries (a bare " ".join would let
+        # the shell re-split/interpret `-c "print(1)"`).
+        sid = client.submit_job(entrypoint=shlex.join(words),
+                                submission_id=args.submission_id)
+        print(f"submitted job {sid}")
+        if args.wait:
+            info = client.wait_until_finished(sid, timeout=args.timeout)
+            print(client.get_job_logs(sid), end="")
+            print(f"job {sid}: {info.status} {info.message}")
+            if info.status != "SUCCEEDED":
+                sys.exit(1)
+    elif args.job_cmd == "status":
+        info = client.get_job_info(args.submission_id)
+        print(f"{info.submission_id}: {info.status} {info.message}")
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        ok = client.stop_job(args.submission_id)
+        print("stopped" if ok else "not running")
+    elif args.job_cmd == "list":
+        rows = [[j.submission_id, j.status,
+                 time.strftime("%H:%M:%S",
+                               time.localtime(j.start_time or 0)),
+                 j.entrypoint[:60]]
+                for j in client.list_jobs()]
+        print(_fmt_table(rows, ["SUBMISSION_ID", "STATUS", "STARTED",
+                                "ENTRYPOINT"]))
+
+
 def cmd_start(args) -> None:
     """Start a head (GCS + daemon) or join a worker daemon to a cluster
     (ref: `ray start --head` / `ray start --address=...`)."""
@@ -230,10 +271,24 @@ def main(argv: Optional[List[str]] = None) -> None:
     sp.add_argument("--head", action="store_true")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
+    jp = sub.add_parser("job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    jps = jsub.add_parser("submit")
+    jps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    jps.add_argument("--submission-id", default=None)
+    jps.add_argument("--wait", action="store_true")
+    jps.add_argument("--timeout", type=float, default=600.0)
+    for name in ("status", "logs", "stop"):
+        jpx = jsub.add_parser(name)
+        jpx.add_argument("submission_id")
+    jsub.add_parser("list")
     args = p.parse_args(argv)
 
     if args.cmd == "start":
         cmd_start(args)
+        return
+    if args.cmd == "job":
+        cmd_job(args)
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,

@@ -1,0 +1,194 @@
+"""Autoscaler: planner unit tests + end-to-end scale-up/down against the
+fake multi-node provider (the reference tests autoscaling the same way,
+ref: python/ray/tests/test_autoscaler_fake_multinode.py)."""
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.binpack import fits_after_removal, plan_scaling
+
+
+# ---------------------------------------------------------------------------
+# planner (pure)
+# ---------------------------------------------------------------------------
+
+TYPES = {
+    "cpu_worker": {"resources": {"CPU": 4, "memory": 8.0}, "max_workers": 5},
+    "tpu_host": {"resources": {"CPU": 8, "TPU": 4, "memory": 16.0},
+                 "max_workers": 2},
+}
+
+
+def test_plan_launches_for_queued_demand():
+    plan = plan_scaling(
+        TYPES, running=[{"CPU": 1}], pending_types=[],
+        demands=[{"CPU": 4}, {"CPU": 4}, {"CPU": 2}])
+    # 10 CPUs of demand, 1 free: needs 3 cpu_workers (4 CPU each).
+    assert plan.to_launch == {"cpu_worker": 3}
+    assert plan.infeasible == []
+
+
+def test_plan_prefers_smallest_sufficient_type():
+    plan = plan_scaling(TYPES, running=[], pending_types=[],
+                        demands=[{"CPU": 2}])
+    assert plan.to_launch == {"cpu_worker": 1}  # not the TPU host
+
+
+def test_plan_tpu_demand_picks_tpu_host():
+    plan = plan_scaling(TYPES, running=[], pending_types=[],
+                        demands=[{"TPU": 4}])
+    assert plan.to_launch == {"tpu_host": 1}
+
+
+def test_plan_respects_max_workers_and_reports_infeasible():
+    plan = plan_scaling(
+        TYPES, running=[], pending_types=[],
+        demands=[{"TPU": 4}] * 3 + [{"TPU": 64}])
+    assert plan.to_launch == {"tpu_host": 2}      # capped at max_workers
+    # third TPU:4 demand hits the cap; TPU:64 fits no type at all.
+    assert {"TPU": 4} in plan.infeasible
+    assert {"TPU": 64} in plan.infeasible
+
+
+def test_plan_counts_booting_capacity():
+    plan = plan_scaling(TYPES, running=[], pending_types=["cpu_worker"],
+                        demands=[{"CPU": 4}])
+    assert plan.to_launch == {}  # the booting worker will absorb it
+
+
+def test_plan_strict_pack_pg_needs_one_big_node():
+    pgs = [{"bundles": [{"CPU": 3}, {"CPU": 3}], "strategy": "STRICT_PACK"}]
+    plan = plan_scaling(TYPES, running=[{"CPU": 4}], pending_types=[],
+                        pending_pgs=pgs)
+    # 6 CPU on ONE node: only tpu_host (8 CPU) can hold it.
+    assert plan.to_launch == {"tpu_host": 1}
+
+
+def test_plan_strict_spread_pg_uses_distinct_nodes():
+    pgs = [{"bundles": [{"CPU": 2}] * 3, "strategy": "STRICT_SPREAD"}]
+    plan = plan_scaling(TYPES, running=[{"CPU": 4}], pending_types=[],
+                        pending_pgs=pgs)
+    # one bundle on the free node, two more nodes for the rest.
+    assert plan.to_launch == {"cpu_worker": 2}
+
+
+def test_plan_resource_requests_pack_against_totals():
+    # Busy node (0 available) but totals cover the request → no launch.
+    plan = plan_scaling(
+        TYPES, running=[{"CPU": 0}], pending_types=[],
+        resource_requests=[{"CPU": 4}], totals=[{"CPU": 4}])
+    assert plan.to_launch == {}
+    # Request beyond totals → launch.
+    plan = plan_scaling(
+        TYPES, running=[{"CPU": 0}], pending_types=[],
+        resource_requests=[{"CPU": 4}, {"CPU": 4}], totals=[{"CPU": 4}])
+    assert plan.to_launch == {"cpu_worker": 1}
+
+
+def test_fits_after_removal():
+    totals = [{"CPU": 4}, {"CPU": 4}]
+    assert fits_after_removal(totals, 0, [{"CPU": 4}])
+    assert not fits_after_removal(totals, 0, [{"CPU": 4}, {"CPU": 2}])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 1 → 4 → 1 under gang demand
+# ---------------------------------------------------------------------------
+
+def test_autoscaling_cluster_scales_up_and_down():
+    import ray_tpu
+    from ray_tpu.autoscaler import AutoscalingCluster
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "worker": {"resources": {"CPU": 2}, "min_workers": 0,
+                       "max_workers": 3},
+        },
+        idle_timeout_s=3.0,
+        update_interval_s=0.5,
+    )
+    try:
+        cluster.connect()
+
+        # A 3-bundle STRICT_SPREAD gang that cannot fit on the 1-CPU head:
+        # the autoscaler must launch all 3 workers for the PG to form.
+        pg = placement_group([{"CPU": 2}] * 3, strategy="STRICT_SPREAD")
+        assert pg.wait(timeout_seconds=90), "gang never formed"
+
+        alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+        assert len(alive) == 4  # head + 3 workers
+
+        # Work actually runs on the scaled-up capacity.
+        @ray_tpu.remote(num_cpus=2)
+        def who():
+            import ray_tpu
+
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        node_ids = ray_tpu.get([
+            who.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i)
+            ).remote()
+            for i in range(3)
+        ])
+        assert len(set(node_ids)) == 3
+
+        # Release the gang → workers idle out and are terminated.
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        remove_placement_group(pg)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.5)
+        assert len(alive) == 1, f"idle workers not reaped: {len(alive)}"
+    finally:
+        cluster.shutdown()
+
+
+def test_request_resources_scales_without_load():
+    import ray_tpu
+    from ray_tpu.autoscaler import AutoscalingCluster, sdk
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "worker": {"resources": {"CPU": 2}, "min_workers": 0,
+                       "max_workers": 2},
+        },
+        idle_timeout_s=2.0,
+        update_interval_s=0.5,
+    )
+    try:
+        cluster.connect()
+        sdk.request_resources(bundles=[{"CPU": 2}, {"CPU": 2}])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 3:
+                break
+            time.sleep(0.5)
+        assert len(alive) == 3, "request_resources did not scale up"
+        # The floor holds: idle timeout passes but nodes stay.
+        time.sleep(4)
+        alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+        assert len(alive) == 3, "request_resources floor violated"
+        # Clearing the request releases the nodes.
+        sdk.request_resources(bundles=[])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.5)
+        assert len(alive) == 1
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,82 @@
+"""Job submission: detached entrypoint jobs against a live cluster
+(ref: dashboard/modules/job tests — submit, track to completion, logs,
+stop)."""
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def job_cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+def test_job_runs_to_success_with_logs(job_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    info = client.wait_until_finished(sid, timeout=120)
+    assert info.status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    assert any(j.submission_id == sid for j in client.list_jobs())
+
+
+def test_job_entrypoint_joins_cluster(job_cluster):
+    """The entrypoint's own ray_tpu.init() must land on THIS cluster
+    (via RAY_TPU_ADDRESS) and be able to run tasks."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = (
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        'print("task result:", ray_tpu.get(f.remote(21)))\n'
+        "ray_tpu.shutdown()\n"
+    )
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c '{script}'")
+    info = client.wait_until_finished(sid, timeout=180)
+    logs = client.get_job_logs(sid)
+    assert info.status == JobStatus.SUCCEEDED, logs
+    assert "task result: 42" in logs
+
+
+def test_job_failure_reported(job_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    info = client.wait_until_finished(sid, timeout=120)
+    assert info.status == JobStatus.FAILED
+    assert "code 3" in info.message
+
+
+def test_job_stop(job_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.monotonic() + 60
+    while (client.get_job_status(sid) == JobStatus.PENDING
+           and time.monotonic() < deadline):
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    info = client.wait_until_finished(sid, timeout=60)
+    assert info.status == JobStatus.STOPPED
+    # Terminal job can be deleted.
+    assert client.delete_job(sid)
+    with pytest.raises(RuntimeError):
+        client.get_job_info(sid)
