@@ -14,6 +14,7 @@ import asyncio
 import inspect
 import logging
 import os
+import queue
 import threading
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
@@ -144,12 +145,40 @@ class WorkerService:
         self._task_pool = ThreadPoolExecutor(max_workers=4,
                                              thread_name_prefix="exec")
         self._max_inline = get_config().max_inline_object_size
+        # Deferred store writes for inline-able results: the caller gets
+        # the value in the reply NOW; the store copy + location record
+        # (needed only by third-party readers of the ref, who poll the
+        # directory anyway) land a moment later off the latency path
+        # (ref: small returns skip plasma via the in-process memory
+        # store, core_worker store_provider/memory_store/).
+        self._store_queue: "queue.Queue" = queue.Queue()
+        self._store_thread = threading.Thread(
+            target=self._store_drain, name="store-defer", daemon=True)
+        self._store_thread.start()
         # Task-event sink (ref: gcs_task_manager.h — powers `ray-tpu list
         # tasks` and the chrome-trace timeline). Batched like locations.
         self._events: List[dict] = []
         self._events_lock = threading.Lock()
         if get_config().task_events_enabled:
             self._start_event_flusher()
+
+    def _store_drain(self) -> None:
+        from ray_tpu.core.object_store import ObjectExistsError as _Exists
+
+        while True:
+            oid, payload = self._store_queue.get()
+            try:
+                self.core.store.put_raw(oid, payload)
+            except _Exists:
+                pass
+            except Exception as e:  # noqa: BLE001 store full: reader
+                logger.debug("deferred store of %s failed: %s",
+                             oid.hex()[:12], e)
+                continue  # falls back to lineage if ever pulled
+            try:
+                self.core.queue_location(oid, len(payload))
+            except Exception:  # noqa: BLE001
+                pass
 
     def _start_event_flusher(self) -> None:
         period = get_config().task_events_flush_ms / 1000
@@ -223,27 +252,22 @@ class WorkerService:
             oid = ObjectID.for_task_return(task_id, i + 1)
             payload = serialization.dumps(v, is_error=is_error)
             inline = payload if len(payload) <= self._max_inline else None
-            stored = True
-            try:
-                self.core.store.put_raw(oid, payload)
-            except ObjectExistsError:
-                # Retried task, contents identical; still (re-)register below
-                # — the first attempt may have died before add_location.
-                pass
-            except Exception:
-                # Store failure (e.g. full) is only tolerable when the value
-                # travels inline in the reply; otherwise the caller's get()
-                # would hang on an object that exists nowhere.
-                stored = False
-                if inline is None:
-                    raise
-            if stored:
-                # Batched async registration: the caller reads the inline
-                # copy from the reply now; remote readers poll the
-                # directory, which converges ms later. A blocking RPC here
-                # would put a control-plane round-trip in EVERY task result
-                # (ref: small returns skip plasma entirely via the
-                # in-process memory store).
+            if inline is not None:
+                # The caller consumes the inline copy from the reply; the
+                # store write + directory record serve only third-party
+                # readers and happen off the reply path (they poll the
+                # directory with backoff, so eventual registration is
+                # enough).
+                self._store_queue.put((oid, payload))
+            else:
+                # No inline copy: the store write must land before the
+                # reply or the caller's get() would race a missing object.
+                try:
+                    self.core.store.put_raw(oid, payload)
+                except ObjectExistsError:
+                    # Retried task, contents identical; still re-register —
+                    # the first attempt may have died before add_location.
+                    pass
                 self.core.queue_location(oid, len(payload))
             out.append(protocol.TaskResult(oid=oid.binary(),
                                            size=len(payload),
@@ -355,6 +379,16 @@ class WorkerService:
         except BaseException as e:  # noqa: BLE001
             logger.exception("actor construction failed")
             return {"ok": False, "error": repr(e)}
+        # Generic escape hatch used by compiled DAGs (the reference's
+        # `__ray_call__`, actor.py): run an arbitrary function with the
+        # actor instance as first argument, on the actor's own thread.
+        def __raytpu_apply__(fn, *a, **kw):
+            return fn(instance, *a, **kw)
+
+        try:
+            instance.__raytpu_apply__ = __raytpu_apply__
+        except AttributeError:
+            pass  # __slots__ class: compiled DAG loops unsupported on it
         self.actor = ActorRuntime(instance, max_concurrency)
         self.actor_id = actor_id
         return {"ok": True}

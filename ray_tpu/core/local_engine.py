@@ -122,6 +122,17 @@ class _LocalActor:
         self.creation_error: Optional[BaseException] = None
         try:
             self.instance = cls(*args, **kwargs)
+            # Same compiled-DAG escape hatch the distributed worker
+            # installs (the reference's `__ray_call__`).
+            inst = self.instance
+
+            def __raytpu_apply__(fn, *a, **kw):
+                return fn(inst, *a, **kw)
+
+            try:
+                inst.__raytpu_apply__ = __raytpu_apply__
+            except AttributeError:
+                pass
         except BaseException as e:  # noqa: BLE001
             self.creation_error = e
 

@@ -1,57 +1,397 @@
-"""Compiled (accelerated) DAG execution.
+"""Compiled (accelerated) DAG execution over mutable shm channels.
 
 Analogue of the reference CompiledDAG (ref: python/ray/dag/
-compiled_dag_node.py:174, execute :532) which pre-allocates mutable
-shared-memory channels between actors. Here the TPU-native analogue is a
-pre-resolved execution plan: actor targets are materialized once and each
-`execute()` submits the whole pipeline without re-walking/re-binding the
-graph. Device-resident channel buffers arrive with the compiled pjit
-pipeline work (parallel/pipeline.py).
+compiled_dag_node.py:174 — execute :532, async :561) and its channel
+substrate (python/ray/experimental/channel.py:50): the graph is resolved
+ONCE into per-actor execution loops connected by mutable shared-memory
+channels, so each `execute()` is a channel write + read — no per-call
+task submission (lease RPC, arg upload, result store) at all.
+
+Compilation model (mirrors the reference's v1 aDAG constraints):
+  * one InputNode, actor-method nodes only (stateless FunctionNodes keep
+    the per-call path — use .execute()), one output or MultiOutputNode;
+  * every DAG actor runs `_compiled_node_loop` via the worker's
+    `__raytpu_apply__` hook, dedicating itself to the DAG (the reference
+    pins the actor's executor the same way);
+  * exceptions are wrapped and forwarded through downstream channels, so
+    a failed stage surfaces at `ref.get()` without wedging the pipeline;
+  * `teardown()` closes the channels; loops drain and the actors return
+    to normal call service.
+
+Stages pipeline naturally: the input channel accepts iteration N+1 as
+soon as stage 1 consumed iteration N (write blocks only on un-acked
+readers), which is the GPipe-style overlap the reference gets from its
+buffered channels.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.dag_node import (
     ActorClassNode,
+    ActorMethodNode,
     DAGNode,
+    FunctionNode,
     InputNode,
     MultiOutputNode,
 )
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+)
+
+
+class _ExecError:
+    """A stage failure in transit: forwarded through downstream channels
+    and re-raised at ref.get() (ref: the reference wraps exceptions into
+    the channel the same way)."""
+
+    def __init__(self, exc: BaseException):
+        try:
+            self.blob = pickle.dumps(exc)
+        except Exception:  # noqa: BLE001
+            self.blob = pickle.dumps(RuntimeError(repr(exc)))
+
+    def raise_(self) -> None:
+        raise pickle.loads(self.blob)
+
+
+def _compiled_node_loop(instance, method_name: str,
+                        arg_template: List[Tuple[str, Any]],
+                        kwarg_template: Dict[str, Tuple[str, Any]],
+                        in_channels: List[Tuple[Channel, int]],
+                        out_channel: Channel) -> str:
+    """Runs inside the DAG actor (via __raytpu_apply__): read inputs,
+    apply the bound method, write the output; repeat until teardown."""
+    method = getattr(instance, method_name)
+    while True:
+        try:
+            values = [ch.read(timeout=None, reader_idx=idx)
+                      for ch, idx in in_channels]
+        except ChannelClosedError:
+            return "closed"
+        failed = next((v for v in values if isinstance(v, _ExecError)),
+                      None)
+        if failed is None:
+            args = [values[src] if kind == "chan" else src
+                    for kind, src in arg_template]
+            kwargs = {k: (values[src] if kind == "chan" else src)
+                      for k, (kind, src) in kwarg_template.items()}
+            try:
+                result = method(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                result = _ExecError(e)
+        else:
+            result = failed  # propagate upstream failure unchanged
+        try:
+            out_channel.write(result, timeout=None)
+        except ChannelClosedError:
+            return "closed"
+
+
+class CompiledDAGRef:
+    """Handle for one execute()'s result (ref: CompiledDAGRef in
+    compiled_dag_node.py). `get()` may be called once, in any order
+    across refs — results are buffered per execution index."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._taken = False
+
+    def get(self, timeout: Optional[float] = None):
+        if self._taken:
+            raise ValueError("CompiledDAGRef.get() already consumed")
+        self._taken = True
+        return self._dag._get_result(self._idx, timeout)
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, **kwargs):
+    MAX_BUFFERED_RESULTS = 1000
+
+    def __init__(self, root: DAGNode, *,
+                 buffer_size_bytes: int = 4 << 20,
+                 submit_timeout: float = 30.0):
         self._root = root
-        # Materialize all actor-class nodes once (channel-like reuse).
+        self._buffer_size = buffer_size_bytes
+        self._submit_timeout = submit_timeout
         self._actor_cache: Dict[int, Any] = {}
-        self._materialize_actors(root)
+        self._channels: List[Channel] = []
+        self._loop_refs: List[Any] = []
+        self._exec_idx = 0
+        self._next_read_idx = 0
+        self._result_buffer: Dict[int, Any] = {}
+        self._torn_down = False
+        self._compile()
 
-    def _materialize_actors(self, node: DAGNode) -> None:
-        seen = set()
-        stack = [node]
-        while stack:
-            n = stack.pop()
+    # -- compilation ----------------------------------------------------
+    def _topo_nodes(self) -> List[DAGNode]:
+        order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}
+
+        def visit(n: DAGNode) -> None:
             if id(n) in seen:
-                continue
-            seen.add(id(n))
-            if isinstance(n, ActorClassNode):
-                if not n._children():
-                    self._actor_cache[id(n)] = n.execute()
-            stack.extend(n._children())
+                return
+            seen[id(n)] = True
+            for c in n._children():
+                visit(c)
+            order.append(n)
 
-    def execute(self, *args, **kwargs):
-        cache = dict(self._actor_cache)
-        return self._root._execute(cache, args, kwargs)
+        visit(self._root)
+        return order
 
-    async def execute_async(self, *args, **kwargs):
-        return self.execute(*args, **kwargs)
+    def _materialize_actor(self, node: DAGNode):
+        """ActorClassNode targets instantiate once for the DAG's life."""
+        if id(node) not in self._actor_cache:
+            if node._children():
+                raise ValueError(
+                    "compiled DAG actor constructors cannot depend on "
+                    "other DAG nodes")
+            self._actor_cache[id(node)] = node.execute()
+        return self._actor_cache[id(node)]
 
-    def teardown(self) -> None:
+    def _compile(self) -> None:
+        nodes = self._topo_nodes()
+        method_nodes = [n for n in nodes if isinstance(n, ActorMethodNode)]
+        inputs = [n for n in nodes if isinstance(n, InputNode)]
+        if any(isinstance(n, FunctionNode) for n in nodes):
+            raise ValueError(
+                "compiled DAGs support actor-method nodes only; stateless "
+                "task nodes keep the per-call path (use .execute())")
+        if len(inputs) != 1:
+            raise ValueError("compiled DAGs need exactly one InputNode "
+                             "(the execution trigger)")
+        if not method_nodes:
+            raise ValueError("compiled DAG has no actor-method nodes")
+        self._input_node = inputs[0]
+
+        if isinstance(self._root, MultiOutputNode):
+            output_nodes = list(self._root._bound_args)
+        else:
+            output_nodes = [self._root]
+        if not all(isinstance(o, ActorMethodNode) for o in output_nodes):
+            raise ValueError("compiled DAG outputs must be actor methods")
+
+        # Producer -> consumer wiring. A producer gets ONE channel with a
+        # reader slot per consuming node (+ one for the driver if it is a
+        # DAG output).
+        consumers: Dict[int, List[ActorMethodNode]] = {}
+        for n in method_nodes:
+            # Dedupe: a node reading the same producer for two arg slots
+            # still consumes ONE version per iteration (a duplicate reader
+            # slot would never ack and wedge the writer).
+            deps = {id(d): d for d in n._children()}.values()
+            for dep in deps:
+                if isinstance(dep, (InputNode, ActorMethodNode)):
+                    consumers.setdefault(id(dep), []).append(n)
+
+        chan_of: Dict[int, Channel] = {}
+        reader_slot: Dict[Tuple[int, int], int] = {}
+
+        def ensure_channel(prod: DAGNode) -> Channel:
+            if id(prod) in chan_of:
+                return chan_of[id(prod)]
+            cons = consumers.get(id(prod), [])
+            n_readers = len(cons) + (1 if prod in output_nodes else 0)
+            if n_readers == 0:
+                raise ValueError("dangling DAG node with no consumers")
+            ch = Channel.create(n_readers, capacity=self._buffer_size)
+            for slot, c in enumerate(cons):
+                reader_slot[(id(prod), id(c))] = slot
+            chan_of[id(prod)] = ch
+            self._channels.append(ch)
+            return ch
+
+        self._input_chan: Channel = ensure_channel(self._input_node)
+        for n in method_nodes:
+            ensure_channel(n)
+
+        # Launch one loop per method node.
+        from ray_tpu.actor import ActorHandle, ActorMethod
+
+        for n in method_nodes:
+            target = n._target
+            if isinstance(target, ActorClassNode):
+                target = self._materialize_actor(target)
+            if not isinstance(target, ActorHandle):
+                raise ValueError(
+                    f"compiled DAG method target must be an actor, got "
+                    f"{type(target).__name__}")
+            in_channels: List[Tuple[Channel, int]] = []
+            chan_index: Dict[int, int] = {}
+
+            def slot_for(dep: DAGNode) -> int:
+                if id(dep) not in chan_index:
+                    ch = chan_of[id(dep)]
+                    in_channels.append(
+                        (ch, reader_slot[(id(dep), id(n))]))
+                    chan_index[id(dep)] = len(in_channels) - 1
+                return chan_index[id(dep)]
+
+            def encode(v):
+                if isinstance(v, (InputNode, ActorMethodNode)):
+                    return ("chan", slot_for(v))
+                if isinstance(v, DAGNode):
+                    raise ValueError(
+                        f"unsupported arg node {type(v).__name__} in "
+                        "compiled DAG")
+                return ("const", v)
+
+            arg_template = [encode(a) for a in n._bound_args]
+            kwarg_template = {k: encode(v)
+                              for k, v in n._bound_kwargs.items()}
+            if not in_channels:
+                raise ValueError(
+                    f"compiled DAG node {n._method_name!r} has no channel "
+                    "inputs — every node must (transitively) depend on "
+                    "the InputNode so executions drive it")
+            ref = ActorMethod(target, "__raytpu_apply__").remote(
+                _compiled_node_loop, n._method_name, arg_template,
+                kwarg_template, in_channels, chan_of[id(n)])
+            self._loop_refs.append(ref)
+
+        # Driver-side output readers: the driver's slot is the LAST one.
+        self._output_readers: List[Tuple[Channel, int]] = []
+        for o in output_nodes:
+            ch = chan_of[id(o)]
+            self._output_readers.append((ch, ch.n_readers - 1))
+        self._multi_output = isinstance(self._root, MultiOutputNode)
+
+    # -- execution ------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise ValueError("compiled DAG was torn down")
+        if kwargs:
+            raise ValueError("compiled DAGs take positional input only")
+        if self._exec_idx - self._next_read_idx >= self.MAX_BUFFERED_RESULTS:
+            raise ValueError(
+                f"{self.MAX_BUFFERED_RESULTS} un-consumed results; call "
+                "get() on earlier CompiledDAGRefs first")
+        value = args[0] if len(args) == 1 else args
+        # The channel rings bound in-flight executions; when they fill,
+        # drain finished outputs into the result buffer so deep
+        # submit-then-get patterns keep flowing (the reference buffers
+        # results the same way, compiled_dag_node max_buffered_results).
+        import time
+
+        deadline = time.monotonic() + self._submit_timeout
+        while True:
+            self._drain_ready()
+            try:
+                self._input_chan.write(value, timeout=0.05)
+                break
+            except ChannelTimeoutError:
+                if time.monotonic() >= deadline:
+                    self._check_loops()  # dead DAG actor is the likely cause
+                    raise ChannelTimeoutError(
+                        f"execute() blocked >{self._submit_timeout}s: "
+                        "pipeline full and no output consumed")
+        ref = CompiledDAGRef(self, self._exec_idx)
+        self._exec_idx += 1
+        return ref
+
+    def _drain_ready(self) -> None:
+        """Move already-published outputs into the result buffer
+        (non-blocking), releasing ring backpressure."""
+        while (self._next_read_idx < self._exec_idx
+               and len(self._result_buffer) < self.MAX_BUFFERED_RESULTS):
+            if not all(ch.peek_ready() for ch, _ in self._output_readers):
+                return
+            outs = [ch.read(timeout=1.0, reader_idx=slot)
+                    for ch, slot in self._output_readers]
+            self._result_buffer[self._next_read_idx] = (
+                outs if self._multi_output else outs[0])
+            self._next_read_idx += 1
+
+    async def execute_async(self, *args, **kwargs) -> CompiledDAGRef:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self.execute(*args, **kwargs))
+
+    def _check_loops(self) -> None:
+        """Surface a dead DAG actor as an error instead of a hang."""
         import ray_tpu
 
-        for handle in self._actor_cache.values():
-            try:
-                ray_tpu.kill(handle)
-            except Exception:
-                pass
+        done, _ = ray_tpu.wait(list(self._loop_refs), num_returns=1,
+                               timeout=0)
+        if done:
+            ray_tpu.get(done[0])  # raises if the loop/actor died
+            raise RuntimeError(
+                "a compiled DAG actor exited its execution loop; "
+                "tear down and recompile")
+
+    def _read_iteration(self, deadline: Optional[float]) -> list:
+        """All-or-nothing read of one iteration's outputs: wait until
+        EVERY output channel has the next version published, then consume
+        them together. A partial read (one channel consumed, another
+        timed out) would misalign every later iteration. Waits in 1s
+        slices so a dead stage actor surfaces as an error, not a hang."""
+        import time
+
+        next_liveness = time.monotonic() + 1.0
+        backoff = 1e-6
+        while True:
+            if all(ch.peek_ready() for ch, _ in self._output_readers):
+                return [ch.read(timeout=5.0, reader_idx=slot)
+                        for ch, slot in self._output_readers]
+            now = time.monotonic()
+            if now >= next_liveness:
+                self._check_loops()
+                next_liveness = now + 1.0
+            if deadline is not None and now >= deadline:
+                raise ChannelTimeoutError(
+                    "compiled DAG result not ready before timeout")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 2e-4)
+
+    def _get_result(self, idx: int, timeout: Optional[float]):
+        import time
+
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self._next_read_idx <= idx:
+            outs = self._read_iteration(deadline)
+            self._result_buffer[self._next_read_idx] = (
+                outs if self._multi_output else outs[0])
+            self._next_read_idx += 1
+        result = self._result_buffer.pop(idx)
+        if isinstance(result, _ExecError):
+            result.raise_()
+        if isinstance(result, list):
+            for r in result:
+                if isinstance(r, _ExecError):
+                    r.raise_()
+        return result
+
+    # -- teardown -------------------------------------------------------
+    def teardown(self, kill_actors: bool = False) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._channels:
+            ch.close()
+        import ray_tpu
+
+        try:
+            ray_tpu.wait(list(self._loop_refs),
+                         num_returns=len(self._loop_refs), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        for ch in self._channels:
+            ch.unlink()
+        if kill_actors:
+            for handle in self._actor_cache.values():
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,7 @@
+"""Experimental substrate for compiled DAGs (ref: python/ray/experimental/
+channel.py — mutable-object channels backing accelerated DAGs)."""
+from ray_tpu.experimental.channel import (  # noqa: F401
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+)

@@ -74,7 +74,7 @@ def test_compiled_dag_reuses_actors():
         node = Stage.bind()
         dag = node.step.bind(inp)
     compiled = dag.experimental_compile()
-    assert ray_tpu.get(compiled.execute(0)) == 1
+    assert compiled.execute(0).get() == 1
     # Same actor across executions => state persists.
-    assert ray_tpu.get(compiled.execute(0)) == 2
+    assert compiled.execute(0).get() == 2
     compiled.teardown()
