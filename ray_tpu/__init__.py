@@ -30,7 +30,7 @@ from ray_tpu import exceptions
 
 _SUBPACKAGES = ("data", "train", "tune", "serve", "dag", "util", "parallel",
                 "ops", "models", "workflow", "rllib", "autoscaler",
-                "job_submission")
+                "job_submission", "dashboard", "experimental")
 
 
 def __getattr__(name):

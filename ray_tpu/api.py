@@ -73,7 +73,13 @@ def init(
             raise RuntimeError(
                 "ray_tpu.init() has already been called. Pass "
                 "ignore_reinit_error=True to ignore.")
-        if local_mode:
+        if address is not None and address.startswith("ray-tpu://"):
+            # Thin-client mode: drive a remote cluster through its client
+            # proxy (ref: ray.init("ray://host:port") → Ray Client).
+            from ray_tpu.util.client import ClientWorker
+
+            _worker = ClientWorker(address)
+        elif local_mode:
             from ray_tpu.core.local_engine import LocalCoreWorker
 
             _worker = LocalCoreWorker(num_cpus=num_cpus)

@@ -1,0 +1,273 @@
+"""Dashboard head: aiohttp REST API + embedded HTML UI.
+
+Analogue of the reference `DashboardHead` (ref: dashboard/head.py, REST
+routes in dashboard/modules/{node,actor,job,state,metrics}/*). One
+asyncio process: every /api/* route is a thin view over GCS RPCs, so the
+dashboard holds no state of its own and can restart freely.
+
+    GET /api/nodes            node table (+ per-node resource totals)
+    GET /api/actors           actor table
+    GET /api/tasks?limit=N    recent task events
+    GET /api/jobs             driver jobs + submitted jobs
+    GET /api/pgs              placement groups
+    GET /api/cluster_status   autoscaler view (demand, idle, requests)
+    GET /api/metrics          per-node daemon metrics (Prometheus text)
+    GET /api/timeline         chrome://tracing JSON of task events
+    GET /                     embedded HTML UI polling the above
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ray_tpu.core.distributed.rpc import AsyncRpcClient
+
+logger = logging.getLogger(__name__)
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray-tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1d21}
+ header{background:#1a1d21;color:#fff;padding:10px 18px;font-size:15px}
+ header span{opacity:.65;margin-left:10px;font-size:12px}
+ main{padding:14px 18px;display:grid;gap:14px}
+ section{background:#fff;border:1px solid #e3e6ea;border-radius:8px;padding:10px 14px}
+ h2{font-size:13px;text-transform:uppercase;letter-spacing:.06em;color:#5a6472;margin:2px 0 8px}
+ table{border-collapse:collapse;width:100%;font-size:12.5px}
+ th,td{text-align:left;padding:3px 10px 3px 0;border-bottom:1px solid #eef0f3;font-variant-numeric:tabular-nums}
+ th{color:#8a93a0;font-weight:600}
+ .ok{color:#0a7d33}.bad{color:#b3261e}.muted{color:#8a93a0}
+</style></head><body>
+<header>ray-tpu dashboard<span id="addr"></span><span id="ts"></span></header>
+<main>
+ <section><h2>Nodes</h2><table id="nodes"></table></section>
+ <section><h2>Resources</h2><table id="resources"></table></section>
+ <section><h2>Actors</h2><table id="actors"></table></section>
+ <section><h2>Jobs</h2><table id="jobs"></table></section>
+ <section><h2>Placement groups</h2><table id="pgs"></table></section>
+ <section><h2>Recent tasks</h2><table id="tasks"></table></section>
+</main>
+<script>
+const esc=s=>String(s??"").replace(/[&<>]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const row=(cells,h)=> "<tr>"+cells.map(c=>`<${h?"th":"td"}>${c}</${h?"th":"td"}>`).join("")+"</tr>";
+async function j(u){const r=await fetch(u);return r.json()}
+async function tick(){
+ try{
+  const [nodes,actors,jobs,pgs,tasks,status]=await Promise.all([
+    j("/api/nodes"),j("/api/actors"),j("/api/jobs"),j("/api/pgs"),
+    j("/api/tasks?limit=25"),j("/api/cluster_status")]);
+  document.getElementById("ts").textContent="updated "+new Date().toLocaleTimeString();
+  document.getElementById("nodes").innerHTML=row(["node","state","address","cpu","tpu","idle s"],1)+
+   status.nodes.map(n=>row([esc(n.node_id.slice(0,12)),
+     n.alive?'<span class="ok">ALIVE</span>':'<span class="bad">DEAD</span>',
+     esc((nodes.find(x=>x.node_id==n.node_id)||{}).address||""),
+     `${(n.total.CPU??0)-(n.available.CPU??0)}/${n.total.CPU??0}`,
+     `${(n.total.TPU??0)-(n.available.TPU??0)}/${n.total.TPU??0}`,
+     n.alive?n.idle_s.toFixed(0):""])).join("");
+  const tot={},av={};
+  for(const n of status.nodes){ if(!n.alive)continue;
+    for(const k in n.total){tot[k]=(tot[k]??0)+n.total[k];}
+    for(const k in n.available){av[k]=(av[k]??0)+n.available[k];}}
+  document.getElementById("resources").innerHTML=row(["resource","used","total"],1)+
+   Object.keys(tot).sort().map(k=>row([esc(k),
+     k=="memory"?((tot[k]-(av[k]??0))/1e9).toFixed(1)+" GB":(tot[k]-(av[k]??0)).toFixed(1),
+     k=="memory"?(tot[k]/1e9).toFixed(1)+" GB":tot[k]])).join("");
+  document.getElementById("actors").innerHTML=row(["actor","class","state","name","node"],1)+
+   actors.map(a=>row([esc(a.actor_id.slice(0,12)),esc(a.cls_name),
+     a.state=="ALIVE"?'<span class="ok">ALIVE</span>':esc(a.state),
+     esc(a.name||""),esc((a.node_id||"").slice(0,12))])).join("");
+  document.getElementById("jobs").innerHTML=row(["job","kind","state","entrypoint"],1)+
+   jobs.map(x=>row([esc(x.id),esc(x.kind),esc(x.state),
+     `<span class="muted">${esc(x.entrypoint||"")}</span>`])).join("");
+  document.getElementById("pgs").innerHTML=row(["pg","state","strategy","bundles"],1)+
+   pgs.map(p=>row([esc(p.pg_id.slice(0,12)),esc(p.state),esc(p.strategy),
+     (p.bundles||[]).length])).join("");
+  document.getElementById("tasks").innerHTML=row(["task","name","state","ms","node"],1)+
+   tasks.map(t=>row([esc((t.task_id||"").slice(0,12)),esc(t.name),
+     t.state=="FINISHED"?'<span class="ok">FINISHED</span>':esc(t.state),
+     ((t.end_ts-t.start_ts)*1000).toFixed(1),
+     esc((t.node_id||"").slice(0,12))])).join("");
+ }catch(e){document.getElementById("ts").textContent="error: "+e}
+}
+document.getElementById("addr").textContent=location.host;
+tick();setInterval(tick,2000);
+</script></body></html>"""
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self._gcs: Optional[AsyncRpcClient] = None
+        self._runner = None
+
+    async def _call(self, service: str, method: str, **kw):
+        if self._gcs is None:
+            self._gcs = AsyncRpcClient(self.gcs_address)
+        return await self._gcs.call(service, method, timeout=15, **kw)
+
+    # -- handlers -------------------------------------------------------
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    def _json(self, payload):
+        from aiohttp import web
+
+        return web.Response(text=json.dumps(payload),
+                            content_type="application/json")
+
+    async def _nodes(self, request):
+        return self._json(await self._call("NodeInfo", "list_nodes"))
+
+    async def _actors(self, request):
+        return self._json(await self._call("ActorManager", "list_actors"))
+
+    async def _tasks(self, request):
+        limit = int(request.query.get("limit", "200"))
+        return self._json(await self._call("TaskEvents", "list_events",
+                                           limit=limit))
+
+    async def _jobs(self, request):
+        from ray_tpu.job_submission import parse_job_records
+
+        out = []
+        for job in await self._call("JobManager", "list_jobs"):
+            out.append({
+                "id": job["job_id"], "kind": "driver",
+                "state": "FINISHED" if job.get("finished") else "RUNNING",
+                "entrypoint": "",
+            })
+        # Submitted jobs live in the KV under the "job" namespace; the
+        # record layout is owned by job_submission.parse_job_records.
+        items = {}
+        for key in await self._call("KV", "keys", namespace="job",
+                                    prefix=b""):
+            if b":" in key:
+                continue
+            items[key] = await self._call("KV", "get", namespace="job",
+                                          key=key)
+        for info in parse_job_records(items):
+            out.append({
+                "id": info.submission_id, "kind": "submission",
+                "state": info.status,
+                "entrypoint": info.entrypoint,
+            })
+        return self._json(out)
+
+    async def _pgs(self, request):
+        return self._json(await self._call("PlacementGroups", "list_pgs"))
+
+    async def _cluster_status(self, request):
+        return self._json(await self._call("AutoscalerState",
+                                           "get_cluster_status"))
+
+    async def _metrics(self, request):
+        """Aggregate per-node Prometheus text (ref: dashboard metrics
+        module scraping each node's metrics agent)."""
+        async def scrape(n):
+            client = AsyncRpcClient(n["address"])
+            try:
+                text = await client.call("NodeDaemon", "get_metrics",
+                                         timeout=5)
+                return f"# node {n['node_id'][:12]}\n{text}"
+            except Exception as e:  # noqa: BLE001
+                return f"# node {n['node_id'][:12]} unreachable: {e}"
+            finally:
+                await client.close()
+
+        alive = [n for n in await self._call("NodeInfo", "list_nodes")
+                 if n["alive"]]
+        # One slow node bounds the scrape, not the sum over nodes.
+        chunks = await asyncio.gather(*[scrape(n) for n in alive])
+        from aiohttp import web
+
+        return web.Response(text="\n".join(chunks),
+                            content_type="text/plain")
+
+    async def _timeline(self, request):
+        from ray_tpu.util.timeline import chrome_trace
+
+        limit = int(request.query.get("limit", "10000"))
+        events = await self._call("TaskEvents", "list_events", limit=limit)
+        return self._json(chrome_trace(events))
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/pgs", self._pgs)
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/metrics", self._metrics)
+        app.router.add_get("/api/timeline", self._timeline)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        logger.info("dashboard at http://%s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self._gcs is not None:
+            await self._gcs.close()
+
+
+def start_dashboard(gcs_address: str, host: str = "127.0.0.1",
+                    port: int = 0):
+    """In-process helper: run the dashboard on a daemon thread; returns
+    (DashboardHead, bound_port)."""
+    import threading
+
+    head = DashboardHead(gcs_address, host, port)
+    started = threading.Event()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(head.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True,
+                     name="dashboard-head").start()
+    if not started.wait(30):
+        raise RuntimeError("dashboard failed to start")
+    return head, head.port
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[dashboard] %(message)s")
+
+    async def run():
+        head = DashboardHead(args.gcs_address, args.host, args.port)
+        port = await head.start()
+        print(f"DASHBOARD_PORT={port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -13,4 +13,5 @@ from ray_tpu.job_submission.client import (  # noqa: F401
     JobInfo,
     JobStatus,
     JobSubmissionClient,
+    parse_job_records,
 )

@@ -38,6 +38,20 @@ class JobInfo:
     end_time: Optional[float] = None
 
 
+def parse_job_records(items: Dict[bytes, Optional[bytes]]) -> List[JobInfo]:
+    """Decode raw KV entries from the "job" namespace into JobInfo rows.
+
+    The single owner of the KV layout (sub-keys carry a ':' — e.g.
+    '<id>:logs' — and are not job records); the CLI, dashboard, and
+    client all list jobs through this."""
+    out = []
+    for key, raw in items.items():
+        if b":" in key or raw is None:
+            continue
+        out.append(JobInfo(**json.loads(raw.decode())))
+    return sorted(out, key=lambda j: j.start_time or 0)
+
+
 class JobSubmissionClient:
     """Submit shell entrypoints to a cluster and track them.
 
@@ -134,14 +148,9 @@ class JobSubmissionClient:
             return raw.decode(errors="replace")
 
     def list_jobs(self) -> List[JobInfo]:
-        out = []
-        for key in self._worker.kv_keys(JOB_KV_NAMESPACE, b""):
-            if b":" in key:
-                continue  # logs entries
-            info = self._get_info(key.decode())
-            if info is not None:
-                out.append(info)
-        return sorted(out, key=lambda j: j.start_time or 0)
+        items = {key: self._worker.kv_get(JOB_KV_NAMESPACE, key)
+                 for key in self._worker.kv_keys(JOB_KV_NAMESPACE, b"")}
+        return parse_job_records(items)
 
     # -- control --------------------------------------------------------
     def stop_job(self, submission_id: str) -> bool:

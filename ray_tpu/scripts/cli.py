@@ -227,6 +227,27 @@ def cmd_job(args) -> None:
                                 "ENTRYPOINT"]))
 
 
+def cmd_dashboard(args) -> None:
+    """Serve the web dashboard for a running cluster (ref: `ray
+    dashboard`, dashboard/head.py)."""
+    import asyncio
+
+    from ray_tpu.dashboard.head import DashboardHead
+
+    address = _resolve_address(args)
+
+    async def run():
+        head = DashboardHead(address, args.host, args.port)
+        port = await head.start()
+        print(f"dashboard at http://{args.host}:{port} (Ctrl-C to stop)")
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_start(args) -> None:
     """Start a head (GCS + daemon) or join a worker daemon to a cluster
     (ref: `ray start --head` / `ray start --address=...`)."""
@@ -282,6 +303,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         jpx = jsub.add_parser(name)
         jpx.add_argument("submission_id")
     jsub.add_parser("list")
+    dp = sub.add_parser("dashboard")
+    dp.add_argument("--host", default="127.0.0.1")
+    dp.add_argument("--port", type=int, default=8265)
     args = p.parse_args(argv)
 
     if args.cmd == "start":
@@ -289,6 +313,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
     if args.cmd == "job":
         cmd_job(args)
+        return
+    if args.cmd == "dashboard":
+        cmd_dashboard(args)
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,

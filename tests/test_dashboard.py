@@ -1,0 +1,73 @@
+"""Dashboard REST head over a live cluster (ref: dashboard/tests —
+route-level checks against a running GCS)."""
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def dash_cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dashboard import start_dashboard
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.connect()
+    head, port = start_dashboard(cluster.address)
+    yield cluster, port
+    cluster.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_dashboard_routes(dash_cluster):
+    import ray_tpu
+
+    cluster, port = dash_cluster
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="dash_actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    assert ray_tpu.get(f.remote(1), timeout=30) == 2
+
+    status, body = _get(port, "/api/nodes")
+    assert status == 200
+    nodes = json.loads(body)
+    assert any(n["alive"] for n in nodes)
+
+    status, body = _get(port, "/api/actors")
+    actors = json.loads(body)
+    assert any(x.get("name") == "dash_actor" for x in actors)
+
+    status, body = _get(port, "/api/cluster_status")
+    cs = json.loads(body)
+    assert "nodes" in cs and "pending_actors" in cs
+
+    status, body = _get(port, "/api/tasks?limit=50")
+    assert status == 200
+
+    status, body = _get(port, "/api/jobs")
+    jobs = json.loads(body)
+    assert any(j["kind"] == "driver" for j in jobs)
+
+    status, body = _get(port, "/")
+    assert status == 200 and b"ray-tpu dashboard" in body
+
+    status, body = _get(port, "/api/timeline")
+    assert status == 200
+
+    status, body = _get(port, "/api/metrics")
+    assert status == 200
