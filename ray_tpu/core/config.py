@@ -56,9 +56,6 @@ class Config:
     worker_register_timeout_s: int = 30
     # Object transfer chunk size over DCN (ref: ray_config_def.h:352 — 5 MiB).
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
-    # Memory monitor
-    memory_usage_threshold: float = 0.95
-    memory_monitor_refresh_ms: int = 250
 
     # ---- object store ----
     # Per-node shared-memory store capacity. 0 => 30% of system RAM
@@ -88,6 +85,16 @@ class Config:
     task_events_flush_ms: int = 500
     # Worker-side unflushed-event backstop when the GCS is unreachable.
     task_events_max_buffer: int = 10000
+    # Opt-in distributed tracing: span context rides TaskSpecs, spans
+    # flush into the TaskEvents sink (ref: ray.init tracing hooks,
+    # util/tracing/tracing_helper.py).
+    tracing_enabled: bool = False
+    # Node memory monitor (ref: src/ray/common/memory_monitor.h:52 —
+    # refresh cadence; 0 disables) + usage fraction above which the
+    # daemon kills workers, newest task lease first (ref LIFO-retriable
+    # policy, raylet/worker_killing_policy.h:64).
+    memory_monitor_refresh_ms: int = 250
+    memory_usage_threshold: float = 0.95
 
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout

@@ -230,6 +230,15 @@ class DistributedCoreWorker:
         self.loop_thread = loop_thread or EventLoopThread(
             name="core-worker-rpc")
         self.gcs = SyncRpcClient(gcs_address, self.loop_thread)
+        from ray_tpu.core.distributed.pull_manager import PullManager
+
+        self._pull_manager = PullManager(self.loop_thread.loop,
+                                         self._fetch_object_chunks)
+        if get_config().tracing_enabled:
+            # Driver-side spans flush to the same TaskEvents sink workers
+            # use, or root spans would dangle (children reference a
+            # parent the sink never saw).
+            self.loop_thread.submit(self._span_flush_loop())
         self.daemon = SyncRpcClient(daemon_address, self.loop_thread)
         self.store = ObjectStore(store_dir)
 
@@ -415,12 +424,13 @@ class DistributedCoreWorker:
                 old = self._inline_cache_order.pop(0)
                 self._inline_cache.pop(old, None)
 
-    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
-            ) -> List[Any]:
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None,
+            _priority: Optional[int] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        return [self._get_one(r, deadline) for r in refs]
+        return [self._get_one(r, deadline, _priority) for r in refs]
 
-    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float],
+                 priority: Optional[int] = None) -> Any:
         oid = ref.id()
         backoff = 0.002
         while True:
@@ -445,7 +455,8 @@ class DistributedCoreWorker:
                     raise rexc.GetTimeoutError(ref.hex()) from None
                 continue
             # 4) remote fetch via directory
-            pulled, num_locations = self._try_pull_remote(oid)
+            pulled, num_locations = self._try_pull_remote(oid,
+                                                          priority=priority)
             if pulled:
                 continue  # now in local store
             # 5) object lost (no copies anywhere): lineage reconstruction
@@ -456,17 +467,23 @@ class DistributedCoreWorker:
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.05)
 
-    def _try_pull_remote(self, oid: ObjectID) -> Tuple[bool, int]:
+    def _try_pull_remote(self, oid: ObjectID,
+                         priority: Optional[int] = None
+                         ) -> Tuple[bool, int]:
         """Returns (pulled_into_local_store, usable_location_count).
 
         A node that explicitly answers "missing" evicted its copy without
         telling the directory — such stale locations are removed so an
         object whose every copy was LRU-evicted counts as lost (and
         becomes reconstructable) rather than polling forever. Unreachable
-        nodes still count: they may come back."""
+        nodes still count: they may come back. Transfers go through the
+        PullManager (dedup + priority + in-flight budget)."""
+        from ray_tpu.core.distributed import pull_manager as pm
+
         info = self.gcs.call("ObjectDirectory", "get_locations",
                              object_id=oid.binary(), timeout=30)
         stale = 0
+        candidates = []
         for node in info["nodes"]:
             if node["node_id"] == self.node_id:
                 if self.store.contains(oid):
@@ -475,29 +492,36 @@ class DistributedCoreWorker:
                 stale += 1
                 self._remove_stale_location(oid, node["node_id"])
                 continue
-            try:
-                data = self._pull_from(node["address"], oid)
-            except Exception as e:  # noqa: BLE001
-                logger.debug("pull from %s failed: %s", node["address"], e)
-                continue
-            if data is None:
-                stale += 1
-                self._remove_stale_location(oid, node["node_id"])
-                continue
-            try:
-                self.store.put_raw(oid, data)
-            except Exception:  # noqa: BLE001 already raced in
-                pass
-            # This node now genuinely holds a copy — register it so other
-            # processes (e.g. a worker fetching task args) can find it.
-            try:
-                self.gcs.call("ObjectDirectory", "add_location",
-                              object_id=oid.binary(), node_id=self.node_id,
-                              size=len(data), timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
-            return True, len(info["nodes"])
-        return False, len(info["nodes"]) - stale
+            candidates.append((node["node_id"], node["address"]))
+        if not candidates:
+            return False, len(info["nodes"]) - stale
+        try:
+            data, stale_nodes = self._pull_manager.pull_sync(
+                oid.binary(), candidates, info.get("size") or 1,
+                priority=pm.PRIORITY_GET if priority is None else priority)
+        except Exception as e:  # noqa: BLE001 transfer timeout/failure:
+            # retriable — the caller's get loop keeps polling, exactly as
+            # the per-node try/except of the pre-PullManager path did.
+            logger.debug("pull of %s failed: %s", oid.hex()[:12], e)
+            return False, len(info["nodes"]) - stale
+        for nid in stale_nodes:
+            stale += 1
+            self._remove_stale_location(oid, nid)
+        if data is None:
+            return False, len(info["nodes"]) - stale
+        try:
+            self.store.put_raw(oid, data)
+        except Exception:  # noqa: BLE001 already raced in
+            pass
+        # This node now genuinely holds a copy — register it so other
+        # processes (e.g. a worker fetching task args) can find it.
+        try:
+            self.gcs.call("ObjectDirectory", "add_location",
+                          object_id=oid.binary(), node_id=self.node_id,
+                          size=len(data), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        return True, len(info["nodes"])
 
     def _remove_stale_location(self, oid: ObjectID, node_id: str) -> None:
         try:
@@ -637,22 +661,97 @@ class DistributedCoreWorker:
             if r.inline is not None:
                 self._cache_inline(ObjectID(r.oid), r.inline)
 
-    def _pull_from(self, address: str, oid: ObjectID) -> Optional[bytes]:
-        async def pull():
-            client = AsyncRpcClient(address)
-            try:
-                chunks = []
-                async for item in client.stream(
-                        "NodeDaemon", "stream_pull_object",
-                        object_id=oid.binary(), timeout=120):
-                    if item.get("missing"):
-                        return None
-                    chunks.append(item["data"])
-                return b"".join(chunks)
-            finally:
-                await client.close()
+    async def _fetch_object_chunks(self, address: str,
+                                   oid_b: bytes) -> Optional[bytes]:
+        """One chunked transfer from a holder's daemon (PullManager's
+        fetch fn)."""
+        client = AsyncRpcClient(address)
+        try:
+            chunks = []
+            async for item in client.stream(
+                    "NodeDaemon", "stream_pull_object",
+                    object_id=oid_b, timeout=120):
+                if item.get("missing"):
+                    return None
+                chunks.append(item["data"])
+            return b"".join(chunks)
+        finally:
+            await client.close()
 
-        return self.loop_thread.run(pull(), timeout=150)
+    async def _span_flush_loop(self) -> None:
+        from ray_tpu.util import tracing
+
+        period = get_config().task_events_flush_ms / 1000
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            batch = tracing.drain()
+            if not batch:
+                continue
+            try:
+                gcs = await self._aget_gcs()
+                await gcs.call("TaskEvents", "add_events", events=batch,
+                               timeout=10)
+            except Exception:  # noqa: BLE001 retried next tick
+                pass
+
+    def prefetch(self, refs: List[ObjectRef]) -> None:
+        """Best-effort background pulls at the lowest priority (ref: the
+        reference's prefetch/wait request class, pull_manager.h:52) —
+        dataset pipelines warm the local store without competing with
+        blocking gets."""
+        def run():
+            from ray_tpu.core.distributed import pull_manager as pm
+
+            for r in refs:
+                try:
+                    oid = r.id()
+                    if (self._inline_cache.get(oid) is not None
+                            or self.store.contains(oid)):
+                        continue
+                    self._try_pull_remote(oid,
+                                          priority=pm.PRIORITY_PREFETCH)
+                except Exception:  # noqa: BLE001 best effort
+                    pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="prefetch").start()
+
+    def push_object(self, ref: ObjectRef, target_node_id: str,
+                    timeout: float = 150.0) -> bool:
+        """Proactively replicate an object to another node's store (ref:
+        push_manager.h:30) — pre-stage data where work will run."""
+        oid = ref.id()
+        nodes = {n["node_id"]: n
+                 for n in self.gcs.call("NodeInfo", "list_nodes",
+                                        timeout=30)
+                 if n["alive"]}
+        target = nodes.get(target_node_id)
+        if target is None:
+            return False
+        info = self.gcs.call("ObjectDirectory", "get_locations",
+                             object_id=oid.binary(), timeout=30)
+        holders = [n["node_id"] for n in info["nodes"]]
+        if self.store.contains(oid) and self.node_id not in holders:
+            holders.append(self.node_id)  # registration still in flight
+        if target_node_id in holders:
+            return True
+        # Prefer this node's daemon as the pusher, else any ALIVE holder.
+        if self.node_id in holders:
+            holder_id = self.node_id
+        else:
+            holder_id = next((h for h in holders if h in nodes), None)
+        if holder_id is None or holder_id not in nodes:
+            return False
+        client = SyncRpcClient(nodes[holder_id]["address"],
+                               self.loop_thread)
+        try:
+            reply = client.call("NodeDaemon", "push_object",
+                                object_id=oid.binary(),
+                                target_address=target["address"],
+                                timeout=timeout)
+            return bool(reply.get("ok"))
+        finally:
+            client.close()
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
@@ -836,6 +935,10 @@ class DistributedCoreWorker:
                      "name": options.name
                      or getattr(func, "__qualname__", "task")},
         )
+        if get_config().tracing_enabled:
+            from ray_tpu.util import tracing
+
+            spec["trace_ctx"] = tracing.inject()
         if options.max_retries > 0 and get_config().lineage_pinning_enabled:
             with self._lock:
                 entry = {"spec": spec, "demand": demand, "sched": sched,
@@ -1045,6 +1148,10 @@ class DistributedCoreWorker:
             options={"max_retries": options.max_task_retries,
                      "name": method_name},
         )
+        if get_config().tracing_enabled:
+            from ray_tpu.util import tracing
+
+            spec["trace_ctx"] = tracing.inject()
         self.loop_thread.loop.call_soon_threadsafe(
             self._actor_submit_on_loop, aid, spec, return_ids, fut, options)
         return [ObjectRef(oid, self.address) for oid in return_ids]

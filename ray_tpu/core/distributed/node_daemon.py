@@ -93,6 +93,12 @@ class NodeDaemon:
         self._lease_waiters: deque = deque()             # asyncio futures
         self._infeasible_waits: Dict[int, rs.ResourceSet] = {}
         self._infeasible_seq = 0
+        # Push manager state (ref: push_manager.h:30 — dedup + bounded
+        # concurrent pushes; receiving side assembles chunks).
+        self._push_inflight: Dict[Tuple[str, bytes], asyncio.Future] = {}
+        self._push_sem = asyncio.Semaphore(4)
+        # object_id -> [bytearray, last_touch_monotonic]
+        self._push_partial: Dict[bytes, list] = {}
         self._view = ClusterView()
         self._tasks: List[asyncio.Task] = []
         self._soft_limit = int(get_config().num_workers_soft_limit
@@ -115,6 +121,7 @@ class NodeDaemon:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._monitor_workers_loop()),
             asyncio.ensure_future(self._refresh_view_loop()),
+            asyncio.ensure_future(self._memory_monitor_loop()),
         ]
         self._start_metrics_http()
         logger.info("node daemon %s on %s (resources=%s store=%s)",
@@ -262,6 +269,9 @@ class NodeDaemon:
             "raytpu_lease_grant_seconds",
             "Lease request to grant latency",
             boundaries=(0.001, 0.01, 0.1, 1, 10)).set_default_tags(tags)
+        self._m_oom_kills = Counter(
+            "raytpu_oom_worker_kills_total",
+            "Workers killed by the memory monitor").set_default_tags(tags)
 
     def get_metrics(self) -> str:
         """Prometheus exposition text; also served over HTTP when
@@ -425,6 +435,77 @@ class NodeDaemon:
                     self._workers.pop(handle.worker_id, None)
                     raise RuntimeError(
                         "worker failed to register in time") from None
+
+    # ------------------------------------------------------------------
+    # memory monitor + OOM killing (ref: memory_monitor.h:52, LIFO-
+    # retriable WorkerKillingPolicy worker_killing_policy.h:64)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        try:
+            import psutil
+
+            return psutil.virtual_memory().percent / 100.0
+        except Exception:  # noqa: BLE001
+            try:
+                info = {}
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        k, v = line.split(":", 1)
+                        info[k] = int(v.strip().split()[0])
+                return 1.0 - info["MemAvailable"] / info["MemTotal"]
+            except Exception:  # noqa: BLE001
+                return 0.0
+
+    async def _memory_monitor_loop(self):
+        cfg = get_config()
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        if period <= 0:
+            return
+        while True:
+            await asyncio.sleep(period)
+            usage = self._memory_usage_fraction()
+            if usage > cfg.memory_usage_threshold:
+                self.relieve_memory_pressure(usage)
+
+    def relieve_memory_pressure(self, usage: float) -> dict:
+        """One sweep under pressure: drop all idle workers, then kill the
+        NEWEST leased task worker (LIFO keeps long-running work alive —
+        the retried victim loses the least progress; actors are never
+        chosen, matching the reference's retriable-first policy).
+        Returns what was done (also an RPC for tests/operators)."""
+        killed_idle = 0
+        while self._idle:
+            handle = self._idle.popleft()
+            self._workers.pop(handle.worker_id, None)
+            try:
+                handle.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            killed_idle += 1
+        victim = None
+        newest = None
+        for lease in self._leases.values():
+            w = lease.worker
+            if w.actor_id is not None or w.proc.poll() is not None:
+                continue
+            if newest is None or lease.granted_at > newest.granted_at:
+                newest = lease
+        if newest is not None:
+            victim = newest.worker
+            logger.warning(
+                "memory pressure (%.0f%%): killing newest task worker "
+                "%s (lease age %.1fs); the task retries elsewhere",
+                usage * 100, victim.worker_id[:8],
+                time.monotonic() - newest.granted_at)
+            try:
+                victim.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            self._m_oom_kills.inc()
+        return {"killed_idle": killed_idle,
+                "killed_worker": victim.worker_id if victim else None,
+                "usage": usage}
 
     def _reap_idle_workers(self) -> None:
         """Enforce num_workers_soft_limit: idle task workers beyond the
@@ -845,6 +926,91 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # object plane
     # ------------------------------------------------------------------
+    async def push_object(self, object_id: bytes,
+                          target_address: str) -> dict:
+        """Proactively push a local object into another node's store
+        (ref: src/ray/object_manager/push_manager.h:30 — deduplicated,
+        bounded-concurrency chunked pushes). Used for pre-staging /
+        replication; the pull path stays the default."""
+        oid = ObjectID(object_id)
+        key = (target_address, object_id)
+        existing = self._push_inflight.get(key)
+        if existing is not None:
+            # Dedup shares the in-flight transfer's OUTCOME — a bare
+            # "ok" here would report success for a push that then fails.
+            return await asyncio.shield(existing)
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            return {"ok": False, "error": "object not local"}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._push_inflight[key] = fut
+        try:
+            async with self._push_sem:
+                chunk = get_config().object_transfer_chunk_bytes
+                total = buf.size
+                client = AsyncRpcClient(target_address)
+                try:
+                    off = 0
+                    while True:
+                        end = min(off + chunk, total)
+                        last = end >= total
+                        await client.call(
+                            "NodeDaemon", "receive_object_chunk",
+                            object_id=object_id, offset=off,
+                            total_size=total,
+                            data=bytes(buf.view[off:end]), last=last,
+                            timeout=120)
+                        if last:
+                            break
+                        off = end
+                finally:
+                    await client.close()
+            reply = {"ok": True, "bytes": total}
+        except Exception as e:  # noqa: BLE001
+            reply = {"ok": False, "error": repr(e)}
+        finally:
+            buf.release()
+            self._push_inflight.pop(key, None)
+        if not fut.done():
+            fut.set_result(reply)
+        return reply
+
+    async def receive_object_chunk(self, object_id: bytes, offset: int,
+                                   total_size: int, data: bytes,
+                                   last: bool) -> dict:
+        """Receiving side of push_object: assemble chunks, seal into the
+        local store, register the new location."""
+        oid = ObjectID(object_id)
+        now = time.monotonic()
+        # Expire abandoned partials (pusher died mid-push): a stale
+        # full-object bytearray per failed push would pin RAM forever.
+        for ob, entry in list(self._push_partial.items()):
+            if now - entry[1] > 300:
+                del self._push_partial[ob]
+        if self.store.contains(oid):
+            self._push_partial.pop(object_id, None)
+            return {"ok": True, "already": True}
+        entry = self._push_partial.setdefault(
+            object_id, [bytearray(total_size), now])
+        buf = entry[0]
+        entry[1] = now
+        buf[offset:offset + len(data)] = data
+        if not last:
+            return {"ok": True}
+        del self._push_partial[object_id]
+        try:
+            self.store.put_raw(oid, bytes(buf))
+        except Exception:  # noqa: BLE001 raced in via pull
+            pass
+        try:
+            await self.gcs.call("ObjectDirectory", "add_location",
+                                object_id=object_id,
+                                node_id=self.node_id,
+                                size=total_size, timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        return {"ok": True, "sealed": True}
+
     async def stream_pull_object(self, object_id: bytes):
         """Chunked zero-copy-read transfer (ref: object_manager.proto Push,
         5 MiB chunks ray_config_def.h:352)."""

@@ -1,0 +1,124 @@
+"""Pull manager: prioritized, deduplicated, bounded object transfer.
+
+Analogue of the reference `PullManager`
+(ref: src/ray/object_manager/pull_manager.h:52 — prioritized pull
+request queues with an in-flight bandwidth budget; request classes
+get > task-arg > prefetch, matching its TaskArgs/Get/Wait bundles).
+
+Why it exists even in a pull-based design: concurrent `get()`s of the
+same remote object must share ONE transfer; a storm of pulls must not
+hold unbounded chunk buffers in RAM; and a user blocking in `get()`
+must cut ahead of background prefetch. All transfer work runs on the
+process's RPC loop; sync callers block on a concurrent future.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+PRIORITY_GET = 0        # a caller is blocked in ray.get()
+PRIORITY_TASK_ARG = 1   # a leased worker needs args to start
+PRIORITY_PREFETCH = 2   # speculative (dataset prefetch etc.)
+
+# (data, stale_node_ids): data None => no location produced the object.
+PullResult = Tuple[Optional[bytes], List[str]]
+FetchFn = Callable[[str, bytes], Awaitable[Optional[bytes]]]
+
+
+class PullManager:
+    def __init__(self, loop: asyncio.AbstractEventLoop, fetch: FetchFn,
+                 max_concurrent: int = 4,
+                 max_inflight_bytes: int = 256 << 20):
+        self._loop = loop
+        self._fetch = fetch
+        self._max_concurrent = max_concurrent
+        self._max_inflight_bytes = max_inflight_bytes
+        self._inflight_bytes = 0
+        self._queue: Optional[asyncio.PriorityQueue] = None
+        self._inflight: Dict[bytes, asyncio.Future] = {}
+        self._seq = itertools.count()      # FIFO within a priority class
+        self._started = False
+        self._bytes_freed: Optional[asyncio.Event] = None
+
+    # -- sync facade ----------------------------------------------------
+    def pull_sync(self, oid_b: bytes,
+                  nodes: List[Tuple[str, str]],   # (node_id, address)
+                  size_hint: int,
+                  priority: int = PRIORITY_GET,
+                  timeout: Optional[float] = 150.0) -> PullResult:
+        fut = asyncio.run_coroutine_threadsafe(
+            self.pull(oid_b, nodes, size_hint, priority), self._loop)
+        return fut.result(timeout)
+
+    # -- async core -----------------------------------------------------
+    async def pull(self, oid_b: bytes, nodes: List[Tuple[str, str]],
+                   size_hint: int,
+                   priority: int = PRIORITY_GET) -> PullResult:
+        self._ensure_started()
+        existing = self._inflight.get(oid_b)
+        if existing is not None:
+            # Share the transfer; stale bookkeeping belongs to its owner.
+            data = await asyncio.shield(existing)
+            return data, []
+        fut: asyncio.Future = self._loop.create_future()
+        self._inflight[oid_b] = fut
+        done: asyncio.Future = self._loop.create_future()
+        await self._queue.put(
+            (priority, next(self._seq),
+             (oid_b, list(nodes), max(size_hint, 1), fut, done)))
+        try:
+            return await done
+        finally:
+            self._inflight.pop(oid_b, None)
+
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._queue = asyncio.PriorityQueue()
+        self._bytes_freed = asyncio.Event()
+        for _ in range(self._max_concurrent):
+            asyncio.ensure_future(self._puller())
+
+    async def _puller(self) -> None:
+        while True:
+            _, _, (oid_b, nodes, size, fut, done) = await self._queue.get()
+            # Bandwidth budget: block this puller until the estimated
+            # bytes fit (one oversized object is always admitted alone).
+            while (self._inflight_bytes > 0
+                   and self._inflight_bytes + size
+                   > self._max_inflight_bytes):
+                self._bytes_freed.clear()
+                await self._bytes_freed.wait()
+            self._inflight_bytes += size
+            try:
+                data, stale = await self._transfer(oid_b, nodes)
+            except Exception as e:  # noqa: BLE001
+                data, stale = None, []
+                logger.debug("pull of %s failed: %s", oid_b.hex()[:12], e)
+            finally:
+                self._inflight_bytes -= size
+                self._bytes_freed.set()
+            if not fut.done():
+                fut.set_result(data)
+            if not done.done():
+                done.set_result((data, stale))
+
+    async def _transfer(self, oid_b: bytes,
+                        nodes: List[Tuple[str, str]]) -> PullResult:
+        stale: List[str] = []
+        for node_id, address in nodes:
+            try:
+                data = await self._fetch(address, oid_b)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("pull from %s failed: %s", address, e)
+                continue           # unreachable: node may come back
+            if data is None:
+                stale.append(node_id)   # answered "missing": evicted
+                continue
+            return data, stale
+        return None, stale

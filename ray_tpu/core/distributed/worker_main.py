@@ -190,6 +190,10 @@ class WorkerService:
                 await _a.sleep(period)
                 with self._events_lock:
                     batch, self._events = self._events, []
+                if get_config().tracing_enabled:
+                    from ray_tpu.util import tracing
+
+                    batch = batch + tracing.drain()
                 if not batch:
                     continue
                 try:
@@ -226,7 +230,10 @@ class WorkerService:
 
     # ---- helpers ------------------------------------------------------
     def _fetch_arg(self, oid: ObjectID) -> Any:
-        return self.core.get([_mkref(oid)], timeout=300)[0]
+        from ray_tpu.core.distributed.pull_manager import PRIORITY_TASK_ARG
+
+        return self.core.get([_mkref(oid)], timeout=300,
+                             _priority=PRIORITY_TASK_ARG)[0]
 
     def _store_results(self, spec: dict, value: Any,
                        is_error: bool = False) -> List[protocol.TaskResult]:
@@ -325,9 +332,14 @@ class WorkerService:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
                                                 self._fetch_arg)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+            from ray_tpu.util import tracing
+
+            with tracing.extract_and_span(spec.get("trace_ctx"),
+                                          f"task:{name}",
+                                          task_id=spec["task_id"].hex()):
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
             self._record_event(spec, "FINISHED", start_ts, _time.time())
@@ -450,9 +462,14 @@ class WorkerService:
         start_ts = _time.time()
         try:
             method = getattr(self.actor.instance, spec["method_name"])
-            result = method(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+            from ray_tpu.util import tracing
+
+            with tracing.extract_and_span(spec.get("trace_ctx"),
+                                          f"actor:{name}",
+                                          task_id=spec["task_id"].hex()):
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
             self._record_event(spec, "FINISHED", start_ts, _time.time())

@@ -145,13 +145,13 @@ class DashboardHead:
             })
         # Submitted jobs live in the KV under the "job" namespace; the
         # record layout is owned by job_submission.parse_job_records.
-        items = {}
-        for key in await self._call("KV", "keys", namespace="job",
-                                    prefix=b""):
-            if b":" in key:
-                continue
-            items[key] = await self._call("KV", "get", namespace="job",
-                                          key=key)
+        keys = [k for k in await self._call("KV", "keys", namespace="job",
+                                            prefix=b"")
+                if b":" not in k]
+        raws = await asyncio.gather(*[
+            self._call("KV", "get", namespace="job", key=k)
+            for k in keys])
+        items = dict(zip(keys, raws))
         for info in parse_job_records(items):
             out.append({
                 "id": info.submission_id, "kind": "submission",

@@ -1,0 +1,53 @@
+"""Joblib backend: scikit-learn parallelism on the cluster.
+
+Analogue of the reference's joblib integration (ref: python/ray/util/
+joblib/ — register_ray() + RayBackend over the multiprocessing Pool
+shim). After `register_ray_tpu()`, `joblib.parallel_backend("ray-tpu")`
+routes every joblib.Parallel fan-out (e.g. sklearn GridSearchCV) through
+cluster actors.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def register_ray_tpu() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray-tpu", RayTpuBackend)
+
+
+try:
+    from joblib._parallel_backends import MultiprocessingBackend
+except ImportError:  # pragma: no cover — joblib not installed
+    MultiprocessingBackend = object
+
+
+class RayTpuBackend(MultiprocessingBackend):
+    """joblib backend whose pool is the actor-based Pool shim."""
+
+    supports_timeout = True
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        import ray_tpu
+
+        ray_tpu.init(ignore_reinit_error=True)
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs is None or n_jobs == -1:
+            return max(1, cpus)
+        return max(1, min(n_jobs, cpus))
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **kwargs):
+        n_jobs = self.effective_n_jobs(n_jobs)
+        from ray_tpu.util.multiprocessing import Pool
+
+        self._pool = Pool(processes=n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def terminate(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.terminate()
+            self._pool = None

@@ -1,0 +1,226 @@
+"""Drop-in multiprocessing.Pool over the cluster.
+
+Analogue of the reference's Pool shim (ref: python/ray/util/
+multiprocessing/pool.py — a Pool API whose workers are Ray actors, so
+pools span machines). Each pool worker is one actor; apply/map calls
+round-robin over them with the standard result types (ApplyResult /
+chunked ordered map / imap / imap_unordered).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+
+class TimeoutError(Exception):  # noqa: A001 — multiprocessing parity
+    pass
+
+
+class _PoolActorCls:
+    """One pool worker; created lazily as a ray_tpu actor."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run(self, func, args, kwargs):
+        return func(*args, **kwargs)
+
+    def run_batch(self, func, chunk):
+        return [func(*a) for a in chunk]
+
+
+class ApplyResult:
+    """multiprocessing.pool.ApplyResult parity over an ObjectRef."""
+
+    def __init__(self, ref, callback=None, error_callback=None):
+        self._ref = ref
+        self._callback = callback
+        self._error_callback = error_callback
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        threading.Thread(target=self._wait_thread, daemon=True).start()
+
+    def _wait_thread(self):
+        import ray_tpu
+
+        try:
+            self._value = ray_tpu.get(self._ref)
+            if self._callback is not None:
+                self._callback(self._value)
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+            if self._error_callback is not None:
+                self._error_callback(e)
+        finally:
+            self._done.set()
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result not ready")
+        return self._error is None
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class MapResult(ApplyResult):
+    """Ordered map over chunk refs."""
+
+    def __init__(self, refs: List[Any], callback=None,
+                 error_callback=None):
+        self._refs = refs
+        super().__init__(refs[0] if refs else None, callback,
+                         error_callback)
+
+    def _wait_thread(self):
+        import ray_tpu
+
+        try:
+            chunks = ray_tpu.get(self._refs) if self._refs else []
+            self._value = list(itertools.chain.from_iterable(chunks))
+            if self._callback is not None:
+                self._callback(self._value)
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+            if self._error_callback is not None:
+                self._error_callback(e)
+        finally:
+            self._done.set()
+
+
+class Pool:
+    """multiprocessing.Pool API over cluster actors (ref: util/
+    multiprocessing/pool.py Pool). `processes=None` sizes the pool to the
+    cluster's CPU count."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: Sequence = (), ray_address: Optional[str] = None):
+        import ray_tpu
+
+        ray_tpu.init(address=ray_address, ignore_reinit_error=True)
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources()
+                                   .get("CPU", 1)))
+        self._n = processes
+        cls = ray_tpu.remote(_PoolActorCls)
+        self._actors = [cls.options(num_cpus=1).remote(initializer,
+                                                       tuple(initargs))
+                        for _ in range(processes)]
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+
+    # -- apply ----------------------------------------------------------
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None, callback=None,
+                    error_callback=None) -> ApplyResult:
+        self._check_running()
+        actor = self._actors[next(self._rr)]
+        ref = actor.run.remote(func, tuple(args), kwds or {})
+        return ApplyResult(ref, callback, error_callback)
+
+    # -- map ------------------------------------------------------------
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]
+                ) -> List[List[tuple]]:
+        items = [(x,) if not isinstance(x, tuple) else x
+                 for x in iterable]
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _map_refs(self, func, chunks) -> List[Any]:
+        return [self._actors[next(self._rr)].run_batch.remote(func, c)
+                for c in chunks]
+
+    def map(self, func, iterable, chunksize=None) -> list:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> MapResult:
+        self._check_running()
+        refs = self._map_refs(func, self._chunks(iterable, chunksize))
+        return MapResult(refs, callback, error_callback)
+
+    def starmap(self, func, iterable, chunksize=None) -> list:
+        return self.map(func, [tuple(a) for a in iterable], chunksize)
+
+    def starmap_async(self, func, iterable, chunksize=None,
+                      callback=None, error_callback=None) -> MapResult:
+        return self.map_async(func, [tuple(a) for a in iterable],
+                              chunksize, callback, error_callback)
+
+    def imap(self, func, iterable, chunksize=1):
+        self._check_running()
+        refs = self._map_refs(func, self._chunks(iterable, chunksize))
+        import ray_tpu
+
+        def gen():
+            for ref in refs:         # submission order == yield order
+                for v in ray_tpu.get(ref):
+                    yield v
+
+        return gen()
+
+    def imap_unordered(self, func, iterable, chunksize=1):
+        self._check_running()
+        refs = self._map_refs(func, self._chunks(iterable, chunksize))
+        import ray_tpu
+
+        def gen():
+            pending = list(refs)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                for v in ray_tpu.get(done[0]):
+                    yield v
+
+        return gen()
+
+    # -- lifecycle ------------------------------------------------------
+    def _check_running(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        import ray_tpu
+
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []
+
+    def join(self, timeout: float = 30.0):
+        if not self._closed:
+            raise ValueError("join() before close()")
+        deadline = time.monotonic() + timeout
+        while self._actors and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -23,6 +23,11 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
         events = fetch_task_events()
     trace = []
     for e in events:
+        if e.get("kind") == "span":
+            from ray_tpu.util.tracing import spans_to_chrome_trace
+
+            trace.extend(spans_to_chrome_trace([e]))
+            continue
         start, end = e.get("start_ts"), e.get("end_ts")
         if start is None or end is None:
             continue

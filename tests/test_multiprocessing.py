@@ -1,0 +1,83 @@
+"""multiprocessing.Pool + joblib shims over the cluster (ref:
+python/ray/tests/test_multiprocessing.py, test_joblib.py).
+
+Helpers are defined inside each test: cloudpickle then serializes them
+by value (a module-level function in a test file would pickle by
+reference to a module the workers can't import)."""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def mp_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pool_apply_and_map(mp_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    sq = lambda x: x * x          # noqa: E731
+    add = lambda a, b: a + b      # noqa: E731
+    with Pool(processes=2) as p:
+        assert p.apply(add, (2, 3)) == 5
+        r = p.apply_async(sq, (7,))
+        assert r.get(timeout=60) == 49
+        assert r.successful()
+        assert p.map(sq, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_imap_ordering(mp_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    sq = lambda x: x * x          # noqa: E731
+    with Pool(processes=2) as p:
+        assert list(p.imap(sq, range(8), chunksize=2)) == [
+            x * x for x in range(8)]
+        assert sorted(p.imap_unordered(sq, range(8), chunksize=2)) == \
+            sorted(x * x for x in range(8))
+
+
+def test_pool_error_propagates(mp_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise RuntimeError("pool boom")
+
+    with Pool(processes=1) as p:
+        r = p.apply_async(boom, (1,))
+        with pytest.raises(Exception, match="pool boom"):
+            r.get(timeout=60)
+        assert not r.successful()
+
+
+def test_pool_initializer_and_state(mp_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init(v):
+        import os
+
+        os.environ["_POOL_INIT"] = str(v)
+
+    def read(_):
+        import os
+
+        return os.environ.get("_POOL_INIT")
+
+    with Pool(processes=2, initializer=init, initargs=(42,)) as p:
+        assert p.map(read, range(4)) == ["42"] * 4
+
+
+def test_joblib_backend(mp_cluster):
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    sq = lambda x: x * x          # noqa: E731
+    with joblib.parallel_backend("ray-tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(sq)(i) for i in range(6))
+    assert out == [x * x for x in range(6)]

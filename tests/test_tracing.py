@@ -1,0 +1,97 @@
+"""Tracing: span context propagation through task/actor submission
+(ref: python/ray/tests/test_tracing.py — spans appear for remote calls
+with proper parenting)."""
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def traced_cluster():
+    import ray_tpu
+    from ray_tpu.core import config as cfg_mod
+    from ray_tpu.cluster_utils import Cluster
+    import os
+
+    os.environ["RAY_TPU_TRACING_ENABLED"] = "1"
+    cfg_mod.reset_config()
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+    os.environ.pop("RAY_TPU_TRACING_ENABLED", None)
+    cfg_mod.reset_config()
+
+
+def test_span_nesting_local():
+    import os
+
+    from ray_tpu.core import config as cfg_mod
+    from ray_tpu.util import tracing
+
+    os.environ["RAY_TPU_TRACING_ENABLED"] = "1"
+    cfg_mod.reset_config()
+    try:
+        with tracing.span("outer") as outer:
+            with tracing.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        spans = tracing.drain()
+        names = {s["name"] for s in spans}
+        assert {"outer", "inner"} <= names
+        for s in spans:
+            assert s["end_ts"] >= s["start_ts"]
+    finally:
+        os.environ.pop("RAY_TPU_TRACING_ENABLED", None)
+        cfg_mod.reset_config()
+
+
+def test_remote_spans_inherit_trace(traced_cluster):
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Act:
+        def m(self, x):
+            return x * 2
+
+    with tracing.span("driver_op") as root:
+        trace_id = root.trace_id
+        assert ray_tpu.get(child.remote(1), timeout=60) == 2
+        a = Act.remote()
+        assert ray_tpu.get(a.m.remote(4), timeout=60) == 8
+
+    # Worker-side spans flush into the GCS TaskEvents sink.
+    w = _global_worker()
+    deadline = time.monotonic() + 30
+    found = []
+    while time.monotonic() < deadline:
+        events = w.gcs.call("TaskEvents", "list_events", limit=1000,
+                            timeout=10)
+        found = [e for e in events if e.get("kind") == "span"
+                 and e.get("trace_id") == trace_id]
+        if len(found) >= 2:
+            break
+        time.sleep(0.25)
+    names = {s["name"] for s in found}
+    assert any(n.startswith("task:") and n.endswith("child")
+               for n in names), names
+    assert "actor:Act.m" in names, names
+    # Execution spans parent to the driver span that submitted them.
+    assert all(s["parent_id"] == root.span_id for s in found)
+
+
+def test_timeline_includes_spans(traced_cluster):
+    from ray_tpu.util.timeline import chrome_trace
+
+    events = [{"kind": "span", "name": "s", "trace_id": "t" * 16,
+               "span_id": "a" * 16, "parent_id": None,
+               "start_ts": 1.0, "end_ts": 2.0, "attrs": {}}]
+    trace = chrome_trace(events)
+    assert trace and trace[0]["cat"] == "span"
+    assert trace[0]["dur"] == pytest.approx(1e6)
