@@ -7,13 +7,15 @@ TPU device feeding (`Dataset.iter_jax_batches` double-buffers host→HBM).
 from ray_tpu.data.dataset import Dataset, GroupedData, from_block_list
 from ray_tpu.data.read_api import (
     from_arrow, from_huggingface, from_items, from_numpy, from_pandas,
-    from_torch, range, range_tensor, read_binary_files, read_csv,
-    read_images, read_json, read_numpy, read_parquet, read_text)
+    from_torch, range, range_tensor, read_bigquery, read_binary_files,
+    read_csv, read_images, read_json, read_mongo, read_numpy,
+    read_parquet, read_sql, read_text, read_tfrecords, read_webdataset)
 
 __all__ = [
     "Dataset", "GroupedData", "from_block_list",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_huggingface", "from_torch",
     "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_images", "read_numpy",
+    "read_binary_files", "read_images", "read_numpy", "read_tfrecords",
+    "read_webdataset", "read_sql", "read_mongo", "read_bigquery",
 ]

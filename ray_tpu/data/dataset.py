@@ -280,11 +280,28 @@ class Dataset:
 
     # ---------------- execution / consumption ----------------
     def to_block_refs(self) -> Iterator[Any]:
-        yield from execute(self._read_tasks, self._stages)
+        from ray_tpu.data.stats import DatasetStats
+
+        self._last_stats = DatasetStats()
+        yield from execute(self._read_tasks, self._stages,
+                           stats=self._last_stats)
 
     def iter_blocks(self) -> Iterator[B.Block]:
         for ref in self.to_block_refs():
-            yield ray_tpu.get(ref)
+            blk = ray_tpu.get(ref)
+            stats = getattr(self, "_last_stats", None)
+            if stats is not None:
+                stats.consumed_rows += blk.num_rows
+                stats.consumed_bytes += blk.nbytes
+            yield blk
+
+    def stats(self) -> str:
+        """Execution stats of the most recent consumption (ref:
+        Dataset.stats(), data/_internal/stats.py)."""
+        stats = getattr(self, "_last_stats", None)
+        if stats is None:
+            return "Dataset has not been executed yet."
+        return stats.summary()
 
     def materialize(self) -> "Dataset":
         refs = list(self.to_block_refs())
@@ -384,6 +401,21 @@ class Dataset:
 
     def write_json(self, path: str) -> None:
         self._write(path, "json")
+
+    def write_tfrecords(self, path: str) -> None:
+        """One .tfrecords file per block, rows encoded as
+        tf.train.Example via the built-in codec (ref: Dataset.
+        write_tfrecords)."""
+        import os
+
+        from ray_tpu.data import tfrecord
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self.iter_blocks()):
+            f = os.path.join(path, f"part-{i:05d}.tfrecords")
+            tfrecord.write_records(
+                f, (tfrecord.encode_example(row)
+                    for row in B.iter_rows(blk)))
 
     def _write(self, path: str, fmt: str) -> None:
         import os

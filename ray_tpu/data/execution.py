@@ -17,10 +17,38 @@ from typing import Any, Callable, Iterator, List, Optional
 import ray_tpu
 from ray_tpu.data.block import Block, concat
 from ray_tpu.data.plan import AllToAllStage, MapStage, ReadTask, fuse_map_chain
+from ray_tpu.data.stats import DatasetStats, StageStats
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_MAX_IN_FLIGHT = 16
+
+
+def _default_window() -> int:
+    """Resource-aware base window (ref: backpressure_policy/
+    concurrency_cap_backpressure_policy.py): enough in-flight tasks to
+    cover the cluster's CPUs twice, bounded."""
+    try:
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+    except Exception:  # noqa: BLE001
+        cpus = 4
+    return max(4, min(2 * cpus, 64))
+
+
+def _effective_window(base: int) -> int:
+    """Shrink the window under object-store pressure (ref:
+    backpressure_policy/streaming_output_backpressure_policy.py — the
+    executor must not outrun consumers into an overflowing store)."""
+    try:
+        from ray_tpu.api import _global_worker
+
+        store = _global_worker().store
+        cap = getattr(store, "capacity", 0)
+        if cap and store.used / cap > 0.85:
+            return max(2, base // 4)
+    except Exception:  # noqa: BLE001
+        pass
+    return base
 
 
 def _run_read(read_fn, map_fn) -> Block:
@@ -73,8 +101,13 @@ class _ActorPool:
 
 
 def execute(read_tasks: List[ReadTask], stages: List[Any], *,
-            max_in_flight: int = DEFAULT_MAX_IN_FLIGHT) -> Iterator[Any]:
+            max_in_flight: Optional[int] = None,
+            stats: Optional[DatasetStats] = None) -> Iterator[Any]:
     """Yield block refs for the fully-applied plan, streaming."""
+    if max_in_flight is None:
+        max_in_flight = _default_window()
+    if stats is None:
+        stats = DatasetStats()
     # Split the stage list into segments separated by all-to-all barriers.
     segments: List[List[Any]] = [[]]
     for st in stages:
@@ -85,15 +118,18 @@ def execute(read_tasks: List[ReadTask], stages: List[Any], *,
             segments[-1].append(st)
 
     stream: Iterator[Any] = _stream_source(read_tasks, segments[0],
-                                           max_in_flight)
+                                           max_in_flight, stats)
     i = 1
     while i < len(segments):
         barrier: AllToAllStage = segments[i]
+        bstat = stats.new_stage(barrier.name)
+        bstat.on_submit()
         # ref_fn receives the (lazy) upstream ref iterator; most barriers
         # list() it, but streaming ones (Limit) can stop pulling early.
         refs = barrier.ref_fn(stream)
+        bstat.on_output()
         map_seg = segments[i + 1]
-        stream = _stream_maps(iter(refs), map_seg, max_in_flight)
+        stream = _stream_maps(iter(refs), map_seg, max_in_flight, stats)
         i += 2
     yield from stream
 
@@ -115,61 +151,82 @@ def _split_actor_stages(stages: List[MapStage]):
     return groups
 
 
-def _stream_source(read_tasks, map_stages, max_in_flight) -> Iterator[Any]:
+def _group_name(group) -> str:
+    if isinstance(group, list):
+        return "+".join(s.name for s in group) or "Map"
+    return group.name
+
+
+def _stream_source(read_tasks, map_stages, max_in_flight,
+                   stats: DatasetStats) -> Iterator[Any]:
     groups = _split_actor_stages(map_stages)
     head_fused = None
+    head_name = "Read"
     if groups and isinstance(groups[0], list):
         head_fused = fuse_map_chain([s.block_fn for s in groups[0]])
+        head_name = "Read+" + _group_name(groups[0])
         groups = groups[1:]
 
     run_read = ray_tpu.remote(_run_read)
     stream = _windowed(
-        ((run_read, (t.fn, head_fused)) for t in read_tasks), max_in_flight)
+        ((run_read, (t.fn, head_fused)) for t in read_tasks), max_in_flight,
+        stats.new_stage(head_name))
     for g in groups:
-        stream = _apply_group(stream, g, max_in_flight)
+        stream = _apply_group(stream, g, max_in_flight, stats)
     return stream
 
 
-def _stream_maps(refs: Iterator[Any], map_stages, max_in_flight):
+def _stream_maps(refs: Iterator[Any], map_stages, max_in_flight,
+                 stats: DatasetStats):
     groups = _split_actor_stages(map_stages)
     stream = refs
     for g in groups:
-        stream = _apply_group(stream, g, max_in_flight)
+        stream = _apply_group(stream, g, max_in_flight, stats)
     return stream
 
 
-def _apply_group(stream: Iterator[Any], group, max_in_flight):
+def _apply_group(stream: Iterator[Any], group, max_in_flight,
+                 stats: DatasetStats):
+    stage_stats = stats.new_stage(_group_name(group))
     if isinstance(group, list):
         fused = fuse_map_chain([s.block_fn for s in group])
         run_map = ray_tpu.remote(_run_map)
         return _windowed(((run_map, (ref, fused)) for ref in stream),
-                         max_in_flight)
-    return _actor_stream(stream, group, max_in_flight)
+                         max_in_flight, stage_stats)
+    return _actor_stream(stream, group, max_in_flight, stage_stats)
 
 
-def _windowed(submissions, max_in_flight) -> Iterator[Any]:
+def _windowed(submissions, max_in_flight,
+              stage_stats: Optional[StageStats] = None) -> Iterator[Any]:
     """Submit (remote_fn, args) lazily, keep <= max_in_flight running,
     yield refs in submission order (blocks stay ordered like the
-    reference's default; the window still overlaps execution)."""
+    reference's default; the window still overlaps execution). The
+    window shrinks under object-store pressure (backpressure policy)."""
     in_flight: List[Any] = []
     submissions = iter(submissions)
     exhausted = False
     while True:
-        while not exhausted and len(in_flight) < max_in_flight:
+        window = _effective_window(max_in_flight)
+        while not exhausted and len(in_flight) < window:
             try:
                 fn, args = next(submissions)
             except StopIteration:
                 exhausted = True
                 break
             in_flight.append(fn.remote(*args))
+            if stage_stats is not None:
+                stage_stats.on_submit()
         if not in_flight:
             return
         head = in_flight.pop(0)
         ray_tpu.wait([head], num_returns=1, timeout=None)
+        if stage_stats is not None:
+            stage_stats.on_output()
         yield head
 
 
-def _actor_stream(stream: Iterator[Any], stage: MapStage, max_in_flight):
+def _actor_stream(stream: Iterator[Any], stage: MapStage, max_in_flight,
+                  stage_stats: Optional[StageStats] = None):
     pool = _ActorPool(stage.actor_fn_maker, max(1, stage.num_actors))
     try:
         pending: List[Any] = []  # (ref, actor_idx) in submission order
@@ -184,12 +241,16 @@ def _actor_stream(stream: Iterator[Any], stage: MapStage, max_in_flight):
                     exhausted = True
                     break
                 i, ref = pool.submit(block_ref)
+                if stage_stats is not None:
+                    stage_stats.on_submit()
                 pending.append((ref, i))
             if not pending:
                 return
             ref, i = pending.pop(0)
             ray_tpu.wait([ref], num_returns=1, timeout=None)
             pool.done(i)
+            if stage_stats is not None:
+                stage_stats.on_output()
             yield ref
     finally:
         pool.shutdown()

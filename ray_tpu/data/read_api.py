@@ -204,3 +204,108 @@ def read_numpy(paths, **_kw) -> Dataset:
         return B.from_batch({"data": np.load(f)})
 
     return _tasks_from_files(files, read_one, "read_numpy")
+
+
+def read_tfrecords(paths, **_kw) -> Dataset:
+    """TFRecord files of tf.train.Example protos (ref: datasource/
+    tfrecords_datasource.py) — decoded by the built-in codec, no
+    tensorflow needed."""
+    files = _expand_paths(paths, (".tfrecords", ".tfrecord"))
+
+    def read_one(f):
+        from ray_tpu.data import tfrecord
+
+        rows = [tfrecord.decode_example(p)
+                for p in tfrecord.read_records(f)]
+        if not rows:
+            return pa.table({})
+        return B.from_rows(rows)
+
+    return _tasks_from_files(files, read_one, "read_tfrecords")
+
+
+def read_webdataset(paths, *, decode: bool = True, **_kw) -> Dataset:
+    """WebDataset tar shards: members named <key>.<ext> group into one
+    sample per key (ref: datasource/webdataset_datasource.py). Known
+    extensions decode (json/txt/cls/npy); everything else stays bytes."""
+    files = _expand_paths(paths, (".tar",))
+
+    def read_one(f):
+        import io
+        import json as jsonlib
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(f) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." not in base:
+                    continue
+                key, ext = base.split(".", 1)
+                data = tar.extractfile(member).read()
+                if decode:
+                    if ext == "json":
+                        data = jsonlib.loads(data)
+                    elif ext in ("txt", "text"):
+                        data = data.decode()
+                    elif ext == "cls":
+                        data = int(data)
+                    elif ext == "npy":
+                        data = np.load(io.BytesIO(data))
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = data
+        rows = [samples[k] for k in order]
+        if not rows:
+            return pa.table({})
+        # Shards routinely have optional fields: normalize to the union
+        # of keys (missing -> None) or column construction KeyErrors.
+        all_keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in all_keys:
+                    all_keys.append(k)
+        rows = [{k: r.get(k) for k in all_keys} for r in rows]
+        return B.from_rows(rows)
+
+    return _tasks_from_files(files, read_one, "read_webdataset")
+
+
+def read_sql(sql: str, connection_factory, **_kw) -> Dataset:
+    """Run a query through a DBAPI connection factory (ref: datasource/
+    sql_datasource.py — e.g. `lambda: sqlite3.connect(path)`). The query
+    executes inside one read task on the cluster (arbitrary SQL cannot
+    be partitioned generically; shard by issuing multiple queries)."""
+
+    def read_one(_unused=None):
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if not rows:
+            return pa.table({c: [] for c in cols})
+        return pa.table({c: [r[i] for r in rows]
+                         for i, c in enumerate(cols)})
+
+    return Dataset([ReadTask(read_one, name="read_sql")])
+
+
+def read_mongo(*args, **kwargs):
+    raise ImportError(
+        "read_mongo needs the `pymongo` package, which is not available "
+        "in this environment; load via read_sql/read_parquet instead")
+
+
+def read_bigquery(*args, **kwargs):
+    raise ImportError(
+        "read_bigquery needs `google-cloud-bigquery`, which is not "
+        "available in this environment; export to parquet/GCS and use "
+        "read_parquet instead")

@@ -1,0 +1,59 @@
+"""Per-execution dataset statistics.
+
+Analogue of the reference's DatasetStats (ref: python/ray/data/
+_internal/stats.py — per-operator wall time/task counts surfaced by
+`ds.stats()` after an execution). Collected driver-side by the streaming
+executor; consumption counters (rows/bytes) fill in as blocks are
+actually fetched by the iterating caller.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class StageStats:
+    name: str
+    tasks: int = 0
+    first_submit: Optional[float] = None
+    last_output: Optional[float] = None
+
+    def on_submit(self) -> None:
+        self.tasks += 1
+        if self.first_submit is None:
+            self.first_submit = time.monotonic()
+
+    def on_output(self) -> None:
+        self.last_output = time.monotonic()
+
+    @property
+    def wall_s(self) -> float:
+        if self.first_submit is None or self.last_output is None:
+            return 0.0
+        return self.last_output - self.first_submit
+
+
+class DatasetStats:
+    def __init__(self):
+        self.stages: List[StageStats] = []
+        self.consumed_rows = 0
+        self.consumed_bytes = 0
+        self.started = time.monotonic()
+
+    def new_stage(self, name: str) -> StageStats:
+        st = StageStats(name)
+        self.stages.append(st)
+        return st
+
+    def summary(self) -> str:
+        lines = ["Dataset execution stats:"]
+        for st in self.stages:
+            lines.append(
+                f"  {st.name}: {st.tasks} tasks, {st.wall_s * 1000:.0f} ms"
+                f" wall")
+        lines.append(
+            f"  consumed: {self.consumed_rows} rows, "
+            f"{self.consumed_bytes / 1e6:.2f} MB")
+        return "\n".join(lines)

@@ -15,13 +15,22 @@ evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
   analogue of LearnerGroup weight sync (core/learner/learner_group.py:60).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import register_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "PPO",
     "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
     "register_env",
 ]

@@ -1,0 +1,226 @@
+"""DQN: double Q-learning with (prioritized) replay.
+
+ref: rllib/algorithms/dqn/dqn.py (training_step: sample -> store ->
+train from replay -> target sync) and dqn_rainbow_learner.py. TPU-first
+shape: the TD update is one jitted program (double-DQN targets, Huber
+loss, importance weighting) returning per-sample TD errors for the
+priority write-back; the target network is a second param pytree synced
+by assignment every `target_network_update_freq` updates.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import apply_mlp_q, init_mlp_q
+from ray_tpu.rllib.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DQNHyperparams:
+    lr: float = 1e-3
+    gamma: float = 0.99
+    train_batch_size: int = 64
+    num_updates_per_iteration: int = 16
+    target_network_update_freq: int = 100    # in learner updates
+    double_q: bool = True
+    grad_clip: float = 10.0
+
+
+class DQNLearner:
+    def __init__(self, obs_dim: int, num_actions: int, hp: DQNHyperparams,
+                 seed: int = 0, hidden=(64, 64)):
+        self.hp = hp
+        rng = jax.random.PRNGKey(seed)
+        self.params = init_mlp_q(rng, obs_dim, num_actions, hidden)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip),
+            optax.adam(hp.lr),
+        )
+        self.opt_state = self._tx.init(self.params)
+        self._updates = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        hp = self.hp
+
+        def loss_fn(params, target_params, batch):
+            q = apply_mlp_q(params, batch["obs"])
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next_target = apply_mlp_q(target_params, batch["next_obs"])
+            if hp.double_q:
+                # Online net picks the argmax, target net evaluates it.
+                q_next_online = apply_mlp_q(params, batch["next_obs"])
+                next_a = jnp.argmax(q_next_online, axis=1)
+            else:
+                next_a = jnp.argmax(q_next_target, axis=1)
+            next_q = jnp.take_along_axis(
+                q_next_target, next_a[:, None], axis=1)[:, 0]
+            target = (batch["rewards"]
+                      + hp.gamma * (1.0 - batch["terminals"])
+                      * jax.lax.stop_gradient(next_q))
+            td = q_sa - target
+            loss = jnp.mean(batch["weights"] * optax.huber_loss(td))
+            return loss, td
+
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        return jax.jit(update, donate_argnums=(0, 2))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> tuple:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k != "batch_indexes"}
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, jbatch)
+        self._updates += 1
+        if self._updates % self.hp.target_network_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(jnp.copy,
+                                                        self.params)
+        return float(loss), np.asarray(td)
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, params: Any) -> None:
+        self.params = jax.device_put(params)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state),
+                "updates": self._updates}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self._updates = state["updates"]
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.train_batch_size = 64
+        self.num_updates_per_iteration = 16
+        self.target_network_update_freq = 100
+        self.double_q = True
+        self.grad_clip = 10.0
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = True
+        self.learning_starts = 500           # env steps before updates
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_iterations = 40
+
+    def training(self, *, lr=None, gamma=None, train_batch_size=None,
+                 num_updates_per_iteration=None,
+                 target_network_update_freq=None, double_q=None,
+                 grad_clip=None, replay_buffer_capacity=None,
+                 prioritized_replay=None, learning_starts=None,
+                 epsilon_initial=None, epsilon_final=None,
+                 epsilon_decay_iterations=None, **kwargs) -> "DQNConfig":
+        for k, v in dict(
+                lr=lr, gamma=gamma, train_batch_size=train_batch_size,
+                num_updates_per_iteration=num_updates_per_iteration,
+                target_network_update_freq=target_network_update_freq,
+                double_q=double_q, grad_clip=grad_clip,
+                replay_buffer_capacity=replay_buffer_capacity,
+                prioritized_replay=prioritized_replay,
+                learning_starts=learning_starts,
+                epsilon_initial=epsilon_initial,
+                epsilon_final=epsilon_final,
+                epsilon_decay_iterations=epsilon_decay_iterations).items():
+            if v is not None:
+                setattr(self, k, v)
+        return super().training(**kwargs)
+
+    def hyperparams(self) -> DQNHyperparams:
+        return DQNHyperparams(
+            lr=self.lr, gamma=self.gamma,
+            train_batch_size=self.train_batch_size,
+            num_updates_per_iteration=self.num_updates_per_iteration,
+            target_network_update_freq=self.target_network_update_freq,
+            double_q=self.double_q, grad_clip=self.grad_clip)
+
+
+class DQN(Algorithm):
+    """training_step: collect epsilon-greedy transitions into replay,
+    run K sampled TD updates, write priorities back, broadcast."""
+
+    def _setup_learner(self, obs_dim: int, num_actions: int) -> DQNLearner:
+        cfg: DQNConfig = self.config
+        if cfg.prioritized_replay:
+            self.replay = PrioritizedReplayBuffer(
+                cfg.replay_buffer_capacity, seed=cfg.seed)
+        else:
+            self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                       seed=cfg.seed)
+        self._env_steps = 0
+        return DQNLearner(obs_dim, num_actions, cfg.hyperparams(),
+                          seed=cfg.seed, hidden=cfg.model_hidden)
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config
+        frac = min(1.0, self._iteration / max(1,
+                                              cfg.epsilon_decay_iterations))
+        return (cfg.epsilon_initial
+                + frac * (cfg.epsilon_final - cfg.epsilon_initial))
+
+    def _collect(self, epsilon: float):
+        T = self.config.rollout_fragment_length
+        if self._remote:
+            import ray_tpu
+
+            outs = ray_tpu.get(
+                [w.sample_transitions.remote(T, epsilon)
+                 for w in self.workers], timeout=600)
+        else:
+            outs = [self.workers[0].sample_transitions(T, epsilon)]
+        batch = {k: np.concatenate([o["batch"][k] for o in outs])
+                 for k in outs[0]["batch"]}
+        returns = [r for o in outs for r in o["episode_returns"]]
+        return batch, returns
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: DQNConfig = self.config
+        eps = self._epsilon()
+        batch, episode_returns = self._collect(eps)
+        self.replay.add_batch(batch)
+        self._env_steps += len(batch["rewards"])
+
+        metrics: Dict[str, float] = {"epsilon": eps}
+        if self._env_steps >= cfg.learning_starts and len(self.replay) \
+                >= cfg.train_batch_size:
+            losses = []
+            for _ in range(cfg.num_updates_per_iteration):
+                sample = self.replay.sample(cfg.train_batch_size)
+                loss, td = self.learner.update(sample)
+                self.replay.update_priorities(sample["batch_indexes"], td)
+                losses.append(loss)
+            metrics["loss"] = float(np.mean(losses))
+            self._broadcast_weights()
+        if episode_returns:
+            metrics["episode_return_mean"] = float(
+                np.mean(episode_returns))
+            metrics["num_episodes"] = float(len(episode_returns))
+        metrics["num_env_steps_sampled"] = float(self._env_steps)
+        metrics["replay_size"] = float(len(self.replay))
+        return metrics

@@ -44,3 +44,28 @@ def apply_mlp_policy(params: Params, obs: jnp.ndarray
     logits = tower("pi", obs)
     value = tower("v", obs)[..., 0]
     return logits, value
+
+
+def init_mlp_q(rng: jax.Array, obs_dim: int, num_actions: int,
+               hidden: Sequence[int] = (64, 64)) -> Params:
+    """Q-network MLP: obs -> Q(s, .) (the DQN RLModule analogue)."""
+    params: Params = {}
+    sizes = [obs_dim, *hidden, num_actions]
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, key = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / fan_in)
+        params[f"q_w{i}"] = jax.random.normal(key, (fan_in, fan_out)) * scale
+        params[f"q_b{i}"] = jnp.zeros((fan_out,))
+    return params
+
+
+def apply_mlp_q(params: Params, obs: jnp.ndarray) -> jnp.ndarray:
+    """obs [B, obs_dim] -> Q [B, A]."""
+    x = obs
+    i = 0
+    while f"q_w{i}" in params:
+        x = x @ params[f"q_w{i}"] + params[f"q_b{i}"]
+        if f"q_w{i + 1}" in params:
+            x = jnp.tanh(x)
+        i += 1
+    return x

@@ -1,0 +1,90 @@
+"""Replay buffers: uniform + prioritized experience replay.
+
+ref: rllib/utils/replay_buffers/{replay_buffer.py,
+prioritized_replay_buffer.py} — ring storage, proportional priority
+sampling with importance weights and post-update priority writes.
+Storage is flat numpy rings (one array per field), so sampling is pure
+vectorized indexing.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._size = 0
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        if self._store is None:
+            self._store = {
+                k: np.empty((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()}
+        idx = (self._pos + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._store[k][idx] = v
+        self._pos = (self._pos + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+        self._on_add(idx)
+
+    def _on_add(self, idx: np.ndarray) -> None:
+        pass
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        out = {k: v[idx] for k, v in self._store.items()}
+        out["batch_indexes"] = idx
+        out["weights"] = np.ones(batch_size, np.float32)
+        return out
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        pass  # uniform: no-op
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional PER (ref: prioritized_replay_buffer.py): sample
+    P(i) ∝ p_i^alpha, correct with importance weights
+    w_i = (N * P(i))^-beta / max w, write back |td_error| + eps."""
+
+    def __init__(self, capacity: int, *, alpha: float = 0.6,
+                 beta: float = 0.4, eps: float = 1e-6, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def _on_add(self, idx: np.ndarray) -> None:
+        self._prio[idx] = self._max_prio ** self.alpha
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        p = self._prio[:self._size]
+        total = p.sum()
+        if total <= 0:
+            return super().sample(batch_size)
+        probs = p / total
+        idx = self._rng.choice(self._size, batch_size, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        out = {k: v[idx] for k, v in self._store.items()}
+        out["batch_indexes"] = idx
+        out["weights"] = weights
+        return out
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        pr = np.abs(priorities) + self.eps
+        self._prio[idx] = pr ** self.alpha
+        self._max_prio = max(self._max_prio, float(pr.max()))

@@ -31,6 +31,19 @@ def _value_only(params, obs):
     return apply_mlp_policy(params, obs)[1]
 
 
+@jax.jit
+def _q_policy_step(params, obs, key, epsilon):
+    """Epsilon-greedy over Q(s, .) for off-policy collection."""
+    from ray_tpu.rllib.models import apply_mlp_q
+
+    q = apply_mlp_q(params, obs)
+    greedy = jnp.argmax(q, axis=1)
+    k1, k2 = jax.random.split(key)
+    rand_a = jax.random.randint(k1, greedy.shape, 0, q.shape[1])
+    explore = jax.random.uniform(k2, greedy.shape) < epsilon
+    return jnp.where(explore, rand_a, greedy)
+
+
 class RolloutWorker:
     def __init__(self, env: Union[str, Callable[..., VectorEnv]],
                  num_envs: int = 8, seed: int = 0,
@@ -96,6 +109,52 @@ class RolloutWorker:
                 "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
                 "rewards": rew_buf, "dones": done_buf, "values": val_buf,
                 "final_value": final_value,
+            },
+            "episode_returns": episode_returns,
+        }
+
+    def sample_transitions(self, num_steps: int,
+                           epsilon: float = 0.0) -> Dict[str, Any]:
+        """Off-policy collection for DQN-style learners: flat
+        (s, a, r, s', terminal) transitions with epsilon-greedy actions.
+        `terminal` excludes time-limit truncations (those bootstrap), and
+        s' is the PRE-reset observation on episode ends (the auto-reset
+        obs would poison TD targets)."""
+        assert self._params is not None, "set_weights() before sample()"
+        E = self.env.num_envs
+        obs_buf = np.empty((E * num_steps, self.obs_dim), np.float32)
+        act_buf = np.empty((E * num_steps,), np.int32)
+        rew_buf = np.empty((E * num_steps,), np.float32)
+        next_buf = np.empty((E * num_steps, self.obs_dim), np.float32)
+        term_buf = np.empty((E * num_steps,), np.float32)
+        episode_returns: List[float] = []
+
+        obs = self._obs
+        eps = jnp.float32(epsilon)
+        for t in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            actions = np.asarray(_q_policy_step(self._params, obs, key,
+                                                eps))
+            lo, hi = t * E, (t + 1) * E
+            obs_buf[lo:hi] = obs
+            act_buf[lo:hi] = actions
+            obs, rewards, dones, ep_ret = self.env.step(actions)
+            rew_buf[lo:hi] = rewards
+            # final_obs is every env's TRUE successor state this step.
+            next_buf[lo:hi] = self.env.final_obs
+            trunc = getattr(self.env, "truncateds", None)
+            terminal = dones.astype(np.float32)
+            if trunc is not None:
+                terminal = terminal * (1.0 - trunc.astype(np.float32))
+            term_buf[lo:hi] = terminal
+            finished = ~np.isnan(ep_ret)
+            if finished.any():
+                episode_returns.extend(ep_ret[finished].tolist())
+        self._obs = obs
+        return {
+            "batch": {
+                "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "next_obs": next_buf, "terminals": term_buf,
             },
             "episode_returns": episode_returns,
         }

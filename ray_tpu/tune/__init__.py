@@ -6,10 +6,13 @@ machinery with ray_tpu.train.
 """
 from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (choice, grid_search, loguniform, quniform,
-                                 randint, sample_from, uniform)
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 Searcher, TPESearcher, choice, grid_search,
+                                 loguniform, quniform, randint, sample_from,
+                                 uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 ASHAScheduler = AsyncHyperBandScheduler
@@ -20,5 +23,7 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "quniform", "sample_from",
     "FIFOScheduler", "AsyncHyperBandScheduler", "ASHAScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "ConcurrencyLimiter",
 ]

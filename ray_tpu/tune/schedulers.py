@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
 
 
 class FIFOScheduler:
@@ -158,3 +159,85 @@ class PopulationBasedTraining(FIFOScheduler):
             elif key in out and isinstance(out[key], (int, float)):
                 out[key] = out[key] * self.rng.choice([0.8, 1.2])
         return out
+
+
+class HyperBandScheduler(FIFOScheduler):
+    """Synchronous successive halving (ref: hyperband.py HyperBand — one
+    bracket, simplified): every live trial PAUSES at each rung milestone;
+    once the whole rung has reported, the top 1/eta resume from their
+    checkpoints and the rest stop. Unlike ASHA (async, stop-only), sync
+    halving never stops a trial that a straggler would later beat.
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.eta = reduction_factor
+        self.max_t = max_t
+        self.rung_levels: List[int] = []
+        r = grace_period
+        while r < max_t:
+            self.rung_levels.append(r)
+            r *= reduction_factor
+        self.participants: set = set()          # live trial ids
+        self._next_rung: Dict[str, int] = {}    # trial -> rung index due
+        self._rung_scores: Dict[int, Dict[str, float]] = defaultdict(dict)
+        self._resume: List[str] = []
+        self._stop: List[str] = []
+
+    def on_trial_add(self, trial_id: str) -> None:
+        self.participants.add(trial_id)
+        self._next_rung[trial_id] = 0
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        if trial_id not in self.participants:
+            self.on_trial_add(trial_id)
+        t = result.get(self.time_attr)
+        val = result.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        idx = self._next_rung.get(trial_id, len(self.rung_levels))
+        if idx >= len(self.rung_levels):
+            return CONTINUE
+        milestone = self.rung_levels[idx]
+        if t < milestone:
+            return CONTINUE
+        self._rung_scores[idx][trial_id] = val
+        self._next_rung[trial_id] = idx + 1
+        self._maybe_complete_rung(idx)
+        return PAUSE
+
+    def _maybe_complete_rung(self, idx: int) -> None:
+        scores = self._rung_scores[idx]
+        waiting = {tid for tid in self.participants
+                   if self._next_rung.get(tid, 99) <= idx}
+        if waiting:
+            return                     # stragglers still running the rung
+        reported = list(scores.items())
+        if not reported:
+            return
+        reported.sort(key=lambda kv: kv[1], reverse=(self.mode == "max"))
+        k = max(1, len(reported) // self.eta)
+        survivors = [tid for tid, _ in reported[:k]]
+        losers = [tid for tid, _ in reported[k:]]
+        self._resume.extend(survivors)
+        self._stop.extend(losers)
+        for tid in losers:
+            self.participants.discard(tid)
+        self._rung_scores[idx] = {}
+
+    def pending_transitions(self) -> tuple:
+        """Controller drains (resume_ids, stop_ids) once per tick."""
+        resume, self._resume = self._resume, []
+        stop, self._stop = self._stop, []
+        return resume, stop
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        self.participants.discard(trial_id)
+        # A natural finish may complete a rung its peers were waiting on.
+        for idx in range(len(self.rung_levels)):
+            self._maybe_complete_rung(idx)

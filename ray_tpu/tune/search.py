@@ -123,3 +123,196 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
                     cfg[k] = v
             variants.append(cfg)
     return variants
+
+
+# ---------------------------------------------------------------------------
+# Searcher plug-in interface (ref: python/ray/tune/search/searcher.py —
+# Searcher.suggest/on_trial_result/on_trial_complete; integrations like
+# OptunaSearch implement the same surface)
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    """Suggest configs one trial at a time; observe results to adapt.
+
+    set_space() is called by the Tuner before the first suggest with the
+    param_space and optimization target."""
+
+    def set_space(self, param_space: Dict[str, Any], metric: Optional[str],
+                  mode: str, seed: Optional[int] = None) -> None:
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        pass
+
+    def _random_config(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling as a Searcher (ref: search/basic_variant.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return self._random_config()
+
+
+class TPESearcher(Searcher):
+    """Native adaptive searcher in the TPE spirit (ref: the role Optuna's
+    TPE fills behind search/optuna.py): after `n_initial` random trials,
+    candidates are drawn near the top-`gamma` observed configs (Gaussian
+    jitter for numeric axes, frequency-weighted choice for categorical)
+    and the best of `n_candidates` under a nearest-neighbour score is
+    suggested."""
+
+    def __init__(self, n_initial: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 16, jitter: float = 0.15):
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.jitter = jitter
+        self._obs: List[tuple] = []     # (config, score)
+        self._live: Dict[str, dict] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._obs) < self.n_initial:
+            cfg = self._random_config()
+        else:
+            cfg = self._adaptive_config()
+        self._live[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or not result or self.metric not in result:
+            return
+        score = result[self.metric]
+        if self.mode == "min":
+            score = -score
+        self._obs.append((cfg, score))
+
+    def _split_configs(self) -> tuple:
+        ranked = sorted(self._obs, key=lambda o: -o[1])
+        k = max(1, int(len(ranked) * self.gamma))
+        return ([cfg for cfg, _ in ranked[:k]],
+                [cfg for cfg, _ in ranked[k:]])
+
+    def _distance(self, a: dict, b: dict) -> float:
+        """Normalized config distance: numeric axes scaled to their
+        domain span, categorical mismatch counts 1."""
+        import math
+
+        d = 0.0
+        for key, dom in self.param_space.items():
+            if isinstance(dom, (Uniform, QUniform, Randint)):
+                span = float(dom.high - dom.low) or 1.0
+                d += ((a[key] - b[key]) / span) ** 2
+            elif isinstance(dom, LogUniform):
+                span = (dom._hi - dom._lo) or 1.0
+                d += ((math.log(max(a[key], 1e-300))
+                       - math.log(max(b[key], 1e-300))) / span) ** 2
+            elif isinstance(dom, (Categorical, GridSearch, Domain)):
+                d += 0.0 if a.get(key) == b.get(key) else 1.0
+        return math.sqrt(d)
+
+    def _adaptive_config(self) -> Dict[str, Any]:
+        top, bad = self._split_configs()
+        best = None
+        best_score = None
+        for _ in range(self.n_candidates):
+            anchor = self.rng.choice(top)
+            cand = {}
+            for key, dom in self.param_space.items():
+                if isinstance(dom, (Uniform, QUniform)):
+                    span = (dom.high - dom.low) * self.jitter
+                    v = anchor[key] + self.rng.gauss(0.0, span)
+                    v = min(max(v, dom.low), dom.high)
+                    if isinstance(dom, QUniform):
+                        v = round(v / dom.q) * dom.q
+                    cand[key] = v
+                elif isinstance(dom, LogUniform):
+                    import math
+
+                    lv = math.log(anchor[key]) + self.rng.gauss(
+                        0.0, (dom._hi - dom._lo) * self.jitter)
+                    cand[key] = math.exp(min(max(lv, dom._lo), dom._hi))
+                elif isinstance(dom, Randint):
+                    span = max(1, int((dom.high - dom.low) * self.jitter))
+                    v = anchor[key] + self.rng.randint(-span, span)
+                    cand[key] = min(max(v, dom.low), dom.high - 1)
+                elif isinstance(dom, (Categorical, GridSearch)):
+                    values = (dom.categories
+                              if isinstance(dom, Categorical) else dom.values)
+                    counts = {v: 1 for v in values}
+                    for c in top:
+                        if c[key] in counts:
+                            counts[c[key]] += 2
+                    total = sum(counts.values())
+                    r = self.rng.uniform(0, total)
+                    acc = 0
+                    for v, w in counts.items():
+                        acc += w
+                        if r <= acc:
+                            cand[key] = v
+                            break
+                elif isinstance(dom, Domain):
+                    cand[key] = dom.sample(self.rng)
+                else:
+                    cand[key] = dom
+            # 1-NN surrogate: prefer candidates near the good group and
+            # far from the bad group (the l(x)/g(x) ratio TPE optimizes,
+            # reduced to nearest-neighbour distances).
+            d_good = min(self._distance(cand, c) for c in top)
+            d_bad = (min(self._distance(cand, c) for c in bad)
+                     if bad else 1.0)
+            score = d_bad - d_good
+            if best_score is None or score > best_score:
+                best, best_score = cand, score
+        return best
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap a searcher's outstanding suggestions (ref: search/
+    concurrency_limiter.py) — adaptive searchers learn little from 64
+    blind parallel draws."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._outstanding: set = set()
+
+    def set_space(self, *args, **kwargs) -> None:
+        self.searcher.set_space(*args, **kwargs)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._outstanding) >= self.max_concurrent:
+            return None      # Tuner retries on a later tick
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._outstanding.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        self._outstanding.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

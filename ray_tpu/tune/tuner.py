@@ -20,7 +20,7 @@ import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result, RunConfig
 from ray_tpu.train.session import TrainSession, install_session, uninstall_session
-from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import generate_variants
 
@@ -32,6 +32,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Optional[Any] = None
+    # Searcher plug-in (ref: tune/search/searcher.py): suggests configs
+    # adaptively; None => pre-expanded grid/random variants.
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
 
 
@@ -131,17 +134,96 @@ class Tuner:
         self._space = param_space
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restored_trials: Optional[List[_Trial]] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                *, tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its state snapshot (ref:
+        Tuner.restore, tune/execution/experiment_state.py): finished
+        trials keep their results; unfinished ones restart from their
+        last reported checkpoint."""
+        import json
+
+        state_file = os.path.join(path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        tuner = cls(trainable, param_space={},
+                    tune_config=tune_config or TuneConfig(),
+                    run_config=RunConfig(storage_path=os.path.dirname(path)
+                                         or ".",
+                                         name=os.path.basename(path)))
+        trials = []
+        for t in state["trials"]:
+            trial = _Trial(trial_id=t["trial_id"], config=t["config"])
+            trial.checkpoint = t.get("checkpoint")
+            if t["state"] in ("TERMINATED", "STOPPED"):
+                # Cleanly finished: keep its results as-is.
+                trial.state = t["state"]
+                trial.iteration = t["iteration"]
+                trial.last_metrics = t["last_metrics"]
+                trial.history = t.get("history", [])
+                trial.error = t.get("error")
+            else:
+                # Resumes from its last checkpoint: stale error/history
+                # belong to the aborted attempt, not the resumed one.
+                trial.state = "PENDING"
+            trials.append(trial)
+        tuner._restored_trials = trials
+        return tuner
+
+    _SNAPSHOT_MIN_INTERVAL_S = 5.0
+
+    def _snapshot(self, exp_dir: str, trials: List["_Trial"],
+                  force: bool = False) -> None:
+        # Rate-limited: rewriting every-trial histories 20x/s would let
+        # snapshot I/O dominate the control loop on long runs.
+        now = time.monotonic()
+        last = getattr(self, "_last_snapshot", 0.0)
+        if not force and now - last < self._SNAPSHOT_MIN_INTERVAL_S:
+            return
+        self._last_snapshot = now
+        import json
+
+        state = {"trials": [
+            {"trial_id": t.trial_id, "config": t.config, "state": t.state,
+             "iteration": t.iteration, "last_metrics": t.last_metrics,
+             "history": t.history, "checkpoint": t.checkpoint,
+             "error": t.error}
+            for t in trials]}
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, os.path.join(exp_dir,
+                                         "experiment_state.json"))
+        except (OSError, TypeError):
+            pass  # unpicklable config values: snapshots are best-effort
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(self._space, tc.num_samples, tc.seed)
+        searcher = tc.search_alg
         exp_dir = self.run_config.resolve_storage()
-        trials = [
-            _Trial(trial_id=f"trial_{i:04d}", config=cfg)
-            for i, cfg in enumerate(variants)]
-        pending = list(trials)
+        os.makedirs(exp_dir, exist_ok=True)
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            pending = [t for t in trials if t.state == "PENDING"]
+            spawned = len(trials)
+        elif searcher is not None:
+            searcher.set_space(self._space, tc.metric, tc.mode, tc.seed)
+            trials = []
+            pending = []
+            spawned = 0
+        else:
+            variants = generate_variants(self._space, tc.num_samples,
+                                         tc.seed)
+            trials = [_Trial(trial_id=f"trial_{i:04d}", config=cfg)
+                      for i, cfg in enumerate(variants)]
+            pending = list(trials)
+            spawned = len(trials)
         running: List[_Trial] = []
+        paused: List[_Trial] = []
         RemoteTrial = ray_tpu.remote(TrialActor)
 
         def launch(trial: _Trial, checkpoint: Optional[str] = None):
@@ -153,9 +235,49 @@ class Tuner:
             trial.state = "RUNNING"
             running.append(trial)
 
-        while pending or running:
+        def fill_slots():
+            nonlocal spawned
             while pending and len(running) < tc.max_concurrent_trials:
                 launch(pending.pop(0))
+            while (searcher is not None
+                   and self._restored_trials is None
+                   and spawned < tc.num_samples
+                   and len(running) < tc.max_concurrent_trials):
+                tid = f"trial_{spawned:04d}"
+                cfg = searcher.suggest(tid)
+                if cfg is None:
+                    break  # e.g. ConcurrencyLimiter: retry next tick
+                trial = _Trial(trial_id=tid, config=cfg)
+                trials.append(trial)
+                spawned += 1
+                try:
+                    launch(trial)
+                except Exception as e:  # noqa: BLE001
+                    # The searcher must hear about the failure or its
+                    # concurrency slot leaks for the whole experiment.
+                    trial.state = "ERROR"
+                    trial.error = repr(e)
+                    searcher.on_trial_complete(tid, None)
+
+        def more_to_spawn() -> bool:
+            return (searcher is not None
+                    and self._restored_trials is None
+                    and spawned < tc.num_samples)
+
+        fill_slots()
+        while pending or running or paused or more_to_spawn():
+            fill_slots()
+            if not (pending or running or paused):
+                # Nothing live and fill_slots() could not spawn (budget
+                # spent, or the searcher declined with nothing running —
+                # an exhausted space): done.
+                break
+            if not running and not pending and paused:
+                # Only paused trials remain (e.g. HyperBand waiting on a
+                # rung that lost its stragglers): resume them all.
+                for trial in list(paused):
+                    paused.remove(trial)
+                    launch(trial)
             polls = ray_tpu.get(
                 [t.actor.poll.remote() for t in running], timeout=120)
             done: List[_Trial] = []
@@ -168,10 +290,24 @@ class Tuner:
                     trial.history.append(m)
                     if item["checkpoint"]:
                         trial.checkpoint = item["checkpoint"]
+                    if searcher is not None:
+                        searcher.on_trial_result(trial.trial_id, m)
                     decision = scheduler.on_result(trial.trial_id, m)
                     if decision == STOP and trial.state == "RUNNING":
                         trial.state = "STOPPED"
                         done.append(trial)
+                        break
+                    if decision == PAUSE and trial.state == "RUNNING":
+                        # Park the trial; the scheduler resumes or stops
+                        # it via pending_transitions (sync HyperBand
+                        # rungs, ref: hyperband.py PAUSE semantics).
+                        trial.state = "PAUSED"
+                        running.remove(trial)
+                        paused.append(trial)
+                        try:
+                            ray_tpu.kill(trial.actor)
+                        except Exception:  # noqa: BLE001
+                            pass
                         break
                 if trial.state == "RUNNING":
                     if p["error"]:
@@ -181,6 +317,25 @@ class Tuner:
                     elif p["finished"]:
                         trial.state = "TERMINATED"
                         done.append(trial)
+            # Scheduler-driven pause transitions (sync HyperBand rungs).
+            if hasattr(scheduler, "pending_transitions"):
+                resume_ids, stop_ids = scheduler.pending_transitions()
+                by_id = {t.trial_id: t for t in trials}
+                for tid in stop_ids:
+                    trial = by_id.get(tid)
+                    if trial is not None and trial.state == "PAUSED":
+                        paused.remove(trial)
+                        trial.state = "STOPPED"
+                        scheduler.on_trial_complete(tid)
+                        if searcher is not None:
+                            # Also frees ConcurrencyLimiter slots.
+                            searcher.on_trial_complete(
+                                tid, trial.last_metrics)
+                for tid in resume_ids:
+                    trial = by_id.get(tid)
+                    if trial is not None and trial.state == "PAUSED":
+                        paused.remove(trial)
+                        launch(trial)
             # PBT exploit/explore: restart bottom trials from a top trial.
             if isinstance(scheduler, PopulationBasedTraining):
                 by_id = {t.trial_id: t for t in trials}
@@ -205,11 +360,15 @@ class Tuner:
                 if trial in running:
                     running.remove(trial)
                 scheduler.on_trial_complete(trial.trial_id)
+                if searcher is not None:
+                    searcher.on_trial_complete(trial.trial_id,
+                                               trial.last_metrics)
                 if trial.actor is not None:
                     try:
                         ray_tpu.kill(trial.actor)
                     except Exception:  # noqa: BLE001
                         pass
+            self._snapshot(exp_dir, trials, force=bool(done))
             if running and not done:
                 time.sleep(0.05)
 
