@@ -54,6 +54,19 @@ def main() -> None:
     port = serve.http_port()
     url = f"http://127.0.0.1:{port}/llm?stream=1&method=stream"
 
+    # Replica readiness: the LLM replica compiles prefill/decode in its
+    # constructor, which can exceed the router's replica-wait budget on a
+    # loaded host — poll the controller before timing anything.
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        st = serve.status().get("llm", {})
+        if st.get("ready", 0) >= 1:
+            break
+        time.sleep(1.0)
+    else:
+        raise RuntimeError(f"llm replicas never became ready: "
+                           f"{serve.status()}")
+
     # Warmup: trigger prefill/decode compiles before timing.
     def one_request(prompt_len: int = 16):
         body = json.dumps({"tokens": list(range(1, prompt_len + 1)),

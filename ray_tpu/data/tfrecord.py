@@ -204,31 +204,40 @@ def decode_example(payload: bytes) -> Dict[str, Any]:
     return row
 
 
+def _signed64(val: int) -> int:
+    return val - (1 << 64) if val >= 1 << 63 else val
+
+
 def _decode_feature(feature: bytes) -> Any:
+    """Both packed and unpacked repeated encodings are accepted (packed
+    is merely the default on the wire; conformant parsers must read
+    either), accumulating every occurrence."""
     for field, _, body in _iter_fields(feature):
         if field == 1:      # BytesList
             values = [v for f, _, v in _iter_fields(body) if f == 1]
             return values[0] if len(values) == 1 else values
-        if field == 2:      # FloatList (packed)
+        if field == 2:      # FloatList
+            floats: list = []
             for f, wire, v in _iter_fields(body):
-                if f == 1 and wire == 2:
-                    floats = [struct.unpack_from("<f", v, i)[0]
-                              for i in range(0, len(v), 4)]
-                    return floats[0] if len(floats) == 1 else floats
-                if f == 1 and wire == 5:
-                    return struct.unpack("<f", v)[0]
-            return []
-        if field == 3:      # Int64List (packed)
+                if f != 1:
+                    continue
+                if wire == 2:       # packed run
+                    floats.extend(struct.unpack_from("<f", v, i)[0]
+                                  for i in range(0, len(v), 4))
+                elif wire == 5:     # unpacked element
+                    floats.append(struct.unpack("<f", v)[0])
+            return floats[0] if len(floats) == 1 else floats
+        if field == 3:      # Int64List
+            ints: list = []
             for f, wire, v in _iter_fields(body):
-                if f == 1 and wire == 2:
-                    out, pos = [], 0
+                if f != 1:
+                    continue
+                if wire == 2:       # packed run
+                    pos = 0
                     while pos < len(v):
                         val, pos = _read_varint(v, pos)
-                        if val >= 1 << 63:
-                            val -= 1 << 64
-                        out.append(val)
-                    return out[0] if len(out) == 1 else out
-                if f == 1 and wire == 0:
-                    return v
-            return []
+                        ints.append(_signed64(val))
+                elif wire == 0:     # unpacked element
+                    ints.append(_signed64(v))
+            return ints[0] if len(ints) == 1 else ints
     return None

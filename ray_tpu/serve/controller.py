@@ -12,6 +12,7 @@ bump (ref: _private/long_poll.py:173 LongPollHost).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import traceback
@@ -34,6 +35,14 @@ class ServeController:
         self._lock = threading.RLock()
         self._stop = False
         self._last_scale: Dict[str, float] = {}
+        # Startup bookkeeping: a replica whose constructor is still
+        # running (model load + jit compile can take minutes) must not
+        # be killed by the health probe — grace until its FIRST
+        # successful check (ref: deployment initialization_timeout_s).
+        self._started_at: Dict[str, float] = {}
+        self._ready: set = set()
+        self._startup_grace_s = float(
+            os.environ.get("RAY_TPU_SERVE_STARTUP_GRACE_S", "600"))
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
         self._thread.start()
@@ -78,6 +87,10 @@ class ServeController:
             st = self._state.get(app_name, {"replicas": {}, "version": 0})
             return {
                 "running": len(st["replicas"]),
+                # Constructor finished AND passed a health probe — what
+                # "can serve a request right now" actually means.
+                "ready": sum(1 for n in st["replicas"]
+                             if n in self._ready),
                 "target": tgt["num_replicas"] if tgt else 0,
                 "version": st["version"],
             }
@@ -141,6 +154,8 @@ class ServeController:
                     pass
                 have.pop(name)
                 gens.pop(name, None)
+                self._started_at.pop(name, None)
+                self._ready.discard(name)
 
             # replace replicas from an older deploy generation (redeploy
             # with new code/args must not leave old-version replicas serving)
@@ -165,12 +180,21 @@ class ServeController:
                 ).remote(tgt["target"], tgt["args"], tgt["kwargs"], name)
                 have[name] = handle
                 gens[name] = gen
-            # health check
+                self._started_at[name] = time.monotonic()
+            # health check: starting replicas get grace until their first
+            # successful probe; after that a failed probe means dead.
+            now = time.monotonic()
             for name in list(have):
                 try:
                     ray_tpu.get(have[name].check_health.remote(), timeout=10)
+                    self._ready.add(name)
                 except Exception:  # noqa: BLE001
-                    _kill(name)
+                    still_starting = (
+                        name not in self._ready
+                        and now - self._started_at.get(name, now)
+                        < self._startup_grace_s)
+                    if not still_starting:
+                        _kill(name)
             with self._lock:
                 cur = self._state.setdefault(
                     app, {"replicas": {}, "gens": {}, "version": 0})

@@ -273,8 +273,29 @@ class Tuner:
                 # an exhausted space): done.
                 break
             if not running and not pending and paused:
-                # Only paused trials remain (e.g. HyperBand waiting on a
-                # rung that lost its stragglers): resume them all.
+                # Drain scheduler verdicts FIRST: a just-completed rung
+                # may have queued resumes/stops for these paused trials —
+                # force-resuming a queued loser would let it run to max_t
+                # and corrupt the rung accounting.
+                if hasattr(scheduler, "pending_transitions"):
+                    resume_ids, stop_ids = scheduler.pending_transitions()
+                    by_id = {t.trial_id: t for t in trials}
+                    for tid in stop_ids:
+                        trial = by_id.get(tid)
+                        if trial is not None and trial.state == "PAUSED":
+                            paused.remove(trial)
+                            trial.state = "STOPPED"
+                            scheduler.on_trial_complete(tid)
+                            if searcher is not None:
+                                searcher.on_trial_complete(
+                                    tid, trial.last_metrics)
+                    for tid in resume_ids:
+                        trial = by_id.get(tid)
+                        if trial is not None and trial.state == "PAUSED":
+                            paused.remove(trial)
+                            launch(trial)
+                # Anything STILL paused is genuinely stranded (e.g. a
+                # rung that lost its stragglers to errors): resume it.
                 for trial in list(paused):
                     paused.remove(trial)
                     launch(trial)
