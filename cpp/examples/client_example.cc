@@ -1,0 +1,58 @@
+// End-to-end exercise of the C++ client API against a live cluster
+// (ref: cpp/example/example.cc in the reference). Run with the GCS
+// address as argv[1]; a Python driver must have called
+// ray_tpu.register_cross_lang("cpp_add", fn) first.
+#include <cstdio>
+#include <string>
+
+#include "ray_tpu_client/ray_tpu_client.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <gcs host:port>\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray_tpu::Client client(argv[1]);
+
+    // KV round-trip.
+    client.KvPut("cppdemo", "greeting", "hello from c++");
+    std::string got;
+    if (!client.KvGet("cppdemo", "greeting", &got) ||
+        got != "hello from c++") {
+      std::fprintf(stderr, "KV roundtrip mismatch\n");
+      return 1;
+    }
+    std::printf("KV: %s\n", got.c_str());
+
+    // Cluster introspection.
+    ray_tpu::Value nodes = client.Nodes();
+    std::printf("NODES: %zu\n", nodes.items.size());
+
+    // Task submission: Python function registered as "cpp_add".
+    ray_tpu::Value result = client.SubmitTask(
+        "cpp_add",
+        {ray_tpu::Value::Int(20), ray_tpu::Value::Int(22)});
+    if (result.kind != ray_tpu::Value::Kind::Int) {
+      std::fprintf(stderr, "unexpected result kind\n");
+      return 1;
+    }
+    std::printf("TASK_RESULT: %lld\n",
+                static_cast<long long>(result.i));
+
+    // Structured args/results.
+    ray_tpu::Value d = ray_tpu::Value::Dict();
+    d.Set("xs", ray_tpu::Value::List({ray_tpu::Value::Float(1.5),
+                                      ray_tpu::Value::Float(2.5)}));
+    d.Set("label", ray_tpu::Value::Str("sum"));
+    ray_tpu::Value structured = client.SubmitTask("cpp_describe", {d});
+    const ray_tpu::Value* total = structured.Get("total");
+    std::printf("STRUCTURED_TOTAL: %.1f\n",
+                total != nullptr ? total->f : -1.0);
+    std::printf("CPP_CLIENT_OK\n");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,770 @@
+// C++ client API for the ray_tpu runtime.
+//
+// Role parity with the reference C++ worker API (ref: cpp/include/ray/api/
+// — ray::Init/Put/Get/Task(...).Remote() over the C++ CoreWorker). This
+// client speaks the framework's native wire protocol directly:
+//
+//   * length-prefixed frames (u32 len | u8 type | u64 req_id | payload)
+//     to the GCS / node daemons / workers — the same framing rpc.py uses;
+//   * a minimal pickle codec (protocol-3 encode, protocol<=5 decode of
+//     primitives/containers) for RPC payloads;
+//   * the RTPU object framing for task args/results.
+//
+// Capabilities: cluster KV, node/actor introspection, and task
+// submission: Python functions registered via
+// `ray_tpu.register_cross_lang(name, fn)` are invoked from C++ with the
+// full lease -> direct worker push -> inline result protocol (the same
+// hot path Python drivers use). Cross-language values are restricted to
+// primitives/lists/dicts/bytes — the same contract the reference imposes
+// on its cross-language boundary.
+//
+// Header-only; link against nothing but the C++ standard library.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------------------
+// Value: the cross-language data model
+// ---------------------------------------------------------------------------
+
+struct Value {
+  enum class Kind { None, Bool, Int, Float, Bytes, Str, List, Tuple, Dict };
+  Kind kind = Kind::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // Bytes or Str payload
+  std::vector<Value> items;                      // List / Tuple
+  std::vector<std::pair<Value, Value>> entries;  // Dict
+
+  static Value None() { return Value{}; }
+  static Value Bool(bool v) {
+    Value x; x.kind = Kind::Bool; x.b = v; return x;
+  }
+  static Value Int(int64_t v) {
+    Value x; x.kind = Kind::Int; x.i = v; return x;
+  }
+  static Value Float(double v) {
+    Value x; x.kind = Kind::Float; x.f = v; return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x; x.kind = Kind::Bytes; x.s = std::move(v); return x;
+  }
+  static Value Str(std::string v) {
+    Value x; x.kind = Kind::Str; x.s = std::move(v); return x;
+  }
+  static Value List(std::vector<Value> v) {
+    Value x; x.kind = Kind::List; x.items = std::move(v); return x;
+  }
+  static Value Tuple(std::vector<Value> v) {
+    Value x; x.kind = Kind::Tuple; x.items = std::move(v); return x;
+  }
+  static Value Dict() { Value x; x.kind = Kind::Dict; return x; }
+
+  void Set(const std::string& key, Value v) {
+    entries.emplace_back(Str(key), std::move(v));
+  }
+  const Value* Get(const std::string& key) const {
+    for (const auto& kv : entries) {
+      if (kv.first.kind == Kind::Str && kv.first.s == key) {
+        return &kv.second;
+      }
+    }
+    return nullptr;
+  }
+  bool IsTruthy() const {
+    switch (kind) {
+      case Kind::None: return false;
+      case Kind::Bool: return b;
+      case Kind::Int: return i != 0;
+      case Kind::Float: return f != 0.0;
+      case Kind::Bytes:
+      case Kind::Str: return !s.empty();
+      case Kind::List:
+      case Kind::Tuple: return !items.empty();
+      case Kind::Dict: return !entries.empty();
+    }
+    return false;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// pickle encode (protocol 3 subset)
+// ---------------------------------------------------------------------------
+
+namespace detail {
+
+inline void PutU32(std::string* out, uint32_t v) {
+  char buf[4];
+  std::memcpy(buf, &v, 4);  // little-endian hosts only (x86/arm64)
+  out->append(buf, 4);
+}
+
+inline void PickleValue(const Value& v, std::string* out) {
+  switch (v.kind) {
+    case Value::Kind::None:
+      out->push_back('N');
+      break;
+    case Value::Kind::Bool:
+      out->push_back(v.b ? '\x88' : '\x89');
+      break;
+    case Value::Kind::Int:
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        out->push_back('J');
+        int32_t x = static_cast<int32_t>(v.i);
+        out->append(reinterpret_cast<const char*>(&x), 4);
+      } else {
+        out->push_back('\x8a');  // LONG1
+        out->push_back(8);
+        out->append(reinterpret_cast<const char*>(&v.i), 8);
+      }
+      break;
+    case Value::Kind::Float: {
+      out->push_back('G');  // big-endian double
+      const auto* p = reinterpret_cast<const unsigned char*>(&v.f);
+      for (int k = 7; k >= 0; --k) out->push_back(static_cast<char>(p[k]));
+      break;
+    }
+    case Value::Kind::Bytes:
+      if (v.s.size() < 256) {
+        out->push_back('C');
+        out->push_back(static_cast<char>(v.s.size()));
+      } else {
+        out->push_back('B');
+        PutU32(out, static_cast<uint32_t>(v.s.size()));
+      }
+      out->append(v.s);
+      break;
+    case Value::Kind::Str:
+      out->push_back('X');
+      PutU32(out, static_cast<uint32_t>(v.s.size()));
+      out->append(v.s);
+      break;
+    case Value::Kind::List:
+      out->push_back(']');
+      if (!v.items.empty()) {
+        out->push_back('(');
+        for (const auto& it : v.items) PickleValue(it, out);
+        out->push_back('e');
+      }
+      break;
+    case Value::Kind::Tuple:
+      if (v.items.empty()) {
+        out->push_back(')');
+      } else if (v.items.size() <= 3) {
+        for (const auto& it : v.items) PickleValue(it, out);
+        out->push_back(static_cast<char>('\x84' + v.items.size()));
+      } else {
+        out->push_back('(');
+        for (const auto& it : v.items) PickleValue(it, out);
+        out->push_back('t');
+      }
+      break;
+    case Value::Kind::Dict:
+      out->push_back('}');
+      if (!v.entries.empty()) {
+        out->push_back('(');
+        for (const auto& kv : v.entries) {
+          PickleValue(kv.first, out);
+          PickleValue(kv.second, out);
+        }
+        out->push_back('u');
+      }
+      break;
+  }
+}
+
+}  // namespace detail
+
+inline std::string PickleDumps(const Value& v) {
+  std::string out;
+  out.push_back('\x80');
+  out.push_back(3);
+  detail::PickleValue(v, &out);
+  out.push_back('.');
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// pickle decode (primitives/containers from protocols <= 5)
+// ---------------------------------------------------------------------------
+
+class PickleError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+namespace detail {
+
+class Unpickler {
+ public:
+  explicit Unpickler(const std::string& data) : d_(data) {}
+
+  Value Load() {
+    std::vector<Value> stack;
+    std::vector<size_t> marks;
+    while (pos_ < d_.size()) {
+      unsigned char op = Next();
+      switch (op) {
+        case 0x80:  // PROTO
+          Next();
+          break;
+        case 0x95:  // FRAME
+          Skip(8);
+          break;
+        case '.':  // STOP
+          if (stack.empty()) throw PickleError("empty stack at STOP");
+          return stack.back();
+        case 'N':
+          stack.push_back(Value::None());
+          break;
+        case 0x88:
+          stack.push_back(Value::Bool(true));
+          break;
+        case 0x89:
+          stack.push_back(Value::Bool(false));
+          break;
+        case 'K':
+          stack.push_back(Value::Int(Next()));
+          break;
+        case 'M': {
+          uint16_t v = Next();
+          v |= static_cast<uint16_t>(Next()) << 8;
+          stack.push_back(Value::Int(v));
+          break;
+        }
+        case 'J': {
+          int32_t v;
+          Read(&v, 4);
+          stack.push_back(Value::Int(v));
+          break;
+        }
+        case 0x8a: {  // LONG1
+          unsigned char n = Next();
+          if (n > 8) throw PickleError("LONG1 too wide");
+          int64_t v = 0;
+          unsigned char bytes[8] = {0};
+          Read(bytes, n);
+          std::memcpy(&v, bytes, 8);
+          if (n > 0 && n < 8 && (bytes[n - 1] & 0x80)) {
+            for (int k = n; k < 8; ++k) {
+              v |= (static_cast<int64_t>(0xff) << (8 * k));
+            }
+          }
+          stack.push_back(Value::Int(v));
+          break;
+        }
+        case 'G': {  // BINFLOAT, big-endian
+          unsigned char buf[8];
+          Read(buf, 8);
+          unsigned char le[8];
+          for (int k = 0; k < 8; ++k) le[k] = buf[7 - k];
+          double v;
+          std::memcpy(&v, le, 8);
+          stack.push_back(Value::Float(v));
+          break;
+        }
+        case 'C': {  // SHORT_BINBYTES
+          size_t n = Next();
+          stack.push_back(Value::Bytes(Take(n)));
+          break;
+        }
+        case 'B': {  // BINBYTES
+          uint32_t n;
+          Read(&n, 4);
+          stack.push_back(Value::Bytes(Take(n)));
+          break;
+        }
+        case 0x8e: {  // BINBYTES8
+          uint64_t n;
+          Read(&n, 8);
+          stack.push_back(Value::Bytes(Take(n)));
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          size_t n = Next();
+          stack.push_back(Value::Str(Take(n)));
+          break;
+        }
+        case 'X': {  // BINUNICODE
+          uint32_t n;
+          Read(&n, 4);
+          stack.push_back(Value::Str(Take(n)));
+          break;
+        }
+        case 0x8d: {  // BINUNICODE8
+          uint64_t n;
+          Read(&n, 8);
+          stack.push_back(Value::Str(Take(n)));
+          break;
+        }
+        case ')':
+          stack.push_back(Value::Tuple({}));
+          break;
+        case 0x85:
+        case 0x86:
+        case 0x87: {
+          size_t n = op - 0x84;
+          if (stack.size() < n) throw PickleError("short stack for TUPLEn");
+          std::vector<Value> items(stack.end() - n, stack.end());
+          stack.resize(stack.size() - n);
+          stack.push_back(Value::Tuple(std::move(items)));
+          break;
+        }
+        case 't': {  // TUPLE (to mark)
+          size_t m = PopMark(&marks, stack.size());
+          std::vector<Value> items(stack.begin() + m, stack.end());
+          stack.resize(m);
+          stack.push_back(Value::Tuple(std::move(items)));
+          break;
+        }
+        case ']':
+          stack.push_back(Value::List({}));
+          break;
+        case '}':
+          stack.push_back(Value::Dict());
+          break;
+        case '(':
+          marks.push_back(stack.size());
+          break;
+        case 'a': {  // APPEND
+          if (stack.size() < 2) throw PickleError("short stack for APPEND");
+          Value v = std::move(stack.back());
+          stack.pop_back();
+          stack.back().items.push_back(std::move(v));
+          break;
+        }
+        case 'e': {  // APPENDS
+          size_t m = PopMark(&marks, stack.size());
+          if (m == 0) throw PickleError("APPENDS without target");
+          Value& target = stack[m - 1];
+          for (size_t k = m; k < stack.size(); ++k) {
+            target.items.push_back(std::move(stack[k]));
+          }
+          stack.resize(m);
+          break;
+        }
+        case 's': {  // SETITEM
+          if (stack.size() < 3) throw PickleError("short stack for SETITEM");
+          Value v = std::move(stack.back());
+          stack.pop_back();
+          Value k = std::move(stack.back());
+          stack.pop_back();
+          stack.back().entries.emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': {  // SETITEMS
+          size_t m = PopMark(&marks, stack.size());
+          if (m == 0) throw PickleError("SETITEMS without target");
+          Value& target = stack[m - 1];
+          if ((stack.size() - m) % 2 != 0) {
+            throw PickleError("odd SETITEMS run");
+          }
+          for (size_t k = m; k + 1 < stack.size(); k += 2) {
+            target.entries.emplace_back(std::move(stack[k]),
+                                        std::move(stack[k + 1]));
+          }
+          stack.resize(m);
+          break;
+        }
+        case 0x94:  // MEMOIZE
+          if (stack.empty()) throw PickleError("MEMOIZE on empty stack");
+          memo_.push_back(stack.back());
+          break;
+        case 'q':  // BINPUT
+          Next();
+          if (stack.empty()) throw PickleError("BINPUT on empty stack");
+          memo_.push_back(stack.back());
+          break;
+        case 'r': {  // LONG_BINPUT
+          uint32_t n;
+          Read(&n, 4);
+          if (stack.empty()) throw PickleError("LONG_BINPUT empty stack");
+          memo_.push_back(stack.back());
+          break;
+        }
+        case 'h': {  // BINGET
+          size_t n = Next();
+          if (n >= memo_.size()) throw PickleError("BINGET out of range");
+          stack.push_back(memo_[n]);
+          break;
+        }
+        case 'j': {  // LONG_BINGET
+          uint32_t n;
+          Read(&n, 4);
+          if (n >= memo_.size()) throw PickleError("LONG_BINGET range");
+          stack.push_back(memo_[n]);
+          break;
+        }
+        default:
+          throw PickleError("unsupported pickle opcode " +
+                            std::to_string(static_cast<int>(op)) +
+                            " (cross-language values are limited to "
+                            "primitives/containers)");
+      }
+    }
+    throw PickleError("pickle ended without STOP");
+  }
+
+ private:
+  unsigned char Next() {
+    if (pos_ >= d_.size()) throw PickleError("truncated pickle");
+    return static_cast<unsigned char>(d_[pos_++]);
+  }
+  void Skip(size_t n) {
+    if (pos_ + n > d_.size()) throw PickleError("truncated pickle");
+    pos_ += n;
+  }
+  void Read(void* out, size_t n) {
+    if (pos_ + n > d_.size()) throw PickleError("truncated pickle");
+    std::memcpy(out, d_.data() + pos_, n);
+    pos_ += n;
+  }
+  std::string Take(size_t n) {
+    if (pos_ + n > d_.size()) throw PickleError("truncated pickle");
+    std::string out = d_.substr(pos_, n);
+    pos_ += n;
+    return out;
+  }
+  static size_t PopMark(std::vector<size_t>* marks, size_t fallback) {
+    if (marks->empty()) throw PickleError("no mark");
+    size_t m = marks->back();
+    marks->pop_back();
+    (void)fallback;
+    return m;
+  }
+
+  const std::string& d_;
+  size_t pos_ = 0;
+  std::vector<Value> memo_;
+};
+
+}  // namespace detail
+
+inline Value PickleLoads(const std::string& data) {
+  return detail::Unpickler(data).Load();
+}
+
+// ---------------------------------------------------------------------------
+// RTPU object framing (serialization.py: header <IBBHQ> + pickle)
+// ---------------------------------------------------------------------------
+
+inline std::string FrameObject(const Value& v) {
+  std::string pkl = PickleDumps(v);
+  std::string out;
+  uint32_t magic = 0x52545055;
+  out.append(reinterpret_cast<const char*>(&magic), 4);
+  out.push_back(1);   // version
+  out.push_back(0);   // flags
+  uint16_t nbufs = 0;
+  out.append(reinterpret_cast<const char*>(&nbufs), 2);
+  uint64_t len = pkl.size();
+  out.append(reinterpret_cast<const char*>(&len), 8);
+  out.append(pkl);
+  return out;
+}
+
+inline Value UnframeObject(const std::string& data) {
+  if (data.size() < 16) throw PickleError("short object frame");
+  uint32_t magic;
+  std::memcpy(&magic, data.data(), 4);
+  if (magic != 0x52545055) throw PickleError("bad object magic");
+  unsigned char flags = static_cast<unsigned char>(data[5]);
+  uint16_t nbufs;
+  std::memcpy(&nbufs, data.data() + 6, 2);
+  uint64_t pkl_len;
+  std::memcpy(&pkl_len, data.data() + 8, 8);
+  if (flags & 1) throw PickleError("result is a Python exception");
+  if (nbufs != 0) {
+    throw PickleError("result carries binary buffers (numpy?) — "
+                      "cross-language results must be plain values");
+  }
+  std::string pkl = data.substr(16 + 8ull * nbufs, pkl_len);
+  return PickleLoads(pkl);
+}
+
+// ---------------------------------------------------------------------------
+// RPC connection (frames over a blocking socket)
+// ---------------------------------------------------------------------------
+
+class RpcError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Connection {
+ public:
+  explicit Connection(const std::string& address, int timeout_s = 60) {
+    auto colon = address.rfind(':');
+    if (colon == std::string::npos) throw RpcError("bad address " + address);
+    std::string host = address.substr(0, colon);
+    std::string port = address.substr(colon + 1);
+
+    struct addrinfo hints = {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) {
+      throw RpcError("resolve failed: " + address);
+    }
+    fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      if (fd_ >= 0) close(fd_);
+      throw RpcError("connect failed: " + address);
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    struct timeval tv = {timeout_s, 0};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  ~Connection() {
+    if (fd_ >= 0) close(fd_);
+  }
+  Connection(const Connection&) = delete;
+  Connection& operator=(const Connection&) = delete;
+
+  Value Call(const std::string& service, const std::string& method,
+             const Value& kwargs) {
+    Value req = Value::Tuple(
+        {Value::Str(service), Value::Str(method), kwargs});
+    std::string payload = PickleDumps(req);
+    uint64_t req_id = ++req_counter_;
+    std::string frame;
+    uint32_t len = static_cast<uint32_t>(9 + payload.size());
+    frame.append(reinterpret_cast<const char*>(&len), 4);
+    frame.push_back(1);  // REQ
+    frame.append(reinterpret_cast<const char*>(&req_id), 8);
+    frame.append(payload);
+    SendAll(frame);
+
+    for (;;) {
+      std::string head = RecvExactly(13);
+      uint32_t flen;
+      std::memcpy(&flen, head.data(), 4);
+      unsigned char ftype = static_cast<unsigned char>(head[4]);
+      uint64_t rid;
+      std::memcpy(&rid, head.data() + 5, 8);
+      std::string body = RecvExactly(flen - 9);
+      if (ftype != 2 /*RES*/ || rid != req_id) continue;
+      Value reply = PickleLoads(body);
+      const Value* ok = reply.Get("ok");
+      if (ok == nullptr) throw RpcError("malformed reply");
+      if (!ok->IsTruthy()) {
+        // The error value is an arbitrary pickled exception; the
+        // traceback string is decodable.
+        const Value* tb = reply.Get("traceback");
+        throw RpcError(service + "." + method + " failed" +
+                       (tb != nullptr && tb->kind == Value::Kind::Str
+                            ? ":\n" + tb->s
+                            : ""));
+      }
+      const Value* result = reply.Get("result");
+      return result != nullptr ? *result : Value::None();
+    }
+  }
+
+ private:
+  void SendAll(const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = send(fd_, data.data() + off, data.size() - off, 0);
+      if (n <= 0) throw RpcError("send failed");
+      off += static_cast<size_t>(n);
+    }
+  }
+  std::string RecvExactly(size_t n) {
+    std::string out(n, '\0');
+    size_t off = 0;
+    while (off < n) {
+      ssize_t got = recv(fd_, out.data() + off, n - off, 0);
+      if (got <= 0) throw RpcError("recv failed / timeout");
+      off += static_cast<size_t>(got);
+    }
+    return out;
+  }
+
+  int fd_ = -1;
+  uint64_t req_counter_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Client: the public API
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  explicit Client(const std::string& gcs_address)
+      : gcs_(gcs_address), rng_(std::random_device{}()) {}
+
+  // ---- KV (ref: cpp/include/ray/api/ray_runtime.h KV surface) ----
+  void KvPut(const std::string& ns, const std::string& key,
+             const std::string& value) {
+    Value kw = Value::Dict();
+    kw.Set("namespace", Value::Str(ns));
+    kw.Set("key", Value::Bytes(key));
+    kw.Set("value", Value::Bytes(value));
+    gcs_.Call("KV", "put", kw);
+  }
+  bool KvGet(const std::string& ns, const std::string& key,
+             std::string* out) {
+    Value kw = Value::Dict();
+    kw.Set("namespace", Value::Str(ns));
+    kw.Set("key", Value::Bytes(key));
+    Value v = gcs_.Call("KV", "get", kw);
+    if (v.kind != Value::Kind::Bytes) return false;
+    *out = v.s;
+    return true;
+  }
+  void KvDel(const std::string& ns, const std::string& key) {
+    Value kw = Value::Dict();
+    kw.Set("namespace", Value::Str(ns));
+    kw.Set("key", Value::Bytes(key));
+    gcs_.Call("KV", "delete", kw);
+  }
+
+  // ---- introspection ----
+  Value Nodes() { return gcs_.Call("NodeInfo", "list_nodes", Value::Dict()); }
+  Value Actors() {
+    return gcs_.Call("ActorManager", "list_actors", Value::Dict());
+  }
+
+  // ---- tasks (lease -> push -> inline result) ----
+  Value SubmitTask(const std::string& registered_name,
+                   const std::vector<Value>& args,
+                   double num_cpus = 1.0) {
+    std::string fn_key;
+    if (!KvGet("xlang", registered_name, &fn_key)) {
+      throw RpcError("no cross-language function registered as '" +
+                     registered_name +
+                     "' (register with ray_tpu.register_cross_lang)");
+    }
+    std::string daemon_addr = PickDaemon();
+
+    // Lease a worker (ref: direct_task_transport.cc RequestNewWorker).
+    Value grant;
+    {
+      int hops = 0;
+      std::string addr = daemon_addr;
+      for (;;) {
+        Connection daemon(addr);
+        Value kw = Value::Dict();
+        Value demand = Value::Dict();
+        demand.Set("CPU", Value::Float(num_cpus));
+        kw.Set("demand", demand);
+        kw.Set("strategy", Value::Str("hybrid"));
+        kw.Set("affinity", Value::None());
+        kw.Set("soft", Value::Bool(false));
+        kw.Set("placement", Value::None());
+        kw.Set("runtime_env", Value::None());
+        grant = daemon.Call("NodeDaemon", "request_lease", kw);
+        const Value* spill = grant.Get("spill_to");
+        if (spill != nullptr && spill->kind == Value::Kind::Str) {
+          if (++hops > 8) throw RpcError("too many lease spillbacks");
+          addr = spill->s;
+          continue;
+        }
+        daemon_addr = addr;
+        break;
+      }
+    }
+    const Value* granted = grant.Get("granted");
+    if (granted == nullptr || !granted->IsTruthy()) {
+      const Value* err = grant.Get("error");
+      throw RpcError("lease refused" +
+                     (err != nullptr && err->kind == Value::Kind::Str
+                          ? ": " + err->s
+                          : ""));
+    }
+    std::string lease_id = grant.Get("lease_id")->s;
+    std::string worker_addr = grant.Get("worker_address")->s;
+
+    // Build the task spec (protocol.make_task_spec layout).
+    std::string task_id = RandomBytes(16);
+    Value spec = Value::Dict();
+    spec.Set("task_id", Value::Bytes(task_id));
+    spec.Set("fn_key", Value::Bytes(fn_key));
+    spec.Set("args_blob",
+             Value::Bytes(FrameObject(Value::Tuple(
+                 {Value::List(args), Value::Dict()}))));
+    spec.Set("num_returns", Value::Int(1));
+    spec.Set("caller_address", Value::Str("cpp-client"));
+    spec.Set("job_id", Value::Str("cpp"));
+    Value options = Value::Dict();
+    options.Set("max_retries", Value::Int(0));
+    options.Set("name", Value::Str(registered_name));
+    spec.Set("options", options);
+    spec.Set("actor_id", Value::None());
+    spec.Set("method_name", Value::Str(""));
+    spec.Set("seq", Value::Int(-1));
+    spec.Set("attempt", Value::Int(0));
+
+    Value result;
+    std::string error;
+    try {
+      Connection worker(worker_addr, 600);
+      Value kw = Value::Dict();
+      kw.Set("spec", spec);
+      Value reply = worker.Call("Worker", "execute_simple", kw);
+      const Value* ok = reply.Get("ok");
+      if (ok != nullptr && ok->IsTruthy()) {
+        result = UnframeObject(reply.Get("payload")->s);
+      } else {
+        const Value* repr = reply.Get("error_repr");
+        error = "task failed" +
+                (repr != nullptr ? ": " + repr->s : std::string());
+      }
+    } catch (const std::exception& e) {
+      error = e.what();
+    }
+    // Always hand the lease back.
+    try {
+      Connection daemon(daemon_addr);
+      Value kw = Value::Dict();
+      kw.Set("lease_id", Value::Str(lease_id));
+      daemon.Call("NodeDaemon", "return_lease", kw);
+    } catch (const std::exception&) {
+      // daemon will reap the lease on worker-idle timeout
+    }
+    if (!error.empty()) throw RpcError(error);
+    return result;
+  }
+
+ private:
+  std::string PickDaemon() {
+    Value nodes = Nodes();
+    for (const auto& n : nodes.items) {
+      const Value* alive = n.Get("alive");
+      if (alive != nullptr && alive->IsTruthy()) {
+        return n.Get("address")->s;
+      }
+    }
+    throw RpcError("no alive nodes");
+  }
+  std::string RandomBytes(size_t n) {
+    std::string out(n, '\0');
+    std::uniform_int_distribution<int> dist(0, 255);
+    for (auto& c : out) c = static_cast<char>(dist(rng_));
+    return out;
+  }
+
+  Connection gcs_;
+  std::mt19937_64 rng_;
+};
+
+}  // namespace ray_tpu

@@ -18,6 +18,7 @@ from ray_tpu.api import (
     kill,
     nodes,
     put,
+    register_cross_lang,
     remote,
     shutdown,
     wait,

@@ -182,6 +182,23 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     return ActorHandle(actor_id, name, TaskOptions(), [])
 
 
+def register_cross_lang(name: str, func) -> None:
+    """Expose a Python function to non-Python clients by name (ref: the
+    reference's cross-language function registry used by the C++/Java
+    worker APIs). The C++ client resolves `name` via the GCS KV and
+    submits tasks running `func` on Python workers."""
+    worker = _global_worker()
+    if hasattr(worker, "_export_function"):
+        # Canonical export path: dedup cache + overwrite=False.
+        key = worker._export_function(func)
+    else:  # local mode / thin client: direct KV export
+        from ray_tpu.core.distributed import protocol
+
+        key, blob = protocol.function_key(func)
+        worker.kv_put(b"fn", key, blob)
+    worker.kv_put(b"xlang", name.encode(), key)
+
+
 def cluster_resources() -> dict:
     return _global_worker().cluster_resources()
 

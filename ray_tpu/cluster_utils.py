@@ -29,12 +29,29 @@ class NodeHandle:
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[dict] = None):
-        self.gcs_proc, self.gcs_address = start_gcs_process()
+                 head_node_args: Optional[dict] = None,
+                 gcs_storage_dir: Optional[str] = None):
+        self.gcs_storage_dir = gcs_storage_dir
+        self.gcs_proc, self.gcs_address = start_gcs_process(
+            storage_dir=gcs_storage_dir)
         self.nodes: List[NodeHandle] = []
         self.head: Optional[NodeHandle] = None
         if initialize_head:
             self.head = self.add_node(**(head_node_args or {}))
+
+    def kill_gcs(self) -> None:
+        """Hard-kill the GCS (fault-injection); restart_gcs() brings it
+        back on the SAME port (ref: GCS fault-tolerance tests,
+        test_gcs_fault_tolerance.py)."""
+        self.gcs_proc.kill()
+        self.gcs_proc.wait(timeout=10)
+
+    def restart_gcs(self) -> None:
+        host, port = self.gcs_address.rsplit(":", 1)
+        self.gcs_proc, address = start_gcs_process(
+            host=host, port=int(port),
+            storage_dir=self.gcs_storage_dir)
+        assert address == self.gcs_address
 
     @property
     def address(self) -> str:

@@ -69,12 +69,14 @@ def _read_handshake(proc: subprocess.Popen, pattern: str,
                        f"{_HANDSHAKE_TIMEOUT}s")
 
 
-def start_gcs_process(host: str = "127.0.0.1",
-                      port: int = 0) -> tuple:
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.core.distributed.gcs_server",
-         "--host", host, "--port", str(port)],
-        stdout=subprocess.PIPE, stderr=None, env=child_env())
+def start_gcs_process(host: str = "127.0.0.1", port: int = 0,
+                      storage_dir: Optional[str] = None) -> tuple:
+    cmd = [sys.executable, "-m", "ray_tpu.core.distributed.gcs_server",
+           "--host", host, "--port", str(port)]
+    if storage_dir:
+        cmd += ["--storage-dir", storage_dir]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
+                            env=child_env())
     info = _read_handshake(proc, r"GCS_PORT=(?P<port>\d+)", "GCS server")
     return proc, f"{host}:{info['port']}"
 

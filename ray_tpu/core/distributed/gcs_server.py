@@ -64,10 +64,16 @@ class Pubsub:
 
 class KV:
     """Namespaced key-value store (ref: gcs InternalKV — used for the
-    function table, runtime env URIs, cluster metadata)."""
+    function table, runtime env URIs, cluster metadata). Durable when
+    the GCS runs with a storage dir (the function table must survive a
+    GCS restart or restarted actors cannot fetch their classes)."""
 
-    def __init__(self):
-        self._data: Dict[Tuple[str, bytes], bytes] = {}
+    def __init__(self, store=None):
+        from ray_tpu.core.distributed.gcs_storage import NullStore
+
+        self._store = store or NullStore()
+        self._data: Dict[Tuple[str, bytes], bytes] = dict(
+            self._store.all("kv"))
 
     def put(self, namespace: str, key: bytes, value: bytes,
             overwrite: bool = True) -> bool:
@@ -75,12 +81,14 @@ class KV:
         if not overwrite and k in self._data:
             return False
         self._data[k] = value
+        self._store.put("kv", k, value)
         return True
 
     def get(self, namespace: str, key: bytes) -> Optional[bytes]:
         return self._data.get((namespace, key))
 
     def delete(self, namespace: str, key: bytes) -> bool:
+        self._store.delete("kv", (namespace, key))
         return self._data.pop((namespace, key), None) is not None
 
     def keys(self, namespace: str, prefix: bytes = b"") -> List[bytes]:
@@ -199,11 +207,56 @@ class ActorManager:
     gcs_actor_scheduler.h). Creation flow: pick node → ask its daemon to
     start a dedicated worker → push the creation task → publish address."""
 
-    def __init__(self, gcs: "GcsServer"):
+    def __init__(self, gcs: "GcsServer", store=None):
+        from ray_tpu.core.distributed.gcs_storage import NullStore
+
         self._gcs = gcs
+        self._store = store or NullStore()
         self.actors: Dict[str, ActorRecord] = {}
         self.named: Dict[Tuple[str, str], str] = {}
         self._pending: asyncio.Queue = asyncio.Queue()
+        # Recovery (ref: GcsActorManager::Initialize reloading from
+        # storage): reload records; queued/restarting actors reschedule,
+        # ALIVE ones are revalidated once daemons re-register.
+        for rec_dict in self._store.all("actor").values():
+            rec = ActorRecord(**rec_dict)
+            self.actors[rec.actor_id] = rec
+            if rec.name and rec.state != ACTOR_DEAD:
+                self.named[(rec.namespace, rec.name)] = rec.actor_id
+
+    def requeue_loaded(self) -> None:
+        """Called once the event loop runs: resume scheduling of loaded
+        non-terminal actors and validate loaded ALIVE ones."""
+        for rec in self.actors.values():
+            if rec.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                self._pending.put_nowait(rec.actor_id)
+        alive = [r.actor_id for r in self.actors.values()
+                 if r.state == ACTOR_ALIVE]
+        if alive:
+            asyncio.ensure_future(self._validate_loaded(alive))
+
+    async def _validate_loaded(self, actor_ids: List[str]) -> None:
+        # Let daemons re-register first (their workers may be fine).
+        await asyncio.sleep(get_config().health_check_period_ms / 1000 * 2)
+        for aid in actor_ids:
+            rec = self.actors.get(aid)
+            if rec is None or rec.state != ACTOR_ALIVE:
+                continue
+            ok = False
+            try:
+                client = AsyncRpcClient(rec.worker_address)
+                try:
+                    reply = await client.call("Worker", "ping", timeout=5)
+                    ok = reply.get("actor_id") == rec.actor_id
+                finally:
+                    await client.close()
+            except Exception:  # noqa: BLE001
+                ok = False
+            if not ok:
+                self._handle_failure(rec, "worker lost while GCS was down")
+
+    def _persist(self, rec: ActorRecord) -> None:
+        self._store.put("actor", rec.actor_id, dataclasses.asdict(rec))
 
     # -- RPC surface ----------------------------------------------------
     async def create_actor(self, record: dict) -> dict:
@@ -216,6 +269,7 @@ class ActorManager:
                     f"'{rec.namespace}'")
             self.named[key] = rec.actor_id
         self.actors[rec.actor_id] = rec
+        self._persist(rec)
         await self._pending.put(rec.actor_id)
         return {"actor_id": rec.actor_id}
 
@@ -277,6 +331,9 @@ class ActorManager:
         self._publish(rec)
 
     def _publish(self, rec: ActorRecord) -> None:
+        # Every state transition flows through here: one persistence
+        # point keeps the durable record in lockstep.
+        self._persist(rec)
         self._gcs.pubsub.publish("actor", {
             "actor_id": rec.actor_id, "state": rec.state,
             "worker_address": rec.worker_address,
@@ -474,10 +531,52 @@ class PlacementGroupManager:
     the flagship use is slice-atomic gangs: one bundle per host of a slice,
     STRICT_PACK within an ICI domain."""
 
-    def __init__(self, gcs: "GcsServer"):
+    def __init__(self, gcs: "GcsServer", store=None):
+        from ray_tpu.core.distributed.gcs_storage import NullStore
+
         self._gcs = gcs
+        self._store = store or NullStore()
         self.groups: Dict[str, PgRecord] = {}
         self._pending: asyncio.Queue = asyncio.Queue()
+        for rec_dict in self._store.all("pg").values():
+            rec = PgRecord(**rec_dict)
+            self.groups[rec.pg_id] = rec
+
+    def requeue_loaded(self) -> None:
+        for rec in self.groups.values():
+            if rec.state == PG_PENDING:
+                self._pending.put_nowait(rec.pg_id)
+        created = [r.pg_id for r in self.groups.values()
+                   if r.state == PG_CREATED]
+        if created:
+            asyncio.ensure_future(self._validate_loaded(created))
+
+    async def _validate_loaded(self, pg_ids: List[str]) -> None:
+        """A loaded CREATED gang whose host died during the GCS outage
+        must re-form: the node never re-registers, so on_node_dead would
+        never fire for it (the PG analogue of actor revalidation)."""
+        await asyncio.sleep(get_config().health_check_period_ms / 1000
+                            * get_config().health_check_failure_threshold)
+        view = self._gcs.nodes.view
+        for pg_id in pg_ids:
+            rec = self.groups.get(pg_id)
+            if rec is None or rec.state != PG_CREATED:
+                continue
+            missing = [nid for nid in rec.nodes
+                       if nid not in view.nodes
+                       or not view.nodes[nid].alive]
+            if missing:
+                logger.warning(
+                    "pg %s lost node(s) %s during GCS outage; "
+                    "re-reserving the gang", pg_id[:8],
+                    [m[:8] for m in missing])
+                rec.state = PG_PENDING
+                rec.nodes = []
+                self._persist(rec)
+                self._pending.put_nowait(pg_id)
+
+    def _persist(self, rec: PgRecord) -> None:
+        self._store.put("pg", rec.pg_id, dataclasses.asdict(rec))
 
     async def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
                         strategy: str, name: Optional[str] = None,
@@ -485,6 +584,7 @@ class PlacementGroupManager:
         rec = PgRecord(pg_id=pg_id, bundles=bundles, strategy=strategy,
                        name=name, owner_job=owner_job, detached=detached)
         self.groups[pg_id] = rec
+        self._persist(rec)
         await self._pending.put(pg_id)
         return {"pg_id": pg_id}
 
@@ -523,6 +623,7 @@ class PlacementGroupManager:
                 pass
         rec.state = PG_REMOVED
         rec.nodes = []
+        self._persist(rec)
         return {"ok": True}
 
     def on_node_dead(self, node_id: str) -> None:
@@ -532,6 +633,7 @@ class PlacementGroupManager:
                 # slice loses a host => the slice's gang must re-form).
                 rec.state = PG_PENDING
                 rec.nodes = []
+                self._persist(rec)
                 self._pending.put_nowait(rec.pg_id)
 
     def on_job_finished(self, job_id: str) -> None:
@@ -595,6 +697,7 @@ class PlacementGroupManager:
             return True
         rec.nodes = placement
         rec.state = PG_CREATED
+        self._persist(rec)
         self._gcs.pubsub.publish("pg", {"pg_id": rec.pg_id,
                                         "state": PG_CREATED,
                                         "nodes": placement})
@@ -604,9 +707,12 @@ class PlacementGroupManager:
 class JobManager:
     """Driver/job registry (ref: gcs_job_manager.h)."""
 
-    def __init__(self, gcs: "GcsServer"):
+    def __init__(self, gcs: "GcsServer", store=None):
+        from ray_tpu.core.distributed.gcs_storage import NullStore
+
         self._gcs = gcs
-        self.jobs: Dict[str, dict] = {}
+        self._store = store or NullStore()
+        self.jobs: Dict[str, dict] = dict(self._store.all("job"))
 
     def register_job(self, job_id: str, driver_address: str,
                      metadata: Optional[dict] = None) -> dict:
@@ -615,6 +721,7 @@ class JobManager:
             "start_time": time.time(), "finished": False,
             "metadata": metadata or {},
         }
+        self._store.put("job", job_id, self.jobs[job_id])
         return {"ok": True}
 
     def finish_job(self, job_id: str) -> dict:
@@ -622,6 +729,7 @@ class JobManager:
         if job is not None:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._store.put("job", job_id, job)
         self._gcs.actors.on_job_finished(job_id)
         self._gcs.placement_groups.on_job_finished(job_id)
         return {"ok": True}
@@ -706,14 +814,22 @@ class AutoscalerStateManager:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_dir: Optional[str] = None):
+        from ray_tpu.core.distributed.gcs_storage import open_store
+
+        # Durable backend (ref: gcs_storage knob, ray_config_def.h:402):
+        # with a storage dir, KV/actors/PGs/jobs survive a GCS restart —
+        # daemons re-register via heartbeats and detached actors keep
+        # their names (the Redis-backed fault-tolerance story).
+        self.store = open_store(storage_dir)
         self.pubsub = Pubsub()
-        self.kv = KV()
+        self.kv = KV(self.store)
         self.nodes = NodeInfo(self)
-        self.actors = ActorManager(self)
+        self.actors = ActorManager(self, self.store)
         self.objects = ObjectDirectory(self)
-        self.placement_groups = PlacementGroupManager(self)
-        self.jobs = JobManager(self)
+        self.placement_groups = PlacementGroupManager(self, self.store)
+        self.jobs = JobManager(self, self.store)
         self.task_events = TaskEvents()
         self.autoscaler_state = AutoscalerStateManager(self)
         self.server = RpcServer(host, port)
@@ -746,6 +862,9 @@ class GcsServer:
             asyncio.ensure_future(self.actors.scheduling_loop()),
             asyncio.ensure_future(self.placement_groups.scheduling_loop()),
         ]
+        # Resume scheduling of state loaded from durable storage.
+        self.actors.requeue_loaded()
+        self.placement_groups.requeue_loaded()
         logger.info("GCS listening on %s", self.server.address)
         return port
 
@@ -753,6 +872,7 @@ class GcsServer:
         for t in self._tasks:
             t.cancel()
         await self.server.stop()
+        self.store.close()
 
 
 def main():
@@ -762,6 +882,8 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--storage-dir", default=None,
+                        help="durable state dir (GCS fault tolerance)")
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -769,7 +891,7 @@ def main():
         format="[gcs] %(asctime)s %(levelname)s %(message)s")
 
     async def run():
-        gcs = GcsServer(args.host, args.port)
+        gcs = GcsServer(args.host, args.port, storage_dir=args.storage_dir)
         port = await gcs.start()
         # Handshake: parent reads the bound port from stdout.
         print(f"GCS_PORT={port}", flush=True)

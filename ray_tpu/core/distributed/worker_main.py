@@ -485,6 +485,31 @@ class WorkerService:
                                error=repr(e))
             return {"results": [], "error": err}
 
+    async def execute_simple(self, spec: dict) -> dict:
+        """Cross-language task entry (ref: the C++ worker API's task
+        path, cpp/src/ray/runtime/task/): same execution as push_task
+        but the reply is a PLAIN dict of primitives — no dataclasses —
+        so non-Python clients with a minimal pickle codec can parse it.
+        The result payload is the framed serialization bytes."""
+        loop = asyncio.get_running_loop()
+        reply = await loop.run_in_executor(self._task_pool, self._execute,
+                                           spec)
+        err = reply.get("error")
+        if err is not None:
+            return {"ok": False, "error_repr": repr(err)}
+        r = reply["results"][0]
+        inline = r.inline
+        if inline is None:
+            buf = self.core.store.get_buffer(ObjectID(r.oid))
+            if buf is None:
+                return {"ok": False,
+                        "error_repr": "result evicted before reply"}
+            try:
+                inline = bytes(buf.view)
+            finally:
+                buf.release()
+        return {"ok": True, "payload": inline, "oid": r.oid}
+
     def ping(self) -> dict:
         return {"ok": True, "pid": os.getpid(),
                 "actor_id": self.actor_id}

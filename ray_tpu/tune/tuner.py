@@ -264,6 +264,34 @@ class Tuner:
                     and self._restored_trials is None
                     and spawned < tc.num_samples)
 
+        def drain_scheduler_transitions() -> None:
+            """Apply rung verdicts until none remain: stopping a trial
+            can complete ANOTHER rung (on_trial_complete cascades), so a
+            single pass could leave freshly-queued losers to be wrongly
+            force-resumed."""
+            if not hasattr(scheduler, "pending_transitions"):
+                return
+            while True:
+                resume_ids, stop_ids = scheduler.pending_transitions()
+                if not resume_ids and not stop_ids:
+                    return
+                by_id = {t.trial_id: t for t in trials}
+                for tid in stop_ids:
+                    trial = by_id.get(tid)
+                    if trial is not None and trial.state == "PAUSED":
+                        paused.remove(trial)
+                        trial.state = "STOPPED"
+                        scheduler.on_trial_complete(tid)
+                        if searcher is not None:
+                            # Also frees ConcurrencyLimiter slots.
+                            searcher.on_trial_complete(
+                                tid, trial.last_metrics)
+                for tid in resume_ids:
+                    trial = by_id.get(tid)
+                    if trial is not None and trial.state == "PAUSED":
+                        paused.remove(trial)
+                        launch(trial)
+
         fill_slots()
         while pending or running or paused or more_to_spawn():
             fill_slots()
@@ -273,27 +301,10 @@ class Tuner:
                 # an exhausted space): done.
                 break
             if not running and not pending and paused:
-                # Drain scheduler verdicts FIRST: a just-completed rung
-                # may have queued resumes/stops for these paused trials —
-                # force-resuming a queued loser would let it run to max_t
-                # and corrupt the rung accounting.
-                if hasattr(scheduler, "pending_transitions"):
-                    resume_ids, stop_ids = scheduler.pending_transitions()
-                    by_id = {t.trial_id: t for t in trials}
-                    for tid in stop_ids:
-                        trial = by_id.get(tid)
-                        if trial is not None and trial.state == "PAUSED":
-                            paused.remove(trial)
-                            trial.state = "STOPPED"
-                            scheduler.on_trial_complete(tid)
-                            if searcher is not None:
-                                searcher.on_trial_complete(
-                                    tid, trial.last_metrics)
-                    for tid in resume_ids:
-                        trial = by_id.get(tid)
-                        if trial is not None and trial.state == "PAUSED":
-                            paused.remove(trial)
-                            launch(trial)
+                # Apply all queued rung verdicts first — force-resuming
+                # a loser queued for STOP would let it run to max_t and
+                # corrupt the rung accounting.
+                drain_scheduler_transitions()
                 # Anything STILL paused is genuinely stranded (e.g. a
                 # rung that lost its stragglers to errors): resume it.
                 for trial in list(paused):
@@ -339,24 +350,7 @@ class Tuner:
                         trial.state = "TERMINATED"
                         done.append(trial)
             # Scheduler-driven pause transitions (sync HyperBand rungs).
-            if hasattr(scheduler, "pending_transitions"):
-                resume_ids, stop_ids = scheduler.pending_transitions()
-                by_id = {t.trial_id: t for t in trials}
-                for tid in stop_ids:
-                    trial = by_id.get(tid)
-                    if trial is not None and trial.state == "PAUSED":
-                        paused.remove(trial)
-                        trial.state = "STOPPED"
-                        scheduler.on_trial_complete(tid)
-                        if searcher is not None:
-                            # Also frees ConcurrencyLimiter slots.
-                            searcher.on_trial_complete(
-                                tid, trial.last_metrics)
-                for tid in resume_ids:
-                    trial = by_id.get(tid)
-                    if trial is not None and trial.state == "PAUSED":
-                        paused.remove(trial)
-                        launch(trial)
+            drain_scheduler_transitions()
             # PBT exploit/explore: restart bottom trials from a top trial.
             if isinstance(scheduler, PopulationBasedTraining):
                 by_id = {t.trial_id: t for t in trials}

@@ -1,0 +1,118 @@
+"""State API: programmatic cluster introspection.
+
+Analogue of the reference state SDK (ref: python/ray/util/state/api.py —
+list_tasks/list_actors/list_nodes/list_placement_groups/list_jobs,
+backed by the GCS task-event and registry tables; CLI in state_cli.py —
+ours is `ray-tpu list ...`). Each call is one GCS RPC through the
+ambient driver connection; `filters` are (key, predicate, value) tuples
+with predicate "=" or "!=", matching the reference surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+Filter = Tuple[str, str, Any]
+
+
+def _gcs():
+    from ray_tpu.api import _global_worker
+
+    return _global_worker().gcs
+
+
+def _apply_filters(rows: List[dict],
+                   filters: Optional[List[Filter]]) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, pred, value in filters:
+            have = row.get(key)
+            if pred == "=":
+                ok = have == value
+            elif pred == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported predicate {pred!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def list_nodes(filters: Optional[List[Filter]] = None,
+               limit: int = 10000) -> List[dict]:
+    rows = _gcs().call("NodeInfo", "list_nodes", timeout=30)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters: Optional[List[Filter]] = None,
+                limit: int = 10000) -> List[dict]:
+    rows = _gcs().call("ActorManager", "list_actors", timeout=30)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(filters: Optional[List[Filter]] = None,
+               limit: int = 10000) -> List[dict]:
+    rows = _gcs().call("TaskEvents", "list_events", limit=limit,
+                       timeout=30)
+    rows = [r for r in rows if r.get("kind") != "span"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters: Optional[List[Filter]] = None,
+                          limit: int = 10000) -> List[dict]:
+    rows = _gcs().call("PlacementGroups", "list_pgs", timeout=30)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(filters: Optional[List[Filter]] = None,
+              limit: int = 10000) -> List[dict]:
+    rows = _gcs().call("JobManager", "list_jobs", timeout=30)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_workers(filters: Optional[List[Filter]] = None,
+                 limit: int = 10000) -> List[dict]:
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    w = _global_worker()
+    rows: List[dict] = []
+    for n in list_nodes():
+        if not n["alive"]:
+            continue
+        client = SyncRpcClient(n["address"], w.loop_thread)
+        try:
+            for worker in client.call("NodeDaemon", "list_workers",
+                                      timeout=10):
+                worker["node_id"] = n["node_id"]
+                rows.append(worker)
+        except Exception:  # noqa: BLE001 node mid-restart
+            continue
+        finally:
+            client.close()
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Per-task-name state counts (ref: `ray summary tasks`)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in list_tasks():
+        name = t.get("name", "task")
+        state = t.get("state", "UNKNOWN")
+        summary.setdefault(name, {})
+        summary[name][state] = summary[name].get(state, 0) + 1
+    return summary
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    return _gcs().call("ActorManager", "get_actor", actor_id=actor_id,
+                       timeout=30)
+
+
+def cluster_status() -> dict:
+    """The autoscaler's view: demand, idle times, resource requests."""
+    return _gcs().call("AutoscalerState", "get_cluster_status", timeout=30)

@@ -1,0 +1,60 @@
+"""State API SDK (ref: python/ray/tests/test_state_api.py — list_*
+functions return live cluster state with filters)."""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def state_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_state_api_lists(state_cluster):
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def stask(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class SActor:
+        def ping(self):
+            return "ok"
+
+    a = SActor.options(name="state_actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    assert ray_tpu.get(stask.remote(1), timeout=60) == 2
+
+    nodes = state.list_nodes()
+    assert any(n["alive"] for n in nodes)
+    assert state.list_nodes(filters=[("alive", "=", True)])
+
+    actors = state.list_actors(filters=[("name", "=", "state_actor")])
+    assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks(filters=[("state", "=", "FINISHED")])
+        if any("stask" in t.get("name", "") for t in tasks):
+            break
+        time.sleep(0.3)
+    assert any("stask" in t.get("name", "") for t in tasks)
+
+    summary = state.summarize_tasks()
+    assert any("stask" in name for name in summary)
+
+    workers = state.list_workers()
+    assert workers and all("node_id" in w for w in workers)
+
+    jobs = state.list_jobs()
+    assert jobs
+
+    cs = state.cluster_status()
+    assert "nodes" in cs
+    ray_tpu.kill(a)
