@@ -344,6 +344,7 @@ class NodeDaemon:
     def list_workers(self) -> list:
         return [{"worker_id": h.worker_id, "pid": h.proc.pid,
                  "actor_id": h.actor_id, "busy": h.busy,
+                 "address": h.address,
                  "alive": h.proc.poll() is None}
                 for h in self._workers.values()]
 

@@ -510,6 +510,18 @@ class WorkerService:
                 buf.release()
         return {"ok": True, "payload": inline, "oid": r.oid}
 
+    async def profile(self, duration_s: float = 2.0,
+                      interval_s: float = 0.01) -> dict:
+        """On-demand stack sampling of this worker (ref: dashboard
+        py-spy profiling, reporter/profile_manager.py:75). Runs on a
+        sampler thread, so in-flight task execution keeps going and IS
+        what gets sampled."""
+        from ray_tpu.util.profiling import profile_here
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: profile_here(duration_s, interval_s))
+
     def ping(self) -> dict:
         return {"ok": True, "pid": os.getpid(),
                 "actor_id": self.actor_id}

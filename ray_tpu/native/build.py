@@ -22,6 +22,11 @@ def library_path() -> str:
 
     Cross-process safe: concurrent workers serialize on an flock and use
     per-pid temp names so a half-written .so is never published."""
+    override = os.environ.get("RAY_TPU_STORE_LIB")
+    if override:
+        # Instrumented builds (TSAN/ASAN via cmake -DSANITIZE=...) run
+        # the python suite against their own .so.
+        return override
     with _lock:
         if (os.path.exists(_LIB)
                 and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):

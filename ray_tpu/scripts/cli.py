@@ -227,6 +227,44 @@ def cmd_job(args) -> None:
                                 "ENTRYPOINT"]))
 
 
+def cmd_stack(gcs: _Gcs, args) -> None:
+    """Sample a live worker's stacks (ref: `ray stack` / dashboard
+    py-spy profiling). Target by worker-id prefix, or omit to sample
+    every worker on every node."""
+    from ray_tpu.util.profiling import render_report
+
+    for n in gcs.call("NodeInfo", "list_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            workers = gcs.daemon(n["address"]).call(
+                "NodeDaemon", "list_workers", timeout=10)
+        except Exception:  # noqa: BLE001
+            continue
+        for w in workers:
+            if args.worker and not w["worker_id"].startswith(args.worker):
+                continue
+            if not w.get("address"):
+                continue
+            print(f"== worker {w['worker_id'][:12]} pid={w['pid']} "
+                  f"on node {n['node_id'][:12]}")
+            try:
+                report = gcs.daemon(w["address"]).call(
+                    "Worker", "profile", duration_s=args.duration,
+                    timeout=args.duration + 30)
+                print(render_report(report))
+                if args.out:
+                    from ray_tpu.util.profiling import (
+                        write_flamegraph_collapsed,
+                    )
+
+                    path = f"{args.out}.{w['worker_id'][:12]}.collapsed"
+                    write_flamegraph_collapsed(report, path)
+                    print(f"collapsed stacks -> {path}")
+            except Exception as e:  # noqa: BLE001
+                print(f"  <unreachable: {e}>")
+
+
 def cmd_dashboard(args) -> None:
     """Serve the web dashboard for a running cluster (ref: `ray
     dashboard`, dashboard/head.py)."""
@@ -306,6 +344,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     dp = sub.add_parser("dashboard")
     dp.add_argument("--host", default="127.0.0.1")
     dp.add_argument("--port", type=int, default=8265)
+    kp = sub.add_parser("stack")
+    kp.add_argument("--worker", help="worker id prefix filter")
+    kp.add_argument("--duration", type=float, default=2.0)
+    kp.add_argument("--out", help="write collapsed flamegraph stacks")
     args = p.parse_args(argv)
 
     if args.cmd == "start":
@@ -319,7 +361,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
-     "metrics": cmd_metrics}[args.cmd](gcs, args)
+     "metrics": cmd_metrics, "stack": cmd_stack}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

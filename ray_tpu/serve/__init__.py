@@ -4,7 +4,8 @@ Controller-reconciled replica sets as named detached actors, power-of-two
 request routing, dynamic batching, HTTP ingress, request autoscaling.
 """
 from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
-                               http_port, run, shutdown, start_http_proxy,
+                               http_port, rpc_ingress_port, run, shutdown,
+                               start_http_proxy, start_rpc_ingress,
                                status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
@@ -17,7 +18,8 @@ __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "shutdown", "status", "delete",
     "get_deployment_handle", "get_app_handle",
-    "start_http_proxy", "http_port",
+    "start_http_proxy", "http_port", "start_rpc_ingress",
+    "rpc_ingress_port",
     "DeploymentHandle", "DeploymentResponse", "StreamingResponse",
     "multiplexed", "get_multiplexed_model_id",
     "batch",

@@ -51,30 +51,59 @@ def run(app: Application | Deployment, *, name: str = "default",
     return handle
 
 
+def _get_or_start_ingress(cached_handle, actor_cls_path: str,
+                          actor_name: str, host: str, port: int):
+    """Validate a cached detached ingress actor or start a fresh one
+    (shared by the HTTP proxy and the native RPC ingress). The cached
+    handle may belong to a previous cluster — a driver that shut down
+    without serve.shutdown() — so it is pinged before reuse. Returns
+    (handle, bound_port)."""
+    if cached_handle is not None:
+        try:
+            return cached_handle, ray_tpu.get(
+                cached_handle.port.remote(), timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+    import importlib
+
+    module, cls_name = actor_cls_path.rsplit(".", 1)
+    cls = getattr(importlib.import_module(module), cls_name)
+    handle = ray_tpu.remote(cls).options(
+        name=actor_name, lifetime="detached",
+        max_concurrency=32).remote(host, port)
+    return handle, ray_tpu.get(handle.port.remote(), timeout=30)
+
+
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
     """Start (or return) the node's HTTP proxy actor."""
     global _proxy_handle, _proxy_port
-    if _proxy_handle is not None:
-        # The cached handle may belong to a previous cluster (driver
-        # shut down without serve.shutdown()); validate before reuse.
-        try:
-            _proxy_port = ray_tpu.get(_proxy_handle.port.remote(),
-                                      timeout=5)
-        except Exception:  # noqa: BLE001
-            _proxy_handle = None
-            _proxy_port = None
-    if _proxy_handle is None:
-        from ray_tpu.serve.http_proxy import HTTPProxy
-
-        _proxy_handle = ray_tpu.remote(HTTPProxy).options(
-            name="serve:http_proxy", lifetime="detached",
-            max_concurrency=32).remote(host, port)
-        _proxy_port = ray_tpu.get(_proxy_handle.port.remote(), timeout=30)
+    _proxy_handle, _proxy_port = _get_or_start_ingress(
+        _proxy_handle, "ray_tpu.serve.http_proxy.HTTPProxy",
+        "serve:http_proxy", host, port)
     return _proxy_handle
 
 
 def http_port() -> Optional[int]:
     return _proxy_port
+
+
+_rpc_ingress_handle = None
+_rpc_ingress_port = None
+
+
+def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
+    """Start (or return) the native-protocol ingress actor (ref: the
+    gRPC proxy, serve/_private/proxy.py:533 — a binary ingress next to
+    HTTP for service-to-service calls)."""
+    global _rpc_ingress_handle, _rpc_ingress_port
+    _rpc_ingress_handle, _rpc_ingress_port = _get_or_start_ingress(
+        _rpc_ingress_handle, "ray_tpu.serve.rpc_ingress.RpcIngress",
+        "serve:rpc_ingress", host, port)
+    return _rpc_ingress_handle
+
+
+def rpc_ingress_port() -> Optional[int]:
+    return _rpc_ingress_port
 
 
 def get_deployment_handle(app_name: str = "default") -> DeploymentHandle:
@@ -99,6 +128,7 @@ def delete(app_name: str) -> None:
 
 def shutdown() -> None:
     global _proxy_handle, _proxy_port
+    global _rpc_ingress_handle, _rpc_ingress_port
     if _proxy_handle is not None:
         try:
             ray_tpu.get(_proxy_handle.stop.remote(), timeout=10)
@@ -107,6 +137,14 @@ def shutdown() -> None:
             pass
         _proxy_handle = None
         _proxy_port = None
+    if _rpc_ingress_handle is not None:
+        try:
+            ray_tpu.get(_rpc_ingress_handle.stop.remote(), timeout=10)
+            ray_tpu.kill(_rpc_ingress_handle)
+        except Exception:  # noqa: BLE001
+            pass
+        _rpc_ingress_handle = None
+        _rpc_ingress_port = None
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.shutdown.remote(), timeout=30)

@@ -1,0 +1,118 @@
+"""HuggingFace Transformers integration for ray_tpu.train.
+
+Analogue of the reference glue (ref: python/ray/train/huggingface/
+transformers/_transformers_utils.py — RayTrainReportCallback :30 bridges
+transformers' logging into train.report; prepare_trainer :104 wires the
+distributed context into the HF Trainer). Used inside a
+TorchTrainer/JaxTrainer train loop:
+
+    def train_loop(config):
+        trainer = transformers.Trainer(...)
+        trainer.add_callback(RayTrainReportCallback())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+
+try:
+    from transformers.trainer_callback import TrainerCallback
+except ImportError:  # pragma: no cover — transformers not installed
+    TrainerCallback = object
+
+
+class RayTrainReportCallback(TrainerCallback):
+    """Report HF Trainer logs (and checkpoints when HF saves one) to the
+    ray_tpu.train session (ref: _transformers_utils.py:30)."""
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if not logs:
+            return
+        from ray_tpu.train.session import report
+
+        metrics = {k: v for k, v in logs.items()
+                   if isinstance(v, (int, float))}
+        metrics.setdefault("step", state.global_step)
+        metrics.setdefault("epoch", float(state.epoch or 0))
+        try:
+            report(metrics)
+        except RuntimeError:
+            pass  # not inside a train session (plain HF run): no-op
+
+    def on_save(self, args, state, control, **kwargs):
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.train.session import report
+
+        ckpt_dir = os.path.join(
+            args.output_dir, f"checkpoint-{state.global_step}")
+        if not os.path.isdir(ckpt_dir):
+            return
+        try:
+            report({"step": state.global_step,
+                    "checkpoint_saved": 1.0},
+                   checkpoint=Checkpoint(ckpt_dir))
+        except RuntimeError:
+            pass
+
+
+def prepare_trainer(trainer: Any) -> Any:
+    """Wire the distributed session context into an HF Trainer (ref:
+    _transformers_utils.py:104): world size/rank come from the gang, and
+    non-rank-0 workers silence their progress bars."""
+    from ray_tpu.train.session import get_context
+
+    ctx = get_context()
+    try:
+        rank = ctx.get_world_rank()
+        world = ctx.get_world_size()
+    except RuntimeError:
+        return trainer  # not inside a train session
+    if rank != 0:
+        # Progress/report callbacks are resolved inside Trainer.__init__
+        # — mutating trainer.args after the fact does nothing; the
+        # callbacks themselves must go (one progress bar / one wandb run
+        # per gang, not per worker).
+        try:
+            from transformers.trainer_callback import (
+                PrinterCallback,
+                ProgressCallback,
+            )
+
+            trainer.remove_callback(ProgressCallback)
+            trainer.remove_callback(PrinterCallback)
+            from transformers.integrations import (
+                get_reporting_integration_callbacks,
+            )
+
+            for cb_cls in get_reporting_integration_callbacks(
+                    trainer.args.report_to):
+                trainer.remove_callback(cb_cls)
+        except Exception:  # noqa: BLE001 transformers-version drift
+            pass
+        trainer.args.disable_tqdm = True
+        if world > 1:
+            # Per-worker output dirs: concurrent gang members must not
+            # race on one checkpoint directory.
+            trainer.args.output_dir = os.path.join(
+                tempfile.gettempdir(), f"hf_worker_{rank}")
+    return trainer
+
+
+def prepare_model(model: Any, device: Optional[str] = None) -> Any:
+    """Torch-model preparation inside a gang (ref: train/torch/
+    train_loop_utils.py:158 prepare_model — DDP/FSDP wrap). Under the
+    torch-gloo backend the process group is already initialized by the
+    JaxTrainer/TorchTrainer backend; this wraps in DDP when distributed
+    is live, else returns the model unchanged."""
+    import torch
+
+    if torch.distributed.is_available() \
+            and torch.distributed.is_initialized() \
+            and torch.distributed.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model

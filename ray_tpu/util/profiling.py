@@ -1,0 +1,75 @@
+"""On-demand CPU profiling of live workers: a py-spy-lite.
+
+Analogue of the reference's dashboard profiling
+(ref: dashboard/modules/reporter/profile_manager.py:75
+CpuProfilingManager — attaches py-spy to a worker PID on demand). py-spy
+isn't in this image, so the equivalent samples the target process's own
+thread stacks via sys._current_frames() from a sampler thread inside the
+worker (workers expose it as the `profile` RPC). Output: collapsed
+flamegraph lines ("a;b;c count") and a top-of-stacks summary — the same
+artifacts a py-spy `record --format raw` run produces.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+
+def sample_stacks(duration_s: float = 2.0, interval_s: float = 0.01,
+                  exclude_thread: Optional[int] = None) -> Dict[str, int]:
+    """Sample all threads' stacks for `duration_s`; returns collapsed
+    stack -> count (root;...;leaf, frames as module:function:line)."""
+    counts: Counter = Counter()
+    deadline = time.monotonic() + duration_s
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me or tid == exclude_thread:
+                continue
+            parts: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                             f"{code.co_name}:{f.f_lineno}")
+                f = f.f_back
+            counts[";".join(reversed(parts))] += 1
+        time.sleep(interval_s)
+    return dict(counts)
+
+
+def profile_here(duration_s: float = 2.0,
+                 interval_s: float = 0.01) -> dict:
+    """Sample from the CALLING thread (which excludes itself): no helper
+    thread, or its join() would show up at ~100% of samples."""
+    stacks = sample_stacks(duration_s, interval_s)
+    total = sum(stacks.values()) or 1
+    leaves: Counter = Counter()
+    for stack, n in stacks.items():
+        leaves[stack.rsplit(";", 1)[-1]] += n
+    return {
+        "samples": total,
+        "stacks": stacks,                       # collapsed flamegraph
+        "top": leaves.most_common(20),
+        "duration_s": duration_s,
+    }
+
+
+def render_report(report: dict) -> str:
+    lines = [f"{report['samples']} samples over "
+             f"{report['duration_s']:.1f}s"]
+    lines.append("top frames (leaf, % of samples):")
+    for frame, n in report["top"]:
+        lines.append(f"  {100.0 * n / report['samples']:5.1f}%  {frame}")
+    return "\n".join(lines)
+
+
+def write_flamegraph_collapsed(report: dict, path: str) -> str:
+    """Collapsed-stack file for flamegraph.pl / speedscope import."""
+    with open(path, "w") as f:
+        for stack, n in sorted(report["stacks"].items()):
+            f.write(f"{stack} {n}\n")
+    return path

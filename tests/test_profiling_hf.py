@@ -1,0 +1,144 @@
+"""On-demand worker profiling + serve RPC ingress + HF train glue
+(ref: dashboard/modules/reporter profiling tests; serve gRPC proxy
+tests; train/tests/test_transformers_*)."""
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def prof_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_profile_running_worker(prof_cluster):
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+    from ray_tpu.util.profiling import render_report
+
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self, seconds):
+            import time
+
+            end = time.time() + seconds
+            total = 0
+            while time.time() < end:
+                total += sum(range(200))  # hot loop to sample
+            return total
+
+    s = Spinner.remote()
+    ref = s.spin.remote(4.0)
+    time.sleep(0.5)
+
+    w = _global_worker()
+    info = w.gcs.call("ActorManager", "get_actor",
+                      actor_id=s._actor_id.hex(), timeout=10)
+    client = SyncRpcClient(info["worker_address"], w.loop_thread)
+    report = client.call("Worker", "profile", duration_s=1.0, timeout=40)
+    assert report["samples"] > 10
+    text = render_report(report)
+    # The hot method dominates the samples.
+    assert "spin" in text
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_cli_stack_command(prof_cluster, capsys):
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.scripts.cli import main as cli_main
+
+    @ray_tpu.remote
+    def busy():
+        import time
+
+        t = time.time()
+        while time.time() - t < 3:
+            pass
+        return 1
+
+    ref = busy.remote()
+    time.sleep(0.5)
+    cli_main(["--address", _global_worker().gcs_address, "stack",
+              "--duration", "0.5"])
+    out = capsys.readouterr().out
+    assert "samples over" in out
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_serve_rpc_ingress(prof_cluster):
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def describe(self, name):
+            return f"doubler:{name}"
+
+    serve.run(serve.deployment(Doubler).bind(), name="doubler",
+              route_prefix=None)
+    serve.start_rpc_ingress()
+    port = serve.rpc_ingress_port()
+    assert port
+
+    w = _global_worker()
+    client = SyncRpcClient(f"127.0.0.1:{port}", w.loop_thread)
+    assert client.call("ServeIngress", "invoke", app="doubler",
+                       args=(21,), timeout=60) == 42
+    assert client.call("ServeIngress", "invoke", app="doubler",
+                       target_method="describe", args=("x",),
+                       timeout=60) == "doubler:x"
+    serve.delete("doubler")
+
+
+def test_hf_report_callback_outside_session_is_noop():
+    transformers = pytest.importorskip("transformers")
+    from ray_tpu.train.huggingface import RayTrainReportCallback
+
+    cb = RayTrainReportCallback()
+
+    class FakeState:
+        global_step = 3
+        epoch = 1.0
+
+    # No active session: must not raise.
+    cb.on_log(None, FakeState(), None, logs={"loss": 0.5})
+
+
+def test_hf_report_callback_reports_into_session(tmp_path):
+    pytest.importorskip("transformers")
+    from ray_tpu.train.huggingface import RayTrainReportCallback
+    from ray_tpu.train.session import (
+        TrainSession,
+        install_session,
+        uninstall_session,
+    )
+
+    session = TrainSession(world_rank=0, world_size=1, local_rank=0,
+                           trial_dir=str(tmp_path), latest_checkpoint=None,
+                           experiment_name="hf")
+    install_session(session)
+    try:
+        cb = RayTrainReportCallback()
+
+        class FakeState:
+            global_step = 7
+            epoch = 2.0
+
+        cb.on_log(None, FakeState(), None,
+                  logs={"loss": 0.25, "ignored": "str"})
+        item = session.results.get_nowait()
+        assert item["metrics"]["loss"] == 0.25
+        assert item["metrics"]["step"] == 7
+        assert "ignored" not in item["metrics"]
+    finally:
+        uninstall_session()
