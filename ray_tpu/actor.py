@@ -46,8 +46,13 @@ class ActorMethod:
         worker = _global_worker()
         opts = getattr(self, "_call_options", None)
         if opts is None:
-            opts = dataclasses.replace(self._handle._options,
-                                       num_returns=self._num_returns)
+            # Cached: dataclasses.replace per call is measurable on the
+            # submission hot path, and the defaults never change.
+            opts = getattr(self, "_default_options", None)
+            if opts is None:
+                opts = dataclasses.replace(self._handle._options,
+                                           num_returns=self._num_returns)
+                self._default_options = opts
         refs = worker.submit_actor_task(
             self._handle._actor_id, self._method_name, list(args),
             dict(kwargs), opts)
@@ -72,7 +77,12 @@ class ActorHandle:
     def __getattr__(self, item: str) -> ActorMethod:
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        # Cache on the instance: __getattr__ only fires on a miss, so
+        # repeated `handle.method` calls reuse one ActorMethod (and its
+        # cached options) instead of allocating per call.
+        method = ActorMethod(self, item)
+        self.__dict__[item] = method
+        return method
 
     def __repr__(self) -> str:
         return f"ActorHandle({self._cls_name}, {self._actor_id.hex()[:16]})"

@@ -8,8 +8,30 @@ so that lineage reconstruction can recompute them.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import os
+import struct
 from typing import ClassVar
+
+# ID generation is on the task-submission hot path; an os.urandom
+# syscall per ID costs ~10x a counter. Uniqueness: an 8-byte per-process
+# random prefix (re-drawn after fork) + a monotonically increasing
+# counter, padded/truncated to the ID size.
+_id_prefix: bytes = b""
+_id_prefix_pid: int = -1
+_id_counter = itertools.count()
+
+
+def _fast_random_bytes(size: int) -> bytes:
+    if size < 12:
+        return os.urandom(size)  # too small for prefix+counter
+    global _id_prefix, _id_prefix_pid
+    pid = os.getpid()
+    if pid != _id_prefix_pid:
+        _id_prefix = os.urandom(16)
+        _id_prefix_pid = pid
+    return (_id_prefix[:size - 8]
+            + struct.pack("<Q", next(_id_counter)))
 
 
 class BaseID:
@@ -26,7 +48,7 @@ class BaseID:
 
     @classmethod
     def generate(cls) -> "BaseID":
-        return cls(os.urandom(cls.SIZE))
+        return cls(_fast_random_bytes(cls.SIZE))
 
     @classmethod
     def nil(cls) -> "BaseID":

@@ -39,7 +39,22 @@ def _align(n: int) -> int:
 def serialize(obj: Any, *, is_error: bool = False) -> Tuple[bytes, List[memoryview]]:
     """Serialize to (header+pickle bytes, out-of-band buffers)."""
     buffers: List[pickle.PickleBuffer] = []
-    pkl = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    try:
+        # Plain pickle first: the C pickler is ~10x cloudpickle and
+        # handles the common case (task args/results are data, not
+        # code). Two fallbacks to cloudpickle: objects plain pickle
+        # can't do at all (closures/lambdas raise), and anything pickled
+        # BY REFERENCE into __main__ — resolvable on this driver but not
+        # in a worker process, where cloudpickle's by-value pickling is
+        # required (same split cloudpickle itself makes).
+        pkl = pickle.dumps(obj, protocol=5,
+                           buffer_callback=buffers.append)
+        if b"__main__" in pkl or b"__mp_main__" in pkl:
+            raise ValueError("main-module reference")
+    except Exception:  # noqa: BLE001
+        buffers.clear()
+        pkl = cloudpickle.dumps(obj, protocol=5,
+                                buffer_callback=buffers.append)
     views = [b.raw() for b in buffers]
     flags = FLAG_ERROR if is_error else 0
     head = _HEADER.pack(MAGIC, 1, flags, len(views), len(pkl))

@@ -29,6 +29,12 @@ class RpcIngress:
         self._handles: Dict[str, object] = {}
         self._executor = ThreadPoolExecutor(max_workers=executor_threads,
                                             thread_name_prefix="ingress")
+        # Streams park a thread in next() for their whole lifetime: a
+        # separate pool keeps slow streams from starving unary invokes
+        # (same split as http_proxy's _stream_executor).
+        self._stream_executor = ThreadPoolExecutor(
+            max_workers=executor_threads,
+            thread_name_prefix="ingress-stream")
         self._host = host
         self._want_port = port
         self._port: Optional[int] = None
@@ -94,13 +100,13 @@ class _IngressService:
             handle = handle.options(method_name=target_method)
         loop = asyncio.get_running_loop()
         stream = await loop.run_in_executor(
-            self._ingress._executor,
+            self._ingress._stream_executor,
             lambda: handle.remote_streaming(*args, **(kwargs or {})))
         it = iter(stream)
         try:
             while True:
                 item = await loop.run_in_executor(
-                    self._ingress._executor,
+                    self._ingress._stream_executor,
                     lambda: next(it, _SENTINEL))
                 if item is _SENTINEL:
                     return

@@ -55,6 +55,9 @@ class RunConfig:
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # Experiment callbacks (ref: RunConfig.callbacks + air/integrations):
+    # tune.callbacks.Callback instances invoked by the Tuner loop.
+    callbacks: list = dataclasses.field(default_factory=list)
     verbose: int = 1
 
     def resolve_storage(self) -> str:

@@ -95,9 +95,11 @@ def prepare_trainer(trainer: Any) -> Any:
         trainer.args.disable_tqdm = True
         if world > 1:
             # Per-worker output dirs: concurrent gang members must not
-            # race on one checkpoint directory.
-            trainer.args.output_dir = os.path.join(
-                tempfile.gettempdir(), f"hf_worker_{rank}")
+            # race on one checkpoint directory; mkdtemp (not a fixed
+            # /tmp path) so concurrent jobs on one host don't collide
+            # with each other either.
+            trainer.args.output_dir = tempfile.mkdtemp(
+                prefix=f"hf_worker_{rank}_")
     return trainer
 
 
@@ -109,6 +111,8 @@ def prepare_model(model: Any, device: Optional[str] = None) -> Any:
     is live, else returns the model unchanged."""
     import torch
 
+    if device is not None:
+        model = model.to(device)
     if torch.distributed.is_available() \
             and torch.distributed.is_initialized() \
             and torch.distributed.get_world_size() > 1:

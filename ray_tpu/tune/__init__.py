@@ -13,6 +13,10 @@ from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, TPESearcher, choice, grid_search,
                                  loguniform, quniform, randint, sample_from,
                                  uniform)
+from ray_tpu.tune.callbacks import (Callback, CSVLoggerCallback,
+                                    JsonLoggerCallback,
+                                    MLflowLoggerCallback,
+                                    WandbLoggerCallback)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 ASHAScheduler = AsyncHyperBandScheduler
@@ -26,4 +30,6 @@ __all__ = [
     "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
     "Searcher", "BasicVariantGenerator", "TPESearcher",
     "ConcurrencyLimiter",
+    "Callback", "JsonLoggerCallback", "CSVLoggerCallback",
+    "WandbLoggerCallback", "MLflowLoggerCallback",
 ]

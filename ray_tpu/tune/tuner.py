@@ -324,6 +324,12 @@ class Tuner:
                         trial.checkpoint = item["checkpoint"]
                     if searcher is not None:
                         searcher.on_trial_result(trial.trial_id, m)
+                    for cb in self.run_config.callbacks:
+                        try:
+                            cb.on_trial_result(trial.trial_id,
+                                               trial.config, m)
+                        except Exception:  # noqa: BLE001 logging must
+                            pass           # never kill the experiment
                     decision = scheduler.on_result(trial.trial_id, m)
                     if decision == STOP and trial.state == "RUNNING":
                         trial.state = "STOPPED"
@@ -378,6 +384,13 @@ class Tuner:
                 if searcher is not None:
                     searcher.on_trial_complete(trial.trial_id,
                                                trial.last_metrics)
+                for cb in self.run_config.callbacks:
+                    try:
+                        cb.on_trial_complete(trial.trial_id, trial.config,
+                                             trial.last_metrics,
+                                             trial.error)
+                    except Exception:  # noqa: BLE001
+                        pass
                 if trial.actor is not None:
                     try:
                         ray_tpu.kill(trial.actor)
@@ -395,4 +408,10 @@ class Tuner:
             metrics["config"] = t.config
             results.append(Result(metrics=metrics, checkpoint=ckpt,
                                   error=err, metrics_history=t.history))
-        return ResultGrid(results, trials)
+        grid = ResultGrid(results, trials)
+        for cb in self.run_config.callbacks:
+            try:
+                cb.on_experiment_end(grid)
+            except Exception:  # noqa: BLE001
+                pass
+        return grid

@@ -9,13 +9,16 @@ same DAG and loads any step whose result is already durable, re-executing
 only the missing suffix.
 """
 from ray_tpu.workflow.api import (
+    catch,
+    event,
     get_output,
     get_status,
     list_all,
     resume,
     run,
     run_async,
+    send_event,
 )
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all"]
+           "list_all", "event", "send_event", "catch"]

@@ -40,6 +40,8 @@ def _step_key(node: DAGNode, topo_index: int) -> str:
         name = "input"
     elif isinstance(node, MultiOutputNode):
         name = "output"
+    elif isinstance(node, EventNode):
+        name = f"event-{node.event_name}"
     return f"{topo_index:04d}_{name}"
 
 
@@ -92,6 +94,19 @@ class _WorkflowRun:
                        "error": error, "ts": time.time()}, f)
 
     # -- execution ---------------------------------------------------------
+    def _wait_event(self, node: "EventNode") -> Any:
+        path = os.path.join(self.dir, "events", f"{node.event_name}.pkl")
+        deadline = (None if node.timeout_s is None
+                    else time.monotonic() + node.timeout_s)
+        while not os.path.exists(path):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"workflow event {node.event_name!r} not delivered "
+                    f"within {node.timeout_s}s")
+            time.sleep(node.poll_s)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
     def execute(self, *input_args, **input_kwargs) -> Any:
         import ray_tpu
 
@@ -111,6 +126,11 @@ class _WorkflowRun:
                 if stored is not None:
                     value = stored["value"]
                 else:
+                    if isinstance(node, EventNode):
+                        value = self._wait_event(node)
+                        self._save_step(node, {"value": value})
+                        cache[key] = value
+                        return value
                     args = [run_node(a) if isinstance(a, DAGNode) else a
                             for a in node._bound_args]
                     kwargs = {k: (run_node(v) if isinstance(v, DAGNode)
@@ -118,7 +138,15 @@ class _WorkflowRun:
                               for k, v in node._bound_kwargs.items()}
                     if isinstance(node, FunctionNode):
                         ref = node._rf.remote(*args, **kwargs)
-                        value = ray_tpu.get(ref)
+                        if getattr(node, "_wf_catch", False):
+                            # catch_exceptions semantics: failures are
+                            # data, not workflow aborts.
+                            try:
+                                value = (ray_tpu.get(ref), None)
+                            except BaseException as e:  # noqa: BLE001
+                                value = (None, repr(e))
+                        else:
+                            value = ray_tpu.get(ref)
                     else:
                         raise TypeError(
                             f"workflows support function DAGs; got "
@@ -141,6 +169,48 @@ class _WorkflowRun:
 
 _live_runs: Dict[str, Future] = {}
 _lock = threading.Lock()
+
+
+class EventNode(DAGNode):
+    """Durable external-event wait (ref: workflow/event_listener.py +
+    http_event_provider.py): execution blocks at this node until
+    `send_event(workflow_id, name, payload)` delivers; the payload is
+    checkpointed like any step, so a resumed run does not re-wait."""
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None,
+                 poll_s: float = 0.2):
+        super().__init__((), {})
+        self.event_name = name
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+    def _execute_impl(self, cache, input_args, input_kwargs):
+        raise TypeError("EventNode only executes inside workflow.run()")
+
+
+def event(name: str, timeout_s: Optional[float] = None) -> EventNode:
+    """A DAG node that waits for a named external event."""
+    return EventNode(name, timeout_s)
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None,
+               storage: Optional[str] = None) -> None:
+    """Deliver an event to a (possibly running) workflow: cross-process
+    via the workflow's durable storage dir."""
+    d = os.path.join(_wf_dir(workflow_id, storage), "events")
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{name}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, os.path.join(d, f"{name}.pkl"))
+
+
+def catch(node: DAGNode) -> DAGNode:
+    """Mark a step so failures become values: downstream receives
+    (result, None) on success or (None, error_repr) on failure (ref:
+    workflow step option catch_exceptions)."""
+    node._wf_catch = True  # type: ignore[attr-defined]
+    return node
 
 
 def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,

@@ -34,11 +34,18 @@ def test_profile_running_worker(prof_cluster):
 
     s = Spinner.remote()
     ref = s.spin.remote(4.0)
-    time.sleep(0.5)
 
     w = _global_worker()
-    info = w.gcs.call("ActorManager", "get_actor",
-                      actor_id=s._actor_id.hex(), timeout=10)
+    deadline = time.monotonic() + 60
+    info = {}
+    while time.monotonic() < deadline:
+        info = w.gcs.call("ActorManager", "get_actor",
+                          actor_id=s._actor_id.hex(), timeout=10) or {}
+        if info.get("worker_address"):
+            break
+        time.sleep(0.2)
+    assert info.get("worker_address"), info
+    time.sleep(0.3)  # let spin() start executing
     client = SyncRpcClient(info["worker_address"], w.loop_thread)
     report = client.call("Worker", "profile", duration_s=1.0, timeout=40)
     assert report["samples"] > 10

@@ -1,0 +1,118 @@
+"""Workflow events/catch + tune experiment callbacks (ref: workflow
+event tests, tune logger tests)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def wf_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_workflow_event_delivery(wf_cluster, tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def combine(x, approval):
+        return {"x": x, "approved": approval["ok"]}
+
+    with InputNode() as inp:
+        dag = combine.bind(inp, workflow.event("approval", timeout_s=60))
+
+    def deliver():
+        time.sleep(0.8)
+        workflow.send_event("evt_wf", "approval", {"ok": True},
+                            storage=str(tmp_path))
+
+    threading.Thread(target=deliver, daemon=True).start()
+    t0 = time.monotonic()
+    out = workflow.run(dag, 5, workflow_id="evt_wf",
+                       storage=str(tmp_path))
+    assert out == {"x": 5, "approved": True}
+    assert time.monotonic() - t0 >= 0.7  # actually waited
+
+    # Resume does not re-wait: the event result is durable.
+    t0 = time.monotonic()
+    out2 = workflow.resume("evt_wf", dag, 5, storage=str(tmp_path))
+    assert out2 == {"x": 5, "approved": True}
+    assert time.monotonic() - t0 < 0.7
+
+
+def test_workflow_event_timeout(wf_cluster, tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def use(e):
+        return e
+
+    dag = use.bind(workflow.event("never", timeout_s=0.5))
+    with pytest.raises(TimeoutError):
+        workflow.run(dag, workflow_id="evt_to", storage=str(tmp_path))
+
+
+def test_workflow_catch_exceptions(wf_cluster, tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("wf boom")
+
+    @ray_tpu.remote
+    def handle(pair):
+        value, err = pair
+        return f"recovered:{err is not None}"
+
+    dag = handle.bind(workflow.catch(boom.bind()))
+    out = workflow.run(dag, workflow_id="catch_wf", storage=str(tmp_path))
+    assert out == "recovered:True"
+
+
+def test_tune_logger_callbacks(wf_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    exp_dir = str(tmp_path / "cb_exp")
+
+    def objective(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="cb_exp",
+            callbacks=[tune.JsonLoggerCallback(exp_dir),
+                       tune.CSVLoggerCallback(exp_dir)]),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    for trial_id in ("trial_0000", "trial_0001"):
+        jpath = os.path.join(exp_dir, trial_id, "result.json")
+        lines = [json.loads(line) for line in open(jpath)]
+        assert len(lines) == 3
+        assert "score" in lines[0]
+        cpath = os.path.join(exp_dir, trial_id, "progress.csv")
+        assert "score" in open(cpath).readline()
+
+
+def test_gated_trackers_raise_helpfully():
+    from ray_tpu import tune
+
+    with pytest.raises(ImportError, match="wandb"):
+        tune.WandbLoggerCallback(project="x")
+    with pytest.raises(ImportError, match="mlflow"):
+        tune.MLflowLoggerCallback()
