@@ -113,6 +113,9 @@ class NodeInfo:
             labels=labels or {})
         logger.info("node %s registered at %s resources=%s", node_id[:8],
                     address, resources)
+        self._gcs.event_log.emit("node", "INFO",
+                                 f"node {node_id[:8]} registered",
+                                 node_id=node_id, address=address)
         self._gcs.pubsub.publish(
             "node", {"event": "added", "node_id": node_id,
                      "address": address, "resources": resources,
@@ -154,6 +157,9 @@ class NodeInfo:
             return {"ok": False}
         n.alive = False
         logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        self._gcs.event_log.emit("node", "WARNING",
+                                 f"node {node_id[:8]} dead: {reason}",
+                                 node_id=node_id, reason=reason)
         self._gcs.pubsub.publish(
             "node", {"event": "dead", "node_id": node_id, "reason": reason})
         self._gcs.actors.on_node_dead(node_id)
@@ -323,6 +329,10 @@ class ActorManager:
 
     # -- internals ------------------------------------------------------
     def _mark_dead(self, rec: ActorRecord, reason: str) -> None:
+        self._gcs.event_log.emit(
+            "actor", "WARNING",
+            f"actor {rec.actor_id[:8]} ({rec.cls_name}) dead: {reason}",
+            actor_id=rec.actor_id, reason=reason)
         rec.state = ACTOR_DEAD
         rec.death_reason = reason
         rec.worker_address = ""
@@ -351,6 +361,11 @@ class ActorManager:
             self._pending.put_nowait(rec.actor_id)
             logger.info("actor %s restarting (%d/%s)", rec.actor_id[:8],
                         rec.restarts_used, rec.max_restarts)
+            self._gcs.event_log.emit(
+                "actor", "WARNING",
+                f"actor {rec.actor_id[:8]} restarting "
+                f"({rec.restarts_used}/{rec.max_restarts}): {reason}",
+                actor_id=rec.actor_id)
         else:
             self._mark_dead(rec, reason)
 
@@ -634,6 +649,10 @@ class PlacementGroupManager:
                 rec.state = PG_PENDING
                 rec.nodes = []
                 self._persist(rec)
+                self._gcs.event_log.emit(
+                    "placement_group", "WARNING",
+                    f"pg {rec.pg_id[:8]} gang lost node "
+                    f"{node_id[:8]}; re-reserving", pg_id=rec.pg_id)
                 self._pending.put_nowait(rec.pg_id)
 
     def on_job_finished(self, job_id: str) -> None:
@@ -738,6 +757,42 @@ class JobManager:
         return list(self.jobs.values())
 
 
+class EventLog:
+    """Structured cluster event log (ref: src/ray/util/event.h RAY_EVENT
+    macros + the dashboard event module): node/actor/PG lifecycle events
+    with severity, queryable via `ray-tpu list events` and the dashboard.
+    """
+
+    def __init__(self, max_events: int = 20000):
+        self.events: deque = deque(maxlen=max_events)
+
+    def emit(self, source: str, severity: str, message: str,
+             **fields) -> dict:
+        self.events.append({
+            "ts": time.time(), "source": source,
+            "severity": severity, "message": message, **fields,
+        })
+        return {"ok": True}
+
+    def add_event(self, source: str, severity: str, message: str,
+                  fields: Optional[dict] = None) -> dict:
+        return self.emit(source, severity, message, **(fields or {}))
+
+    def list_events(self, source: Optional[str] = None,
+                    severity: Optional[str] = None,
+                    limit: int = 1000) -> List[dict]:
+        out = []
+        for e in reversed(self.events):
+            if source is not None and e["source"] != source:
+                continue
+            if severity is not None and e["severity"] != severity:
+                continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        return out
+
+
 class TaskEvents:
     """Task event sink (ref: gcs_task_manager.h — powers `ray list tasks`
     and the timeline)."""
@@ -831,6 +886,7 @@ class GcsServer:
         self.placement_groups = PlacementGroupManager(self, self.store)
         self.jobs = JobManager(self, self.store)
         self.task_events = TaskEvents()
+        self.event_log = EventLog()
         self.autoscaler_state = AutoscalerStateManager(self)
         self.server = RpcServer(host, port)
         self._daemon_clients: Dict[str, AsyncRpcClient] = {}
@@ -852,6 +908,7 @@ class GcsServer:
             ("ActorManager", self.actors), ("ObjectDirectory", self.objects),
             ("PlacementGroups", self.placement_groups),
             ("JobManager", self.jobs), ("TaskEvents", self.task_events),
+            ("EventLog", self.event_log),
             ("AutoscalerState", self.autoscaler_state),
             ("Pubsub", self.pubsub),
         ]:

@@ -160,6 +160,11 @@ class DashboardHead:
             })
         return self._json(out)
 
+    async def _events(self, request):
+        limit = int(request.query.get("limit", "500"))
+        return self._json(await self._call("EventLog", "list_events",
+                                           limit=limit))
+
     async def _pgs(self, request):
         return self._json(await self._call("PlacementGroups", "list_pgs"))
 
@@ -208,6 +213,7 @@ class DashboardHead:
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/pgs", self._pgs)
+        app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/cluster_status", self._cluster_status)
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)

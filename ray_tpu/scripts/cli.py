@@ -143,6 +143,15 @@ def cmd_list(gcs: _Gcs, args) -> None:
                  str(len(p.get("bundles", [])))]
                 for p in gcs.call("PlacementGroups", "list_pgs")]
         print(_fmt_table(rows, ["PG_ID", "STATE", "STRATEGY", "BUNDLES"]))
+    elif kind == "events":
+        import datetime
+
+        rows = [[datetime.datetime.fromtimestamp(e["ts"]).strftime(
+                     "%H:%M:%S"),
+                 e["source"], e["severity"], e["message"]]
+                for e in gcs.call("EventLog", "list_events",
+                                  limit=args.limit)]
+        print(_fmt_table(rows, ["TIME", "SOURCE", "SEVERITY", "MESSAGE"]))
     elif kind == "workers":
         rows = []
         for n in gcs.call("NodeInfo", "list_nodes"):
@@ -319,7 +328,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     sub.add_parser("status")
     lp = sub.add_parser("list")
     lp.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs",
-                                     "pgs", "workers"])
+                                     "pgs", "workers", "events"])
     lp.add_argument("--limit", type=int, default=200)
     tp = sub.add_parser("timeline")
     tp.add_argument("--out", default="timeline.json")

@@ -94,12 +94,17 @@ def prepare_trainer(trainer: Any) -> Any:
             pass
         trainer.args.disable_tqdm = True
         if world > 1:
-            # Per-worker output dirs: concurrent gang members must not
-            # race on one checkpoint directory; mkdtemp (not a fixed
-            # /tmp path) so concurrent jobs on one host don't collide
-            # with each other either.
-            trainer.args.output_dir = tempfile.mkdtemp(
-                prefix=f"hf_worker_{rank}_")
+            # Per-worker output dirs under the TRIAL directory: stable
+            # across fault-tolerant restarts (resume_from_checkpoint
+            # finds prior checkpoints), unique per trial (no cross-job
+            # collisions), and cleaned up with the trial.
+            try:
+                base = ctx.get_trial_dir()
+            except RuntimeError:
+                base = tempfile.mkdtemp(prefix="hf_gang_")
+            trainer.args.output_dir = os.path.join(
+                base, f"hf_worker_{rank}")
+            os.makedirs(trainer.args.output_dir, exist_ok=True)
     return trainer
 
 

@@ -174,6 +174,21 @@ class Tuner:
 
     _SNAPSHOT_MIN_INTERVAL_S = 5.0
 
+    def _warn_callback(self, cb) -> None:
+        """A broken logger must not kill the experiment, but silence
+        would hide that NOTHING is being logged — warn once per
+        callback object."""
+        import logging
+
+        warned = getattr(self, "_warned_callbacks", None)
+        if warned is None:
+            warned = self._warned_callbacks = set()
+        if id(cb) not in warned:
+            warned.add(id(cb))
+            logging.getLogger(__name__).warning(
+                "experiment callback %s raised; further errors from it "
+                "are suppressed", type(cb).__name__, exc_info=True)
+
     def _snapshot(self, exp_dir: str, trials: List["_Trial"],
                   force: bool = False) -> None:
         # Rate-limited: rewriting every-trial histories 20x/s would let
@@ -329,7 +344,7 @@ class Tuner:
                             cb.on_trial_result(trial.trial_id,
                                                trial.config, m)
                         except Exception:  # noqa: BLE001 logging must
-                            pass           # never kill the experiment
+                            self._warn_callback(cb)  # never kill the run
                     decision = scheduler.on_result(trial.trial_id, m)
                     if decision == STOP and trial.state == "RUNNING":
                         trial.state = "STOPPED"
@@ -390,7 +405,7 @@ class Tuner:
                                              trial.last_metrics,
                                              trial.error)
                     except Exception:  # noqa: BLE001
-                        pass
+                        self._warn_callback(cb)
                 if trial.actor is not None:
                     try:
                         ray_tpu.kill(trial.actor)
@@ -413,5 +428,5 @@ class Tuner:
             try:
                 cb.on_experiment_end(grid)
             except Exception:  # noqa: BLE001
-                pass
+                self._warn_callback(cb)
         return grid

@@ -140,10 +140,12 @@ class _WorkflowRun:
                         ref = node._rf.remote(*args, **kwargs)
                         if getattr(node, "_wf_catch", False):
                             # catch_exceptions semantics: failures are
-                            # data, not workflow aborts.
+                            # data, not workflow aborts. Exception only:
+                            # a KeyboardInterrupt/SystemExit must still
+                            # abort, not become a durable step value.
                             try:
                                 value = (ray_tpu.get(ref), None)
-                            except BaseException as e:  # noqa: BLE001
+                            except Exception as e:  # noqa: BLE001
                                 value = (None, repr(e))
                         else:
                             value = ray_tpu.get(ref)
@@ -188,18 +190,28 @@ class EventNode(DAGNode):
         raise TypeError("EventNode only executes inside workflow.run()")
 
 
+def _check_event_name(name: str) -> str:
+    if not name or any(c in name for c in "/\\\0") or name.startswith("."):
+        raise ValueError(
+            f"invalid event name {name!r}: names are file-path components")
+    return name
+
+
 def event(name: str, timeout_s: Optional[float] = None) -> EventNode:
     """A DAG node that waits for a named external event."""
-    return EventNode(name, timeout_s)
+    return EventNode(_check_event_name(name), timeout_s)
 
 
 def send_event(workflow_id: str, name: str, payload: Any = None,
                storage: Optional[str] = None) -> None:
     """Deliver an event to a (possibly running) workflow: cross-process
     via the workflow's durable storage dir."""
+    _check_event_name(name)
     d = os.path.join(_wf_dir(workflow_id, storage), "events")
     os.makedirs(d, exist_ok=True)
-    tmp = os.path.join(d, f".{name}.tmp")
+    # pid-suffixed tmp: concurrent senders must not interleave into one
+    # tmp file (same discipline as _save_step).
+    tmp = os.path.join(d, f".{name}.tmp.{os.getpid()}")
     with open(tmp, "wb") as f:
         pickle.dump(payload, f)
     os.replace(tmp, os.path.join(d, f"{name}.pkl"))

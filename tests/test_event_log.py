@@ -1,0 +1,46 @@
+"""Structured cluster event log (ref: src/ray/util/event.h RAY_EVENT +
+dashboard event module tests)."""
+import time
+
+import pytest
+
+
+def test_event_log_records_lifecycle(tmp_path):
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        w = _global_worker()
+
+        # Node registration emitted an event.
+        events = w.gcs.call("EventLog", "list_events", timeout=10)
+        assert any(e["source"] == "node" and "registered" in e["message"]
+                   for e in events)
+
+        # Actor death emits one.
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        ray_tpu.kill(a)
+        deadline = time.monotonic() + 30
+        found = False
+        while time.monotonic() < deadline and not found:
+            events = w.gcs.call("EventLog", "list_events",
+                                source="actor", timeout=10)
+            found = any("dead" in e["message"] for e in events)
+            time.sleep(0.2)
+        assert found
+
+        # Severity filter.
+        warns = w.gcs.call("EventLog", "list_events",
+                           severity="WARNING", timeout=10)
+        assert all(e["severity"] == "WARNING" for e in warns)
+    finally:
+        cluster.shutdown()
