@@ -776,7 +776,13 @@ class EventLog:
 
     def add_event(self, source: str, severity: str, message: str,
                   fields: Optional[dict] = None) -> dict:
-        return self.emit(source, severity, message, **(fields or {}))
+        # Reserved keys would collide with emit()'s own parameters (a
+        # caller 'message'/'ts' must not TypeError or clobber the
+        # timestamp); namespace them.
+        clean = {(f"field_{k}" if k in ("source", "severity", "message",
+                                        "ts") else k): v
+                 for k, v in (fields or {}).items()}
+        return self.emit(source, severity, message, **clean)
 
     def list_events(self, source: Optional[str] = None,
                     severity: Optional[str] = None,

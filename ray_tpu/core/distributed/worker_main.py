@@ -510,6 +510,50 @@ class WorkerService:
                 buf.release()
         return {"ok": True, "payload": inline, "oid": r.oid}
 
+    async def profile_memory(self, duration_s: float = 2.0,
+                             top_n: int = 20) -> dict:
+        """On-demand heap profiling (ref: dashboard memray profiling,
+        reporter/profile_manager.py:186 MemoryProfilingManager — memray
+        isn't in this image, so tracemalloc supplies allocation sites):
+        traces allocations for `duration_s`, returns top allocation
+        sites + total traced bytes."""
+        import tracemalloc
+
+        from ray_tpu.util.profiling import HEAP_TRACE_LOCK
+
+        loop = asyncio.get_running_loop()
+
+        def run():
+            # Serialized: overlapping windows would stop each other's
+            # tracing mid-snapshot (tracemalloc state is process-global).
+            HEAP_TRACE_LOCK.acquire()
+            started_here = not tracemalloc.is_tracing()
+            if started_here:
+                tracemalloc.start(10)
+            try:
+                before = tracemalloc.take_snapshot()
+                import time as _t
+
+                _t.sleep(duration_s)
+                after = tracemalloc.take_snapshot()
+                stats = after.compare_to(before, "traceback")
+                top = []
+                for st in stats[:top_n]:
+                    frames = [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+                              for f in list(st.traceback)[-6:]]
+                    top.append({"size_diff": st.size_diff,
+                                "count_diff": st.count_diff,
+                                "stack": ";".join(frames)})
+                current, peak = tracemalloc.get_traced_memory()
+                return {"top": top, "current_bytes": current,
+                        "peak_bytes": peak, "duration_s": duration_s}
+            finally:
+                if started_here:
+                    tracemalloc.stop()
+                HEAP_TRACE_LOCK.release()
+
+        return await loop.run_in_executor(None, run)
+
     async def profile(self, duration_s: float = 2.0,
                       interval_s: float = 0.01) -> dict:
         """On-demand stack sampling of this worker (ref: dashboard

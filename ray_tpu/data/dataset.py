@@ -324,6 +324,22 @@ class Dataset:
         if carry is not None and carry.num_rows and not drop_last:
             yield B.to_batch(carry, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[dict]:
+        """Batches as torch tensors (ref: Dataset.iter_torch_batches)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            # Arrow-backed arrays are read-only views of the shm store;
+            # torch needs writable memory (in-place training ops), so
+            # copy those (the reference's iterator copies too).
+            yield {k: torch.as_tensor(
+                       v if getattr(v, "flags", None) is None
+                       or v.flags.writeable else np.array(v))
+                   for k, v in batch.items()}
+
     def iter_rows(self) -> Iterator[Any]:
         for blk in self.iter_blocks():
             yield from B.iter_rows(blk)

@@ -96,8 +96,10 @@ def prepare_trainer(trainer: Any) -> Any:
         if world > 1:
             # Per-worker output dirs under the TRIAL directory: stable
             # across fault-tolerant restarts (resume_from_checkpoint
-            # finds prior checkpoints), unique per trial (no cross-job
-            # collisions), and cleaned up with the trial.
+            # finds prior checkpoints) and unique per trial. Concurrent
+            # runs must use distinct RunConfig names — the trial dir
+            # (checkpoints included) is shared per name, the same
+            # contract the reference's storage layout has.
             try:
                 base = ctx.get_trial_dir()
             except RuntimeError:

@@ -16,6 +16,8 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
+import logging
+
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result, RunConfig
@@ -23,6 +25,8 @@ from ray_tpu.train.session import TrainSession, install_session, uninstall_sessi
 from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import generate_variants
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -135,6 +139,7 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._restored_trials: Optional[List[_Trial]] = None
+        self._warned_callbacks: set = set()
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,
@@ -178,14 +183,9 @@ class Tuner:
         """A broken logger must not kill the experiment, but silence
         would hide that NOTHING is being logged — warn once per
         callback object."""
-        import logging
-
-        warned = getattr(self, "_warned_callbacks", None)
-        if warned is None:
-            warned = self._warned_callbacks = set()
-        if id(cb) not in warned:
-            warned.add(id(cb))
-            logging.getLogger(__name__).warning(
+        if id(cb) not in self._warned_callbacks:
+            self._warned_callbacks.add(id(cb))
+            logger.warning(
                 "experiment callback %s raised; further errors from it "
                 "are suppressed", type(cb).__name__, exc_info=True)
 

@@ -17,6 +17,10 @@ import time
 from collections import Counter
 from typing import Dict, List, Optional
 
+# Serializes tracemalloc windows: tracing state is process-global, so
+# overlapping heap-profile requests must queue, not stop each other.
+HEAP_TRACE_LOCK = threading.Lock()
+
 
 def sample_stacks(duration_s: float = 2.0, interval_s: float = 0.01,
                   exclude_thread: Optional[int] = None) -> Dict[str, int]:

@@ -58,7 +58,7 @@ class Rank:
 
 def test_allreduce_and_allgather():
     world = 3
-    ranks = [Rank.remote(r, world) for r in range(world)]
+    ranks = [Rank.remote(r, world, "g_ar") for r in range(world)]
     outs = ray_tpu.get([r.do_allreduce.remote([1.0 * (i + 1)] * 4)
                         for i, r in enumerate(ranks)])
     for out in outs:
@@ -70,7 +70,10 @@ def test_allreduce_and_allgather():
 
 def test_broadcast_and_reducescatter():
     world = 2
-    ranks = [Rank.options(name=f"coll{r}").remote(r, world)
+    # Unique group name per logical group: reusing a name on a live
+    # cluster reads the previous group's leftover KV keys (the module's
+    # documented incarnation/fresh-name contract).
+    ranks = [Rank.options(name=f"coll{r}").remote(r, world, "g_bc")
              for r in range(world)]
     outs = ray_tpu.get([actor.do_broadcast.remote([rank * 10, 1])
                         for rank, actor in enumerate(ranks)])
@@ -81,7 +84,7 @@ def test_broadcast_and_reducescatter():
 
 
 def test_send_recv():
-    ranks = [Rank.remote(r, 2) for r in range(2)]
+    ranks = [Rank.remote(r, 2, "g_p2p") for r in range(2)]
     recv_ref = ranks[1].do_sendrecv.remote(0)  # rank1 recv from rank0
     ray_tpu.get(ranks[0].do_sendrecv.remote(1, value=[7, 8, 9]))
     np.testing.assert_array_equal(ray_tpu.get(recv_ref), [7, 8, 9])

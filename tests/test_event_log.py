@@ -44,3 +44,104 @@ def test_event_log_records_lifecycle(tmp_path):
         assert all(e["severity"] == "WARNING" for e in warns)
     finally:
         cluster.shutdown()
+
+
+def test_profile_memory_rpc():
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+    import time as _time
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        class Alloc:
+            def churn(self, seconds):
+                import time
+
+                end = time.time() + seconds
+                junk = []
+                while time.time() < end:
+                    junk.append(bytearray(64 * 1024))
+                    if len(junk) > 200:
+                        junk.clear()
+                return 1
+
+        a = Alloc.remote()
+        ref = a.churn.remote(3.0)
+        w = _global_worker()
+        deadline = _time.monotonic() + 60
+        info = {}
+        while _time.monotonic() < deadline:
+            info = w.gcs.call("ActorManager", "get_actor",
+                              actor_id=a._actor_id.hex(), timeout=10) or {}
+            if info.get("worker_address"):
+                break
+            _time.sleep(0.2)
+        client = SyncRpcClient(info["worker_address"], w.loop_thread)
+        report = client.call("Worker", "profile_memory",
+                             duration_s=1.0, timeout=40)
+        assert report["top"], report
+        assert any(s["size_diff"] > 0 for s in report["top"])
+        assert ray_tpu.get(ref, timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_util_queue_and_actor_pool():
+    import ray_tpu
+    from ray_tpu.util.actor_pool import ActorPool
+    from ray_tpu.util.queue import Empty, Queue
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        q = Queue(maxsize=4)
+        q.put("a")
+        q.put("b")
+        assert q.qsize() == 2
+        assert q.get() == "a"
+
+        # The queue travels to tasks by handle: same backing actor.
+        @ray_tpu.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i)
+            return n
+
+        assert ray_tpu.get(producer.remote(q, 3), timeout=60) == 3
+        got = [q.get(timeout=10) for _ in range(4)]  # "b" + 0,1,2
+        assert got == ["b", 0, 1, 2]
+        with __import__("pytest").raises(Empty):
+            q.get_nowait()
+        q.shutdown()
+
+        @ray_tpu.remote
+        class Sq:
+            def sq(self, x):
+                return x * x
+
+        pool = ActorPool([Sq.remote() for _ in range(2)])
+        out = list(pool.map(lambda a, v: a.sq.remote(v), range(6)))
+        assert out == [x * x for x in range(6)]
+        out = sorted(pool.map_unordered(lambda a, v: a.sq.remote(v),
+                                        range(6)))
+        assert out == sorted(x * x for x in range(6))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_iter_torch_batches():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        import torch
+
+        from ray_tpu import data
+
+        ds = data.range(10, parallelism=2)
+        batches = list(ds.iter_torch_batches(batch_size=4))
+        assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+        assert sum(len(b["id"]) for b in batches) == 10
+    finally:
+        ray_tpu.shutdown()
