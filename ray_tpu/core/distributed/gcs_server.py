@@ -780,7 +780,7 @@ class EventLog:
         # caller 'message'/'ts' must not TypeError or clobber the
         # timestamp); namespace them.
         clean = {(f"field_{k}" if k in ("source", "severity", "message",
-                                        "ts") else k): v
+                                        "ts", "self") else k): v
                  for k, v in (fields or {}).items()}
         return self.emit(source, severity, message, **clean)
 

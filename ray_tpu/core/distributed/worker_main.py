@@ -526,11 +526,14 @@ class WorkerService:
         def run():
             # Serialized: overlapping windows would stop each other's
             # tracing mid-snapshot (tracemalloc state is process-global).
-            HEAP_TRACE_LOCK.acquire()
+            with HEAP_TRACE_LOCK:
+                return _traced_window()
+
+        def _traced_window():
             started_here = not tracemalloc.is_tracing()
-            if started_here:
-                tracemalloc.start(10)
             try:
+                if started_here:
+                    tracemalloc.start(10)
                 before = tracemalloc.take_snapshot()
                 import time as _t
 
@@ -548,9 +551,8 @@ class WorkerService:
                 return {"top": top, "current_bytes": current,
                         "peak_bytes": peak, "duration_s": duration_s}
             finally:
-                if started_here:
+                if started_here and tracemalloc.is_tracing():
                     tracemalloc.stop()
-                HEAP_TRACE_LOCK.release()
 
         return await loop.run_in_executor(None, run)
 

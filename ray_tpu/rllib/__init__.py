@@ -16,6 +16,7 @@ evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.env import register_env
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import (
@@ -30,6 +31,8 @@ __all__ = [
     "PPOConfig",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "ImpalaConfig",
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
     "register_env",

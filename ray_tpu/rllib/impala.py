@@ -1,0 +1,220 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace correction.
+
+ref: rllib/algorithms/impala/impala.py (decoupled sampling/learning with
+a sample queue) and the V-trace returns of Espeholt et al. 2018. TPU-
+first shape: the learner is ONE jitted program — target-policy logp,
+clipped importance ratios, the V-trace reverse scan, and the combined
+policy/value/entropy losses all fuse under `jax.jit` (`lax.scan` for the
+temporal recursion, static shapes throughout). Asynchrony comes from the
+runtime: rollout workers sample with whatever weights they last
+received, a queue of in-flight sample refs keeps the learner fed, and
+staleness is exactly what V-trace corrects.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import apply_mlp_policy, init_mlp_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaHyperparams:
+    lr: float = 6e-4
+    gamma: float = 0.99
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 40.0
+
+
+class ImpalaLearner:
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hp: ImpalaHyperparams, seed: int = 0, hidden=(64, 64)):
+        self.hp = hp
+        rng = jax.random.PRNGKey(seed)
+        self.params = init_mlp_policy(rng, obs_dim, num_actions, hidden)
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip),
+            optax.rmsprop(hp.lr, decay=0.99, eps=0.1),
+        )
+        self.opt_state = self._tx.init(self.params)
+        self._update = self._build_update()
+
+    def _build_update(self):
+        hp = self.hp
+
+        def vtrace(behavior_logp, target_logp, rewards, dones, values,
+                   final_value):
+            """V-trace targets + pg advantages; all inputs [E, T]."""
+            rho = jnp.minimum(jnp.exp(target_logp - behavior_logp),
+                              hp.rho_clip)
+            c = jnp.minimum(jnp.exp(target_logp - behavior_logp),
+                            hp.c_clip)
+            v_next = jnp.concatenate(
+                [values[:, 1:], final_value[:, None]], axis=1)
+            not_done = 1.0 - dones
+            deltas = rho * (rewards + hp.gamma * not_done * v_next
+                            - values)
+
+            def step(acc, xs):
+                delta, nd, c_t = xs
+                acc = delta + hp.gamma * nd * c_t * acc
+                return acc, acc
+
+            _, acc = jax.lax.scan(
+                step, jnp.zeros(values.shape[0]),
+                (deltas.T, not_done.T, c.T), reverse=True)
+            vs = values + acc.T
+            vs_next = jnp.concatenate(
+                [vs[:, 1:], final_value[:, None]], axis=1)
+            pg_adv = rho * (rewards + hp.gamma * not_done * vs_next
+                            - values)
+            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+        def loss_fn(params, batch):
+            E, T = batch["rewards"].shape
+            obs = batch["obs"].reshape(E * T, -1)
+            logits, value = apply_mlp_policy(params, obs)
+            logits = logits.reshape(E, T, -1)
+            value = value.reshape(E, T)
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=2)[..., 0]
+            vs, pg_adv = vtrace(batch["logp"], target_logp,
+                                batch["rewards"], batch["dones"], value,
+                                batch["final_value"])
+            pg_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean(jnp.square(value - vs))
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            loss = (pg_loss + hp.vf_loss_coeff * vf_loss
+                    - hp.entropy_coeff * entropy)
+            return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                          "entropy": entropy,
+                          "mean_rho": jnp.mean(
+                              jnp.exp(target_logp - batch["logp"]))}
+
+        def update(params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k != "values"}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, jbatch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, params: Any) -> None:
+        self.params = jax.device_put(params)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.lr = 6e-4
+        self.gamma = 0.99
+        self.rho_clip = 1.0
+        self.c_clip = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.queue_depth = 2          # in-flight sample batches per worker
+        self.broadcast_interval = 1   # learner updates between weight syncs
+
+    def training(self, *, lr=None, gamma=None, rho_clip=None, c_clip=None,
+                 vf_loss_coeff=None, entropy_coeff=None, grad_clip=None,
+                 queue_depth=None, broadcast_interval=None,
+                 **kwargs) -> "ImpalaConfig":
+        for k, v in dict(lr=lr, gamma=gamma, rho_clip=rho_clip,
+                         c_clip=c_clip, vf_loss_coeff=vf_loss_coeff,
+                         entropy_coeff=entropy_coeff, grad_clip=grad_clip,
+                         queue_depth=queue_depth,
+                         broadcast_interval=broadcast_interval).items():
+            if v is not None:
+                setattr(self, k, v)
+        return super().training(**kwargs)
+
+    def hyperparams(self) -> ImpalaHyperparams:
+        return ImpalaHyperparams(
+            lr=self.lr, gamma=self.gamma, rho_clip=self.rho_clip,
+            c_clip=self.c_clip, vf_loss_coeff=self.vf_loss_coeff,
+            entropy_coeff=self.entropy_coeff, grad_clip=self.grad_clip)
+
+
+class IMPALA(Algorithm):
+    """training_step: consume the oldest ready sample batch (collected
+    under stale weights — V-trace corrects), update, refill the in-flight
+    queue, broadcast weights on the configured cadence."""
+
+    def _setup_learner(self, obs_dim: int, num_actions: int
+                       ) -> ImpalaLearner:
+        cfg: ImpalaConfig = self.config
+        self._pending: List[Any] = []
+        self._updates_since_broadcast = 0
+        return ImpalaLearner(obs_dim, num_actions, cfg.hyperparams(),
+                             seed=cfg.seed, hidden=cfg.model_hidden)
+
+    def _refill(self) -> None:
+        cfg: ImpalaConfig = self.config
+        T = cfg.rollout_fragment_length
+        if self._remote:
+            target = cfg.queue_depth * len(self.workers)
+            i = 0
+            while len(self._pending) < target:
+                w = self.workers[i % len(self.workers)]
+                self._pending.append(w.sample.remote(T))
+                i += 1
+        else:
+            while len(self._pending) < 1:
+                self._pending.append(self.workers[0].sample(T))
+
+    def training_step(self) -> Dict[str, float]:
+        import ray_tpu
+
+        self._refill()
+        if self._remote:
+            done, rest = ray_tpu.wait(self._pending, num_returns=1,
+                                      timeout=600)
+            self._pending = rest
+            out = ray_tpu.get(done[0])
+        else:
+            out = self._pending.pop(0)
+        batch = out["batch"]
+        metrics = self.learner.update(batch)
+        self._updates_since_broadcast += 1
+        cfg: ImpalaConfig = self.config
+        if self._updates_since_broadcast >= cfg.broadcast_interval:
+            self._broadcast_weights()
+            self._updates_since_broadcast = 0
+        self._refill()   # keep samplers busy while we return
+        if out["episode_returns"]:
+            metrics["episode_return_mean"] = float(
+                np.mean(out["episode_returns"]))
+            metrics["num_episodes"] = float(len(out["episode_returns"]))
+        metrics["num_env_steps_sampled"] = float(batch["rewards"].size)
+        return metrics

@@ -32,15 +32,22 @@ class ActorPool:
         self._pending_order.append(ref)
 
     def get_next(self, timeout: float = None) -> Any:
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order. A timeout raises BEFORE any
+        state changes, so the caller can retry and the busy actor is not
+        handed new work."""
         import ray_tpu
 
         if not self._pending_order:
             raise StopIteration("no pending results")
-        ref = self._pending_order.pop(0)
+        ref = self._pending_order[0]
+        if timeout is not None:
+            done, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+            if not done:
+                raise TimeoutError("next result not ready within timeout")
+        self._pending_order.pop(0)
         actor = self._future_to_actor.pop(ref)
         try:
-            return ray_tpu.get(ref, timeout=timeout)
+            return ray_tpu.get(ref)
         finally:
             self._idle.append(actor)
 

@@ -26,7 +26,10 @@ class _QueueActor:
         except (TimeoutError, asyncio.TimeoutError):
             return False
 
-    def put_nowait(self, item: Any) -> bool:
+    async def put_nowait(self, item: Any) -> bool:
+        # Async like everything else: a sync method on an async actor
+        # runs on a pool thread and would mutate the loop-bound
+        # asyncio.Queue from the wrong thread (lost wakeups).
         try:
             self._q.put_nowait(item)
             return True
@@ -41,19 +44,19 @@ class _QueueActor:
         except (TimeoutError, asyncio.TimeoutError):
             return False, None
 
-    def get_nowait(self) -> tuple:
+    async def get_nowait(self) -> tuple:
         try:
             return True, self._q.get_nowait()
         except asyncio.QueueEmpty:
             return False, None
 
-    def qsize(self) -> int:
+    async def qsize(self) -> int:
         return self._q.qsize()
 
-    def empty(self) -> bool:
+    async def empty(self) -> bool:
         return self._q.empty()
 
-    def full(self) -> bool:
+    async def full(self) -> bool:
         return self._q.full()
 
 
