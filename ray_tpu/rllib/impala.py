@@ -176,6 +176,7 @@ class IMPALA(Algorithm):
         cfg: ImpalaConfig = self.config
         self._pending: List[Any] = []
         self._updates_since_broadcast = 0
+        self._next_worker = 0
         return ImpalaLearner(obs_dim, num_actions, cfg.hyperparams(),
                              seed=cfg.seed, hidden=cfg.model_hidden)
 
@@ -184,11 +185,13 @@ class IMPALA(Algorithm):
         T = cfg.rollout_fragment_length
         if self._remote:
             target = cfg.queue_depth * len(self.workers)
-            i = 0
             while len(self._pending) < target:
-                w = self.workers[i % len(self.workers)]
+                # Persistent round-robin: resetting per call would pile
+                # all steady-state refills onto worker 0 and starve the
+                # rest.
+                w = self.workers[self._next_worker % len(self.workers)]
+                self._next_worker += 1
                 self._pending.append(w.sample.remote(T))
-                i += 1
         else:
             while len(self._pending) < 1:
                 self._pending.append(self.workers[0].sample(T))
@@ -200,6 +203,10 @@ class IMPALA(Algorithm):
         if self._remote:
             done, rest = ray_tpu.wait(self._pending, num_returns=1,
                                       timeout=600)
+            if not done:
+                raise TimeoutError(
+                    "no rollout worker produced a sample batch within "
+                    "600s — check worker health (`ray-tpu list workers`)")
             self._pending = rest
             out = ray_tpu.get(done[0])
         else:

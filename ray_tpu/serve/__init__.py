@@ -8,6 +8,7 @@ from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
                                start_http_proxy, start_rpc_ingress,
                                status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config_deploy import deploy_config
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
                                       Deployment, deployment)
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
@@ -19,7 +20,7 @@ __all__ = [
     "run", "shutdown", "status", "delete",
     "get_deployment_handle", "get_app_handle",
     "start_http_proxy", "http_port", "start_rpc_ingress",
-    "rpc_ingress_port",
+    "rpc_ingress_port", "deploy_config",
     "DeploymentHandle", "DeploymentResponse", "StreamingResponse",
     "multiplexed", "get_multiplexed_model_id",
     "batch",

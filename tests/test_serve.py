@@ -161,3 +161,50 @@ def test_http_ingress():
     except urllib.error.HTTPError as e:
         assert e.code == 404
     serve.delete("http_app")
+
+
+def test_declarative_config_deploy(tmp_path):
+    """App modules must be importable cluster-wide (same contract as the
+    reference's import_path) — the test materializes one on the repo
+    root, which every worker has on PYTHONPATH."""
+    import json
+    import os
+
+    from ray_tpu import serve
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mod_path = os.path.join(repo, "_cfg_demo_app.py")
+    with open(mod_path, "w") as f:
+        f.write(
+            "class Upper:\n"
+            "    def __init__(self, suffix='!'):\n"
+            "        self.suffix = suffix\n"
+            "    def __call__(self, text):\n"
+            "        return text.upper() + self.suffix\n"
+            "def build(suffix='?'):\n"
+            "    from ray_tpu import serve\n"
+            "    return serve.deployment(Upper).bind(suffix)\n")
+    try:
+        cfg = {
+            "applications": [
+                {"name": "upper_cls",
+                 "import_path": "_cfg_demo_app.Upper",
+                 "args": {"suffix": "!!"},
+                 "deployment_config": {"num_replicas": 1}},
+                {"name": "upper_built",
+                 "import_path": "_cfg_demo_app:build",
+                 "args": {"suffix": "??"}},
+            ]
+        }
+        path = str(tmp_path / "serve_config.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        handles = serve.deploy_config(path)
+        assert handles["upper_cls"].remote("hey").result(
+            timeout=60) == "HEY!!"
+        assert handles["upper_built"].remote("ho").result(
+            timeout=60) == "HO??"
+        serve.delete("upper_cls")
+        serve.delete("upper_built")
+    finally:
+        os.unlink(mod_path)
