@@ -93,6 +93,7 @@ def init(
                 resources=resources,
                 namespace=namespace,
                 object_store_memory=object_store_memory,
+                log_to_driver=log_to_driver,
             )
         if runtime_env and hasattr(_worker, "job_runtime_env"):
             # Job-level default env: tasks/actors without an explicit

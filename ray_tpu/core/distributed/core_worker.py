@@ -108,6 +108,7 @@ class _TaskLane:
         queues us behind busy resources, daemon restarts) back off and
         retry; only a definitive scheduler refusal fails the queue."""
         failures = 0
+        cancelled = False
         try:
             while self.queue:
                 try:
@@ -115,7 +116,9 @@ class _TaskLane:
                 except rexc.RayTpuError as e:
                     self._fail_queued(e)
                     return
-                except BaseException as e:  # noqa: BLE001 transient
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 transient
                     failures += 1
                     if failures > 50:
                         self._fail_queued(e)
@@ -130,11 +133,26 @@ class _TaskLane:
                         await daemon.call(
                             "NodeDaemon", "return_lease",
                             lease_id=grant["lease_id"], timeout=10)
+                    except asyncio.CancelledError:
+                        cancelled = True
+                        raise
                     except Exception:  # noqa: BLE001
                         pass
+        except asyncio.CancelledError:
+            # Event-loop shutdown: cancel waiters instead of spinning the
+            # retry loop on a dead control plane, and do NOT respawn a
+            # replacement pursuer (it would outlive the cancel sweep and
+            # die as a destroyed-pending task at interpreter exit).
+            cancelled = True
+            for _, fut in self.queue:
+                if not fut.done():
+                    fut.cancel()
+            self.queue.clear()
+            raise
         finally:
             self.pursuers -= 1
-            self._maybe_scale()
+            if not cancelled:
+                self._maybe_scale()
 
     async def _lease_with_spillback(self):
         cfg = get_config()
@@ -147,6 +165,7 @@ class _TaskLane:
                 strategy=sched["strategy"], affinity=sched["affinity"],
                 soft=sched["soft"], placement=sched["placement"],
                 runtime_env=sched.get("runtime_env"),
+                job_id=self.core.job_id,
                 timeout=cfg.worker_lease_timeout_ms / 1000)
             if grant.get("spill_to"):
                 daemon_addr = grant["spill_to"]
@@ -182,12 +201,18 @@ class _TaskLane:
                 replies = await worker.call(
                     "Worker", "push_tasks",
                     specs=[s for s, _ in batch], timeout=None)
-            except BaseException as e:  # noqa: BLE001
+            except asyncio.CancelledError:
+                # Event-loop shutdown, not a worker death: cancel the
+                # batch instead of re-queueing it forever.
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.cancel()
+                raise
+            except Exception as e:  # noqa: BLE001
                 # Worker likely died mid-batch: re-queue the batch (fresh
                 # leases redistribute it) instead of charging every task a
                 # full retry attempt for one worker's death.
-                err = (e if isinstance(e, Exception)
-                       else RuntimeError(repr(e)))
+                err = e
                 for spec, fut in batch:
                     n = spec.get("_lane_retries", 0) + 1
                     spec["_lane_retries"] = n
@@ -216,6 +241,7 @@ class DistributedCoreWorker:
         is_driver: bool,
         worker_address: str = "",
         loop_thread: Optional[EventLoopThread] = None,
+        log_to_driver: bool = True,
     ):
         self.gcs_address = gcs_address
         self.node_id = node_id
@@ -250,7 +276,7 @@ class DistributedCoreWorker:
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._free_batch: List[bytes] = []
         self._inline_cache: Dict[ObjectID, bytes] = {}
-        self._inline_cache_order: List[ObjectID] = []
+        self._inline_cache_order: deque = deque()
 
         # ---- pending tasks (futures resolve when reply arrives) ----
         self._pending_objects: Dict[ObjectID, Future] = {}
@@ -300,7 +326,44 @@ class DistributedCoreWorker:
         self._shutdown = False
         install_refcounter(self._ref_added, self._ref_removed)
         if is_driver:
+            if log_to_driver and os.environ.get(
+                    "RAY_TPU_LOG_TO_DRIVER", "1") not in ("0", "false"):
+                self.loop_thread.submit(self._stream_logs_to_driver())
             atexit.register(self.shutdown)
+
+    async def _stream_logs_to_driver(self) -> None:
+        """Print this job's worker stdout/stderr on the driver, prefixed
+        (ref: the log_monitor → GCS pubsub → worker.py print_logs path;
+        log records flow from each node's LogMonitor through the GCS
+        LogManager's ``logs`` channel)."""
+        import sys
+
+        from ray_tpu.core.distributed.log_monitor import format_log_prefix
+
+        while not self._shutdown:
+            client = AsyncRpcClient(self.gcs_address)
+            try:
+                async for rec in client.stream(
+                        "Pubsub", "stream_subscribe", channel="logs"):
+                    job = rec.get("job_id")
+                    # Unattributed lines (worker startup before its first
+                    # lease) pass through; other jobs' lines do not.
+                    if job and job != self.job_id:
+                        continue
+                    prefix = format_log_prefix(rec)
+                    out = (sys.stderr if rec.get("stream") == "stderr"
+                           else sys.stdout)
+                    for line in rec["lines"]:
+                        print(f"{prefix} {line}", file=out, flush=True)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 GCS blip: reconnect
+                await asyncio.sleep(1.0)
+            finally:
+                try:
+                    await client.close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------------------------------------------------------------
     # reference counting / distributed GC
@@ -313,20 +376,48 @@ class DistributedCoreWorker:
         if self._shutdown:
             return
         with self._lock:
-            n = self._refcounts.get(ref.id())
-            if n is None:
+            self._decref_locked(ref.id())
+
+    def _decref_locked(self, oid: ObjectID) -> None:
+        n = self._refcounts.get(oid)
+        if n is None:
+            return
+        if n <= 1:
+            del self._refcounts[oid]
+            self._drop_lineage_locked(oid)
+            if oid in self._owned:
+                self._owned.discard(oid)
+                self._inline_cache.pop(oid, None)
+                self._free_batch.append(oid.binary())
+                if len(self._free_batch) >= 100:
+                    self._flush_frees_locked()
+        else:
+            self._refcounts[oid] = n - 1
+
+    def _pin_task_deps(self, deps, fut: Future) -> None:
+        """Pin a submitted task's argument objects until it completes
+        (ref: reference_count.h:61 — 'Add references for the object
+        dependencies of a submitted task'). Without this, the caller
+        dropping its arg ObjectRefs after .remote() lets the free path
+        delete the objects from store+directory while the task is still
+        in flight — its arg fetch then stalls on an object that no
+        longer exists anywhere (observed intermittently in the sort
+        exchange: merge tasks racing the free of partition outputs)."""
+        if not deps:
+            return
+        dep_oids = [ObjectID(d) for d in deps]
+        with self._lock:
+            for oid in dep_oids:
+                self._refcounts[oid] += 1
+
+        def unpin(_f):
+            if self._shutdown:
                 return
-            if n <= 1:
-                del self._refcounts[ref.id()]
-                self._drop_lineage_locked(ref.id())
-                if ref.id() in self._owned:
-                    self._owned.discard(ref.id())
-                    self._inline_cache.pop(ref.id(), None)
-                    self._free_batch.append(ref.id().binary())
-                    if len(self._free_batch) >= 100:
-                        self._flush_frees_locked()
-            else:
-                self._refcounts[ref.id()] = n - 1
+            with self._lock:
+                for oid in dep_oids:
+                    self._decref_locked(oid)
+
+        fut.add_done_callback(unpin)
 
     def _flush_frees_locked(self) -> None:
         batch, self._free_batch = self._free_batch, []
@@ -416,15 +507,22 @@ class DistributedCoreWorker:
             self._loc_flushing = True
             asyncio.ensure_future(self._flush_locations())
 
-    def _cache_inline(self, oid: ObjectID, payload: bytes) -> None:
-        with self._lock:
-            if oid in self._inline_cache:
-                return
+    INLINE_CACHE_CAP = 10000
+
+    def _cache_inline_locked(self, oid: ObjectID, payload: bytes) -> None:
+        if oid not in self._inline_cache:
             self._inline_cache[oid] = payload
             self._inline_cache_order.append(oid)
-            while len(self._inline_cache_order) > 10000:
-                old = self._inline_cache_order.pop(0)
-                self._inline_cache.pop(old, None)
+
+    def _evict_inline_locked(self) -> None:
+        while len(self._inline_cache_order) > self.INLINE_CACHE_CAP:
+            old = self._inline_cache_order.popleft()
+            self._inline_cache.pop(old, None)
+
+    def _cache_inline(self, oid: ObjectID, payload: bytes) -> None:
+        with self._lock:
+            self._cache_inline_locked(oid, payload)
+            self._evict_inline_locked()
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None,
             _priority: Optional[int] = None) -> List[Any]:
@@ -927,6 +1025,7 @@ class DistributedCoreWorker:
             for oid in return_ids:
                 self._pending_objects[oid] = fut
                 self._owned.add(oid)
+        self._pin_task_deps(deps, fut)
 
         spec = protocol.make_task_spec(
             task_id=task_id.binary(), fn_key=fn_key, args_blob=args_blob,
@@ -962,14 +1061,52 @@ class DistributedCoreWorker:
                     old = self._lineage_order.pop(0)
                     self._drop_lineage_locked(old, force=True)
 
-        self.loop_thread.loop.call_soon_threadsafe(
-            self._task_submit_on_loop, spec, demand, sched, return_ids, fut)
+        # Same batched cross-thread handoff as the actor path: one loop
+        # wakeup per submission BURST (see submit_actor_task).
+        self._submit_buffer.append(
+            ("t", (spec, demand, sched, return_ids, fut, deps)))
+        if not self._submit_scheduled:
+            self._submit_scheduled = True
+            self.loop_thread.loop.call_soon_threadsafe(self._drain_submits)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
-    def _task_submit_on_loop(self, spec, demand, sched, return_ids, fut):
+    def _task_submit_on_loop(self, spec, demand, sched, return_ids, fut,
+                             deps=()):
         """Fast path: enqueue straight onto the lane (one future + one
         callback per task, no asyncio.Task). Failures fall back to the
-        retrying coroutine."""
+        retrying coroutine.
+
+        Dependency gating (ref: the raylet's dependency manager,
+        dependency_manager.h — a task is not dispatched until its args
+        are available): a spec whose args reference THIS owner's still-
+        pending task returns is held back until those tasks finish.
+        Without this, a lease-reuse batch can put consumer before
+        producer in ONE worker's sequential run — the consumer blocks
+        fetching args its own batch hasn't produced yet (observed: the
+        range-partition sort's merge tasks deadlocking behind their
+        partition tasks for the full arg-fetch timeout)."""
+        if deps:
+            blockers = []
+            with self._lock:
+                for dep in deps:
+                    dfut = self._pending_objects.get(ObjectID(dep))
+                    if dfut is not None and dfut not in blockers:
+                        blockers.append(dfut)
+            if blockers:
+                remaining = [len(blockers)]
+
+                def on_dep_done(_f):
+                    with self._lock:
+                        remaining[0] -= 1
+                        if remaining[0]:
+                            return
+                    self.loop_thread.loop.call_soon_threadsafe(
+                        self._task_submit_on_loop, spec, demand, sched,
+                        return_ids, fut, ())
+
+                for dfut in blockers:
+                    dfut.add_done_callback(on_dep_done)
+                return
         from ray_tpu.runtime_env import env_hash
 
         key = (tuple(sorted(demand.items())), sched["strategy"],
@@ -988,6 +1125,13 @@ class DistributedCoreWorker:
             retry = False
             try:
                 reply = rf.result()
+            except asyncio.CancelledError:
+                # Loop shutdown (cancel sweep): don't resubmit — a retry
+                # coroutine spawned mid-sweep outlives the drain and dies
+                # as a destroyed-pending task at interpreter exit.
+                if not fut.done():
+                    fut.cancel()
+                return
             except BaseException:  # noqa: BLE001 transport/lease failure
                 retry = True
                 reply = None
@@ -1030,6 +1174,10 @@ class DistributedCoreWorker:
                     continue
                 self._finish_task(return_ids, fut, error=e)
                 return
+            except asyncio.CancelledError:
+                if not fut.done():
+                    fut.cancel()
+                raise
             except BaseException as e:  # noqa: BLE001 system failure
                 last_err = e
                 attempt += 1
@@ -1129,7 +1277,8 @@ class DistributedCoreWorker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
                           kwargs, options: TaskOptions) -> List[ObjectRef]:
         aid = actor_id.hex()
-        args_blob, _ = protocol.pack_args(args, kwargs, self._promote_ref)
+        args_blob, deps = protocol.pack_args(args, kwargs,
+                                             self._promote_ref)
         task_id = TaskID.generate()
         num_returns = options.num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
@@ -1139,6 +1288,7 @@ class DistributedCoreWorker:
             for oid in return_ids:
                 self._pending_objects[oid] = fut
                 self._owned.add(oid)
+        self._pin_task_deps(deps, fut)
         # seq is assigned on the loop at push time, per (actor,
         # incarnation-address) — each restarted incarnation starts at 0,
         # so no cross-incarnation base handshake is needed.
@@ -1158,7 +1308,8 @@ class DistributedCoreWorker:
         # per call. A per-call call_soon_threadsafe costs a syscall plus
         # a GIL fight with the busy loop thread (~700µs/submit under a
         # tight submission loop — the wakeup, not the work, dominates).
-        self._submit_buffer.append((aid, spec, return_ids, fut, options))
+        self._submit_buffer.append(
+            ("a", (aid, spec, return_ids, fut, options)))
         if not self._submit_scheduled:
             self._submit_scheduled = True
             self.loop_thread.loop.call_soon_threadsafe(self._drain_submits)
@@ -1170,48 +1321,46 @@ class DistributedCoreWorker:
         self._submit_scheduled = False
         while True:
             try:
-                item = self._submit_buffer.popleft()
+                kind, item = self._submit_buffer.popleft()
             except IndexError:
                 return
-            self._actor_submit_on_loop(*item)
+            if kind == "a":
+                self._actor_submit_on_loop(*item)
+            else:
+                self._task_submit_on_loop(*item)
 
     def _actor_submit_on_loop(self, aid, spec, return_ids, fut, options):
         """Fast path for resolved actors: enqueue onto the per-address
         push batch directly. Unresolved actors AND transport-failure
         retries go through the per-actor FIFO, so seqs are always
         assigned in submission/failure order by ONE drain coroutine
-        (racing per-call resolvers would renumber arbitrarily)."""
+        (racing per-call resolvers would renumber arbitrarily).
+
+        No per-call asyncio Future/done-callback: the whole submission
+        context rides the push queue and the batch sender completes or
+        retries entries directly — at 10k+ calls/s the per-call future +
+        closure machinery was a measurable slice of the loop thread."""
         info = self._actor_cache.get(aid)
         if not (info and info["state"] == "ALIVE"):
             self._park_actor_submit(aid, (spec, return_ids, fut, options))
             return
         addr = info["worker_address"]
         self._assign_actor_seq(aid, addr, spec)
-        rfut = self._enqueue_actor_push(addr, spec)
+        self._enqueue_actor_push(addr, (aid, spec, return_ids, fut,
+                                        options))
 
-        def on_done(rf):
-            try:
-                reply = rf.result()
-            except BaseException:  # noqa: BLE001 transport failure
-                self._actor_cache.pop(aid, None)
-                retries = spec.get("_push_retries", 0) + 1
-                spec["_push_retries"] = retries
-                if retries > max(1, options.max_task_retries):
-                    self._finish_task(
-                        return_ids, fut,
-                        error=rexc.ActorUnavailableError(
-                            f"actor call failed after {retries} pushes"))
-                    return
-                self._park_actor_submit(
-                    aid, (spec, return_ids, fut, options))
-                return
-            err = reply.get("error")
-            if err is not None:
-                self._finish_task(return_ids, fut, error=err)
-                return
-            self._finish_task(return_ids, fut, results=reply["results"])
-
-        rfut.add_done_callback(on_done)
+    def _handle_push_failure(self, aid, spec, return_ids, fut, options,
+                             exc) -> None:
+        self._actor_cache.pop(aid, None)
+        retries = spec.get("_push_retries", 0) + 1
+        spec["_push_retries"] = retries
+        if retries > max(1, options.max_task_retries):
+            self._finish_task(
+                return_ids, fut,
+                error=rexc.ActorUnavailableError(
+                    f"actor call failed after {retries} pushes"))
+            return
+        self._park_actor_submit(aid, (spec, return_ids, fut, options))
 
     def _park_actor_submit(self, aid: str, item: tuple) -> None:
         pend = self._actor_pending.get(aid)
@@ -1220,26 +1369,28 @@ class DistributedCoreWorker:
             asyncio.ensure_future(self._drain_actor_pending(aid))
         pend.append(item)
 
-    def _enqueue_actor_push(self, addr: str, spec: dict) -> asyncio.Future:
+    def _enqueue_actor_push(self, addr: str, item: tuple) -> None:
         q = self._push_queues.get(addr)
         if q is None:
             q = self._push_queues[addr] = deque()
-        rfut = self.loop_thread.loop.create_future()
-        q.append((spec, rfut))
+        q.append(item)
         if not self._push_flushing.get(addr):
             self._push_flushing[addr] = True
             asyncio.ensure_future(self._actor_push_flusher(addr))
-        return rfut
 
     async def _drain_actor_pending(self, aid: str) -> None:
         try:
             await self._resolve_actor_async(
                 aid, timeout=get_config().actor_creation_timeout_s)
-        except BaseException as e:  # noqa: BLE001
-            err = e if isinstance(e, Exception) else RuntimeError(repr(e))
+        except asyncio.CancelledError:
+            for _, _, fut, _ in self._actor_pending.pop(aid, ()):
+                if not fut.done():
+                    fut.cancel()
+            raise
+        except Exception as e:  # noqa: BLE001
             for spec, return_ids, fut, options in self._actor_pending.pop(
                     aid, ()):
-                self._finish_task(return_ids, fut, error=err)
+                self._finish_task(return_ids, fut, error=e)
             return
         pend = self._actor_pending.pop(aid, deque())
         # Synchronous drain (no awaits): later fast-path submissions
@@ -1274,13 +1425,20 @@ class DistributedCoreWorker:
         try:
             try:
                 client = await self._aclient(addr)
-            except BaseException as e:  # noqa: BLE001
+            except asyncio.CancelledError:
+                # Loop shutdown, not a transport failure: cancel waiters
+                # instead of re-parking (a re-park would spawn new drain
+                # tasks during the cancel sweep).
                 while q:
-                    _, f = q.popleft()
-                    if not f.done():
-                        f.set_exception(
-                            e if isinstance(e, Exception)
-                            else RuntimeError(repr(e)))
+                    _, _, _, fut, _ = q.popleft()
+                    if not fut.done():
+                        fut.cancel()
+                raise
+            except Exception as e:  # noqa: BLE001
+                while q:
+                    aid, spec, return_ids, fut, options = q.popleft()
+                    self._handle_push_failure(aid, spec, return_ids, fut,
+                                              options, e)
                 return
             burst = False
             while q:
@@ -1305,16 +1463,47 @@ class DistributedCoreWorker:
         try:
             replies = await client.call(
                 "Worker", "push_actor_tasks",
-                specs=[s for s, _ in batch], timeout=None)
-        except BaseException as e:  # noqa: BLE001
-            for _, f in batch:
-                if not f.done():
-                    f.set_exception(e if isinstance(e, Exception)
-                                    else RuntimeError(repr(e)))
+                specs=[item[1] for item in batch], timeout=None)
+        except asyncio.CancelledError:
+            # Loop shutdown: cancel the batch, don't re-park it (same
+            # respawn-during-cancel-sweep hazard as _TaskLane).
+            for _, _, _, fut, _ in batch:
+                if not fut.done():
+                    fut.cancel()
+            raise
+        except Exception as e:  # noqa: BLE001
+            for aid, spec, return_ids, fut, options in batch:
+                self._handle_push_failure(aid, spec, return_ids, fut,
+                                          options, e)
             return
-        for (_, f), r in zip(batch, replies):
-            if not f.done():
-                f.set_result(r)
+        self._finish_actor_batch(batch, replies)
+
+    def _finish_actor_batch(self, batch: list, replies: list) -> None:
+        """Complete a whole reply batch under ONE lock acquisition
+        (inline-result caching + pending-object cleanup), then wake the
+        waiters lock-free. The payload must be cached BEFORE the pending
+        entry is popped, or a concurrent get() finds the object nowhere
+        and spuriously attempts reconstruction."""
+        with self._lock:
+            pending = self._pending_objects
+            for (aid, spec, return_ids, fut, options), reply in zip(
+                    batch, replies):
+                err = reply.get("error")
+                if err is None:
+                    for r in reply["results"]:
+                        if r.inline is not None:
+                            self._cache_inline_locked(ObjectID(r.oid),
+                                                      r.inline)
+                else:
+                    payload = serialization.dumps(err, is_error=True)
+                    for oid in return_ids:
+                        self._cache_inline_locked(oid, payload)
+                for oid in return_ids:
+                    pending.pop(oid, None)
+            self._evict_inline_locked()
+        for (aid, spec, return_ids, fut, options), _ in zip(batch, replies):
+            if not fut.done():
+                fut.set_result(None)
 
     async def _resolve_actor_async(self, actor_id_hex: str,
                                    timeout: float = 60.0) -> dict:

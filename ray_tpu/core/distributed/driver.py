@@ -35,6 +35,9 @@ def child_env() -> Dict[str, str]:
     parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(":")
                           if p]
     env["PYTHONPATH"] = ":".join(dict.fromkeys(parts))
+    # Never inherit a parent-watch aimed at some OTHER process: a child
+    # whose getppid() doesn't match would exit at its first poll.
+    env.pop("RAY_TPU_WATCH_PPID", None)
     return env
 
 
@@ -69,14 +72,75 @@ def _read_handshake(proc: subprocess.Popen, pattern: str,
                        f"{_HANDSHAKE_TIMEOUT}s")
 
 
+# Pre-bound at import: preexec_fn runs between fork and exec in the
+# child of a (usually multithreaded) parent, where taking the import or
+# allocator lock can deadlock — the body must be one pre-resolved C call.
+try:
+    import ctypes as _ctypes
+    import signal as _signal
+
+    _PRCTL = _ctypes.CDLL("libc.so.6", use_errno=True).prctl
+    _PDEATHSIG_ARGS = (1, int(_signal.SIGTERM), 0, 0, 0)  # PR_SET_PDEATHSIG
+except Exception:  # noqa: BLE001 non-Linux / no libc
+    _PRCTL = None
+
+
+def pdeathsig_preexec():
+    """preexec_fn: deliver SIGTERM to the child when its parent dies.
+
+    A SIGKILL'd driver (OOM, `kill -9` on a test run) cannot run its
+    atexit cleanup, and without this every GCS/daemon/worker it spawned
+    lives on forever — leaked heartbeating clusters that interfere with
+    the next run (the reference gets the same effect from raylet's
+    parent-death monitoring). Linux-only; harmless no-op elsewhere."""
+    if _PRCTL is not None:
+        _PRCTL(*_PDEATHSIG_ARGS)
+
+
+def _die_with_parent_env(env: Dict[str, str]) -> Dict[str, str]:
+    """Mark a child to exit when THIS process dies (see watch_parent in
+    this module). PR_SET_PDEATHSIG is unusable here: it fires when the
+    forking THREAD exits, and the autoscaler launches nodes from
+    short-lived threads — daemons got SIGTERM'd moments after boot."""
+    env = dict(env)
+    env["RAY_TPU_WATCH_PPID"] = str(os.getpid())
+    return env
+
+
+def start_watch_parent_thread() -> None:
+    """Child side of die_with_parent: poll until the spawning parent is
+    gone (we got reparented), then exit — a SIGKILL'd driver must not
+    leave heartbeating clusters behind (ref: raylet parent-death
+    monitoring). No-op unless RAY_TPU_WATCH_PPID is set."""
+    import threading
+
+    want = os.environ.get("RAY_TPU_WATCH_PPID")
+    if not want:
+        return
+    want_pid = int(want)
+
+    def watch():
+        while True:
+            time.sleep(1.0)
+            if os.getppid() != want_pid:
+                os._exit(0)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="parent-watch").start()
+
+
 def start_gcs_process(host: str = "127.0.0.1", port: int = 0,
-                      storage_dir: Optional[str] = None) -> tuple:
+                      storage_dir: Optional[str] = None,
+                      die_with_parent: bool = True) -> tuple:
     cmd = [sys.executable, "-m", "ray_tpu.core.distributed.gcs_server",
            "--host", host, "--port", str(port)]
     if storage_dir:
         cmd += ["--storage-dir", storage_dir]
+    env = child_env()
+    if die_with_parent:
+        env = _die_with_parent_env(env)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
-                            env=child_env())
+                            env=env)
     info = _read_handshake(proc, r"GCS_PORT=(?P<port>\d+)", "GCS server")
     return proc, f"{host}:{info['port']}"
 
@@ -92,6 +156,7 @@ def start_node_daemon_process(
     object_store_memory: int = 0,
     node_id: Optional[str] = None,
     extra_env: Optional[dict] = None,
+    die_with_parent: bool = True,
 ) -> tuple:
     import json
 
@@ -111,6 +176,8 @@ def start_node_daemon_process(
     env = child_env()
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
+    if die_with_parent:
+        env = _die_with_parent_env(env)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
                             env=env)
     info = _read_handshake(
@@ -133,6 +200,7 @@ def connect_or_start_cluster(
     resources: Optional[dict] = None,
     namespace: Optional[str] = None,
     object_store_memory: Optional[int] = None,
+    log_to_driver: bool = True,
 ) -> DistributedCoreWorker:
     spawned: List[subprocess.Popen] = []
     if address is None:
@@ -178,6 +246,7 @@ def connect_or_start_cluster(
         store_dir=node_info["store_dir"],
         job_id=job_id,
         is_driver=True,
+        log_to_driver=log_to_driver,
     )
     worker._spawned_processes = spawned
     # Breadcrumb for the CLI (`ray-tpu status` with no --address), like

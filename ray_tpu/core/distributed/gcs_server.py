@@ -13,6 +13,7 @@ tables gcs_table_storage.h). One asyncio process hosting:
   JobManager       — driver/job registry
   TaskEvents       — task event sink powering the state API
   Pubsub           — long-poll pub/sub (ref: src/ray/pubsub/)
+  LogManager       — worker log hub: ring buffers + driver streaming
 
 State lives in memory (the reference's default, ray_config_def.h:402
 gcs_storage="memory"); a Redis-equivalent durable backend can be slotted in
@@ -430,6 +431,7 @@ class ActorManager:
                 runtime_env=rec.runtime_env,
                 max_concurrency=rec.max_concurrency,
                 placement=rec.placement,
+                owner_job=rec.owner_job or "",
                 timeout=get_config().actor_creation_timeout_s)
         except Exception as e:  # noqa: BLE001
             logger.warning("start_actor on %s failed: %s", node.node_id[:8],
@@ -874,6 +876,61 @@ class AutoscalerStateManager:
         }
 
 
+class LogManager:
+    """Cluster log hub (ref: the log monitor → GCS pubsub → driver path,
+    python/ray/_private/log_monitor.py + worker.py print_logs): node
+    daemons ship tailed worker lines here; drivers subscribe to the
+    ``logs`` pubsub channel; a per-worker ring buffer keeps the last
+    lines of DEAD workers inspectable (dashboard/CLI `ray-tpu logs`)."""
+
+    RING_LINES = 400
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        # (node_id, worker_id, stream) -> deque[str]; insertion-ordered.
+        self._rings: Dict[tuple, deque] = {}
+        self._meta: Dict[tuple, dict] = {}
+
+    def add_logs(self, records: List[dict]) -> int:
+        for rec in records:
+            key = (rec["node_id"], rec["worker_id"], rec["stream"])
+            ring = self._rings.get(key)
+            if ring is None:
+                if len(self._rings) > 4000:  # oldest-worker eviction
+                    old = next(iter(self._rings))
+                    self._rings.pop(old, None)
+                    self._meta.pop(old, None)
+                ring = self._rings[key] = deque(maxlen=self.RING_LINES)
+            ring.extend(rec["lines"])
+            self._meta[key] = {"actor_id": rec.get("actor_id"),
+                               "job_id": rec.get("job_id"),
+                               "pid": rec.get("pid")}
+            self._gcs.pubsub.publish("logs", rec)
+        return len(records)
+
+    def tail_logs(self, node_id: Optional[str] = None,
+                  worker_id: Optional[str] = None,
+                  actor_id: Optional[str] = None,
+                  job_id: Optional[str] = None,
+                  num_lines: int = 100) -> List[dict]:
+        """Recent lines per matching worker stream (dead or alive)."""
+        out = []
+        for (nid, wid, stream), ring in self._rings.items():
+            meta = self._meta.get((nid, wid, stream), {})
+            if node_id and not nid.startswith(node_id):
+                continue
+            if worker_id and not wid.startswith(worker_id):
+                continue
+            if actor_id and not (meta.get("actor_id") or "").startswith(
+                    actor_id):
+                continue
+            if job_id and meta.get("job_id") != job_id:
+                continue
+            out.append({"node_id": nid, "worker_id": wid, "stream": stream,
+                        **meta, "lines": list(ring)[-num_lines:]})
+        return out
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  storage_dir: Optional[str] = None):
@@ -894,6 +951,7 @@ class GcsServer:
         self.task_events = TaskEvents()
         self.event_log = EventLog()
         self.autoscaler_state = AutoscalerStateManager(self)
+        self.logs = LogManager(self)
         self.server = RpcServer(host, port)
         self._daemon_clients: Dict[str, AsyncRpcClient] = {}
         self._tasks: List[asyncio.Task] = []
@@ -917,6 +975,7 @@ class GcsServer:
             ("EventLog", self.event_log),
             ("AutoscalerState", self.autoscaler_state),
             ("Pubsub", self.pubsub),
+            ("LogManager", self.logs),
         ]:
             self.server.add_service(name, svc)
         port = await self.server.start()
@@ -952,6 +1011,9 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format="[gcs] %(asctime)s %(levelname)s %(message)s")
+    from ray_tpu.core.distributed.driver import start_watch_parent_thread
+
+    start_watch_parent_thread()
 
     async def run():
         gcs = GcsServer(args.host, args.port, storage_dir=args.storage_dir)

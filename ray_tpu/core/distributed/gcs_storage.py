@@ -11,9 +11,12 @@ durable backend is a file pair per storage dir:
     wal.pkl        length-prefixed pickled (op, table, key, value)
                    records appended after the snapshot
 
-Writes append to the WAL synchronously (one small write + flush);
-a snapshot rewrite folds the WAL in whenever it grows past
-`snapshot_every` records. Load = snapshot + WAL replay.
+Writes append to the WAL synchronously (one small write + flush +
+fsync — flush alone only reaches the OS page cache, which a host/power
+failure loses; RAY_TPU_GCS_FSYNC=0 downgrades to process-restart-only
+durability when write latency matters more). A snapshot rewrite folds
+the WAL in whenever it grows past `snapshot_every` records. Load =
+snapshot + WAL replay.
 """
 from __future__ import annotations
 
@@ -33,6 +36,8 @@ class PersistentStore:
         self._snapshot_path = os.path.join(directory, "snapshot.pkl")
         self._wal_path = os.path.join(directory, "wal.pkl")
         self._snapshot_every = snapshot_every
+        self._fsync = os.environ.get(
+            "RAY_TPU_GCS_FSYNC", "1").lower() not in ("0", "false")
         self._lock = threading.Lock()
         self._tables: Dict[str, Dict[Any, Any]] = {}
         self._wal_count = 0
@@ -82,6 +87,8 @@ class PersistentStore:
         with self._lock:
             self._wal.write(_LEN.pack(len(blob)) + blob)
             self._wal.flush()
+            if self._fsync:
+                os.fsync(self._wal.fileno())
             self._wal_count += 1
             if self._wal_count >= self._snapshot_every:
                 self._compact_locked()
@@ -90,6 +97,9 @@ class PersistentStore:
         tmp = self._snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(self._tables, f, protocol=5)
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self._snapshot_path)
         self._wal.close()
         self._wal = open(self._wal_path, "wb")

@@ -19,6 +19,7 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 import uuid
 from collections import deque
@@ -43,6 +44,7 @@ class WorkerHandle:
         self.address: Optional[str] = None      # set on register
         self.busy = False
         self.actor_id: Optional[str] = None
+        self.job_id: Optional[str] = None       # last lease's job (logs)
         self.env_key = env_key        # runtime-env identity of this worker
         self.last_idle = time.monotonic()
         self.registered = asyncio.Event()
@@ -84,6 +86,11 @@ class NodeDaemon:
         self.store_dir = store_dir or f"/dev/shm/raytpu_{self.node_id[:12]}"
         self.store = ObjectStore(self.store_dir,
                                  capacity=object_store_memory or 0)
+        # Worker stdout/stderr files live OUTSIDE shm (logs are disk data,
+        # ref: session_latest/logs layout, node.py get_logs_dir_path).
+        self.log_dir = os.environ.get("RAY_TPU_LOG_DIR") or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_logs", self.node_id[:12])
+        os.makedirs(self.log_dir, exist_ok=True)
         self.gcs: Optional[AsyncRpcClient] = None
 
         self._workers: Dict[str, WorkerHandle] = {}     # worker_id -> handle
@@ -117,11 +124,25 @@ class NodeDaemon:
             "NodeInfo", "register_node", node_id=self.node_id,
             address=self.server.address, resources=self.total,
             store_dir=self.store_dir, labels=self.labels, timeout=30)
+        from ray_tpu.core.distributed.log_monitor import LogMonitor
+
+        self._dead_worker_info: Dict[str, dict] = {}
+
+        def worker_info(worker_id: str) -> dict:
+            h = self._workers.get(worker_id)
+            if h is None:
+                return self._dead_worker_info.get(worker_id, {})
+            return {"actor_id": h.actor_id, "job_id": h.job_id,
+                    "pid": h.proc.pid}
+
+        self._log_monitor = LogMonitor(self.log_dir, self.node_id,
+                                       worker_info)
         self._tasks = [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._monitor_workers_loop()),
             asyncio.ensure_future(self._refresh_view_loop()),
             asyncio.ensure_future(self._memory_monitor_loop()),
+            asyncio.ensure_future(self._log_monitor.run(self.gcs)),
         ]
         self._start_metrics_http()
         logger.info("node daemon %s on %s (resources=%s store=%s)",
@@ -226,9 +247,24 @@ class NodeDaemon:
             "--store-dir", self.store_dir,
             "--worker-id", worker_id,
         ]
-        proc = subprocess.Popen(cmd, env=env, cwd=cwd,
-                                stdout=subprocess.DEVNULL,
-                                stderr=None)
+        # Per-worker log files; the LogMonitor tails them to the GCS
+        # (ref: worker stdout/stderr files under session logs,
+        # node.py:1042 + log_monitor.py tailing).
+        out_f = open(os.path.join(self.log_dir,
+                                  f"worker-{worker_id}.out"), "ab")
+        err_f = open(os.path.join(self.log_dir,
+                                  f"worker-{worker_id}.err"), "ab")
+        from ray_tpu.core.distributed.driver import pdeathsig_preexec
+
+        try:
+            # die_with_parent: a SIGKILL'd daemon must not orphan its
+            # workers (they'd keep serving a dead node's address).
+            proc = subprocess.Popen(cmd, env=env, cwd=cwd,
+                                    stdout=out_f, stderr=err_f,
+                                    preexec_fn=pdeathsig_preexec)
+        finally:
+            out_f.close()
+            err_f.close()
         self._m_spawned.inc()
         handle = WorkerHandle(proc, worker_id, env_key=env_key)
         handle.actor_id = actor_id
@@ -523,11 +559,25 @@ class NodeDaemon:
                 break  # deque is in idle order; newer ones won't qualify
             self._idle.popleft()
             self._workers.pop(handle.worker_id, None)
+            self._retire_worker_logs(handle)
             try:
                 handle.proc.terminate()
             except Exception:  # noqa: BLE001
                 pass
             n_task_workers -= 1
+
+    def _retire_worker_logs(self, handle: WorkerHandle) -> None:
+        """Tombstone attribution for the final tail sweep, then let the
+        log monitor drain + unlink the dead worker's files."""
+        mon = getattr(self, "_log_monitor", None)
+        if mon is None:
+            return
+        self._dead_worker_info[handle.worker_id] = {
+            "actor_id": handle.actor_id, "job_id": handle.job_id,
+            "pid": handle.proc.pid}
+        while len(self._dead_worker_info) > 512:
+            self._dead_worker_info.pop(next(iter(self._dead_worker_info)))
+        mon.retire(handle.worker_id)
 
     async def _monitor_workers_loop(self):
         while True:
@@ -536,6 +586,7 @@ class NodeDaemon:
             for wid, handle in list(self._workers.items()):
                 if handle.proc.poll() is not None:
                     self._workers.pop(wid, None)
+                    self._retire_worker_logs(handle)
                     if handle in self._idle:
                         self._idle.remove(handle)
                     if handle.actor_id is not None:
@@ -562,8 +613,25 @@ class NodeDaemon:
                             affinity: Optional[str] = None,
                             soft: bool = False,
                             placement: Optional[Tuple[str, int]] = None,
-                            runtime_env: Optional[dict] = None
-                            ) -> dict:
+                            runtime_env: Optional[dict] = None,
+                            job_id: str = "") -> dict:
+        reply = await self._request_lease(demand, strategy, affinity, soft,
+                                          placement, runtime_env)
+        if job_id and reply.get("granted"):
+            # Log attribution: worker lines stream to the leasing job's
+            # driver (ref: log records carry the worker's job).
+            lease = self._leases.get(reply["lease_id"])
+            if lease is not None:
+                lease.worker.job_id = job_id
+        return reply
+
+    async def _request_lease(self, demand: Dict[str, float],
+                             strategy: str = "hybrid",
+                             affinity: Optional[str] = None,
+                             soft: bool = False,
+                             placement: Optional[Tuple[str, int]] = None,
+                             runtime_env: Optional[dict] = None
+                             ) -> dict:
         cfg = get_config()
         # Placement-group leases draw from the reserved bundle.
         if placement is not None:
@@ -854,8 +922,8 @@ class NodeDaemon:
                           args_blob: bytes, demand: Dict[str, float],
                           runtime_env: Optional[dict] = None,
                           max_concurrency: int = 1,
-                          placement: Optional[Tuple[str, int]] = None
-                          ) -> dict:
+                          placement: Optional[Tuple[str, int]] = None,
+                          owner_job: str = "") -> dict:
         if placement is not None:
             placement = tuple(placement)
             bundle = self._pg_bundles.get(placement)
@@ -897,6 +965,7 @@ class NodeDaemon:
                     return {"ok": False,
                             "error": "actor worker failed to start"}
         handle.busy = True
+        handle.job_id = owner_job or handle.job_id
         client = AsyncRpcClient(handle.address)
         try:
             reply = await client.call(
@@ -1077,6 +1146,12 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format="[raylet] %(asctime)s %(levelname)s %(message)s")
+    # Exit when the spawning driver/launcher dies (workers then follow
+    # via their PDEATHSIG, which is safe for THEM: they are forked from
+    # this process's long-lived main thread).
+    from ray_tpu.core.distributed.driver import start_watch_parent_thread
+
+    start_watch_parent_thread()
 
     import json
 

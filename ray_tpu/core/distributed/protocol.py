@@ -8,9 +8,8 @@ direct-call objects ≤ max_direct_call_object_size).
 """
 from __future__ import annotations
 
-import dataclasses
 import hashlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import cloudpickle
 
@@ -35,13 +34,26 @@ def function_key(func_or_cls) -> bytes:
     return hashlib.sha1(blob).digest(), blob
 
 
+_EMPTY_ARGS_BLOB: Optional[bytes] = None
+_EMPTY_DEPS: List[bytes] = []
+
+
 def pack_args(args: List[Any], kwargs: Dict[str, Any],
               promote) -> Tuple[bytes, List[bytes]]:
     """Serialize (args, kwargs) replacing top-level ObjectRefs with markers.
 
     `promote(ref)` must guarantee the ref's value is readable from the shm
     store / directory by the executing worker. Returns (blob, dep_oids).
+
+    No-arg calls (the dominant shape on actor hot paths) reuse one cached
+    blob — zero serialization work per call.
     """
+    if not args and not kwargs:
+        global _EMPTY_ARGS_BLOB
+        if _EMPTY_ARGS_BLOB is None:
+            _EMPTY_ARGS_BLOB = serialization.dumps(([], {}))
+        return _EMPTY_ARGS_BLOB, _EMPTY_DEPS
+
     deps: List[bytes] = []
 
     def conv(v):
@@ -68,8 +80,12 @@ def unpack_args(blob: bytes, fetch) -> Tuple[List[Any], Dict[str, Any]]:
     return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
 
 
-@dataclasses.dataclass
-class TaskResult:
+class TaskResult(NamedTuple):
+    """One task return on the wire. NamedTuple, not dataclass: replies
+    carry one per return value at tens of thousands per second, and a
+    NamedTuple pickles as a bare args tuple (a dataclass drags a full
+    __dict__ state round-trip)."""
+
     oid: bytes
     size: int
     inline: Optional[bytes] = None   # full framed payload if small

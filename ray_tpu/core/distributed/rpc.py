@@ -72,8 +72,10 @@ async def _read_frame(reader: asyncio.StreamReader
                       ) -> Tuple[int, int, bytes]:
     head = await reader.readexactly(_HEADER.size)
     length, ftype, req_id = _HEADER.unpack(head)
-    if length > MAX_FRAME:
-        raise RpcError(f"frame of {length} bytes exceeds limit")
+    if length < 9 or length > MAX_FRAME:
+        # < 9 would make readexactly() below receive a negative count;
+        # either way the stream is garbage and must be dropped.
+        raise RpcError(f"malformed frame length {length}")
     payload = await reader.readexactly(length - 9)
     return ftype, req_id, payload
 
@@ -156,7 +158,10 @@ class RpcServer:
                          "traceback": traceback.format_exc()}
             finally:
                 inflight.pop(req_id, None)
-            await send(RES, req_id, reply)
+            try:
+                await send(RES, req_id, reply)
+            except (ConnectionError, OSError):
+                pass  # client hung up mid-reply; nothing to tell it
 
         async def run_stream(req_id: int, fn, kwargs: dict) -> None:
             try:
@@ -166,18 +171,24 @@ class RpcServer:
             except asyncio.CancelledError:
                 inflight.pop(req_id, None)
                 raise
+            except (ConnectionError, OSError):
+                inflight.pop(req_id, None)
+                return  # consumer hung up mid-stream
             except Exception as e:  # noqa: BLE001
                 end = {"ok": False, "error": e}
             finally:
                 inflight.pop(req_id, None)
-            await send(STREAM_END, req_id, end)
+            try:
+                await send(STREAM_END, req_id, end)
+            except (ConnectionError, OSError):
+                pass
 
         try:
             while True:
                 try:
                     ftype, req_id, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionError,
-                        OSError):
+                        OSError, RpcError):
                     return
                 if ftype == CANCEL:
                     task = inflight.pop(req_id, None)
@@ -274,12 +285,20 @@ class AsyncRpcClient:
                     if q is not None:
                         q.put_nowait(("end", _de(payload)))
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError) as e:
-            err = RpcError(f"connection to {self.address} lost: {e!r}")
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(err)
+                RpcError, asyncio.CancelledError) as e:
+            if isinstance(e, asyncio.CancelledError):
+                # Deliberate close(): cancel waiters instead of setting
+                # exceptions nobody will retrieve.
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.cancel()
+            else:
+                err = RpcError(f"connection to {self.address} lost: {e!r}")
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(err)
             self._pending.clear()
+            err = RpcError(f"connection to {self.address} lost: {e!r}")
             for q in self._streams.values():
                 q.put_nowait(("end", {"ok": False, "error": err}))
             self._streams.clear()
@@ -373,15 +392,29 @@ class AsyncRpcClient:
         return gen()
 
     async def close(self) -> None:
+        """Clean shutdown: cancel AND await the read loop (a cancelled-
+        but-never-awaited task produces 'Task was destroyed but it is
+        pending!' at interpreter exit), cancel pending call futures, and
+        close the transport."""
         self._closed = True
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-        if self._writer is not None:
+        task, self._reader_task = self._reader_task, None
+        if task is not None:
+            task.cancel()
             try:
-                self._writer.close()
-            except Exception:  # noqa: BLE001
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
-            self._writer = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            try:
+                writer.close()
+                await asyncio.wait_for(writer.wait_closed(), 0.5)
+            except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                pass
 
 
 class EventLoopThread:
@@ -394,6 +427,14 @@ class EventLoopThread:
 
     def __init__(self, name: str = "rpc-loop"):
         self.loop = asyncio.new_event_loop()
+        # Strong roots for submitted background tasks: asyncio holds only
+        # WEAK references to tasks, so a fire-and-forget coroutine whose
+        # awaited future is reachable only through its own frame (task →
+        # frame → client → queue → future → task) is one unreferenced
+        # cycle the GC will happily collect MID-FLIGHT — the coroutine
+        # silently dies with GeneratorExit (observed: the driver's log
+        # subscriber vanished at the first gc pass after init).
+        self._bg_tasks: set = set()
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
         self._started = threading.Event()
@@ -411,29 +452,42 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def submit(self, coro):
-        """Fire-and-forget (returns concurrent Future)."""
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        """Fire-and-forget (returns concurrent Future). The task is
+        rooted in self._bg_tasks until done — see __init__."""
+        async def rooted():
+            task = asyncio.current_task()
+            self._bg_tasks.add(task)
+            try:
+                return await coro
+            finally:
+                self._bg_tasks.discard(task)
+
+        return asyncio.run_coroutine_threadsafe(rooted(), self.loop)
 
     def stop(self):
+        async def _drain():
+            # Sweep REPEATEDLY: a cancelled task's cleanup can spawn new
+            # tasks (e.g. a failure handler resubmitting work), and a
+            # single sweep would leave those to die as destroyed-pending
+            # tasks at interpreter exit.
+            deadline = self.loop.time() + 2.0
+            try:
+                while True:
+                    tasks = [t for t in asyncio.all_tasks(self.loop)
+                             if t is not asyncio.current_task()]
+                    if not tasks or self.loop.time() >= deadline:
+                        break
+                    for task in tasks:
+                        task.cancel()
+                    await asyncio.wait(tasks, timeout=0.3)
+            finally:
+                self.loop.stop()
+
         def _shutdown():
-            tasks = [t for t in asyncio.all_tasks(self.loop)
-                     if t is not asyncio.current_task(self.loop)]
-            for task in tasks:
-                task.cancel()
-
-            async def finish():
-                try:
-                    await asyncio.wait_for(
-                        asyncio.gather(*tasks, return_exceptions=True), 1.0)
-                except (TimeoutError, asyncio.TimeoutError):
-                    pass
-                finally:
-                    self.loop.stop()
-
-            asyncio.ensure_future(finish())
+            asyncio.ensure_future(_drain())
 
         self.loop.call_soon_threadsafe(_shutdown)
-        self._thread.join(timeout=3)
+        self._thread.join(timeout=4)
 
 
 class _BlockingConn:
@@ -445,10 +499,31 @@ class _BlockingConn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = bytearray()
 
-    def roundtrip(self, req_id: int, payload: bytes,
-                  timeout: Optional[float]) -> Any:
+    def stale(self) -> bool:
+        """Has the peer closed this pooled socket (restarted server)?
+
+        A non-blocking MSG_PEEK distinguishes 'peer sent FIN/RST while
+        pooled' from 'healthy idle socket' WITHOUT consuming data —
+        detecting staleness BEFORE the request is sent, so the caller
+        never has to guess whether a failed request already executed."""
+        try:
+            self.sock.setblocking(False)
+            try:
+                data = self.sock.recv(1, socket.MSG_PEEK)
+                return data == b""      # orderly FIN
+            finally:
+                self.sock.setblocking(True)
+        except BlockingIOError:
+            return False                # nothing to read: healthy idle
+        except OSError:
+            return True                 # RST or dead fd
+
+    def send_request(self, req_id: int, payload: bytes,
+                     timeout: Optional[float]) -> None:
         self.sock.settimeout(timeout)
         self.sock.sendall(_frame(REQ, req_id, payload))
+
+    def recv_reply(self, req_id: int) -> Any:
         while True:
             ftype, rid, body = self._recv_frame()
             if ftype == RES and rid == req_id:
@@ -465,6 +540,8 @@ class _BlockingConn:
                 raise ConnectionError("peer closed")
             self._buf += chunk
         length, ftype, req_id = _HEADER.unpack_from(self._buf, 0)
+        if length < 9 or length > MAX_FRAME:
+            raise RpcError(f"malformed frame length {length}")
         total = _HEADER.size + length - 9
         while len(self._buf) < total:
             chunk = self.sock.recv(1024 * 1024)
@@ -500,63 +577,100 @@ class SyncRpcClient:
         self._sem = threading.BoundedSemaphore(self.MAX_POOL)
 
     def call(self, service: str, method: str,
-             timeout: Optional[float] = None, **kwargs) -> Any:
+             timeout: Optional[float] = None, idempotent: bool = False,
+             **kwargs) -> Any:
+        """One blocking RPC.
+
+        Retry semantics (at-most-once by default): stale pooled sockets
+        are detected with a MSG_PEEK probe BEFORE the request is sent,
+        and a send-phase failure retries on a fresh connection — in both
+        cases the request provably never executed. A failure during the
+        reply phase means the server may have already executed the
+        handler, so it is NOT retried (gRPC's transparent reconnect has
+        the same rule) — unless the caller declares the method
+        `idempotent=True` (reads, status polls, overwriting KV puts).
+        """
         payload = _ser((service, method, kwargs))
         with self._lock:
             self._req_id += 1
             req_id = self._req_id
-            conn = self._pool.pop() if self._pool else None
-        self._sem.acquire()
-        try:
-            fresh = conn is None
-            if fresh:
-                try:
-                    conn = _BlockingConn(self.address)
-                except OSError as e:
-                    raise RpcError(
-                        f"connect to {self.address} failed: {e}") from e
+
+        def fresh_conn() -> _BlockingConn:
             try:
-                reply = conn.roundtrip(req_id, payload, timeout)
-            except socket.timeout:
-                # Mid-reply socket is unusable: drop it. The server sees
-                # the close and cancels the handler (deadline parity).
-                conn.close()
+                return _BlockingConn(self.address)
+            except OSError as e:
                 raise RpcError(
-                    f"RPC {service}.{method} to {self.address} failed: "
-                    f"DEADLINE_EXCEEDED after {timeout}s") from None
-            except (ConnectionError, OSError) as e:
-                conn.close()
-                if fresh:
-                    raise RpcError(
-                        f"RPC {service}.{method} to {self.address} "
-                        f"failed: {e!r}") from e
-                # A pooled socket may be stale (peer restarted since it
-                # was pooled): retry ONCE on a fresh connection, like the
-                # transparent reconnect of the gRPC channel this replaced.
-                try:
-                    conn = _BlockingConn(self.address)
-                    reply = conn.roundtrip(req_id, payload, timeout)
-                except socket.timeout:
+                    f"connect to {self.address} failed: {e}") from e
+
+        def rpc_error(e, phase: str) -> RpcError:
+            return RpcError(
+                f"RPC {service}.{method} to {self.address} failed "
+                f"({phase}): {e!r}")
+
+        self._sem.acquire()
+        conn = None
+        try:
+            # Pull a pooled socket, discarding any the peer has closed.
+            while conn is None:
+                with self._lock:
+                    if not self._pool:
+                        break
+                    conn = self._pool.pop()
+                if conn.stale():
                     conn.close()
+                    conn = None
+            if conn is None:
+                conn = fresh_conn()
+            try:
+                conn.send_request(req_id, payload, timeout)
+            except (ConnectionError, OSError, socket.timeout) as e:
+                # Request never fully reached the server (a partial
+                # frame is dropped by the server's length check): safe
+                # to retry once on a fresh connection.
+                conn.close()
+                conn = fresh_conn()
+                try:
+                    conn.send_request(req_id, payload, timeout)
+                except (ConnectionError, OSError, socket.timeout) as e2:
+                    conn.close()
+                    raise rpc_error(e2, "send") from e2
+            for attempt in (0, 1):
+                try:
+                    reply = conn.recv_reply(req_id)
+                    break
+                except socket.timeout:
+                    # Mid-reply socket is unusable: drop it. The server
+                    # sees the close and cancels the handler (deadline
+                    # parity with gRPC).
+                    conn.close()
+                    conn = None
                     raise RpcError(
                         f"RPC {service}.{method} to {self.address} "
                         f"failed: DEADLINE_EXCEEDED after {timeout}s"
                     ) from None
-                except (ConnectionError, OSError) as e2:
+                except (ConnectionError, OSError, RpcError) as e:
+                    conn.close()
+                    conn = None
+                    if not idempotent or attempt:
+                        raise rpc_error(e, "recv") from e
+                    conn = fresh_conn()
                     try:
+                        conn.send_request(req_id, payload, timeout)
+                    except (ConnectionError, OSError,
+                            socket.timeout) as e2:
                         conn.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                    raise RpcError(
-                        f"RPC {service}.{method} to {self.address} "
-                        f"failed: {e2!r}") from e2
+                        conn = None
+                        raise rpc_error(e2, "send") from e2
             with self._lock:
-                if len(self._pool) < self.MAX_POOL:
+                if conn is not None and len(self._pool) < self.MAX_POOL:
                     self._pool.append(conn)
                     conn = None
             if conn is not None:
                 conn.close()
+                conn = None
         finally:
+            if conn is not None:
+                conn.close()
             self._sem.release()
         if not reply["ok"]:
             raise reply["error"]

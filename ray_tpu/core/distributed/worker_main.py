@@ -94,12 +94,29 @@ class ActorRuntime:
         if self._ordered and len(ready) > 1:
             # Ordered sync actor (every method sync when _ordered): run
             # the whole contiguous run in ONE pool job — per-call thread
-            # dispatch would cost more than the methods themselves.
+            # dispatch would cost more than the methods themselves. Reply
+            # delivery is chunked: one loop wakeup per 64 replies instead
+            # of per reply (each call_soon_threadsafe is a syscall + a
+            # GIL fight with the executing thread).
             def run_batch():
+                chunk = []
+
+                def flush():
+                    items, chunk[:] = chunk[:], []
+
+                    def deliver():
+                        for f, r in items:
+                            if not f.done():
+                                f.set_result(r)
+
+                    main_loop.call_soon_threadsafe(deliver)
+
                 for spec, fut in ready:
-                    reply = execute(spec)
-                    main_loop.call_soon_threadsafe(
-                        lambda f=fut, r=reply: f.done() or f.set_result(r))
+                    chunk.append((fut, execute(spec)))
+                    if len(chunk) >= 64:
+                        flush()
+                if chunk:
+                    flush()
 
             self._pool.submit(run_batch)
             return

@@ -82,6 +82,8 @@ def write_to(buf: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
 
 def dumps(obj: Any, *, is_error: bool = False) -> bytes:
     meta, buffers = serialize(obj, is_error=is_error)
+    if not buffers:
+        return meta  # head + pickle, nothing to align
     out = io.BytesIO()
     out.write(meta)
     off = len(meta)

@@ -211,6 +211,7 @@ class CompiledDAG:
         # Launch one loop per method node.
         from ray_tpu.actor import ActorHandle, ActorMethod
 
+        seen_actors: Dict[bytes, str] = {}
         for n in method_nodes:
             target = n._target
             if isinstance(target, ActorClassNode):
@@ -219,6 +220,18 @@ class CompiledDAG:
                 raise ValueError(
                     f"compiled DAG method target must be an actor, got "
                     f"{type(target).__name__}")
+            # Each node runs an infinite __raytpu_apply__ loop on its
+            # actor; with the default max_concurrency=1 a second node on
+            # the SAME actor would queue behind the first forever, and
+            # every execute() would die with an opaque submit timeout.
+            if target._actor_id in seen_actors:
+                raise ValueError(
+                    f"compiled DAG binds two methods of the same actor "
+                    f"({seen_actors[target._actor_id]!r} and "
+                    f"{n._method_name!r} on {target}); each actor may "
+                    "appear in at most one node — use a second actor, "
+                    "or fold the methods into one")
+            seen_actors[target._actor_id] = n._method_name
             in_channels: List[Tuple[Channel, int]] = []
             chan_index: Dict[int, int] = {}
 
@@ -297,7 +310,8 @@ class CompiledDAG:
         (non-blocking), releasing ring backpressure."""
         while (self._next_read_idx < self._exec_idx
                and len(self._result_buffer) < self.MAX_BUFFERED_RESULTS):
-            if not all(ch.peek_ready() for ch, _ in self._output_readers):
+            if not all(ch.peek_ready(slot)
+                       for ch, slot in self._output_readers):
                 return
             outs = [ch.read(timeout=1.0, reader_idx=slot)
                     for ch, slot in self._output_readers]
@@ -335,7 +349,8 @@ class CompiledDAG:
         next_liveness = time.monotonic() + 1.0
         backoff = 1e-6
         while True:
-            if all(ch.peek_ready() for ch, _ in self._output_readers):
+            if all(ch.peek_ready(slot)
+                   for ch, slot in self._output_readers):
                 return [ch.read(timeout=5.0, reader_idx=slot)
                         for ch, slot in self._output_readers]
             now = time.monotonic()

@@ -202,6 +202,17 @@ class DashboardHead:
         events = await self._call("TaskEvents", "list_events", limit=limit)
         return self._json(chrome_trace(events))
 
+    async def _logs(self, request):
+        """Ring-buffered worker logs from the GCS LogManager — includes
+        DEAD workers' last lines (ref: dashboard log viewer over the
+        log monitor's files)."""
+        q = request.query
+        return self._json(await self._call(
+            "LogManager", "tail_logs",
+            node_id=q.get("node_id"), worker_id=q.get("worker_id"),
+            actor_id=q.get("actor_id"), job_id=q.get("job_id"),
+            num_lines=int(q.get("lines", "100"))))
+
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> int:
         from aiohttp import web
@@ -217,6 +228,7 @@ class DashboardHead:
         app.router.add_get("/api/cluster_status", self._cluster_status)
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/logs", self._logs)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

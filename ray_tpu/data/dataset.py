@@ -49,6 +49,35 @@ def _row_map_blockfn(kind: str, fn):
     return block_fn
 
 
+def _rebatch(block_iter: Iterable[B.Block], batch_size: int,
+             batch_format: Optional[str], drop_last: bool) -> Iterator[Any]:
+    """Re-slice a block stream into fixed-size batches."""
+    carry: Optional[B.Block] = None
+    for blk in block_iter:
+        if carry is not None and carry.num_rows:
+            blk = B.concat([carry, blk])
+            carry = None
+        start = 0
+        while blk.num_rows - start >= batch_size:
+            yield B.to_batch(blk.slice(start, batch_size), batch_format)
+            start += batch_size
+        carry = blk.slice(start)
+    if carry is not None and carry.num_rows and not drop_last:
+        yield B.to_batch(carry, batch_format)
+
+
+def _torch_batches(batch_iter: Iterator[dict]) -> Iterator[dict]:
+    """numpy batches → torch tensors (copying read-only shm views;
+    torch needs writable memory for in-place training ops)."""
+    import torch
+
+    for batch in batch_iter:
+        yield {k: torch.as_tensor(
+                   v if getattr(v, "flags", None) is None
+                   or v.flags.writeable else np.array(v))
+               for k, v in batch.items()}
+
+
 class Dataset:
     def __init__(self, read_tasks: List[ReadTask], stages: List[Any] = None):
         self._read_tasks = read_tasks
@@ -187,18 +216,68 @@ class Dataset:
         return self._with(AllToAllStage("RandomShuffle", ref_fn))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed range-partition sort (ref: _internal/planner/
+        exchange/sort_task_spec.py:1 SortTaskSpec — sample → boundaries →
+        per-block partition map → per-range merge). No task ever holds
+        more than ~1/num_blocks of the data, so datasets larger than any
+        single worker's memory sort fine (the previous one-task
+        `sort_all` funneled everything through one worker)."""
+        order = "descending" if descending else "ascending"
+
         def ref_fn(refs):
             refs = list(refs)
             if not refs:
                 return refs
+            n_out = len(refs)
 
             @ray_tpu.remote
-            def sort_all(*blocks):
-                t = B.concat(list(blocks))
-                order = "descending" if descending else "ascending"
-                return t.sort_by([(key, order)])
+            def sort_block(b):
+                return b.sort_by([(key, order)])
 
-            return [sort_all.remote(*refs)]
+            if n_out == 1:
+                return [sort_block.remote(refs[0])]
+
+            # 1) Sample boundary candidates from every block.
+            @ray_tpu.remote
+            def sample_keys(block, k=64):
+                if block.num_rows == 0:
+                    return None
+                idx = np.linspace(0, block.num_rows - 1,
+                                  min(k, block.num_rows)).astype(np.int64)
+                return (block.column(key).take(pa.array(idx))
+                        .to_numpy(zero_copy_only=False))
+
+            samples = [s for s in ray_tpu.get(
+                [sample_keys.remote(r) for r in refs]) if s is not None]
+            if not samples:
+                return refs
+            allsamp = np.sort(np.concatenate(samples))
+            cut_idx = np.linspace(0, allsamp.size - 1,
+                                  n_out + 1).astype(np.int64)[1:-1]
+            bounds = allsamp[cut_idx]
+
+            # 2) Partition map: each block splits into n_out key ranges
+            # (always ascending; descending flips the range order below).
+            @ray_tpu.remote
+            def partition(block, bnds, n):
+                sb = block.sort_by([(key, "ascending")])
+                keys = sb.column(key).to_numpy(zero_copy_only=False)
+                cuts = np.searchsorted(keys, bnds, side="left")
+                edges = [0, *cuts.tolist(), sb.num_rows]
+                parts = tuple(sb.slice(edges[i], edges[i + 1] - edges[i])
+                              for i in range(n))
+                return parts[0] if n == 1 else parts
+
+            # 3) Per-range merge: concat this range's shards + local sort.
+            @ray_tpu.remote
+            def merge(*parts):
+                return B.concat(list(parts)).sort_by([(key, order)])
+
+            parted = [partition.options(num_returns=n_out)
+                      .remote(r, bounds, n_out) for r in refs]
+            out = [merge.remote(*[parted[i][j] for i in range(len(refs))])
+                   for j in range(n_out)]
+            return out[::-1] if descending else out
 
         return self._with(AllToAllStage("Sort", ref_fn))
 
@@ -264,6 +343,18 @@ class Dataset:
             out.append(from_block_list([t]))
         return out
 
+    def streaming_split(self, n: int, *, equal: bool = False
+                        ) -> List["StreamingSplitIterator"]:
+        """N per-consumer iterators over ONE streaming execution of this
+        dataset (ref: _internal/execution/operators/output_splitter.py:1
+        OutputSplitter + Dataset.streaming_split — the multi-worker Train
+        ingest path). Blocks are handed out first-come-first-served by a
+        coordinator actor, so fast consumers take more and slow ones
+        never stall the pipeline; `equal=True` instead enforces
+        round-robin handout (consumers advance in lockstep)."""
+        coord = _SplitCoordinator.remote(self, n, equal)
+        return [StreamingSplitIterator(coord, i) for i in range(n)]
+
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
         whole = B.concat(ray_tpu.get(list(self.to_block_refs())))
         bounds = [0] + list(indices) + [whole.num_rows]
@@ -311,34 +402,15 @@ class Dataset:
                      batch_format: Optional[str] = None,
                      prefetch_batches: int = 1,
                      drop_last: bool = False) -> Iterator[Any]:
-        carry: Optional[B.Block] = None
-        for blk in self.iter_blocks():
-            if carry is not None and carry.num_rows:
-                blk = B.concat([carry, blk])
-                carry = None
-            start = 0
-            while blk.num_rows - start >= batch_size:
-                yield B.to_batch(blk.slice(start, batch_size), batch_format)
-                start += batch_size
-            carry = blk.slice(start)
-        if carry is not None and carry.num_rows and not drop_last:
-            yield B.to_batch(carry, batch_format)
+        yield from _rebatch(self.iter_blocks(), batch_size, batch_format,
+                            drop_last)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            drop_last: bool = False) -> Iterator[dict]:
         """Batches as torch tensors (ref: Dataset.iter_torch_batches)."""
-        import torch
-
-        for batch in self.iter_batches(batch_size=batch_size,
-                                       batch_format="numpy",
-                                       drop_last=drop_last):
-            # Arrow-backed arrays are read-only views of the shm store;
-            # torch needs writable memory (in-place training ops), so
-            # copy those (the reference's iterator copies too).
-            yield {k: torch.as_tensor(
-                       v if getattr(v, "flags", None) is None
-                       or v.flags.writeable else np.array(v))
-                   for k, v in batch.items()}
+        yield from _torch_batches(self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last))
 
     def iter_rows(self) -> Iterator[Any]:
         for blk in self.iter_blocks():
@@ -481,6 +553,82 @@ class Dataset:
         names = [getattr(s, "name", "?") for s in self._stages]
         return (f"Dataset(blocks~{len(self._read_tasks)}, "
                 f"stages={names})")
+
+
+@ray_tpu.remote(num_cpus=0)
+class _SplitCoordinator:
+    """Hands one streaming execution's block refs out to N consumers
+    (ref: output_splitter.py OutputSplitter). Lives in an actor so every
+    consumer — typically a Train worker on another node — pulls from the
+    SAME execution instead of re-executing the dataset per shard."""
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self._n = n
+        self._equal = equal
+        self._it = iter(dataset.to_block_refs())
+        self._queues: List[list] = [[] for _ in range(n)]
+        self._next_rr = 0
+        self._done = False
+
+    def _pull(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._done = True
+            return None
+
+    def next_block(self, consumer_idx: int):
+        """Next block ref for this consumer, or None when exhausted."""
+        if not self._equal:
+            return None if self._done else self._pull()
+        q = self._queues[consumer_idx]
+        while not q and not self._done:
+            ref = self._pull()
+            if ref is None:
+                break
+            self._queues[self._next_rr].append(ref)
+            self._next_rr = (self._next_rr + 1) % self._n
+        return q.pop(0) if q else None
+
+
+class StreamingSplitIterator:
+    """One consumer's shard of a streaming_split (ref: DataIterator,
+    python/ray/data/iterator.py — the object handed to each Train
+    worker). Pickles cleanly (actor handle + index), single pass.
+
+    `block_timeout_s` bounds each next_block wait (None = wait forever,
+    the default: the FIRST block legitimately waits on the whole
+    upstream pipeline — an AllToAll barrier, autoscaler provisioning)."""
+
+    def __init__(self, coord, idx: int,
+                 block_timeout_s: Optional[float] = None):
+        self._coord = coord
+        self._idx = idx
+        self._block_timeout_s = block_timeout_s
+
+    def iter_blocks(self) -> Iterator[B.Block]:
+        while True:
+            ref = ray_tpu.get(self._coord.next_block.remote(self._idx),
+                              timeout=self._block_timeout_s)
+            if ref is None:
+                return
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False) -> Iterator[Any]:
+        yield from _rebatch(self.iter_blocks(), batch_size, batch_format,
+                            drop_last)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[dict]:
+        yield from _torch_batches(self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last))
+
+    def iter_rows(self) -> Iterator[Any]:
+        for blk in self.iter_blocks():
+            yield from B.iter_rows(blk)
 
 
 class GroupedData:

@@ -84,7 +84,7 @@ class Channel:
         self.n_readers = n_readers
         self.n_slots = n_slots
         self._mm: Optional[mmap.mmap] = None
-        self._last_read = 0             # last consumed version
+        self._last_read: Optional[int] = None  # last consumed version
         self._w_seq: Optional[int] = None
 
     # -- layout ---------------------------------------------------------
@@ -192,17 +192,27 @@ class Channel:
         _U64.pack_into(mm, _WSEQ_OFF, v)
         self._w_seq = v
 
-    def peek_ready(self) -> bool:
+    def _recover_last_read(self, mm, reader_idx: int) -> int:
+        """First touch in this process: resume from the reader's ack word
+        in shared memory (mirror of the writer's _w_seq recovery) — a
+        restarted/re-unpickled reader that starts at 0 would wait forever
+        for a version whose slot was overwritten long ago."""
+        if self._last_read is None:
+            self._last_read = _U64.unpack_from(
+                mm, _ACKS_OFF + 8 * reader_idx)[0]
+        return self._last_read
+
+    def peek_ready(self, reader_idx: int = 0) -> bool:
         """Is the next version already published? (non-consuming)."""
         mm = self._map()
-        v = self._last_read + 1
+        v = self._recover_last_read(mm, reader_idx) + 1
         off = self._slot_off((v - 1) % self.n_slots)
         return _U64.unpack_from(mm, off)[0] == 2 * v
 
     def read(self, timeout: Optional[float] = None,
              reader_idx: int = 0) -> Any:
         mm = self._map()
-        v = self._last_read + 1
+        v = self._recover_last_read(mm, reader_idx) + 1
         off = self._slot_off((v - 1) % self.n_slots)
 
         def published():

@@ -274,6 +274,55 @@ def cmd_stack(gcs: _Gcs, args) -> None:
                 print(f"  <unreachable: {e}>")
 
 
+def cmd_logs(gcs: _Gcs, args) -> None:
+    """Worker log access (ref: `ray logs` CLI, log_monitor tailing):
+    dumps the GCS ring buffers (works for DEAD workers too), or streams
+    the live pubsub channel with --follow."""
+    if args.follow:
+        import asyncio
+
+        from ray_tpu.core.distributed.log_monitor import format_log_prefix
+        from ray_tpu.core.distributed.rpc import AsyncRpcClient
+
+        async def follow():
+            client = AsyncRpcClient(gcs.address)
+            try:
+                async for rec in client.stream(
+                        "Pubsub", "stream_subscribe", channel="logs"):
+                    if args.node and not rec["node_id"].startswith(
+                            args.node):
+                        continue
+                    if args.worker and not rec["worker_id"].startswith(
+                            args.worker):
+                        continue
+                    if args.actor and not (rec.get("actor_id")
+                                           or "").startswith(args.actor):
+                        continue
+                    if args.job and rec.get("job_id") != args.job:
+                        continue
+                    prefix = format_log_prefix(rec)
+                    for line in rec["lines"]:
+                        print(f"{prefix} {line}", flush=True)
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(follow())
+        except KeyboardInterrupt:
+            pass
+        return
+    records = gcs.call("LogManager", "tail_logs", node_id=args.node,
+                       worker_id=args.worker, actor_id=args.actor,
+                       job_id=args.job, num_lines=args.lines)
+    for rec in sorted(records, key=lambda r: (r["node_id"],
+                                              r["worker_id"])):
+        who = (f"actor={rec['actor_id'][:12]}" if rec.get("actor_id")
+               else f"worker={rec['worker_id'][:12]}")
+        print(f"== {who} node={rec['node_id'][:12]} [{rec['stream']}]")
+        for line in rec["lines"]:
+            print(f"  {line}")
+
+
 def cmd_dashboard(args) -> None:
     """Serve the web dashboard for a running cluster (ref: `ray
     dashboard`, dashboard/head.py)."""
@@ -304,7 +353,7 @@ def cmd_start(args) -> None:
     )
 
     if args.head:
-        gcs_proc, gcs_address = start_gcs_process()
+        gcs_proc, gcs_address = start_gcs_process(die_with_parent=False)
         print(f"GCS started at {gcs_address}")
         os.makedirs(os.path.dirname(BREADCRUMB), mode=0o700, exist_ok=True)
         with open(BREADCRUMB, "w") as f:
@@ -314,11 +363,36 @@ def cmd_start(args) -> None:
             sys.exit("error: worker start needs --address <gcs>")
         gcs_address = args.address
     proc, info = start_node_daemon_process(
-        gcs_address, num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+        gcs_address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        die_with_parent=False)
     print(f"node daemon {info['node_id'][:12]} at {info['address']} "
           f"(store {info['store_dir']})")
     print(f"join more nodes with: ray-tpu start --address {gcs_address}")
     print("processes run until killed (Ctrl-C detaches, does not stop them)")
+
+
+def cmd_up(args) -> None:
+    """Launch a cluster from a YAML config (ref: `ray up`,
+    autoscaler/_private/commands.py create_or_update_cluster)."""
+    if args.no_block:
+        # The autoscaler must outlive this CLI process: run the blocking
+        # launcher detached (its own session; `ray-tpu down` reaps it).
+        from ray_tpu.autoscaler.launcher import spawn_detached_launcher
+
+        address = spawn_detached_launcher(args.config)
+        print(f"cluster up (detached launcher); connect with "
+              f"ray_tpu.init(address={address!r})")
+        return
+    from ray_tpu.autoscaler.launcher import cluster_up
+
+    cluster_up(args.config, block=True)
+
+
+def cmd_down(args) -> None:
+    """Tear down a launched cluster (ref: `ray down`)."""
+    from ray_tpu.autoscaler.launcher import cluster_down
+
+    cluster_down(args.config)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -357,8 +431,30 @@ def main(argv: Optional[List[str]] = None) -> None:
     kp.add_argument("--worker", help="worker id prefix filter")
     kp.add_argument("--duration", type=float, default=2.0)
     kp.add_argument("--out", help="write collapsed flamegraph stacks")
+    up = sub.add_parser("up")
+    up.add_argument("config", help="cluster YAML path")
+    up.add_argument("--no-block", action="store_true",
+                    help="return after startup; the autoscaler runs in a "
+                         "detached launcher process (`ray-tpu down` "
+                         "stops it)")
+    dn = sub.add_parser("down")
+    dn.add_argument("config", help="cluster YAML path or cluster name")
+    gp = sub.add_parser("logs")
+    gp.add_argument("--node", help="node id prefix filter")
+    gp.add_argument("--worker", help="worker id prefix filter")
+    gp.add_argument("--actor", help="actor id prefix filter")
+    gp.add_argument("--job", help="exact job id filter")
+    gp.add_argument("--lines", type=int, default=100)
+    gp.add_argument("--follow", action="store_true",
+                    help="stream live lines instead of dumping buffers")
     args = p.parse_args(argv)
 
+    if args.cmd == "up":
+        cmd_up(args)
+        return
+    if args.cmd == "down":
+        cmd_down(args)
+        return
     if args.cmd == "start":
         cmd_start(args)
         return
@@ -370,7 +466,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
-     "metrics": cmd_metrics, "stack": cmd_stack}[args.cmd](gcs, args)
+     "metrics": cmd_metrics, "stack": cmd_stack,
+     "logs": cmd_logs}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

@@ -136,10 +136,20 @@ def reserve_slice(pod_type: str, timeout: float = 60.0,
     """
     import time as _time
 
+    from ray_tpu.core.distributed import accelerators as _acc
+
+    expected_hosts = _acc.num_hosts_in_pod(pod_type)
     deadline = _time.monotonic() + timeout
     last_err = "no slices found"
     while _time.monotonic() < deadline:
         for sl in list_slices(pod_type):
+            if expected_hosts and sl.num_hosts < expected_hosts:
+                # Slice still booting (autoscaler launched it seconds
+                # ago; some hosts haven't registered): reserving a
+                # partial gang would hand out a PG with missing bundles.
+                last_err = (f"slice {sl.name} has {sl.num_hosts}/"
+                            f"{expected_hosts} hosts up")
+                continue
             bundle = {sl.name: 1.0, "TPU": sl.chips_per_host}
             if cpus_per_host:
                 bundle["CPU"] = cpus_per_host

@@ -104,6 +104,26 @@ def test_channel_close_unblocks():
     ch.unlink()
 
 
+def test_channel_reader_reattach_recovers_ack():
+    """A re-unpickled/restarted reader must resume from its ack word in
+    shared memory, not from version 0 (whose slot was overwritten)."""
+    ch = Channel.create(n_readers=1, capacity=1 << 16, n_slots=2)
+    try:
+        reader = Channel(ch.path, ch.capacity, ch.n_readers, ch.n_slots)
+        for i in range(5):                  # > n_slots: ring wrapped
+            ch.write(i, timeout=5)
+            assert reader.read(timeout=5) == i
+        # Fresh handle = restarted reader process (state lost).
+        reattached = Channel(ch.path, ch.capacity, ch.n_readers,
+                             ch.n_slots)
+        assert not reattached.peek_ready()  # nothing new — no hang
+        ch.write("after", timeout=5)
+        assert reattached.read(timeout=5) == "after"
+    finally:
+        ch.close()
+        ch.unlink()
+
+
 def test_channel_capacity_error():
     ch = Channel.create(n_readers=1, capacity=1024)
     try:
@@ -238,4 +258,14 @@ def test_compiled_rejects_function_nodes(cluster):
     with InputNode() as inp:
         dag = f.bind(inp)
     with pytest.raises(ValueError, match="actor-method"):
+        dag.experimental_compile()
+
+
+def test_compiled_rejects_two_methods_of_same_actor(cluster):
+    """Two nodes on one actor would deadlock its single apply loop —
+    must be a descriptive compile-time error, not a 30s submit timeout."""
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(a.add.bind(inp))
+    with pytest.raises(ValueError, match="same actor"):
         dag.experimental_compile()

@@ -174,3 +174,76 @@ def test_from_pandas_arrow_hf():
     df = pd.DataFrame({"a": [1, 2, 3]})
     assert rd.from_pandas(df).count() == 3
     assert rd.from_arrow(pa.table({"a": [1, 2]})).count() == 2
+
+
+def test_distributed_sort_range_partitions():
+    """Sort is a range-partition exchange (ref: sort_task_spec.py): the
+    output keeps multi-block structure (no single-task funnel), blocks
+    are globally ordered end-to-end, and each merge task only saw its
+    own key range."""
+    rng = np.random.default_rng(7)
+    vals = rng.permutation(2000).astype(float)
+    ds = rd.from_items([{"v": float(v)} for v in vals], parallelism=8)
+    out = ds.sort("v")
+    refs = list(out.to_block_refs())
+    assert len(refs) == 8  # one output block per range, not one total
+    blocks = ray_tpu.get(refs)
+    got = np.concatenate([b.column("v").to_numpy() for b in blocks])
+    np.testing.assert_array_equal(got, np.sort(vals))
+    # Every task held only its own range: block boundaries are ordered
+    # and non-overlapping.
+    for a, b in zip(blocks, blocks[1:]):
+        if a.num_rows and b.num_rows:
+            assert a.column("v")[-1].as_py() <= b.column("v")[0].as_py()
+
+    # Descending composes through the same exchange.
+    desc = ds.sort("v", descending=True)
+    dvals = [r["v"] for r in desc.take_all()]
+    assert dvals == sorted(vals.tolist(), reverse=True)
+
+
+def test_distributed_sort_string_keys():
+    words = [f"w{i:04d}" for i in range(300)]
+    rng = np.random.default_rng(3)
+    shuffled = list(words)
+    rng.shuffle(shuffled)
+    ds = rd.from_items([{"s": w} for w in shuffled], parallelism=6)
+    got = [r["s"] for r in ds.sort("s").take_all()]
+    assert got == words
+
+
+def test_streaming_split_consumes_once_disjoint():
+    """4 consumers over ONE execution: together they see every row
+    exactly once (ref: output_splitter.py OutputSplitter)."""
+    ds = rd.range(400, parallelism=8)
+    its = ds.streaming_split(4)
+    seen = [sorted(r["id"] for r in it.iter_rows()) for it in its]
+    all_rows = sorted(x for part in seen for x in part)
+    assert all_rows == list(range(400))
+    # FCFS handout: no row appears in two shards.
+    assert sum(len(p) for p in seen) == 400
+
+
+def test_streaming_split_equal_round_robin():
+    ds = rd.range(320, parallelism=8)
+    its = ds.streaming_split(4, equal=True)
+    counts = [sum(1 for _ in it.iter_rows()) for it in its]
+    assert sum(counts) == 320
+    assert max(counts) - min(counts) <= 40  # one block skew at most
+
+
+def test_streaming_split_feeds_parallel_consumers():
+    """The Train-ingest shape: each worker actor consumes its own shard
+    via iter_torch_batches, concurrently."""
+    ds = rd.range(256, parallelism=8)
+    its = ds.streaming_split(4)
+
+    @ray_tpu.remote
+    def consume(it):
+        total = 0
+        for batch in it.iter_torch_batches(batch_size=32):
+            total += int(batch["id"].sum())
+        return total
+
+    totals = ray_tpu.get([consume.remote(it) for it in its], timeout=120)
+    assert sum(totals) == sum(range(256))

@@ -1,0 +1,231 @@
+"""GCE/TPU node provider + cluster launcher (ref: the reference's GCP
+provider python/ray/autoscaler/_private/gcp/node_provider.py and its
+transport-mocked provider tests, autoscaler/batching_node_provider.py).
+
+The e2e test is the VERDICT r2 #4 "Done" criterion: `up` a sim-gcp
+cluster → a TPU gang demand makes the autoscaler launch v5e slice hosts
+→ the gang schedules across the slice → idle scale-down terminates it →
+`down`.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.gcp import (
+    LABEL_CLUSTER,
+    LABEL_NODE_ID,
+    GcpTpuNodeProvider,
+    GcpTransport,
+    SimGcpTransport,
+    accelerator_to_generation,
+)
+
+
+class RecordingTransport(GcpTransport):
+    """Pure-dict cloud: records calls, no processes."""
+
+    def __init__(self):
+        self.sim = SimGcpTransport(gcs_address=None, spawn_daemons=False)
+
+    def request(self, method, path, body=None):
+        return self.sim.request(method, path, body)
+
+    @property
+    def calls(self):
+        return self.sim.calls
+
+
+def test_accelerator_name_mapping():
+    assert accelerator_to_generation("v5litepod-16") == "v5e-16"
+    assert accelerator_to_generation("v4-16") == "v4-16"
+    assert accelerator_to_generation("v5p-8") == "v5p-8"
+
+
+def test_create_tpu_node_emits_tpu_api_call():
+    t = RecordingTransport()
+    p = GcpTpuNodeProvider("clu", "proj", "us-central2-b", t,
+                           gcs_address="127.0.0.1:1")
+    iid = p.create_node("v5e_16", {"accelerator_type": "v5litepod-16"})
+    call = t.calls[-1]
+    assert call["method"] == "POST"
+    assert "projects/proj/locations/us-central2-b/nodes" in call["path"]
+    assert call["body"]["acceleratorType"] == "v5litepod-16"
+    assert call["body"]["labels"][LABEL_CLUSTER] == "clu"
+    assert "ray-tpu start --address 127.0.0.1:1" in \
+        call["body"]["metadata"]["startup-script"]
+    live = p.non_terminated_nodes()
+    assert iid in live and live[iid].node_type == "v5e_16"
+
+
+def test_create_cpu_vm_emits_compute_call_and_terminate_deletes():
+    t = RecordingTransport()
+    p = GcpTpuNodeProvider("clu", "proj", "us-central1-a", t)
+    iid = p.create_node("cpu", {"machine_type": "n2-standard-4"})
+    call = t.calls[-1]
+    assert "zones/us-central1-a/instances" in call["path"]
+    assert call["body"]["machineType"].endswith("n2-standard-4")
+    p.terminate_node(iid)
+    assert iid not in p.non_terminated_nodes()
+    assert any(c["method"] == "DELETE" for c in t.calls)
+
+
+def test_preempted_instance_disappears_from_view():
+    t = RecordingTransport()
+    p = GcpTpuNodeProvider("clu", "proj", "z", t)
+    iid = p.create_node("v5e_16", {"accelerator_type": "v5litepod-16"})
+    assert iid in p.non_terminated_nodes()
+    # The cloud reaps it out-of-band (spot/queued-resource preemption).
+    t.sim._tpu_nodes.clear()
+    assert iid not in p.non_terminated_nodes()
+
+
+def test_adopts_labeled_instances_from_previous_launcher():
+    t = RecordingTransport()
+    p1 = GcpTpuNodeProvider("clu", "proj", "z", t)
+    iid = p1.create_node("v5e_16", {"accelerator_type": "v5litepod-16"})
+    node_id = p1.non_terminated_nodes()[iid].ray_node_id
+    # Fresh provider over the same cloud (launcher restarted).
+    p2 = GcpTpuNodeProvider("clu", "proj", "z", t)
+    live = p2.non_terminated_nodes()
+    assert iid in live
+    assert live[iid].ray_node_id == node_id
+    assert live[iid].node_type == "v5e_16"
+    # A different cluster's provider must NOT adopt it.
+    p3 = GcpTpuNodeProvider("other", "proj", "z", t)
+    assert iid not in p3.non_terminated_nodes()
+
+
+def test_launcher_config_validation(tmp_path):
+    from ray_tpu.autoscaler.launcher import load_cluster_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\nprovider: {type: aws}\n"
+                   "available_node_types: {}\n")
+    with pytest.raises(ValueError, match="provider type"):
+        load_cluster_config(str(bad))
+    missing = tmp_path / "missing.yaml"
+    missing.write_text("cluster_name: x\n")
+    with pytest.raises(ValueError, match="missing required"):
+        load_cluster_config(str(missing))
+
+
+CLUSTER_YAML = """
+cluster_name: e2e-sim
+provider:
+  type: sim-gcp
+  project_id: test-proj
+  zone: us-central2-b
+head_node_type: head
+idle_timeout_minutes: 0.1
+update_interval_s: 1.0
+available_node_types:
+  head:
+    resources: {"CPU": 2}
+  v5e_16:
+    resources: {"CPU": 4, "TPU": 16, "TPU-v5e-16-head": 1}
+    node_config: {"accelerator_type": "v5litepod-16", "cpus_per_host": 1}
+    min_workers: 0
+    max_workers: 2
+"""
+
+
+def test_up_gang_schedule_scaledown_down(tmp_path):
+    from ray_tpu.autoscaler.launcher import cluster_up
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(CLUSTER_YAML)
+    launcher = cluster_up(str(cfg_path), block=False)
+    try:
+        ray_tpu.init(address=launcher.gcs_address)
+        # Demand a whole v5e-16 slice: nothing satisfies it yet — the
+        # autoscaler must launch one (4 hosts x 4 chips). Pre-scaling by
+        # explicit resource request is the reference's canonical flow
+        # (ref: autoscaler/sdk request_resources before a TPU gang).
+        from ray_tpu.autoscaler.sdk import request_resources
+        from ray_tpu.util import tpu as tpu_util
+
+        request_resources(bundles=[{"TPU": 16.0, "TPU-v5e-16-head": 1.0}])
+        gang = tpu_util.reserve_slice("v5e-16", timeout=180)
+
+        @ray_tpu.remote(num_cpus=0, resources={"TPU": 4})
+        def host_info():
+            import os
+
+            return (ray_tpu.get_runtime_context().get_node_id(),
+                    os.environ.get("TPU_NAME"))
+
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        outs = ray_tpu.get([
+            host_info.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=gang.pg,
+                    placement_group_bundle_index=i)).remote()
+            for i in range(4)
+        ], timeout=180)
+        assert len({o[0] for o in outs}) == 4      # 4 distinct hosts
+        assert len({o[1] for o in outs}) == 1      # one slice
+        launched = launcher.provider.non_terminated_nodes()
+        assert len(launched) >= 1
+
+        # Release the gang AND the standing request; the idle timeout
+        # (6s) must then scale the slice down.
+        gang.release()
+        request_resources(bundles=[])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if not launcher.provider.non_terminated_nodes():
+                break
+            time.sleep(2)
+        assert not launcher.provider.non_terminated_nodes(), \
+            "idle slice never scaled down"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            launcher.down()
+
+
+def test_detached_up_then_down(tmp_path):
+    """`ray-tpu up --no-block` semantics: the cluster outlives the CLI
+    process (detached launcher), and `down` reaps everything."""
+    import subprocess
+
+    from ray_tpu.autoscaler.launcher import (
+        cluster_down,
+        spawn_detached_launcher,
+    )
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        "cluster_name: detached-e2e\n"
+        "provider: {type: fake}\n"
+        "head_node_type: head\n"
+        "available_node_types:\n"
+        "  head: {resources: {CPU: 2}}\n"
+        "  worker: {resources: {CPU: 1}, min_workers: 0, max_workers: 2}\n")
+    address = spawn_detached_launcher(str(cfg))
+    try:
+        ray_tpu.init(address=address)
+
+        @ray_tpu.remote
+        def ping():
+            return "pong"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "pong"
+        ray_tpu.shutdown()
+    finally:
+        cluster_down("detached-e2e")
+    # The whole tree (launcher + GCS + head + workers) must be gone.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        out = subprocess.run(
+            ["pgrep", "-f", "ray_tpu.autoscaler.launcher"],
+            capture_output=True, text=True)
+        if not out.stdout.strip():
+            return
+        time.sleep(0.5)
+    raise AssertionError("detached launcher still running after down")

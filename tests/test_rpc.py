@@ -1,0 +1,201 @@
+"""RPC transport semantics: retry safety, shutdown hygiene, framing.
+
+Covers the at-most-once contract of SyncRpcClient (a request that may
+have executed is never blindly resent — gRPC's transparent-reconnect
+rule, ref: src/ray/rpc/grpc_client.h retry notes), clean client close
+(no leaked read-loop tasks), and malformed-frame rejection.
+"""
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core.distributed.rpc import (
+    _HEADER,
+    AsyncRpcClient,
+    EventLoopThread,
+    RpcError,
+    RpcServer,
+    SyncRpcClient,
+)
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        self.value += 1
+        return self.value
+
+    def get(self):
+        return self.value
+
+    async def sleepy(self, seconds):
+        await asyncio.sleep(seconds)
+        return "done"
+
+
+@pytest.fixture()
+def loop_thread():
+    lt = EventLoopThread("rpc-test-loop")
+    yield lt
+    lt.stop()
+
+
+def _start_server(loop_thread, service, port=0):
+    server = RpcServer(port=port)
+    server.add_service("svc", service)
+    loop_thread.run(server.start())
+    return server
+
+
+def test_sync_pool_stale_socket_detected_no_double_execution(loop_thread):
+    """Server restarts while sockets sit in the pool: the next call must
+    succeed via the MSG_PEEK staleness probe — without resending a
+    request that might already have executed (count stays exact)."""
+    svc = Counter()
+    server = _start_server(loop_thread, svc)
+    port = server.port
+    client = SyncRpcClient(server.address)
+    assert client.call("svc", "bump") == 1
+    loop_thread.run(server.stop())
+    # Same service object, same port: a "restarted" control plane.
+    server2 = _start_server(loop_thread, svc, port=port)
+    deadline = time.monotonic() + 5
+    while True:  # port rebind may race the old listener teardown
+        try:
+            assert client.call("svc", "bump", timeout=5) == 2
+            break
+        except RpcError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    assert svc.value == 2  # exactly-once per call: no hidden resend
+    client.close()
+    loop_thread.run(server2.stop())
+
+
+class _ExecuteThenDropServer:
+    """Raw framed server that EXECUTES the request (bumps a counter)
+    then drops the connection without replying — the ambiguous-failure
+    case a client must not blindly retry."""
+
+    def __init__(self):
+        self.executions = 0
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                head = b""
+                while len(head) < _HEADER.size:
+                    chunk = conn.recv(_HEADER.size - len(head))
+                    if not chunk:
+                        break
+                    head += chunk
+                if len(head) == _HEADER.size:
+                    length, _, _ = _HEADER.unpack(head)
+                    body = b""
+                    while len(body) < length - 9:
+                        chunk = conn.recv(length - 9 - len(body))
+                        if not chunk:
+                            break
+                        body += chunk
+                    if len(body) == length - 9:
+                        self.executions += 1  # "handler ran"
+            finally:
+                conn.close()  # ...but the reply never arrives
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_recv_failure_not_retried_unless_idempotent():
+    server = _ExecuteThenDropServer()
+    try:
+        client = SyncRpcClient(f"127.0.0.1:{server.port}")
+        with pytest.raises(RpcError, match="recv"):
+            client.call("svc", "bump", timeout=5)
+        time.sleep(0.1)
+        assert server.executions == 1  # executed once, NOT resent
+
+        with pytest.raises(RpcError):
+            client.call("svc", "get", timeout=5, idempotent=True)
+        time.sleep(0.1)
+        # Idempotent opt-in: one retry happened (2 more executions).
+        assert server.executions == 3
+        client.close()
+    finally:
+        server.close()
+
+
+def test_kill_server_mid_call_clean_rpc_error(loop_thread):
+    svc = Counter()
+    server = _start_server(loop_thread, svc)
+    client = SyncRpcClient(server.address)
+    errs = []
+
+    def call():
+        try:
+            client.call("svc", "sleepy", seconds=30, timeout=20)
+        except RpcError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.3)  # request in flight, handler sleeping
+    loop_thread.run(server.stop())
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(errs) == 1  # clean RpcError, not a hang or raw OSError
+    client.close()
+
+
+def test_async_client_close_awaits_read_loop(loop_thread):
+    svc = Counter()
+    server = _start_server(loop_thread, svc)
+
+    async def scenario():
+        client = AsyncRpcClient(server.address)
+        assert await client.call("svc", "bump") == 1
+        task = client._reader_task
+        await client.close()
+        return task
+
+    task = loop_thread.run(scenario())
+    assert task.done()  # cancelled AND awaited — no destroy-pending noise
+    loop_thread.run(server.stop())
+
+
+def test_malformed_frame_drops_connection_server_survives(loop_thread):
+    svc = Counter()
+    server = _start_server(loop_thread, svc)
+    # Garbage frame with length < 9 (would read a negative payload).
+    bad = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    bad.sendall(struct.pack("<IBQ", 3, 1, 1))
+    time.sleep(0.2)
+    # Server must have dropped it without killing the listener.
+    client = SyncRpcClient(server.address)
+    assert client.call("svc", "bump", timeout=5) == 1
+    bad.close()
+    client.close()
+    loop_thread.run(server.stop())
