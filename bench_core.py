@@ -110,18 +110,31 @@ def main() -> None:
         [actor.ping.remote() for _ in range(k)], timeout=600), n)
     emit("actor_calls_async_per_second", ops, "calls/s")
 
-    # -- n:n async actor calls (ref n_n_actor_calls_async) ----------------
+    # -- n:n async actor calls (ref n_n_actor_calls_async: m=4 parallel
+    # CLIENT TASKS each driving n_cpu actors — ray_perf.py:276-288 `work
+    # .remote(a)` — NOT one driver thread; submission parallelism is part
+    # of the measured quantity) ------------------------------------------
     actors = [Sink.remote() for _ in range(4)]
     ray_tpu.get([a.ping.remote() for a in actors])
+    m = 4
     n = int(4000 * scale)
 
-    def n_n(k):
-        refs = []
-        for i in range(k):
-            refs.append(actors[i % len(actors)].ping.remote())
-        ray_tpu.get(refs, timeout=600)
+    @ray_tpu.remote
+    def nn_client(actor_list, k):
+        import ray_tpu as rt
 
-    emit("n_n_actor_calls_async_per_second", timeit(n_n, n), "calls/s")
+        rt.get([actor_list[i % len(actor_list)].ping.remote()
+                for i in range(k)], timeout=600)
+        return k
+
+    ray_tpu.get([nn_client.remote(actors, 10) for _ in range(m)])  # warm
+
+    def n_n(k):
+        per = k // m
+        ray_tpu.get([nn_client.remote(actors, per) for _ in range(m)],
+                    timeout=600)
+
+    emit("n_n_actor_calls_async_per_second", timeit(n_n, m * n), "calls/s")
 
     # -- put calls/s (small objects, ref multi_client_put_calls — same
     # multi-client shape as above) ----------------------------------------

@@ -230,7 +230,20 @@ def spawn_detached_launcher(config_path: str, wait_s: float = 60.0) -> str:
     cfg = load_cluster_config(config_path)
     path = _state_path(cfg["cluster_name"])
     # A SIGKILL'd previous launcher leaves its state file behind; without
-    # this the poll below would return the DEAD cluster's address.
+    # this the poll below would return the DEAD cluster's address. But a
+    # LIVE launcher with the same name must not be silently orphaned —
+    # deleting its state would put it beyond `ray-tpu down`'s reach.
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        prev_pid = prev.get("launcher_pid")
+        if prev_pid:
+            os.kill(prev_pid, 0)  # raises if gone
+            raise RuntimeError(
+                f"cluster {cfg['cluster_name']!r} is already up "
+                f"(launcher pid {prev_pid}); run `ray-tpu down` first")
+    except (OSError, ValueError, KeyError):
+        pass  # no state / stale state / dead launcher
     _remove_state(cfg["cluster_name"])
     spawned_at = time.time()
     subprocess.Popen(

@@ -64,11 +64,24 @@ class _TaskLane:
     then returned.
     """
 
-    IDLE_HOLD_S = 0.2
+    # Idle leases block OTHER lanes' parked waiters (the daemon can't
+    # reclaim a held lease), so the hold must only bridge a tight
+    # submit-get loop's gap (~1ms lease RT), not a human pause: 200ms
+    # serialized 4 contending submitters into 300ms turns each.
+    IDLE_HOLD_S = 0.02
     MAX_LEASES = 32
     # Batch size balances RPC amortization (16x fewer unaries) against
-    # failure blast radius (a dying worker fails one whole batch).
-    BATCH = 16
+    # failure blast radius (a dying worker fails one whole batch); on a
+    # single-core host (this VM) larger batches win outright — every RPC
+    # is pure overhead on the one shared CPU.
+    BATCH = 64
+    # Lease time-slice: return the lease after this many batches even if
+    # work remains (re-request immediately). The daemon can't reclaim a
+    # held lease, so a lane that drains its whole queue on one lease
+    # starves every other submitter's parked waiters; FIFO re-grants at
+    # slice boundaries round-robin contending lanes at ~1ms re-lease
+    # cost per slice (<1% of a slice's work).
+    BATCHES_PER_LEASE = 4
     # Connection-level batch failures re-queue the affected specs (cheap,
     # spread over fresh batches) up to this many times per spec before
     # surfacing the failure.
@@ -183,7 +196,10 @@ class _TaskLane:
 
     async def _run_worker(self, daemon, grant) -> None:
         worker = await self.core._aclient(grant["worker_address"])
+        batches_run = 0
         while True:
+            if batches_run >= self.BATCHES_PER_LEASE and self.queue:
+                return  # time-slice over: re-lease so other lanes rotate
             batch = []
             while self.queue and len(batch) < self.BATCH:
                 batch.append(self.queue.popleft())
@@ -224,6 +240,7 @@ class _TaskLane:
                 self.wakeup.set()
                 self._maybe_scale()
                 return  # drop this lease; the worker may be gone
+            batches_run += 1
             for (_, fut), reply in zip(batch, replies):
                 if not fut.done():
                     fut.set_result(reply)
@@ -299,6 +316,9 @@ class DistributedCoreWorker:
         # ---- function table cache ----
         self._exported_fns: set = set()
         self._fn_cache: Dict[bytes, Any] = {}
+        import weakref
+
+        self._fn_key_cache = weakref.WeakKeyDictionary()
 
         # ---- actor address cache ----
         self._actor_cache: Dict[str, dict] = {}
@@ -332,38 +352,60 @@ class DistributedCoreWorker:
             atexit.register(self.shutdown)
 
     async def _stream_logs_to_driver(self) -> None:
-        """Print this job's worker stdout/stderr on the driver, prefixed
+        """Relay this job's worker stdout/stderr to the driver, prefixed
         (ref: the log_monitor → GCS pubsub → worker.py print_logs path;
         log records flow from each node's LogMonitor through the GCS
-        LogManager's ``logs`` channel)."""
-        import sys
+        LogManager's ``logs`` channel). Printing happens on a DEDICATED
+        thread: a stalled driver stdout (`python train.py | less`) must
+        block log relay only — a print() on the RPC loop would stall
+        every RPC in the process."""
+        import queue as _queue
 
         from ray_tpu.core.distributed.log_monitor import format_log_prefix
 
-        while not self._shutdown:
-            client = AsyncRpcClient(self.gcs_address)
-            try:
-                async for rec in client.stream(
-                        "Pubsub", "stream_subscribe", channel="logs"):
-                    job = rec.get("job_id")
-                    # Unattributed lines (worker startup before its first
-                    # lease) pass through; other jobs' lines do not.
-                    if job and job != self.job_id:
-                        continue
-                    prefix = format_log_prefix(rec)
-                    out = (sys.stderr if rec.get("stream") == "stderr"
-                           else sys.stdout)
-                    for line in rec["lines"]:
-                        print(f"{prefix} {line}", file=out, flush=True)
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001 GCS blip: reconnect
-                await asyncio.sleep(1.0)
-            finally:
+        printq: "_queue.Queue" = _queue.Queue(maxsize=1000)
+
+        def printer():
+            import sys
+
+            while True:
+                rec = printq.get()
+                if rec is None:
+                    return
+                prefix = format_log_prefix(rec)
+                out = (sys.stderr if rec.get("stream") == "stderr"
+                       else sys.stdout)
+                for line in rec["lines"]:
+                    print(f"{prefix} {line}", file=out, flush=True)
+
+        threading.Thread(target=printer, daemon=True,
+                         name="log-printer").start()
+        try:
+            while not self._shutdown:
+                client = AsyncRpcClient(self.gcs_address)
                 try:
-                    await client.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                    async for rec in client.stream(
+                            "Pubsub", "stream_subscribe", channel="logs"):
+                        job = rec.get("job_id")
+                        # Unattributed lines (worker startup before its
+                        # first lease) pass through; other jobs' do not.
+                        if job and job != self.job_id:
+                            continue
+                        try:
+                            printq.put_nowait(rec)
+                        except _queue.Full:
+                            pass  # consumer stalled: drop, don't block
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 GCS blip: reconnect
+                    await asyncio.sleep(1.0)
+                finally:
+                    try:
+                        await client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        finally:
+            printq.put_nowait(None)
 
     # ------------------------------------------------------------------
     # reference counting / distributed GC
@@ -938,11 +980,25 @@ class DistributedCoreWorker:
     # function table
     # ------------------------------------------------------------------
     def _export_function(self, func) -> bytes:
+        # function_key is cloudpickle + sha1 — hundreds of µs, and it was
+        # being paid on EVERY .remote() of the same function (the hottest
+        # line of task submission by far). Key by function identity;
+        # WeakKeyDictionary so redefined functions don't pin forever.
+        try:
+            key = self._fn_key_cache.get(func)
+        except TypeError:  # unhashable/unweakrefable callable
+            key = None
+        if key is not None:
+            return key
         key, blob = protocol.function_key(func)
         if key not in self._exported_fns:
             self.gcs.call("KV", "put", namespace="fn", key=key, value=blob,
                           overwrite=False, timeout=30)
             self._exported_fns.add(key)
+        try:
+            self._fn_key_cache[func] = key
+        except TypeError:
+            pass  # unhashable/unweakrefable callable: just re-hash later
         return key
 
     def fetch_function(self, key: bytes) -> Any:

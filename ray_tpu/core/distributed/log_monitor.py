@@ -33,6 +33,7 @@ class _Tail:
     def __init__(self, path: str):
         self.path = path
         self.pos = 0
+        self.last_seen_size = -1
         self._partial = b""
 
     def read_new_lines(self) -> List[str]:
@@ -94,15 +95,25 @@ class LogMonitor:
         has shipped everything and the file is huge, truncate it to zero
         (the worker's fd is O_APPEND, so its next write lands at the new
         EOF) — a steadily-printing long-lived actor must not fill the
-        node's disk (ref: the reference's rotated session log files)."""
+        node's disk (ref: the reference's rotated session log files).
+
+        Rotation only fires when the file was QUIET for a whole sweep
+        (size unchanged since last look AND fully shipped): the writer
+        holds no lock we can take, so truncating a file that is being
+        appended to mid-check would silently drop the racing lines —
+        waiting for an idle sweep shrinks that window to the instant
+        between the final getsize and the truncate."""
         try:
             size = os.path.getsize(tail.path)
         except OSError:
             return
-        if size > MAX_FILE_BYTES and tail.pos >= size:
+        quiet = size == tail.last_seen_size
+        tail.last_seen_size = size
+        if size > MAX_FILE_BYTES and tail.pos >= size and quiet:
             try:
                 os.truncate(tail.path, 0)
                 tail.pos = 0
+                tail.last_seen_size = 0
             except OSError:
                 pass
 

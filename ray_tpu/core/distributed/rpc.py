@@ -425,7 +425,21 @@ class EventLoopThread:
     The analogue of the instrumented asio event loop each reference
     process runs (ref: src/ray/common/asio/)."""
 
+    # Dispatch-heavy processes (driver submit thread vs RPC loop, worker
+    # executor vs RPC loop) ping-pong the GIL; CPython's default 5ms
+    # switch interval lets one side hold it for entire scheduling
+    # quanta, serializing the pipeline (measured: n:n actor submission
+    # 2.5k/s at 5ms vs 5k/s at 0.5ms). Applied only when the process is
+    # still on CPython's factory default — an embedding application that
+    # chose its own interval keeps it.
+    SWITCH_INTERVAL_S = 0.0005
+    _DEFAULT_SWITCH_INTERVAL_S = 0.005
+
     def __init__(self, name: str = "rpc-loop"):
+        import sys as _sys
+
+        if _sys.getswitchinterval() == self._DEFAULT_SWITCH_INTERVAL_S:
+            _sys.setswitchinterval(self.SWITCH_INTERVAL_S)
         self.loop = asyncio.new_event_loop()
         # Strong roots for submitted background tasks: asyncio holds only
         # WEAK references to tasks, so a fire-and-forget coroutine whose
