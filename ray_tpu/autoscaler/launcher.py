@@ -238,12 +238,20 @@ def spawn_detached_launcher(config_path: str, wait_s: float = 60.0) -> str:
             prev = json.load(f)
         prev_pid = prev.get("launcher_pid")
         if prev_pid:
-            os.kill(prev_pid, 0)  # raises if gone
-            raise RuntimeError(
-                f"cluster {cfg['cluster_name']!r} is already up "
-                f"(launcher pid {prev_pid}); run `ray-tpu down` first")
+            try:
+                os.kill(prev_pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True  # pid exists, owned by another user
+            if alive:
+                raise RuntimeError(
+                    f"cluster {cfg['cluster_name']!r} is already up "
+                    f"(launcher pid {prev_pid}); run `ray-tpu down` "
+                    "first")
     except (OSError, ValueError, KeyError):
-        pass  # no state / stale state / dead launcher
+        pass  # no state file / unreadable stale state
     _remove_state(cfg["cluster_name"])
     spawned_at = time.time()
     subprocess.Popen(

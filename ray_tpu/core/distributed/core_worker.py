@@ -405,7 +405,10 @@ class DistributedCoreWorker:
                     except Exception:  # noqa: BLE001
                         pass
         finally:
-            printq.put_nowait(None)
+            try:
+                printq.put_nowait(None)
+            except _queue.Full:
+                pass  # daemon printer thread; lost sentinel is harmless
 
     # ------------------------------------------------------------------
     # reference counting / distributed GC

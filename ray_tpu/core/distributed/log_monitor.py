@@ -109,7 +109,13 @@ class LogMonitor:
             return
         quiet = size == tail.last_seen_size
         tail.last_seen_size = size
-        if size > MAX_FILE_BYTES and tail.pos >= size and quiet:
+        # A steady printer is never quiet, so past DOUBLE the threshold
+        # rotation is forced anyway — losing the handful of racing lines
+        # beats filling the node's disk. (A writer outpacing the tailer's
+        # 512KB/sweep read rate would fill the disk regardless.)
+        if tail.pos >= size and (
+                (size > MAX_FILE_BYTES and quiet)
+                or size > 2 * MAX_FILE_BYTES):
             try:
                 os.truncate(tail.path, 0)
                 tail.pos = 0

@@ -18,11 +18,19 @@ from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.env import register_env
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    SampleWriter,
+    read_samples,
+    record_rollouts,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
 )
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
@@ -33,6 +41,13 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "ImpalaConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+    "SampleWriter",
+    "read_samples",
+    "record_rollouts",
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
     "register_env",

@@ -27,6 +27,9 @@ class AlgorithmConfig:
         self.seed = 0
         self.model_hidden: Tuple[int, ...] = (64, 64)
         self.learner_mesh = None  # jax Mesh with a "dp" axis, or None
+        self.evaluation_interval = 0          # iterations; 0 = disabled
+        self.evaluation_num_env_runners = 0   # 0 = evaluate locally
+        self.evaluation_duration = 5          # episodes per evaluation
 
     # builder surface (each returns self, ref: algorithm_config.py)
     def environment(self, env: Union[str, Callable]) -> "AlgorithmConfig":
@@ -67,6 +70,21 @@ class AlgorithmConfig:
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_env_runners: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None
+                   ) -> "AlgorithmConfig":
+        """Periodic deterministic evaluation on a SEPARATE worker set
+        (ref: AlgorithmConfig.evaluation / evaluation/worker_set.py:82),
+        so exploration noise never contaminates reported returns."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = evaluation_num_env_runners
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
         return self
 
     def rl_module(self, *, model_hidden: Optional[Tuple[int, ...]] = None
@@ -110,12 +128,16 @@ class Algorithm:
                            bootstrap_gamma=gamma)
                 for i in range(config.num_env_runners)
             ]
-            self._spaces = ray_tpu.get(self.workers[0].get_spaces.remote())
+            self.space_info = ray_tpu.get(
+                self.workers[0].get_space_info.remote())
         else:
             self.workers = [RolloutWorker(
                 config.env, num_envs=config.num_envs_per_env_runner,
                 seed=config.seed, bootstrap_gamma=gamma)]
-            self._spaces = self.workers[0].get_spaces()
+            self.space_info = self.workers[0].get_space_info()
+        self._spaces = (self.space_info["obs_dim"],
+                        self.space_info["num_actions"])
+        self._eval_workers: List[Any] = []
 
         obs_dim, num_actions = self._spaces
         self.learner = self._setup_learner(obs_dim, num_actions)
@@ -159,11 +181,69 @@ class Algorithm:
             episode_returns.extend(o["episode_returns"])
         return batch, episode_returns
 
+    # -- evaluation (ref: Algorithm.evaluate + worker_set.py:82) -------------
+    _eval_mode = "greedy_pi"   # subclasses: greedy_q (DQN), sac_mean (SAC)
+
+    def _ensure_eval_workers(self) -> None:
+        if self._eval_workers:
+            return
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        cfg = self.config
+        n = cfg.evaluation_num_env_runners
+        gamma = getattr(cfg, "gamma", 0.99)
+        if n > 0:
+            import ray_tpu
+
+            cls = ray_tpu.remote(
+                num_cpus=cfg.num_cpus_per_env_runner)(RolloutWorker)
+            self._eval_workers = [
+                cls.remote(cfg.env, num_envs=cfg.num_envs_per_env_runner,
+                           seed=cfg.seed + 9000 + i,
+                           bootstrap_gamma=gamma)
+                for i in range(n)]
+        else:
+            self._eval_workers = [RolloutWorker(
+                cfg.env, num_envs=cfg.num_envs_per_env_runner,
+                seed=cfg.seed + 9000, bootstrap_gamma=gamma)]
+
+    def evaluate(self) -> Dict[str, float]:
+        """Deterministic episodes on the separate eval worker set."""
+        self._ensure_eval_workers()
+        cfg = self.config
+        weights = self.learner.get_weights()
+        episodes = max(1, cfg.evaluation_duration)
+        if cfg.evaluation_num_env_runners > 0:
+            import ray_tpu
+
+            ref = ray_tpu.put(weights)
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self._eval_workers])
+            n = len(self._eval_workers)
+            per = [episodes // n + (1 if i < episodes % n else 0)
+                   for i in range(n)]
+            outs = ray_tpu.get(
+                [w.evaluate.remote(p, mode=self._eval_mode)
+                 for w, p in zip(self._eval_workers, per) if p],
+                timeout=600)
+            returns = [r for o in outs for r in o]
+        else:
+            w = self._eval_workers[0]
+            w.set_weights(weights)
+            returns = w.evaluate(episodes, mode=self._eval_mode)
+        return {
+            "evaluation/episode_return_mean": float(np.mean(returns)),
+            "evaluation/num_episodes": float(len(returns)),
+        }
+
     # -- public surface (ref: Algorithm.train/save/restore/stop) ------------
     def train(self) -> Dict[str, float]:
         self._iteration += 1
         metrics = self.training_step()
         metrics["training_iteration"] = float(self._iteration)
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self._iteration % interval == 0:
+            metrics.update(self.evaluate())
         return metrics
 
     def get_weights(self) -> Any:
@@ -196,12 +276,17 @@ class Algorithm:
         self._broadcast_weights()
 
     def stop(self) -> None:
-        if self._remote:
+        remote_eval = (getattr(self.config, "evaluation_num_env_runners",
+                               0) > 0)
+        if self._remote or remote_eval:
             import ray_tpu
 
-            for w in self.workers:
+            doomed = (self.workers if self._remote else []) + (
+                self._eval_workers if remote_eval else [])
+            for w in doomed:
                 try:
                     ray_tpu.kill(w)
                 except Exception:  # noqa: BLE001
                     pass
         self.workers = []
+        self._eval_workers = []

@@ -165,6 +165,8 @@ class DQN(Algorithm):
     """training_step: collect epsilon-greedy transitions into replay,
     run K sampled TD updates, write priorities back, broadcast."""
 
+    _eval_mode = "greedy_q"
+
     def _setup_learner(self, obs_dim: int, num_actions: int) -> DQNLearner:
         cfg: DQNConfig = self.config
         if cfg.prioritized_replay:

@@ -27,6 +27,8 @@ def make_env(name: str, num_envs: int, seed: int = 0) -> "VectorEnv":
         return _ENV_REGISTRY[name](num_envs=num_envs, seed=seed)
     if name in ("CartPole-v1", "CartPole"):
         return CartPoleVecEnv(num_envs=num_envs, seed=seed)
+    if name in ("Pendulum-v1", "Pendulum"):
+        return PendulumVecEnv(num_envs=num_envs, seed=seed)
     try:
         return GymnasiumVecEnv(name, num_envs=num_envs, seed=seed)
     except ImportError:
@@ -36,11 +38,18 @@ def make_env(name: str, num_envs: int, seed: int = 0) -> "VectorEnv":
 
 
 class VectorEnv:
-    """Batch of envs stepped in lockstep; auto-resets finished episodes."""
+    """Batch of envs stepped in lockstep; auto-resets finished episodes.
+
+    Discrete envs set `num_actions`; continuous-control envs set
+    `continuous=True` with `act_dim`/`act_limit` (actions are float
+    arrays in [-act_limit, act_limit]^act_dim)."""
 
     num_envs: int
     obs_dim: int
-    num_actions: int
+    num_actions: int = 0
+    continuous: bool = False
+    act_dim: int = 0
+    act_limit: float = 1.0
 
     def reset(self) -> np.ndarray:
         raise NotImplementedError
@@ -129,6 +138,78 @@ class CartPoleVecEnv(VectorEnv):
             self._reset_idx(dones)
         return (self._state.astype(np.float32), rewards,
                 dones.astype(np.float32), episode_returns)
+
+
+class PendulumVecEnv(VectorEnv):
+    """Vectorized Pendulum swing-up (the classic continuous-control
+    benchmark; same dynamics constants gymnasium's Pendulum-v1
+    documents): obs [cosθ, sinθ, θ̇], one torque action in [-2, 2],
+    reward -(θ² + 0.1 θ̇² + 0.001 a²), 200-step time limit (always a
+    truncation — there is no terminal state)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    obs_dim = 3
+    continuous = True
+    act_dim = 1
+    act_limit = 2.0
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self._rng = np.random.default_rng(seed)
+        self._theta = np.zeros(num_envs)
+        self._theta_dot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, dtype=np.int64)
+        self._returns = np.zeros(num_envs)
+
+    def _reset_idx(self, idx: np.ndarray) -> None:
+        n = int(idx.sum())
+        self._theta[idx] = self._rng.uniform(-np.pi, np.pi, n)
+        self._theta_dot[idx] = self._rng.uniform(-1.0, 1.0, n)
+        self._steps[idx] = 0
+        self._returns[idx] = 0.0
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._theta_dot], axis=1).astype(np.float32)
+
+    def reset(self) -> np.ndarray:
+        self._reset_idx(np.ones(self.num_envs, dtype=bool))
+        self.truncateds = np.zeros(self.num_envs, dtype=bool)
+        self.final_obs = self._obs()
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = ((self._theta + np.pi) % (2 * np.pi)) - np.pi  # angle_normalize
+        costs = th ** 2 + 0.1 * self._theta_dot ** 2 + 0.001 * u ** 2
+        new_dot = self._theta_dot + (
+            3 * self.G / (2 * self.L) * np.sin(self._theta)
+            + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        new_dot = np.clip(new_dot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = self._theta + new_dot * self.DT
+        self._theta_dot = new_dot
+        self._steps += 1
+        rewards = (-costs).astype(np.float32)
+        self._returns += rewards
+
+        truncated = self._steps >= self.MAX_STEPS
+        dones = truncated.copy()
+        self.truncateds = truncated.copy()
+        self.final_obs = self._obs()
+        episode_returns = np.full(self.num_envs, np.nan)
+        if dones.any():
+            episode_returns[dones] = self._returns[dones]
+            self._reset_idx(dones)
+        return self._obs(), rewards, dones.astype(np.float32), \
+            episode_returns
 
 
 class GymnasiumVecEnv(VectorEnv):

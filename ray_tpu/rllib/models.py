@@ -46,6 +46,81 @@ def apply_mlp_policy(params: Params, obs: jnp.ndarray
     return logits, value
 
 
+def _init_mlp(rng: jax.Array, prefix: str, sizes: Sequence[int],
+              params: Params, final_scale: float = 1.0) -> jax.Array:
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, key = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / fan_in)
+        if i == len(sizes) - 2:
+            scale = scale * final_scale
+        params[f"{prefix}_w{i}"] = (
+            jax.random.normal(key, (fan_in, fan_out)) * scale)
+        params[f"{prefix}_b{i}"] = jnp.zeros((fan_out,))
+    return rng
+
+
+def _apply_mlp(params: Params, prefix: str, x: jnp.ndarray) -> jnp.ndarray:
+    i = 0
+    while f"{prefix}_w{i}" in params:
+        x = x @ params[f"{prefix}_w{i}"] + params[f"{prefix}_b{i}"]
+        if f"{prefix}_w{i + 1}" in params:
+            x = jnp.tanh(x)
+        i += 1
+    return x
+
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac_actor(rng: jax.Array, obs_dim: int, act_dim: int,
+                   hidden: Sequence[int] = (64, 64)) -> Params:
+    """Squashed-Gaussian policy head: obs -> (mu, log_std) [B, 2*act_dim]
+    (ref: rllib/algorithms/sac — SquashedGaussian action dist)."""
+    params: Params = {}
+    _init_mlp(rng, "actor", [obs_dim, *hidden, 2 * act_dim], params,
+              final_scale=0.01)
+    return params
+
+
+def apply_sac_actor(params: Params, obs: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    out = _apply_mlp(params, "actor", obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def sample_squashed(mu: jnp.ndarray, log_std: jnp.ndarray, key: jax.Array,
+                    act_limit: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reparameterized tanh-squashed sample + its log-prob (with the
+    tanh change-of-variables correction)."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    logp_gauss = (-0.5 * ((pre - mu) / std) ** 2 - log_std
+                  - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+    a = jnp.tanh(pre)
+    # log det of tanh: sum log(1 - tanh²); the softplus form is stable.
+    logp = logp_gauss - (2.0 * (jnp.log(2.0) - pre
+                                - jax.nn.softplus(-2.0 * pre))).sum(-1)
+    return a * act_limit, logp
+
+
+def init_twin_q(rng: jax.Array, obs_dim: int, act_dim: int,
+                hidden: Sequence[int] = (64, 64)) -> Params:
+    """Two independent continuous Q towers (clipped double-Q)."""
+    params: Params = {}
+    rng = _init_mlp(rng, "q1", [obs_dim + act_dim, *hidden, 1], params)
+    _init_mlp(rng, "q2", [obs_dim + act_dim, *hidden, 1], params)
+    return params
+
+
+def apply_twin_q(params: Params, obs: jnp.ndarray, act: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    x = jnp.concatenate([obs, act], axis=-1)
+    return (_apply_mlp(params, "q1", x)[..., 0],
+            _apply_mlp(params, "q2", x)[..., 0])
+
+
 def init_mlp_q(rng: jax.Array, obs_dim: int, num_actions: int,
                hidden: Sequence[int] = (64, 64)) -> Params:
     """Q-network MLP: obs -> Q(s, .) (the DQN RLModule analogue)."""

@@ -1,0 +1,228 @@
+"""Offline RL IO + behavior cloning.
+
+ref: rllib/offline/json_reader.py:1 (JsonReader — sharded sample files),
+json_writer.py (JsonWriter — rollout recording), and the BC algorithm
+(rllib/algorithms/bc). TPU-first shape: samples are columnar batches
+written as parquet/JSON shards through ray_tpu.data, so offline
+training rides the same distributed Dataset machinery as everything
+else (shuffling, streaming, multi-reader splits), and the BC update is
+one jitted negative-log-likelihood step.
+
+    writer = SampleWriter(path)              # record during rollout
+    writer.write(batch_dict); writer.close()
+
+    ds = read_samples(path)                  # ray_tpu.data.Dataset
+    bc = (BCConfig().environment("CartPole-v1")
+          .offline_data(input_path=path).build())
+    bc.train()                               # no env interaction at all
+"""
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class SampleWriter:
+    """Shard-per-flush columnar sample recorder (ref: JsonWriter —
+    max_file_size rotation; here one parquet shard per flush)."""
+
+    def __init__(self, path: str, fmt: str = "parquet",
+                 rows_per_shard: int = 10_000):
+        if fmt not in ("parquet", "json"):
+            raise ValueError(f"unsupported offline format {fmt!r}")
+        self.path = path
+        self.fmt = fmt
+        self.rows_per_shard = rows_per_shard
+        self._pending: List[Dict[str, np.ndarray]] = []
+        self._pending_rows = 0
+        os.makedirs(path, exist_ok=True)
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        self._pending.append({k: np.asarray(v) for k, v in batch.items()})
+        self._pending_rows += len(next(iter(batch.values())))
+        if self._pending_rows >= self.rows_per_shard:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        merged = {k: np.concatenate([b[k] for b in self._pending])
+                  for k in self._pending[0]}
+        self._pending, self._pending_rows = [], 0
+        shard = os.path.join(self.path,
+                             f"samples-{uuid.uuid4().hex[:12]}")
+        if self.fmt == "parquet":
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            cols = {}
+            for k, v in merged.items():
+                if v.ndim == 1:
+                    cols[k] = pa.array(v)
+                else:  # fixed-width vector columns (obs, actions)
+                    cols[k] = pa.FixedSizeListArray.from_arrays(
+                        pa.array(v.reshape(-1)), v.shape[1])
+            pq.write_table(pa.table(cols), shard + ".parquet")
+        else:
+            with open(shard + ".json", "w") as f:
+                for i in range(len(next(iter(merged.values())))):
+                    row = {k: (v[i].tolist() if v.ndim > 1
+                               else v[i].item())
+                           for k, v in merged.items()}
+                    f.write(json.dumps(row) + "\n")
+
+    def close(self) -> None:
+        self.flush()
+
+
+def read_samples(path: str):
+    """Offline shards -> ray_tpu.data Dataset (ref: JsonReader, but on
+    the Dataset layer so shuffle/split/stream come for free)."""
+    from ray_tpu import data as rd
+
+    pq_files = [f for f in sorted(os.listdir(path))
+                if f.endswith(".parquet")]
+    if pq_files:
+        return rd.read_parquet(path)
+    return rd.read_json(path)
+
+
+def _columnar(rows: List[dict]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k in rows[0]:
+        v0 = rows[0][k]
+        if isinstance(v0, (list, np.ndarray)):
+            out[k] = np.asarray([r[k] for r in rows], np.float32)
+        else:
+            arr = np.asarray([r[k] for r in rows])
+            out[k] = arr
+    return out
+
+
+class BCConfig(AlgorithmConfig):
+    """Behavior cloning: supervised policy learning from recorded
+    samples — zero environment interaction during training."""
+
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 32
+        self.input_path: Optional[str] = None
+
+    def offline_data(self, *, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, *, lr=None, train_batch_size=None,
+                 num_updates_per_iteration=None, **kwargs) -> "BCConfig":
+        for k, v in dict(
+                lr=lr, train_batch_size=train_batch_size,
+                num_updates_per_iteration=num_updates_per_iteration
+        ).items():
+            if v is not None:
+                setattr(self, k, v)
+        return super().training(**kwargs)
+
+
+class BCLearner:
+    """Jitted NLL step over the discrete policy head."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 seed: int = 0, hidden=(64, 64)):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import apply_mlp_policy, init_mlp_policy
+
+        rng = jax.random.PRNGKey(seed)
+        self.params = init_mlp_policy(rng, obs_dim, num_actions, hidden)
+        self._tx = optax.adam(lr)
+        self.opt_state = self._tx.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            logits, _ = apply_mlp_policy(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None],
+                                       axis=1)[:, 0]
+            return nll.mean()
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def update(self, obs: np.ndarray, actions: np.ndarray) -> float:
+        import jax.numpy as jnp
+
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, jnp.asarray(obs),
+            jnp.asarray(actions.astype(np.int32)))
+        return float(loss)
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        import jax
+
+        self.params = jax.device_put(params)
+
+
+class BC(Algorithm):
+    """training_step: sample minibatches from the OFFLINE dataset (no
+    env rollouts); the env is only used for spaces and evaluation."""
+
+    def _setup_learner(self, obs_dim: int, num_actions: int) -> BCLearner:
+        cfg: BCConfig = self.config
+        if not cfg.input_path:
+            raise ValueError("BCConfig.offline_data(input_path=...) first")
+        ds = read_samples(cfg.input_path)
+        rows = ds.take_all()
+        data = _columnar(rows)
+        self._obs = data["obs"].astype(np.float32)
+        self._actions = data["actions"].astype(np.int64)
+        self._rng = np.random.default_rng(cfg.seed)
+        return BCLearner(obs_dim, num_actions, cfg.lr, seed=cfg.seed,
+                         hidden=cfg.model_hidden)
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: BCConfig = self.config
+        losses = []
+        n = len(self._obs)
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            losses.append(self.learner.update(self._obs[idx],
+                                              self._actions[idx]))
+        self._broadcast_weights()
+        return {"bc_loss": float(np.mean(losses)),
+                "num_offline_rows": float(n)}
+
+
+def record_rollouts(algo: Algorithm, path: str, num_iterations: int = 4,
+                    fmt: str = "parquet") -> str:
+    """Record an algorithm's on-policy rollouts to offline shards
+    (ref: `output` config in the reference — rollout recording)."""
+    writer = SampleWriter(path, fmt=fmt)
+    for _ in range(num_iterations):
+        batch, _ = algo._sample_rollouts()
+        flat = {
+            "obs": batch["obs"].reshape(-1, batch["obs"].shape[-1]),
+            "actions": batch["actions"].reshape(-1),
+            "rewards": batch["rewards"].reshape(-1),
+            "dones": batch["dones"].reshape(-1),
+        }
+        writer.write(flat)
+    writer.close()
+    return path

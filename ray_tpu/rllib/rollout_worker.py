@@ -32,6 +32,29 @@ def _value_only(params, obs):
 
 
 @jax.jit
+def _policy_logits(params, obs):
+    return apply_mlp_policy(params, obs)
+
+
+@jax.jit
+def _sac_policy_step(params, obs, key, act_limit):
+    """Stochastic squashed-Gaussian sample for off-policy collection."""
+    from ray_tpu.rllib.models import apply_sac_actor, sample_squashed
+
+    mu, log_std = apply_sac_actor(params, obs)
+    a, _ = sample_squashed(mu, log_std, key, act_limit)
+    return a
+
+
+@jax.jit
+def _sac_mean_action(params, obs, act_limit):
+    from ray_tpu.rllib.models import apply_sac_actor
+
+    mu, _ = apply_sac_actor(params, obs)
+    return jnp.tanh(mu) * act_limit
+
+
+@jax.jit
 def _q_policy_step(params, obs, key, epsilon):
     """Epsilon-greedy over Q(s, .) for off-policy collection."""
     from ray_tpu.rllib.models import apply_mlp_q
@@ -63,6 +86,15 @@ class RolloutWorker:
 
     def get_spaces(self) -> Tuple[int, int]:
         return self.obs_dim, self.num_actions
+
+    def get_space_info(self) -> Dict[str, Any]:
+        return {
+            "obs_dim": self.obs_dim,
+            "num_actions": self.num_actions,
+            "continuous": getattr(self.env, "continuous", False),
+            "act_dim": getattr(self.env, "act_dim", 0),
+            "act_limit": getattr(self.env, "act_limit", 1.0),
+        }
 
     def set_weights(self, params: Any) -> None:
         self._params = jax.device_put(params)
@@ -112,6 +144,87 @@ class RolloutWorker:
             },
             "episode_returns": episode_returns,
         }
+
+    def sample_transitions_continuous(self, num_steps: int,
+                                      uniform: bool = False
+                                      ) -> Dict[str, Any]:
+        """Off-policy continuous collection (SAC): float actions from the
+        squashed-Gaussian actor (or uniform random warmup), transitions
+        with truncation-aware terminals like sample_transitions."""
+        E = self.env.num_envs
+        act_dim = self.env.act_dim
+        limit = float(self.env.act_limit)
+        obs_buf = np.empty((E * num_steps, self.obs_dim), np.float32)
+        act_buf = np.empty((E * num_steps, act_dim), np.float32)
+        rew_buf = np.empty((E * num_steps,), np.float32)
+        next_buf = np.empty((E * num_steps, self.obs_dim), np.float32)
+        term_buf = np.empty((E * num_steps,), np.float32)
+        episode_returns: List[float] = []
+
+        obs = self._obs
+        for t in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            if uniform:
+                actions = np.asarray(jax.random.uniform(
+                    key, (E, act_dim), minval=-limit, maxval=limit))
+            else:
+                assert self._params is not None
+                actions = np.asarray(_sac_policy_step(
+                    self._params, obs, key, limit))
+            lo, hi = t * E, (t + 1) * E
+            obs_buf[lo:hi] = obs
+            act_buf[lo:hi] = actions
+            obs, rewards, dones, ep_ret = self.env.step(actions)
+            rew_buf[lo:hi] = rewards
+            next_buf[lo:hi] = self.env.final_obs
+            trunc = getattr(self.env, "truncateds", None)
+            terminal = dones.astype(np.float32)
+            if trunc is not None:
+                terminal = terminal * (1.0 - trunc.astype(np.float32))
+            term_buf[lo:hi] = terminal
+            finished = ~np.isnan(ep_ret)
+            if finished.any():
+                episode_returns.extend(ep_ret[finished].tolist())
+        self._obs = obs
+        return {
+            "batch": {
+                "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "next_obs": next_buf, "terminals": term_buf,
+            },
+            "episode_returns": episode_returns,
+        }
+
+    def evaluate(self, num_episodes: int, mode: str = "greedy_pi"
+                 ) -> List[float]:
+        """Deterministic evaluation episodes on FRESH env state (ref:
+        evaluation workers, rllib/evaluation/worker_set.py:82 — separate
+        from training collection so metrics aren't exploration-noised).
+        mode: greedy_pi (argmax logits) | greedy_q (argmax Q) |
+        sac_mean (tanh(mu))."""
+        assert self._params is not None, "set_weights() before evaluate()"
+        limit = float(getattr(self.env, "act_limit", 1.0))
+        returns: List[float] = []
+        obs = self.env.reset()
+        guard = 0
+        while len(returns) < num_episodes and guard < 100_000:
+            guard += 1
+            if mode == "sac_mean":
+                actions = np.asarray(_sac_mean_action(self._params, obs,
+                                                      limit))
+            elif mode == "greedy_q":
+                from ray_tpu.rllib.models import apply_mlp_q
+
+                actions = np.asarray(jnp.argmax(
+                    apply_mlp_q(self._params, jnp.asarray(obs)), axis=1))
+            else:
+                logits, _ = _policy_logits(self._params, obs)
+                actions = np.asarray(jnp.argmax(logits, axis=1))
+            obs, _, _, ep_ret = self.env.step(actions)
+            done = ~np.isnan(ep_ret)
+            if done.any():
+                returns.extend(ep_ret[done].tolist())
+        self._obs = self.env.reset()  # leave training state fresh
+        return returns[:num_episodes]
 
     def sample_transitions(self, num_steps: int,
                            epsilon: float = 0.0) -> Dict[str, Any]:

@@ -1,0 +1,155 @@
+"""SAC (continuous control), offline IO + BC, and evaluation workers.
+
+ref: rllib/algorithms/sac/sac.py (twin-Q + entropy auto-tune),
+rllib/offline/json_reader.py + json_writer.py (sample shards),
+rllib/evaluation/worker_set.py:82 (separate deterministic eval workers).
+"""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import BC, BCConfig, PPOConfig, SACConfig
+from ray_tpu.rllib.env import PendulumVecEnv
+from ray_tpu.rllib.offline import (
+    SampleWriter,
+    read_samples,
+    record_rollouts,
+)
+
+
+def test_pendulum_vec_env_contract():
+    env = PendulumVecEnv(num_envs=3, seed=0)
+    obs = env.reset()
+    assert obs.shape == (3, 3)
+    assert env.continuous and env.act_dim == 1 and env.act_limit == 2.0
+    total = np.zeros(3)
+    for _ in range(200):
+        obs, rew, dones, ep = env.step(np.zeros((3, 1), np.float32))
+        assert rew.shape == (3,) and (rew <= 0).all()
+        total += rew
+    # 200-step time limit: every env truncates on the same step.
+    assert dones.all() and env.truncateds.all()
+    finished = ~np.isnan(ep)
+    assert finished.all()
+    np.testing.assert_allclose(ep, total, rtol=1e-6)
+
+
+def test_sac_learner_update_shapes():
+    from ray_tpu.rllib.sac import SACHyperparams, SACLearner
+
+    learner = SACLearner(obs_dim=3, act_dim=1,
+                         hp=SACHyperparams(act_limit=2.0,
+                                           target_entropy=-1.0),
+                         seed=0, hidden=(32, 32))
+    batch = {
+        "obs": np.random.randn(64, 3).astype(np.float32),
+        "actions": np.random.uniform(-2, 2, (64, 1)).astype(np.float32),
+        "rewards": np.random.randn(64).astype(np.float32),
+        "next_obs": np.random.randn(64, 3).astype(np.float32),
+        "terminals": np.zeros(64, np.float32),
+    }
+    m1 = learner.update(batch)
+    m2 = learner.update(batch)
+    for k in ("critic_loss", "actor_loss", "alpha", "entropy"):
+        assert np.isfinite(m1[k]) and np.isfinite(m2[k])
+    # Target network must have moved (polyak) but stayed close.
+    import jax
+
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        learner.critic, learner.target_critic)
+    assert max(jax.tree_util.tree_leaves(diffs)) > 0
+
+
+def test_sac_improves_pendulum():
+    """The VERDICT CI criterion: SAC improves Pendulum — late-phase
+    episode returns must clearly beat the random-policy warmup phase."""
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .env_runners(num_envs_per_env_runner=4,
+                         rollout_fragment_length=32)
+            # SAC wants ~1 update per env step (ref sac.py defaults);
+            # with these settings the swing-up goes from ~-1250 to
+            # better than -400 in ~50 iterations (~20s CPU).
+            .training(train_batch_size=128,
+                      num_updates_per_iteration=128,
+                      learning_starts=256,
+                      actor_lr=1e-3, critic_lr=1e-3, alpha_lr=1e-3)
+            .debugging(seed=0)
+            .rl_module(model_hidden=(64, 64))
+            .build())
+    early, late = [], []
+    for it in range(55):
+        m = algo.train()
+        r = m.get("episode_return_mean")
+        if r is not None:
+            (early if it < 15 else late).append(r)
+    algo.stop()
+    assert early and late
+    early_mean = float(np.mean(early))
+    late_mean = float(np.mean(late[-3:]))
+    # Random policy on Pendulum ~= -1200..-1500; learning must show.
+    assert late_mean > early_mean + 400, (early_mean, late_mean)
+
+
+def test_sample_writer_roundtrip(tmp_path):
+    w = SampleWriter(str(tmp_path / "off"), fmt="parquet",
+                     rows_per_shard=50)
+    for _ in range(3):
+        w.write({"obs": np.random.randn(40, 4).astype(np.float32),
+                 "actions": np.random.randint(0, 2, 40),
+                 "rewards": np.ones(40, np.float32)})
+    w.close()
+    ds = read_samples(str(tmp_path / "off"))
+    rows = ds.take_all()
+    assert len(rows) == 120
+    assert len(rows[0]["obs"]) == 4
+    assert set(rows[0]) == {"obs", "actions", "rewards"}
+
+
+def test_bc_trains_from_recorded_data(tmp_path, local_ray):
+    """The VERDICT criterion: a BC run trains PURELY from recorded
+    offline data. Record a few PPO rollouts, clone them, and check the
+    cloned policy is meaningfully better than random on CartPole."""
+    ppo = (PPOConfig().environment("CartPole-v1")
+           .env_runners(num_envs_per_env_runner=8,
+                        rollout_fragment_length=64)
+           .debugging(seed=0).build())
+    for _ in range(8):  # competent-ish demonstrator (not expert)
+        ppo.train()
+    path = record_rollouts(ppo, str(tmp_path / "demos"),
+                           num_iterations=6)
+    ppo.stop()
+
+    bc = (BCConfig().environment("CartPole-v1")
+          .offline_data(input_path=path)
+          .training(num_updates_per_iteration=64)
+          .evaluation(evaluation_interval=4, evaluation_duration=5)
+          .debugging(seed=1).build())
+    first = bc.train()["bc_loss"]
+    last = None
+    for _ in range(3):
+        last = bc.train()
+    bc.stop()
+    assert last["bc_loss"] < first          # NLL decreases
+    # Eval ran on the separate worker set this iteration (4 % 4 == 0).
+    assert "evaluation/episode_return_mean" in last
+    assert last["evaluation/episode_return_mean"] > 40  # random ~ 20
+
+
+def test_evaluation_workers_separate_and_deterministic(local_ray):
+    """evaluation() metrics come from a separate deterministic worker
+    set at the configured interval."""
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=4,
+                         rollout_fragment_length=32)
+            .evaluation(evaluation_interval=2, evaluation_duration=4)
+            .debugging(seed=0).build())
+    m1 = algo.train()
+    assert "evaluation/episode_return_mean" not in m1  # iter 1: no eval
+    m2 = algo.train()
+    assert m2["evaluation/num_episodes"] >= 4.0
+    assert np.isfinite(m2["evaluation/episode_return_mean"])
+    # Eval workers exist and are distinct from training workers.
+    assert algo._eval_workers and (algo._eval_workers[0]
+                                   is not algo.workers[0])
+    algo.stop()
