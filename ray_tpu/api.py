@@ -84,7 +84,9 @@ def init(
 
             _worker = LocalCoreWorker(num_cpus=num_cpus)
         else:
-            from ray_tpu.core.cluster import connect_or_start
+            from ray_tpu.core.distributed.driver import (
+                connect_or_start_cluster as connect_or_start,
+            )
 
             _worker = connect_or_start(
                 address=address,

@@ -253,11 +253,18 @@ def spawn_detached_launcher(config_path: str, wait_s: float = 60.0) -> str:
     except (OSError, ValueError, KeyError):
         pass  # no state file / unreadable stale state
     _remove_state(cfg["cluster_name"])
+    from ray_tpu.core.distributed.driver import child_env
+
+    os.makedirs(STATE_DIR, mode=0o700, exist_ok=True)
+    log_path = os.path.join(STATE_DIR,
+                            f"{cfg['cluster_name']}.launcher.log")
     spawned_at = time.time()
-    subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.autoscaler.launcher", config_path],
-        start_new_session=True,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    with open(log_path, "ab") as logf:
+        subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.autoscaler.launcher",
+             config_path],
+            start_new_session=True, env=child_env(),
+            stdout=logf, stderr=logf)
     deadline = spawned_at + wait_s
     while time.time() < deadline:
         try:
@@ -269,7 +276,8 @@ def spawn_detached_launcher(config_path: str, wait_s: float = 60.0) -> str:
             pass
         time.sleep(0.25)
     raise RuntimeError(
-        f"detached launcher produced no state file at {path} in {wait_s}s")
+        f"detached launcher produced no state file at {path} in "
+        f"{wait_s}s; see {log_path}")
 
 
 def main(argv=None) -> None:

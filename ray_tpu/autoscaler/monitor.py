@@ -66,6 +66,7 @@ class AutoscalingCluster:
         *,
         idle_timeout_s: float = 30.0,
         update_interval_s: float = 2.0,
+        launch_timeout_s: float = 120.0,
     ):
         from ray_tpu.core.distributed.driver import (
             start_gcs_process,
@@ -99,7 +100,8 @@ class AutoscalingCluster:
                 node_config=node_config)
         self.autoscaler = StandardAutoscaler(
             self.gcs_address, self.provider, node_types,
-            idle_timeout_s=idle_timeout_s)
+            idle_timeout_s=idle_timeout_s,
+            launch_timeout_s=launch_timeout_s)
         self.monitor = AutoscalerMonitor(self.autoscaler,
                                          interval_s=update_interval_s)
         self.monitor.start()

@@ -247,6 +247,10 @@ class NodeDaemon:
             "--store-dir", self.store_dir,
             "--worker-id", worker_id,
         ]
+        if built_env is not None and built_env.container:
+            # Container plugin: the worker runs inside podman/docker;
+            # env/cwd must ride the run flags, not Popen's env.
+            cmd = built_env.wrap_command(cmd, env)
         # Per-worker log files; the LogMonitor tails them to the GCS
         # (ref: worker stdout/stderr files under session logs,
         # node.py:1042 + log_monitor.py tailing).

@@ -43,6 +43,10 @@ class PullManager:
         self._seq = itertools.count()      # FIFO within a priority class
         self._started = False
         self._bytes_freed: Optional[asyncio.Event] = None
+        # Strong roots: asyncio keeps only weak refs to tasks, and a
+        # puller waiting on OUR queue is an unreferenced cycle the GC
+        # collects mid-flight (same bug class as EventLoopThread._bg_tasks).
+        self._pullers: List[asyncio.Task] = []
 
     # -- sync facade ----------------------------------------------------
     def pull_sync(self, oid_b: bytes,
@@ -82,7 +86,7 @@ class PullManager:
         self._queue = asyncio.PriorityQueue()
         self._bytes_freed = asyncio.Event()
         for _ in range(self._max_concurrent):
-            asyncio.ensure_future(self._puller())
+            self._pullers.append(asyncio.ensure_future(self._puller()))
 
     async def _puller(self) -> None:
         while True:

@@ -37,11 +37,41 @@ class RuntimeEnvBuildError(Exception):
 
 class BuiltEnv:
     def __init__(self, env_vars: Dict[str, str], python: str,
-                 pythonpath: List[str], cwd: Optional[str]):
+                 pythonpath: List[str], cwd: Optional[str],
+                 container: Optional[Tuple[str, List[str], str]] = None):
         self.env_vars = env_vars
         self.python = python
         self.pythonpath = pythonpath
         self.cwd = cwd
+        # Container plugin: (runtime, run_options, image).
+        self.container = container
+
+    def wrap_command(self, cmd: List[str], env: Dict[str, str]
+                     ) -> List[str]:
+        """Wrap the worker argv in `podman/docker run`. env/cwd given to
+        Popen only reach the container CLIENT process — everything the
+        worker needs must ride -e/-w/-v flags (ref: container.py's
+        podman command assembly)."""
+        if not self.container:
+            return cmd
+        runtime, run_options, image = self.container
+        flags: List[str] = []
+        # The package checkout must exist at the same path inside.
+        import ray_tpu as _rt
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_rt.__file__)))
+        flags += ["-v", f"{pkg_root}:{pkg_root}"]
+        for key in ("PYTHONPATH", "RAY_TPU_WORKER_ID", "JAX_PLATFORMS"):
+            if key in env:
+                flags += ["-e", f"{key}={env[key]}"]
+        for k, v in self.env_vars.items():
+            flags += ["-e", f"{k}={v}"]
+        if self.cwd:
+            flags += ["-v", f"{self.cwd}:{self.cwd}", "-w", self.cwd]
+        return [runtime, "run", "--rm", "--network=host",
+                "-v", "/dev/shm:/dev/shm", "-v", "/tmp:/tmp",
+                *flags, *run_options, image] + cmd
 
 
 class RuntimeEnvBuilder:
@@ -157,7 +187,87 @@ class RuntimeEnvBuilder:
         reqs = env.get("pip")
         if reqs:
             python = await self._build_venv(root, reqs)
-        return BuiltEnv(env_vars, python, pythonpath, cwd)
+        conda = env.get("conda")
+        if conda:
+            python = await self._build_conda(root, conda)
+        spec = None
+        container = env.get("container")
+        if container:
+            spec = self._container_spec(container)
+        return BuiltEnv(env_vars, python, pythonpath, cwd,
+                        container=spec)
+
+    # -- conda plugin (ref: _private/runtime_env/conda.py) -------------
+    def _conda_exe(self) -> str:
+        exe = os.environ.get("RAY_TPU_CONDA_EXE") or shutil.which("conda")
+        if not exe:
+            raise RuntimeEnvBuildError(
+                "runtime_env requests conda but no conda executable is "
+                "available (set RAY_TPU_CONDA_EXE or install conda)")
+        return exe
+
+    async def _build_conda(self, root: str, conda) -> str:
+        """Named env: resolve its python. Dict spec: create (cached by
+        the env hash, READY marker like the pip venv)."""
+        exe = self._conda_exe()
+        loop = asyncio.get_running_loop()
+        if isinstance(conda, str):
+            def resolve():
+                out = subprocess.run(
+                    [exe, "run", "-n", conda, "python", "-c",
+                     "import sys; print(sys.executable)"],
+                    capture_output=True, text=True, timeout=120)
+                lines = out.stdout.strip().splitlines()
+                if out.returncode != 0 or not lines:
+                    # Some conda versions swallow child stdout on rc=0 —
+                    # either way a clear build error, not an IndexError.
+                    raise RuntimeError(
+                        f"conda env {conda!r} unusable (rc="
+                        f"{out.returncode}): {out.stderr[-800:]}")
+                return lines[-1]
+
+            return await loop.run_in_executor(None, resolve)
+
+        env_dir = os.path.join(root, "conda")
+        python = os.path.join(env_dir, "bin", "python")
+        ready = os.path.join(root, "CONDA_READY")
+        if os.path.exists(ready) and os.path.exists(python):
+            return python
+
+        def create():
+            import json as _json
+
+            shutil.rmtree(env_dir, ignore_errors=True)
+            spec_path = os.path.join(root, "environment.json")
+            with open(spec_path, "w") as f:
+                _json.dump(conda, f)
+            out = subprocess.run(
+                [exe, "env", "create", "-p", env_dir, "-f", spec_path],
+                capture_output=True, text=True, timeout=1800)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"conda env create failed: {out.stderr[-2000:]}")
+            with open(ready, "w") as f:
+                f.write("ok")
+
+        await loop.run_in_executor(None, create)
+        return python
+
+    # -- container plugin (ref: _private/runtime_env/container.py) -----
+    def _container_spec(self, container: dict
+                        ) -> Tuple[str, List[str], str]:
+        image = container.get("image")
+        if not image:
+            raise RuntimeEnvBuildError("container runtime_env needs "
+                                       "an 'image'")
+        runtime = (os.environ.get("RAY_TPU_CONTAINER_RUNTIME")
+                   or shutil.which("podman") or shutil.which("docker"))
+        if not runtime:
+            raise RuntimeEnvBuildError(
+                "runtime_env requests a container but neither podman nor "
+                "docker is available (set RAY_TPU_CONTAINER_RUNTIME)")
+        return (runtime, [str(o) for o in container.get("run_options",
+                                                        ())], str(image))
 
     async def _build_venv(self, root: str, reqs: List[str]) -> str:
         """--system-site-packages venv + pip install (ref: pip.py builds

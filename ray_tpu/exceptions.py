@@ -51,6 +51,16 @@ class TaskError(RayTpuError):
             f"node={self.node_id[:8]}):\n{self.traceback_str}"
         )
 
+    def __reduce__(self):
+        # Exception's default __reduce__ replays self.args into
+        # __init__, which for this signature stuffs the formatted
+        # message into function_name and DROPS every other field on
+        # unpickle. The cause is deliberately omitted from the wire:
+        # user exception types may not import on the other side (its
+        # text already rides in traceback_str).
+        return (type(self), (self.function_name, self.traceback_str,
+                             None, self.pid, self.node_id))
+
 
 class ActorError(TaskError):
     """An actor method invocation failed."""
@@ -63,6 +73,9 @@ class ActorDiedError(RayTpuError):
         self.actor_id = actor_id
         self.reason = reason
         super().__init__(f"Actor {actor_id[:8]} died: {reason}")
+
+    def __reduce__(self):  # see TaskError.__reduce__
+        return (type(self), (self.actor_id, self.reason))
 
 
 class ActorUnavailableError(RayTpuError):
@@ -79,6 +92,9 @@ class ObjectLostError(RayTpuError):
     def __init__(self, object_id: str = "", message: str = ""):
         self.object_id = object_id
         super().__init__(message or f"Object {object_id[:8]} was lost.")
+
+    def __reduce__(self):  # see TaskError.__reduce__
+        return (type(self), (self.object_id, str(self)))
 
 
 class ObjectReconstructionFailedError(ObjectLostError):

@@ -84,9 +84,6 @@ class RolloutWorker:
         # reward (done=1 with no bootstrap would bias V targets low).
         self._gamma = bootstrap_gamma
 
-    def get_spaces(self) -> Tuple[int, int]:
-        return self.obs_dim, self.num_actions
-
     def get_space_info(self) -> Dict[str, Any]:
         return {
             "obs_dim": self.obs_dim,

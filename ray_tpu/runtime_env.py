@@ -15,6 +15,11 @@ Supported fields:
     pip:         ["requests==...", "/local/pkg"]    -> venv with
                  --system-site-packages + pip install (offline-capable
                  only for local paths in a zero-egress cluster)
+    conda:       "existing-env-name" or {yaml spec dict} -> workers run
+                 on that conda env's python (ref: runtime_env/conda.py)
+    container:   {"image": ..., "run_options": [...]} -> the worker
+                 command is wrapped in podman/docker run
+                 (ref: runtime_env/container.py)
 """
 from __future__ import annotations
 
@@ -25,7 +30,8 @@ import os
 import zipfile
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip")
+_SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip", "conda",
+              "container")
 PKG_NAMESPACE = "pkg"
 
 
@@ -35,7 +41,9 @@ class RuntimeEnv(dict):
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
-                 pip: Optional[List[str]] = None, **extra):
+                 pip: Optional[List[str]] = None,
+                 conda: Optional[Any] = None,
+                 container: Optional[Dict[str, Any]] = None, **extra):
         unknown = set(extra) - set(_SUPPORTED)
         if unknown:
             raise ValueError(f"unsupported runtime_env fields: {unknown}")
@@ -51,6 +59,10 @@ class RuntimeEnv(dict):
             self["py_modules"] = list(py_modules)
         if pip:
             self["pip"] = list(pip)
+        if conda:
+            self["conda"] = conda
+        if container:
+            self["container"] = dict(container)
 
 
 def _zip_path(path: str) -> bytes:
@@ -123,6 +135,26 @@ def normalize(env: Optional[Dict[str, Any]], kv_put) -> Optional[dict]:
         out["py_modules"] = uris
     if env.get("pip"):
         out["pip"] = [str(r) for r in env["pip"]]
+    conda = env.get("conda")
+    if conda:
+        if env.get("pip"):
+            # Same rule as the reference: pip deps belong INSIDE the
+            # conda spec (dependencies: [pip: [...]]), not alongside it.
+            raise ValueError("runtime_env cannot set both 'conda' and "
+                             "'pip'; add pip deps to the conda spec")
+        if not isinstance(conda, (str, dict)):
+            raise ValueError("conda must be an env name or a spec dict")
+        out["conda"] = conda
+    container = env.get("container")
+    if container:
+        if not isinstance(container, dict) or not container.get("image"):
+            raise ValueError("container must be {'image': ..., "
+                             "'run_options': [...]}")
+        out["container"] = {
+            "image": str(container["image"]),
+            "run_options": [str(o) for o in
+                            container.get("run_options") or ()],
+        }
     return out or None
 
 

@@ -192,3 +192,66 @@ def test_request_resources_scales_without_load():
         assert len(alive) == 1
     finally:
         cluster.shutdown()
+
+
+def test_monitor_soak_relaunches_preempted_node():
+    """Monitor-loop soak with PREEMPTION (ref: the reference's
+    AutoscalingCluster pattern, cluster_utils.py:26): a worker node is
+    SIGKILLed out-of-band while a standing resource request holds the
+    capacity floor — the autoscaler must reap the dead instance and
+    launch a replacement without any driver action."""
+    import ray_tpu
+    from ray_tpu.autoscaler import AutoscalingCluster, sdk
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "worker": {"resources": {"CPU": 2}, "min_workers": 0,
+                       "max_workers": 3},
+        },
+        idle_timeout_s=300.0,      # only the request floor matters here
+        update_interval_s=0.5,
+        launch_timeout_s=8.0,      # reap a dead instance quickly
+    )
+    try:
+        cluster.connect()
+        sdk.request_resources(bundles=[{"CPU": 2.0}, {"CPU": 2.0}])
+
+        def alive_workers():
+            return [n for n in ray_tpu.nodes()
+                    if n["Alive"] and n["Resources"].get("CPU") == 2.0]
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and len(alive_workers()) < 2:
+            time.sleep(0.5)
+        assert len(alive_workers()) == 2, "floor never satisfied"
+
+        # Preemption: SIGKILL one worker daemon BEHIND the provider's
+        # back (spot reclaim). The provider keeps listing the instance;
+        # the autoscaler must notice the dead node and replace it.
+        victims = cluster.provider.non_terminated_nodes()
+        victim_id = next(iter(victims))
+        proc = cluster.provider._procs[victim_id]
+        proc.kill()
+
+        dead_seen = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            nodes = ray_tpu.nodes()
+            if any(not n["Alive"] for n in nodes):
+                dead_seen = True
+            live = alive_workers()
+            if dead_seen and len(live) >= 2 and victim_id not in \
+                    cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert dead_seen, "GCS never noticed the preempted node"
+        assert victim_id not in cluster.provider.non_terminated_nodes(), \
+            "dead instance never reaped"
+        assert len(alive_workers()) >= 2, "replacement never launched"
+    finally:
+        try:
+            sdk.request_resources(bundles=[])
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()

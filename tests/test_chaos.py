@@ -37,25 +37,35 @@ def test_tasks_survive_worker_kills(chaos_cluster):
 
 
 def test_actor_survives_worker_kills_with_restart(chaos_cluster):
-    @ray_tpu.remote(max_restarts=20, max_task_retries=20)
+    """Event-based (deflaked): the assertion is 'N calls succeeded AFTER
+    a kill happened', not a wall-clock success ratio — under machine
+    load the old fixed-iteration version starved below its threshold."""
+    @ray_tpu.remote(max_restarts=50, max_task_retries=50)
     class Echo:
         def ping(self, i):
-            time.sleep(0.15)  # keep the workload alive across kill ticks
+            time.sleep(0.1)  # keep the workload alive across kill ticks
             return i
 
     a = Echo.remote()
     assert ray_tpu.get(a.ping.remote(0), timeout=60) == 0
     killer = WorkerKiller(interval_s=0.8, seed=3,
                           include_actor_workers=True).start()
+    ok_after_kill = 0
     try:
-        ok = 0
-        for i in range(30):
+        deadline = time.monotonic() + 120
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
             try:
                 assert ray_tpu.get(a.ping.remote(i), timeout=60) == i
-                ok += 1
+                if killer.kills:
+                    ok_after_kill += 1
             except ray_tpu.exceptions.ActorUnavailableError:
-                time.sleep(0.3)  # restart window; keep going
+                time.sleep(0.2)  # restart window; keep going
+            if ok_after_kill >= 10 and len(killer.kills) >= 1:
+                break
     finally:
         kills = killer.stop()
-    assert ok >= 15, f"too few successful calls under chaos: {ok}"
-    assert len(kills) >= 1
+    assert len(kills) >= 1, "chaos never killed a worker"
+    assert ok_after_kill >= 10, (
+        f"only {ok_after_kill} successful calls after first kill")

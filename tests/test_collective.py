@@ -27,10 +27,13 @@ class Rank:
         return self.g.allgather(v)
 
     def do_broadcast(self, v):
-        return self.g.broadcast(np.asarray(v), src_rank=0)
+        # Generous timeout: these ops rendezvous through the KV; under
+        # full-suite machine load 60s default occasionally starved.
+        return self.g.broadcast(np.asarray(v), src_rank=0, timeout=180.0)
 
     def do_reducescatter(self, x):
-        return self.g.reducescatter(np.asarray(x, dtype=np.float64))
+        return self.g.reducescatter(np.asarray(x, dtype=np.float64),
+                                    timeout=180.0)
 
     def do_sendrecv(self, peer, value=None):
         if value is not None:
@@ -39,7 +42,8 @@ class Rank:
         return self.g.recv(peer)
 
     def do_broadcast_burst(self, n):
-        return [self.g.broadcast(np.asarray([i]), src_rank=0)[0]
+        return [self.g.broadcast(np.asarray([i]), src_rank=0,
+                                 timeout=180.0)[0]
                 for i in range(n)]
 
     def do_send_burst(self, peer, n):

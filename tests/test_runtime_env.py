@@ -17,8 +17,9 @@ from ray_tpu.runtime_env import RuntimeEnv, env_hash, normalize
 def test_runtime_env_validation():
     env = RuntimeEnv(env_vars={"A": "1"}, pip=["x"])
     assert env == {"env_vars": {"A": "1"}, "pip": ["x"]}
+    assert RuntimeEnv(conda="myenv") == {"conda": "myenv"}
     with pytest.raises(ValueError):
-        RuntimeEnv(conda="nope")
+        RuntimeEnv(docker="nope")
     with pytest.raises(TypeError):
         RuntimeEnv(env_vars={"A": 1})
     assert env_hash(None) == ""
@@ -135,3 +136,16 @@ def test_normalize_uploads_and_is_stable(env_cluster, tmp_path):
     assert n1 == n2
     assert n1["working_dir"].startswith("pkg://")
     assert env_hash(n1) == env_hash(n2)
+
+
+def test_conda_missing_is_clear_build_error(env_cluster):
+    """Without any conda on the node, creation fails fast with a clear
+    build error (offline-tolerant), not a hang or retry loop."""
+    @ray_tpu.remote(runtime_env={"conda": "nope"}, max_restarts=0)
+    class C:
+        def ping(self):
+            return 1
+
+    a = C.remote()
+    with pytest.raises(Exception, match="conda"):
+        ray_tpu.get(a.ping.remote(), timeout=120)

@@ -1,0 +1,135 @@
+"""Runtime-env conda + container plugins
+(ref: _private/runtime_env/conda.py, container.py).
+
+No conda/podman in this image: PATH-stubbed fake binaries stand in,
+like the reference's plugin unit tests mock the process layer. The
+fakes must be visible to the NODE DAEMON (it runs the builds), so this
+module runs its own cluster with the env set — in its OWN file so the
+shutdown can't invalidate another module's shared cluster fixture.
+"""
+import glob
+import os
+
+import pytest
+
+import ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# conda + container plugins (ref: _private/runtime_env/conda.py,
+# container.py). No conda/podman in this image: PATH-stubbed fake
+# binaries stand in, like the reference's plugin unit tests mock the
+# process layer. The fakes must be visible to the NODE DAEMON (it runs
+# the builds), so a dedicated cluster is started with the env set.
+# ---------------------------------------------------------------------------
+
+def _write_fake_tools(base: str) -> dict:
+    import stat
+    import sys
+
+    os.makedirs(base, exist_ok=True)
+    conda = os.path.join(base, "conda")
+    with open(conda, "w") as f:
+        f.write(f"""#!/bin/bash
+# fake conda: 'env create -p <dir> -f <spec>' and 'run -n <name> ...'
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+    dir="$4"
+    mkdir -p "$dir/bin"
+    cat > "$dir/bin/python" <<PYEOF
+#!/bin/bash
+export CONDA_ENV_MARKER="$dir"
+exec {sys.executable} "\\$@"
+PYEOF
+    chmod +x "$dir/bin/python"
+    exit 0
+fi
+if [ "$1" = "run" ]; then
+    echo "{sys.executable}"
+    exit 0
+fi
+exit 1
+""")
+    os.chmod(conda, os.stat(conda).st_mode | stat.S_IEXEC)
+
+    record = os.path.join(base, "podman_args.txt")
+    podman = os.path.join(base, "podman")
+    with open(podman, "w") as f:
+        f.write(f"""#!/bin/bash
+echo "$@" >> {record}
+# skip wrapper args up to and including the image, then exec the rest
+while [ "$1" != "test-image:1" ] && [ -n "$1" ]; do shift; done
+shift
+exec "$@"
+""")
+    os.chmod(podman, os.stat(podman).st_mode | stat.S_IEXEC)
+    return {"RAY_TPU_CONDA_EXE": conda,
+            "RAY_TPU_CONTAINER_RUNTIME": podman,
+            "record": record}
+
+
+@pytest.fixture(scope="module")
+def plugin_cluster():
+    import tempfile
+
+    from ray_tpu.cluster_utils import Cluster
+
+    # The shared env_cluster session must end first: one driver per
+    # process, and these tests need daemons with the fake-tool env.
+    ray_tpu.shutdown()
+    base = tempfile.mkdtemp(prefix="rtpu_fake_tools_")
+    tools = _write_fake_tools(base)
+    env = {k: v for k, v in tools.items() if k.startswith("RAY_TPU")}
+    cluster = Cluster(head_node_args={"num_cpus": 2, "env": env})
+    cluster.connect()
+    yield cluster, tools
+    cluster.shutdown()
+
+
+def test_conda_spec_env_builds_and_caches(plugin_cluster):
+    """An actor runs on a conda env the driver doesn't have; the second
+    use is a cache hit (no rebuild)."""
+    import glob
+
+    @ray_tpu.remote(runtime_env={"conda": {"name": "test-env",
+                                           "dependencies": ["python"]}})
+    class CondaActor:
+        def probe(self):
+            import os as _os
+
+            return _os.environ.get("CONDA_ENV_MARKER")
+
+    a = CondaActor.remote()
+    marker = ray_tpu.get(a.probe.remote(), timeout=120)
+    assert marker and "conda" in marker  # ran inside the env dir
+    ray_tpu.kill(a)
+
+    ready = glob.glob("/tmp/ray_tpu_runtime_envs/*/CONDA_READY")
+    assert ready
+    before = max(os.path.getmtime(p) for p in ready)
+    b = CondaActor.remote()
+    assert ray_tpu.get(b.probe.remote(), timeout=120) == marker
+    assert max(os.path.getmtime(p) for p in ready) == before  # cache hit
+    ray_tpu.kill(b)
+
+
+def test_container_wraps_worker_command(plugin_cluster):
+    """The worker command is wrapped in the container runtime; the fake
+    podman records its argv then execs the inner command."""
+    _, tools = plugin_cluster
+
+    @ray_tpu.remote(runtime_env={"container": {
+        "image": "test-image:1", "run_options": ["--ipc=host"]}})
+    def in_container():
+        return "ran"
+
+    assert ray_tpu.get(in_container.remote(), timeout=120) == "ran"
+    argv = open(tools["record"]).read()
+    assert "run --rm --network=host" in argv
+    assert "--ipc=host" in argv and "test-image:1" in argv
+
+
+def test_runtime_env_rejects_pip_plus_conda():
+    from ray_tpu.runtime_env import normalize
+
+    with pytest.raises(ValueError, match="conda"):
+        normalize({"pip": ["x"], "conda": "envname"}, lambda *a: None)

@@ -40,3 +40,27 @@ def test_alignment_of_buffers():
     np.testing.assert_array_equal(a, x)
     np.testing.assert_array_equal(b, y)
     assert s == "tail"
+
+
+def test_exception_fields_survive_pickle_roundtrip():
+    """Exception's default __reduce__ replays args into __init__, which
+    for multi-field signatures silently destroys the fields — the actor
+    death REASON vanished on the wire before the custom __reduce__."""
+    import pickle
+
+    from ray_tpu import exceptions as rexc
+
+    e = rexc.ActorDiedError("abcdef0123456789", "creation failed: no conda")
+    e2 = pickle.loads(pickle.dumps(e))
+    assert e2.actor_id == "abcdef0123456789"
+    assert e2.reason == "creation failed: no conda"
+    assert "no conda" in str(e2)
+
+    t = rexc.TaskError(function_name="f", traceback_str="TB", pid=7,
+                       node_id="n" * 16)
+    t2 = pickle.loads(pickle.dumps(t))
+    assert (t2.function_name, t2.traceback_str, t2.pid) == ("f", "TB", 7)
+
+    o = rexc.ObjectLostError("oid123", "object oid123 evicted")
+    o2 = pickle.loads(pickle.dumps(o))
+    assert o2.object_id == "oid123" and "evicted" in str(o2)

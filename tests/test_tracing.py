@@ -82,8 +82,14 @@ def test_remote_spans_inherit_trace(traced_cluster):
     assert any(n.startswith("task:") and n.endswith("child")
                for n in names), names
     assert "actor:Act.m" in names, names
-    # Execution spans parent to the driver span that submitted them.
-    assert all(s["parent_id"] == root.span_id for s in found)
+    # The EXECUTION spans parent to the driver span that submitted them.
+    # (Only those: the same trace can legitimately carry further nested
+    # spans whose parent is the execution span, not the root — asserting
+    # over every span made this flake whenever one flushed in time.)
+    execution = [s for s in found
+                 if s["name"].startswith(("task:", "actor:"))]
+    assert execution
+    assert all(s["parent_id"] == root.span_id for s in execution)
 
 
 def test_timeline_includes_spans(traced_cluster):
