@@ -1,0 +1,48 @@
+// Example C++ worker: registers remote functions callable from Python
+// through ray_tpu.util.cross_lang.CppWorker (the RAY_REMOTE analogue of
+// the reference's cpp/example, ref: cpp/example/example.cc).
+#include <numeric>
+#include <string>
+#include <vector>
+
+#include "ray_tpu_worker/ray_tpu_worker.hpp"
+
+using ray_tpu::AsFloat;
+using ray_tpu::Value;
+
+// Simple arithmetic across the language boundary.
+static Value Add(const std::vector<Value>& args) {
+  return Value::Float(AsFloat(args[0]) + AsFloat(args[1]));
+}
+RAY_TPU_REMOTE(Add);
+
+// A compute-ish kernel: dot product of two float lists — the shape of
+// work one would actually push to native code.
+static Value Dot(const std::vector<Value>& args) {
+  const auto& a = args[0].items;
+  const auto& b = args[1].items;
+  if (a.size() != b.size()) throw ray_tpu::RpcError("length mismatch");
+  double acc = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) acc += AsFloat(a[i]) * AsFloat(b[i]);
+  return Value::Float(acc);
+}
+RAY_TPU_REMOTE(Dot);
+
+// Structured data both ways: returns {"sum": ..., "n": ...}.
+static Value Describe(const std::vector<Value>& args) {
+  double sum = 0.0;
+  for (const auto& v : args[0].items) sum += AsFloat(v);
+  Value out = Value::Dict();
+  out.Set("sum", Value::Float(sum));
+  out.Set("n", Value::Int(static_cast<int64_t>(args[0].items.size())));
+  return out;
+}
+RAY_TPU_REMOTE(Describe);
+
+// Deliberate failure path: errors surface as CppFunctionError in Python.
+static Value Boom(const std::vector<Value>&) {
+  throw ray_tpu::RpcError("boom from C++");
+}
+RAY_TPU_REMOTE(Boom);
+
+int main() { return ray_tpu::WorkerMain(); }

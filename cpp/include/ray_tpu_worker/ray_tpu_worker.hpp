@@ -1,0 +1,218 @@
+// C++ worker API for the ray_tpu runtime: DEFINE remote functions in C++.
+//
+// Role parity with the reference C++ worker (ref: cpp/include/ray/api.h —
+// RAY_REMOTE(fn) registration + a worker runtime executing tasks pushed
+// to it, cpp/src/ray/runtime/task/task_executor.cc). The client header
+// (ray_tpu_client.hpp) lets C++ CALL INTO the cluster; this header is
+// the other direction: a C++ binary registers functions and serves them
+// over the framework's native frame protocol, so Python drivers invoke
+// C++ code through `ray_tpu.util.cross_lang.CppWorker` with the same
+// Value data model (primitives/bytes/str/list/dict) the cross-language
+// boundary allows.
+//
+//   #include "ray_tpu_worker/ray_tpu_worker.hpp"
+//   static ray_tpu::Value Add(const std::vector<ray_tpu::Value>& args) {
+//     return ray_tpu::Value::Float(ray_tpu::AsFloat(args[0]) +
+//                                  ray_tpu::AsFloat(args[1]));
+//   }
+//   RAY_TPU_REMOTE(Add);          // registered under "Add"
+//   int main() { return ray_tpu::WorkerMain(); }
+//
+// The worker prints `CPP_WORKER_PORT=<port>` on stdout once listening —
+// the same handshake pattern the Python runtime processes use — and then
+// serves forever. Header-only; links against the C++ standard library.
+#pragma once
+
+#include <atomic>
+#include <cstdio>
+#include <functional>
+#include <map>
+#include <thread>
+
+#include "../ray_tpu_client/ray_tpu_client.hpp"
+
+namespace ray_tpu {
+
+using RemoteFn = std::function<Value(const std::vector<Value>&)>;
+
+// Numeric coercion helpers for function bodies (cross-language numbers
+// arrive as Int or Float depending on the Python literal).
+inline double AsFloat(const Value& v) {
+  if (v.kind == Value::Kind::Float) return v.f;
+  if (v.kind == Value::Kind::Int) return static_cast<double>(v.i);
+  if (v.kind == Value::Kind::Bool) return v.b ? 1.0 : 0.0;
+  throw RpcError("value is not numeric");
+}
+
+inline int64_t AsInt(const Value& v) {
+  if (v.kind == Value::Kind::Int) return v.i;
+  if (v.kind == Value::Kind::Bool) return v.b ? 1 : 0;
+  if (v.kind == Value::Kind::Float) return static_cast<int64_t>(v.f);
+  throw RpcError("value is not numeric");
+}
+
+// ---------------------------------------------------------------------------
+// registry
+// ---------------------------------------------------------------------------
+
+inline std::map<std::string, RemoteFn>& FunctionRegistry() {
+  static std::map<std::string, RemoteFn> registry;
+  return registry;
+}
+
+inline bool RegisterFunction(const std::string& name, RemoteFn fn) {
+  FunctionRegistry()[name] = std::move(fn);
+  return true;
+}
+
+// Static-init registration, the RAY_REMOTE analogue.
+#define RAY_TPU_REMOTE(fn) \
+  static const bool _ray_tpu_reg_##fn = ::ray_tpu::RegisterFunction(#fn, fn)
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+
+namespace detail {
+
+inline void SendFrame(int fd, unsigned char ftype, uint64_t req_id,
+                      const std::string& payload) {
+  std::string frame;
+  uint32_t len = static_cast<uint32_t>(9 + payload.size());
+  frame.append(reinterpret_cast<const char*>(&len), 4);
+  frame.push_back(static_cast<char>(ftype));
+  frame.append(reinterpret_cast<const char*>(&req_id), 8);
+  frame.append(payload);
+  size_t off = 0;
+  while (off < frame.size()) {
+    ssize_t n = send(fd, frame.data() + off, frame.size() - off, 0);
+    if (n <= 0) throw RpcError("send failed");
+    off += static_cast<size_t>(n);
+  }
+}
+
+inline bool RecvExactly(int fd, size_t n, std::string* out) {
+  out->assign(n, '\0');
+  size_t off = 0;
+  while (off < n) {
+    ssize_t got = recv(fd, out->data() + off, n - off, 0);
+    if (got <= 0) return false;  // peer closed
+    off += static_cast<size_t>(got);
+  }
+  return true;
+}
+
+// One reply per request: {"ok": True, "result": {"ok":..,"value"/"error"}}.
+// The inner envelope is app-level — a C++ worker cannot pickle a Python
+// exception instance, so errors ride as strings the Python wrapper
+// re-raises (the same rule the reference's cross-language boundary has).
+inline Value AppResult(Value value) {
+  Value inner = Value::Dict();
+  inner.Set("ok", Value::Bool(true));
+  inner.Set("value", std::move(value));
+  return inner;
+}
+
+inline Value AppError(const std::string& msg) {
+  Value inner = Value::Dict();
+  inner.Set("ok", Value::Bool(false));
+  inner.Set("error", Value::Str(msg));
+  return inner;
+}
+
+inline Value HandleRequest(const Value& req) {
+  // req = (service, method, kwargs)
+  if (req.items.size() != 3) return AppError("malformed request tuple");
+  const std::string& method = req.items[1].s;
+  const Value& kwargs = req.items[2];
+  if (method == "ping") return AppResult(Value::Str("pong"));
+  if (method == "list_functions") {
+    std::vector<Value> names;
+    for (const auto& kv : FunctionRegistry()) {
+      names.push_back(Value::Str(kv.first));
+    }
+    return AppResult(Value::List(std::move(names)));
+  }
+  if (method != "invoke") return AppError("no such method " + method);
+  const Value* fn_name = kwargs.Get("fn");
+  const Value* args = kwargs.Get("args");
+  if (fn_name == nullptr || fn_name->kind != Value::Kind::Str) {
+    return AppError("invoke needs a string 'fn'");
+  }
+  auto it = FunctionRegistry().find(fn_name->s);
+  if (it == FunctionRegistry().end()) {
+    return AppError("no registered C++ function " + fn_name->s);
+  }
+  std::vector<Value> argv;
+  if (args != nullptr) argv = args->items;
+  try {
+    return AppResult(it->second(argv));
+  } catch (const std::exception& e) {
+    return AppError(std::string("C++ function ") + fn_name->s +
+                    " raised: " + e.what());
+  }
+}
+
+inline void ServeConn(int fd) {
+  for (;;) {
+    std::string head;
+    if (!RecvExactly(fd, 13, &head)) break;
+    uint32_t flen;
+    std::memcpy(&flen, head.data(), 4);
+    unsigned char ftype = static_cast<unsigned char>(head[4]);
+    uint64_t req_id;
+    std::memcpy(&req_id, head.data() + 5, 8);
+    if (flen < 9) break;  // malformed framing: drop the connection
+    std::string body;
+    if (!RecvExactly(fd, flen - 9, &body)) break;
+    if (ftype != 1 /*REQ*/) continue;  // streams/cancel unsupported
+    Value app;
+    try {
+      app = HandleRequest(PickleLoads(body));
+    } catch (const std::exception& e) {
+      app = AppError(std::string("bad request: ") + e.what());
+    }
+    Value reply = Value::Dict();
+    reply.Set("ok", Value::Bool(true));
+    reply.Set("result", std::move(app));
+    try {
+      SendFrame(fd, 2 /*RES*/, req_id, PickleDumps(reply));
+    } catch (const std::exception&) {
+      break;
+    }
+  }
+  close(fd);
+}
+
+}  // namespace detail
+
+// Serve registered functions forever. Returns only on a fatal socket
+// error. `port=0` binds an ephemeral port; the chosen port is announced
+// as `CPP_WORKER_PORT=<port>` on stdout (flushed) for the spawner.
+inline int WorkerMain(int port = 0) {
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) return 1;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(0x7f000001);  // 127.0.0.1
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    return 1;
+  }
+  if (listen(srv, 64) != 0) return 1;
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("CPP_WORKER_PORT=%d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    int nd = 1;
+    setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &nd, sizeof(nd));
+    std::thread(detail::ServeConn, fd).detach();
+  }
+}
+
+}  // namespace ray_tpu

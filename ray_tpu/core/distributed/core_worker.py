@@ -847,16 +847,34 @@ class DistributedCoreWorker:
         def run():
             from ray_tpu.core.distributed import pull_manager as pm
 
-            for r in refs:
-                try:
-                    oid = r.id()
-                    if (self._inline_cache.get(oid) is not None
-                            or self.store.contains(oid)):
-                        continue
-                    self._try_pull_remote(oid,
-                                          priority=pm.PRIORITY_PREFETCH)
-                except Exception:  # noqa: BLE001 best effort
-                    pass
+            # The producer's directory registration is asynchronous
+            # (batched add_locations), so a single attempt right after
+            # task completion races it — retry for a bounded window.
+            # ROUND-ROBIN over the batch each sweep: a ref whose location
+            # never appears must not starve the refs that are available
+            # right now (this is the dataset-pipeline warming path).
+            remaining = [r.id() for r in refs]
+            deadline = time.monotonic() + 60.0
+            backoff = 0.05
+            while (remaining and not self._shutdown
+                   and time.monotonic() < deadline):
+                still = []
+                for oid in remaining:
+                    try:
+                        if (self._inline_cache.get(oid) is not None
+                                or self.store.contains(oid)):
+                            continue
+                        pulled, _ = self._try_pull_remote(
+                            oid, priority=pm.PRIORITY_PREFETCH)
+                        if pulled:
+                            continue
+                    except Exception:  # noqa: BLE001 best effort
+                        pass
+                    still.append(oid)
+                remaining = still
+                if remaining:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 1.0)
 
         threading.Thread(target=run, daemon=True,
                          name="prefetch").start()

@@ -46,8 +46,26 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.job_id: Optional[str] = None       # last lease's job (logs)
         self.env_key = env_key        # runtime-env identity of this worker
+        # (runtime, container_name) for containerized workers: the Popen
+        # is only the podman/docker CLIENT — killing it leaves the
+        # container running, so teardown must kill by name.
+        self.container: Optional[Tuple[str, str]] = None
         self.last_idle = time.monotonic()
         self.registered = asyncio.Event()
+
+    def kill(self, term: bool = False) -> None:
+        """Stop this worker INCLUDING its container, if any."""
+        if self.container is not None:
+            runtime, name = self.container
+            try:
+                subprocess.run([runtime, "kill", name],
+                               capture_output=True, timeout=20)
+            except Exception:  # noqa: BLE001 best effort
+                pass
+        try:
+            (self.proc.terminate if term else self.proc.kill)()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class Lease:
@@ -158,7 +176,7 @@ class NodeDaemon:
             t.cancel()
         for w in list(self._workers.values()):
             try:
-                w.proc.kill()
+                w.kill()
             except Exception:  # noqa: BLE001
                 pass
         await self.server.stop()
@@ -247,10 +265,14 @@ class NodeDaemon:
             "--store-dir", self.store_dir,
             "--worker-id", worker_id,
         ]
+        container_name = None
         if built_env is not None and built_env.container:
             # Container plugin: the worker runs inside podman/docker;
-            # env/cwd must ride the run flags, not Popen's env.
-            cmd = built_env.wrap_command(cmd, env)
+            # env/cwd must ride the run flags, not Popen's env, and the
+            # container is named so teardown can kill IT (killing the
+            # client process leaves the container running).
+            container_name = f"rtpu-worker-{worker_id[:16]}"
+            cmd = built_env.wrap_command(cmd, env, name=container_name)
         # Per-worker log files; the LogMonitor tails them to the GCS
         # (ref: worker stdout/stderr files under session logs,
         # node.py:1042 + log_monitor.py tailing).
@@ -272,6 +294,8 @@ class NodeDaemon:
         self._m_spawned.inc()
         handle = WorkerHandle(proc, worker_id, env_key=env_key)
         handle.actor_id = actor_id
+        if container_name is not None:
+            handle.container = (built_env.container[0], container_name)
         self._workers[worker_id] = handle
         return handle
 
@@ -395,7 +419,7 @@ class NodeDaemon:
         for h in self._workers.values():
             if h.worker_id == worker_id or (pid and h.proc.pid == pid):
                 try:
-                    h.proc.kill()
+                    h.kill()
                 except Exception:  # noqa: BLE001
                     return {"ok": False}
                 return {"ok": True, "pid": h.proc.pid}
@@ -415,7 +439,7 @@ class NodeDaemon:
             return {"ok": False, "reason": "no candidate workers"}
         victim = rng.choice(candidates)
         try:
-            victim.proc.kill()
+            victim.kill()
         except Exception:  # noqa: BLE001
             return {"ok": False}
         return {"ok": True, "pid": victim.proc.pid,
@@ -472,7 +496,7 @@ class NodeDaemon:
                     raise RuntimeError(
                         "worker died before registering") from None
                 if loop.time() >= deadline:
-                    handle.proc.kill()
+                    handle.kill()
                     self._workers.pop(handle.worker_id, None)
                     raise RuntimeError(
                         "worker failed to register in time") from None
@@ -520,7 +544,7 @@ class NodeDaemon:
             handle = self._idle.popleft()
             self._workers.pop(handle.worker_id, None)
             try:
-                handle.proc.kill()
+                handle.kill()
             except Exception:  # noqa: BLE001
                 pass
             killed_idle += 1
@@ -540,7 +564,7 @@ class NodeDaemon:
                 usage * 100, victim.worker_id[:8],
                 time.monotonic() - newest.granted_at)
             try:
-                victim.proc.kill()
+                victim.kill()
             except Exception:  # noqa: BLE001
                 pass
             self._m_oom_kills.inc()
@@ -565,7 +589,7 @@ class NodeDaemon:
             self._workers.pop(handle.worker_id, None)
             self._retire_worker_logs(handle)
             try:
-                handle.proc.terminate()
+                handle.kill(term=True)
             except Exception:  # noqa: BLE001
                 pass
             n_task_workers -= 1
@@ -963,7 +987,7 @@ class NodeDaemon:
             except asyncio.TimeoutError:
                 if (handle.proc.poll() is not None
                         or loop.time() >= deadline):
-                    handle.proc.kill()
+                    handle.kill()
                     self._workers.pop(handle.worker_id, None)
                     self._release_demand(demand, placement)
                     return {"ok": False,
@@ -980,7 +1004,7 @@ class NodeDaemon:
         finally:
             await client.close()
         if not reply.get("ok"):
-            handle.proc.kill()
+            handle.kill()
             self._workers.pop(handle.worker_id, None)
             self._release_demand(demand, placement)
             return {"ok": False, "error": reply.get("error"),
@@ -993,7 +1017,7 @@ class NodeDaemon:
     async def kill_worker(self, worker_address: str) -> dict:
         for handle in self._workers.values():
             if handle.address == worker_address:
-                handle.proc.kill()
+                handle.kill()
                 return {"ok": True}
         return {"ok": False}
 

@@ -46,25 +46,33 @@ class BuiltEnv:
         # Container plugin: (runtime, run_options, image).
         self.container = container
 
-    def wrap_command(self, cmd: List[str], env: Dict[str, str]
-                     ) -> List[str]:
+    def wrap_command(self, cmd: List[str], env: Dict[str, str],
+                     name: Optional[str] = None) -> List[str]:
         """Wrap the worker argv in `podman/docker run`. env/cwd given to
         Popen only reach the container CLIENT process — everything the
         worker needs must ride -e/-w/-v flags (ref: container.py's
-        podman command assembly)."""
+        podman command assembly). `name` makes the container killable by
+        the daemon (`podman kill <name>`) — signalling the client process
+        does NOT stop the container."""
         if not self.container:
             return cmd
         runtime, run_options, image = self.container
         flags: List[str] = []
+        if name:
+            flags += ["--name", name]
         # The package checkout must exist at the same path inside.
         import ray_tpu as _rt
 
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(_rt.__file__)))
         flags += ["-v", f"{pkg_root}:{pkg_root}"]
-        for key in ("PYTHONPATH", "RAY_TPU_WORKER_ID", "JAX_PLATFORMS"):
-            if key in env:
-                flags += ["-e", f"{key}={env[key]}"]
+        # Every framework knob resolves from RAY_TPU_* env (config.py);
+        # non-container workers inherit ALL of os.environ — forward the
+        # same configuration surface, not a hand-picked subset.
+        for key, val in env.items():
+            if key.startswith("RAY_TPU_") or key in ("PYTHONPATH",
+                                                     "JAX_PLATFORMS"):
+                flags += ["-e", f"{key}={val}"]
         for k, v in self.env_vars.items():
             flags += ["-e", f"{k}={v}"]
         if self.cwd:

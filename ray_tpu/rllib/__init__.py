@@ -30,6 +30,7 @@ from ray_tpu.rllib.replay_buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
 )
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
@@ -43,6 +44,8 @@ __all__ = [
     "ImpalaConfig",
     "SAC",
     "SACConfig",
+    "CQL",
+    "CQLConfig",
     "BC",
     "BCConfig",
     "SampleWriter",

@@ -1,0 +1,80 @@
+"""C++ worker API: C++-DEFINED remote functions served to Python
+(ref: the reference cpp/ worker — RAY_REMOTE registration + task
+execution in a C++ runtime, cpp/src/ray/runtime/task/task_executor.cc).
+Compiles the example worker with g++ at test time, spawns it, and
+drives it through ray_tpu.util.cross_lang.CppWorker."""
+import os
+import subprocess
+
+import pytest
+
+from ray_tpu.util.cross_lang import CppFunctionError, CppWorker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "cpp", "_build", "worker_example")
+
+
+@pytest.fixture(scope="module")
+def worker_binary():
+    import shutil
+
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no C++ toolchain")
+    os.makedirs(os.path.dirname(BIN), exist_ok=True)
+    src = os.path.join(REPO, "cpp", "examples", "worker_example.cc")
+    inc = os.path.join(REPO, "cpp", "include")
+    deps = [src,
+            os.path.join(inc, "ray_tpu_worker", "ray_tpu_worker.hpp"),
+            os.path.join(inc, "ray_tpu_client", "ray_tpu_client.hpp")]
+    if (not os.path.exists(BIN)
+            or os.path.getmtime(BIN) < max(map(os.path.getmtime, deps))):
+        subprocess.run(
+            [gxx, "-std=c++17", "-O2", "-pthread", f"-I{inc}", src,
+             "-o", BIN],
+            check=True, capture_output=True, text=True, timeout=300)
+    return BIN
+
+
+@pytest.fixture(scope="module")
+def cpp_worker(worker_binary):
+    with CppWorker(worker_binary) as w:
+        yield w
+
+
+def test_registry_and_ping(cpp_worker):
+    assert cpp_worker.ping()
+    assert cpp_worker.functions() == ["Add", "Boom", "Describe", "Dot"]
+
+
+def test_invoke_scalars_and_structures(cpp_worker):
+    assert cpp_worker.invoke("Add", 2.0, 3.5) == 5.5
+    assert cpp_worker.invoke("Add", 2, 3) == 5.0  # int coercion
+    assert cpp_worker.invoke("Dot", [1.0, 2.0, 3.0],
+                             [4.0, 5.0, 6.0]) == 32.0
+    out = cpp_worker.invoke("Describe", [1.0, 2.0, 3.0, 4.0])
+    assert out == {"sum": 10.0, "n": 4}
+
+
+def test_cpp_error_surfaces_as_python_exception(cpp_worker):
+    with pytest.raises(CppFunctionError, match="boom from C\\+\\+"):
+        cpp_worker.invoke("Boom")
+    with pytest.raises(CppFunctionError, match="no registered"):
+        cpp_worker.invoke("NoSuchFn")
+
+
+def test_concurrent_submissions(cpp_worker):
+    futs = [cpp_worker.submit("Add", i, i) for i in range(32)]
+    assert [f.result(timeout=60) for f in futs] == [2.0 * i
+                                                   for i in range(32)]
+
+
+def test_worker_dies_with_owner(worker_binary):
+    w = CppWorker(worker_binary)
+    pid = w._proc.pid
+    assert w.invoke("Add", 1, 1) == 2.0
+    w.close()
+    # close() terminates the process (and PDEATHSIG covers owner crash).
+    assert w._proc.poll() is not None
+    with pytest.raises(Exception):
+        os.kill(pid, 0)

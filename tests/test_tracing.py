@@ -75,7 +75,11 @@ def test_remote_spans_inherit_trace(traced_cluster):
                             timeout=10)
         found = [e for e in events if e.get("kind") == "span"
                  and e.get("trace_id") == trace_id]
-        if len(found) >= 2:
+        names = {s["name"] for s in found}
+        # Wait for BOTH execution spans — breaking on a bare count let
+        # the assert run before the actor span's batch flushed.
+        if "actor:Act.m" in names and any(
+                n.startswith("task:") for n in names):
             break
         time.sleep(0.25)
     names = {s["name"] for s in found}
