@@ -1,0 +1,18 @@
+"""AIR substrate: shared configs + the actor/resource execution layer
+(ref: python/ray/air/ — config.py, execution/)."""
+from ray_tpu.air.execution import RayActorManager, TrackedActor
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+
+__all__ = [
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "RayActorManager",
+    "TrackedActor",
+]

@@ -484,7 +484,10 @@ class EventLoopThread:
             # tasks (e.g. a failure handler resubmitting work), and a
             # single sweep would leave those to die as destroyed-pending
             # tasks at interpreter exit.
-            deadline = self.loop.time() + 2.0
+            # Generous deadline: on a loaded single-CPU host a 2s sweep
+            # budget expired mid-drain, leaving cancelled-but-unawaited
+            # tasks to die as destroy-pending noise at interpreter exit.
+            deadline = self.loop.time() + 6.0
             try:
                 while True:
                     tasks = [t for t in asyncio.all_tasks(self.loop)
@@ -493,7 +496,7 @@ class EventLoopThread:
                         break
                     for task in tasks:
                         task.cancel()
-                    await asyncio.wait(tasks, timeout=0.3)
+                    await asyncio.wait(tasks, timeout=0.5)
             finally:
                 self.loop.stop()
 

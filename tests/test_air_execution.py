@@ -1,0 +1,102 @@
+"""AIR execution layer: event-based actor manager
+(ref: python/ray/air/execution/_internal/actor_manager.py:23 — the
+shared lifecycle/task event manager under Tune's controller)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air import RayActorManager
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_counter():
+    # Defined inside a function so cloudpickle ships it BY VALUE —
+    # workers cannot import the tests package.
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def boom(self):
+            raise ValueError("app error")
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    return Counter
+
+
+def _pump_until(mgr, cond, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        mgr.next(timeout=0.5)
+        if cond():
+            return True
+    return False
+
+
+def test_actor_lifecycle_events(cluster):
+    mgr = RayActorManager()
+    events = []
+    t = mgr.add_actor(
+        _make_counter(), kwargs={"start": 5}, resources={"CPU": 0},
+        on_start=lambda a: events.append(("start", a.actor_id)),
+        on_stop=lambda a: events.append(("stop", a.actor_id)))
+    assert t.state == "PENDING"
+    assert _pump_until(mgr, lambda: t.state == "STARTED")
+    assert events == [("start", t.actor_id)]
+
+    results = []
+    mgr.schedule_actor_task(t, "inc", (3,),
+                            on_result=lambda a, r: results.append(r))
+    mgr.schedule_actor_task(t, "inc", (2,),
+                            on_result=lambda a, r: results.append(r))
+    assert _pump_until(mgr, lambda: len(results) == 2)
+    assert results == [8, 10]  # sequential callbacks, in order
+
+    mgr.remove_actor(t)
+    assert _pump_until(mgr, lambda: ("stop", t.actor_id) in events)
+    assert t.state == "STOPPED"
+    mgr.shutdown()
+
+
+def test_task_app_error_does_not_kill_actor(cluster):
+    mgr = RayActorManager()
+    errors, results = [], []
+    t = mgr.add_actor(_make_counter(), resources={"CPU": 0})
+    assert _pump_until(mgr, lambda: t.state == "STARTED")
+    mgr.schedule_actor_task(t, "boom",
+                            on_error=lambda a, e: errors.append(e))
+    assert _pump_until(mgr, lambda: errors)
+    assert t.state == "STARTED"  # app error: actor still healthy
+    mgr.schedule_actor_task(t, "inc",
+                            on_result=lambda a, r: results.append(r))
+    assert _pump_until(mgr, lambda: results)
+    assert results == [1]
+    mgr.shutdown()
+
+
+def test_actor_death_fires_actor_on_error(cluster):
+    mgr = RayActorManager()
+    actor_errors, task_errors = [], []
+    t = mgr.add_actor(_make_counter(), resources={"CPU": 0},
+                      on_error=lambda a, e: actor_errors.append(e))
+    assert _pump_until(mgr, lambda: t.state == "STARTED")
+    mgr.schedule_actor_task(t, "die",
+                            on_error=lambda a, e: task_errors.append(e))
+    assert _pump_until(mgr, lambda: task_errors, timeout=120)
+    assert actor_errors  # the ACTOR-level callback fired too
+    assert t.state == "FAILED"
+    mgr.shutdown()
