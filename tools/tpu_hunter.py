@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Hunt TPU tunnel-up windows and record benchmark evidence.
+
+The tunnel TPU (a single v5e chip reached through axon) flaps: it can be
+down at bench time yet up for long stretches mid-session.  A one-shot
+probe at the end of a round therefore keeps missing real hardware (three
+rounds of CPU-fallback artifacts prove it).  This daemon makes catching a
+window a *standing background task*, the way the reference treats release
+benchmarking as a recorded, repeated process rather than a single run
+(ref: release/release_logs/2.9.3/ — numbers are recorded artifacts, not
+one-off stdout).
+
+Loop, forever (bounded by --max-hours):
+  1. cheap probe: ray_tpu.core.distributed.resources.run_tpu_probe
+     (time-boxed subprocess; a wedged backend cannot hang the hunter)
+  2. on success: run `python bench.py --record` (writes
+     BENCH_TPU_LAST_GOOD.json) and `python bench_serve.py --out
+     BENCH_SERVE_TPU_LAST_GOOD.json`, both time-boxed
+  3. append every result to BENCH_TPU_HISTORY.jsonl, then `git commit
+     --only` the artifact files so the evidence is durable even if the
+     session dies mid-round
+  4. while the tunnel stays up, refresh the record every --refresh-min;
+     while down, re-probe every --interval-min
+
+Run:  nohup python tools/tpu_hunter.py >/dev/null 2>&1 &
+Logs: tools/tpu_hunter.log
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_PATH = os.path.join(REPO, "tools", "tpu_hunter.log")
+HISTORY = os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl")
+ARTIFACTS = ("BENCH_TPU_LAST_GOOD.json", "BENCH_SERVE_TPU_LAST_GOOD.json",
+             "BENCH_TPU_HISTORY.jsonl")
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%H:%M:%S")
+    line = f"[{stamp}] {msg}"
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float = 60.0) -> tuple[int, str]:
+    sys.path.insert(0, REPO)
+    from ray_tpu.core.distributed.resources import run_tpu_probe
+    return run_tpu_probe(timeout_s, compute=True)
+
+
+def run_recorded(cmd: list, timeout_s: float, env_extra: dict) -> str:
+    env = dict(os.environ)
+    env.update(env_extra)
+    try:
+        out = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                             text=True, timeout=timeout_s)
+        return (out.stdout or "") + (out.stderr or "")[-2000:]
+    except subprocess.TimeoutExpired:
+        return f"TIMEOUT after {timeout_s}s"
+
+
+def append_history(kind: str, payload: str) -> None:
+    rec = {"at_utc": datetime.datetime.now(
+        datetime.timezone.utc).isoformat(), "kind": kind}
+    # keep the last JSON line of the tool output if one parses
+    for line in reversed(payload.strip().splitlines()):
+        try:
+            rec["result"] = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if "result" not in rec:
+        rec["raw_tail"] = payload[-800:]
+    with open(HISTORY, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def commit_artifacts(msg: str) -> None:
+    present = [a for a in ARTIFACTS if os.path.exists(os.path.join(REPO, a))]
+    if not present:
+        return
+    for attempt in range(5):  # ride out .git/index.lock contention
+        r = subprocess.run(
+            ["git", "commit", "--only", *present, "-m", msg],
+            cwd=REPO, capture_output=True, text=True)
+        if r.returncode == 0:
+            log(f"committed: {r.stdout.strip().splitlines()[:1]}")
+            return
+        if "nothing to commit" in (r.stdout + r.stderr):
+            log("commit: artifacts unchanged")
+            return
+        time.sleep(3 * (attempt + 1))
+    log(f"commit FAILED: {r.stderr[-300:]}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval-min", type=float, default=8.0,
+                    help="re-probe period while the tunnel is down")
+    ap.add_argument("--refresh-min", type=float, default=45.0,
+                    help="re-record period while the tunnel is up")
+    ap.add_argument("--max-hours", type=float, default=11.5)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+record attempt, then exit")
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600
+    log(f"hunter up (pid {os.getpid()}), interval {args.interval_min}m, "
+        f"refresh {args.refresh_min}m")
+    last_record = 0.0
+    while time.monotonic() < deadline:
+        n, diag = probe()
+        if n <= 0:
+            log(f"probe: down ({diag[:120]})")
+            if args.once:
+                return
+            time.sleep(args.interval_min * 60)
+            continue
+
+        log(f"probe: UP ({n} chip) — recording")
+        out = run_recorded(
+            [sys.executable, "bench.py", "--record"], 1800,
+            {"RAY_TPU_BENCH_PROBE_TIMEOUT_S": "90",
+             "RAY_TPU_BENCH_PROBE_RETRIES": "1"})
+        log(f"bench.py --record: {out.strip().splitlines()[-1][:300] if out.strip() else 'no output'}")
+        append_history("train", out)
+
+        sout = run_recorded(
+            [sys.executable, "bench_serve.py", "--out",
+             "BENCH_SERVE_TPU_LAST_GOOD.json"], 1500, {})
+        log(f"bench_serve: {'ok' if 'serve_requests_per_second' in sout else sout[-200:]}")
+        append_history("serve", sout)
+
+        commit_artifacts(
+            "Record real-TPU bench evidence (tunnel-up window)")
+        last_record = time.monotonic()
+        if args.once:
+            return
+        # tunnel is (was) up: check again sooner, but don't re-record
+        # until refresh-min elapses
+        while (time.monotonic() - last_record < args.refresh_min * 60
+               and time.monotonic() < deadline):
+            time.sleep(60)
+    log("hunter done (max-hours reached)")
+
+
+if __name__ == "__main__":
+    main()
