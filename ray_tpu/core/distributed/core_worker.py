@@ -853,8 +853,13 @@ class DistributedCoreWorker:
             # ROUND-ROBIN over the batch each sweep: a ref whose location
             # never appears must not starve the refs that are available
             # right now (this is the dataset-pipeline warming path).
+            # The window must absorb worst-case control-plane stalls on a
+            # loaded host (a 30s directory-lookup timeout per sweep is
+            # possible): 60s gave up after ~2 slow sweeps and the warm
+            # never landed, so the budget is several slow sweeps deep —
+            # this is a daemon thread, so patience costs nothing.
             remaining = [r.id() for r in refs]
-            deadline = time.monotonic() + 60.0
+            deadline = time.monotonic() + 300.0
             backoff = 0.05
             while (remaining and not self._shutdown
                    and time.monotonic() < deadline):

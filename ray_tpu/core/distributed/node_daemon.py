@@ -692,6 +692,27 @@ class NodeDaemon:
         if strategy == "node_affinity" and affinity is not None:
             if affinity != self.node_id:
                 target = self._view.nodes.get(affinity)
+                if target is None:
+                    # A node ABSENT from the view may be lag, not death:
+                    # the view refreshes at 1 Hz and a lease arriving
+                    # right after the target registered fails spuriously
+                    # (client retries are fast enough to all land inside
+                    # the lag window). Wait out up to ~2 refresh cycles.
+                    # An entry that IS present with alive=False is a
+                    # GCS-confirmed death — fail immediately, waiting
+                    # cannot help. The budget here must stay small: the
+                    # soft-affinity fall-through can still enter the
+                    # 0.6x-lease-timeout infeasible wait below, and the
+                    # combined total must end strictly before the
+                    # client's lease RPC timeout (same knob).
+                    loop = asyncio.get_running_loop()
+                    deadline = loop.time() + min(
+                        2.5, 0.2 * cfg.worker_lease_timeout_ms / 1000.0)
+                    while loop.time() < deadline:
+                        await asyncio.sleep(0.05)
+                        target = self._view.nodes.get(affinity)
+                        if target is not None:
+                            break
                 if target is not None and target.alive:
                     return {"spill_to": target.address}
                 if not soft:

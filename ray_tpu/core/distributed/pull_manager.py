@@ -16,7 +16,8 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Awaitable, Callable, Deque, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -29,16 +30,64 @@ PullResult = Tuple[Optional[bytes], List[str]]
 FetchFn = Callable[[str, bytes], Awaitable[Optional[bytes]]]
 
 
+class _ClassQueue:
+    """Priority-class queue with a reserved minimum-service share.
+
+    A plain priority queue starves the lowest class under sustained
+    higher-priority load — observed as dataset-prefetch pulls deferred
+    past their deadline while get/task-arg traffic flows.  Here a pop
+    normally serves the best (lowest-numbered) non-empty class in FIFO
+    order, but every `fifo_every`-th pop serves the GLOBALLY oldest
+    queued request regardless of class.  A request at global-FIFO depth
+    d is therefore served after at most ``fifo_every * d`` pops — a
+    deterministic bound that needs no clocks or aging timers (ref:
+    src/ray/object_manager/pull_manager.h:52 — the reference likewise
+    keeps lower-priority bundles activatable under quota rather than
+    strictly dominated).
+    """
+
+    def __init__(self, fifo_every: int = 4):
+        self._fifo_every = max(2, fifo_every)
+        self._classes: Dict[int, Deque] = {}
+        self._pops = 0
+        self._event = asyncio.Event()
+
+    def put(self, priority: int, seq: int, item) -> None:
+        self._classes.setdefault(priority, deque()).append((seq, item))
+        self._event.set()
+
+    async def get(self):
+        # Multi-consumer wakeup: re-check emptiness after clear() so a
+        # put() racing between the check and the clear is never lost.
+        while True:
+            live = [(p, d) for p, d in self._classes.items() if d]
+            if live:
+                break
+            self._event.clear()
+            if any(self._classes.values()):
+                continue
+            await self._event.wait()
+        self._pops += 1
+        if self._pops % self._fifo_every == 0:
+            _, d = min(live, key=lambda pd: pd[1][0][0])  # oldest head seq
+        else:
+            _, d = min(live, key=lambda pd: pd[0])        # best class
+        seq, item = d.popleft()
+        return seq, item
+
+
 class PullManager:
     def __init__(self, loop: asyncio.AbstractEventLoop, fetch: FetchFn,
                  max_concurrent: int = 4,
-                 max_inflight_bytes: int = 256 << 20):
+                 max_inflight_bytes: int = 256 << 20,
+                 min_service_every: int = 4):
         self._loop = loop
         self._fetch = fetch
         self._max_concurrent = max_concurrent
         self._max_inflight_bytes = max_inflight_bytes
+        self._min_service_every = min_service_every
         self._inflight_bytes = 0
-        self._queue: Optional[asyncio.PriorityQueue] = None
+        self._queue: Optional[_ClassQueue] = None
         self._inflight: Dict[bytes, asyncio.Future] = {}
         self._seq = itertools.count()      # FIFO within a priority class
         self._started = False
@@ -71,9 +120,9 @@ class PullManager:
         fut: asyncio.Future = self._loop.create_future()
         self._inflight[oid_b] = fut
         done: asyncio.Future = self._loop.create_future()
-        await self._queue.put(
-            (priority, next(self._seq),
-             (oid_b, list(nodes), max(size_hint, 1), fut, done)))
+        self._queue.put(
+            priority, next(self._seq),
+            (oid_b, list(nodes), max(size_hint, 1), fut, done))
         try:
             return await done
         finally:
@@ -83,14 +132,14 @@ class PullManager:
         if self._started:
             return
         self._started = True
-        self._queue = asyncio.PriorityQueue()
+        self._queue = _ClassQueue(self._min_service_every)
         self._bytes_freed = asyncio.Event()
         for _ in range(self._max_concurrent):
             self._pullers.append(asyncio.ensure_future(self._puller()))
 
     async def _puller(self) -> None:
         while True:
-            _, _, (oid_b, nodes, size, fut, done) = await self._queue.get()
+            _, (oid_b, nodes, size, fut, done) = await self._queue.get()
             # Bandwidth budget: block this puller until the estimated
             # bytes fit (one oversized object is always admitted alone).
             while (self._inflight_bytes > 0
