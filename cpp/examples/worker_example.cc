@@ -45,4 +45,34 @@ static Value Boom(const std::vector<Value>&) {
 }
 RAY_TPU_REMOTE(Boom);
 
+// A stateful actor: created/called/killed from Python through
+// CppWorker.create_actor (the ActorHandle<T>.Task analogue, ref:
+// cpp/include/ray/api/actor_handle.h).
+class Counter {
+ public:
+  explicit Counter(const std::vector<Value>& args)
+      : value_(args.empty() ? 0 : ray_tpu::AsInt(args[0])) {
+    if (!args.empty() && ray_tpu::AsInt(args[0]) < 0) {
+      throw ray_tpu::RpcError("Counter start must be >= 0");
+    }
+  }
+  Value Inc(const std::vector<Value>& a) {
+    value_ += a.empty() ? 1 : ray_tpu::AsInt(a[0]);
+    return Value::Int(value_);
+  }
+  Value Get(const std::vector<Value>&) { return Value::Int(value_); }
+  Value Fail(const std::vector<Value>&) {
+    throw ray_tpu::RpcError("counter failure requested");
+  }
+
+ private:
+  int64_t value_;
+};
+static const bool _reg_counter =
+    ray_tpu::RegisterActor<Counter>("Counter")
+        .Method("Inc", &Counter::Inc)
+        .Method("Get", &Counter::Get)
+        .Method("Fail", &Counter::Fail)
+        .Done();
+
 int main() { return ray_tpu::WorkerMain(); }

@@ -27,6 +27,8 @@
 #include <cstdio>
 #include <functional>
 #include <map>
+#include <memory>
+#include <mutex>
 #include <thread>
 
 #include "../ray_tpu_client/ray_tpu_client.hpp"
@@ -68,6 +70,92 @@ inline bool RegisterFunction(const std::string& name, RemoteFn fn) {
 // Static-init registration, the RAY_REMOTE analogue.
 #define RAY_TPU_REMOTE(fn) \
   static const bool _ray_tpu_reg_##fn = ::ray_tpu::RegisterFunction(#fn, fn)
+
+// ---------------------------------------------------------------------------
+// actors: stateful C++ objects with remote method dispatch
+// ---------------------------------------------------------------------------
+//
+// Parity with the reference C++ actor API (ref:
+// cpp/include/ray/api/actor_handle.h — ActorHandle<T>.Task(&T::Method);
+// cpp/src/ray/runtime/task/task_executor.cc executes both task kinds).
+// An actor class takes its constructor args as a Value vector and
+// exposes methods of signature `Value (T::*)(const std::vector<Value>&)`:
+//
+//   class Counter {
+//    public:
+//     explicit Counter(const std::vector<Value>& args)
+//         : value_(args.empty() ? 0 : AsInt(args[0])) {}
+//     Value Inc(const std::vector<Value>& a) {
+//       value_ += AsInt(a[0]); return Value::Int(value_);
+//     }
+//    private:
+//     int64_t value_;
+//   };
+//   static const bool _reg = ray_tpu::RegisterActor<Counter>("Counter")
+//       .Method("Inc", &Counter::Inc).Done();
+//
+// Execution is SERIAL per actor instance (a per-instance mutex), the
+// same single-threaded-per-actor ordering contract Python actors have;
+// distinct instances run concurrently.
+
+struct ActorType {
+  std::function<std::shared_ptr<void>(const std::vector<Value>&)> ctor;
+  std::map<std::string,
+           std::function<Value(void*, const std::vector<Value>&)>> methods;
+};
+
+inline std::map<std::string, ActorType>& ActorTypeRegistry() {
+  static std::map<std::string, ActorType> registry;
+  return registry;
+}
+
+struct ActorInstance {
+  std::shared_ptr<void> self;
+  const ActorType* type = nullptr;
+  std::mutex mu;  // serial method execution per instance
+};
+
+inline std::mutex& ActorTableMu() {
+  static std::mutex mu;
+  return mu;
+}
+
+inline std::map<int64_t, std::shared_ptr<ActorInstance>>& ActorTable() {
+  static std::map<int64_t, std::shared_ptr<ActorInstance>> table;
+  return table;
+}
+
+template <typename T>
+class ActorRegistrar {
+ public:
+  explicit ActorRegistrar(std::string name) : name_(std::move(name)) {
+    type_.ctor =
+        [](const std::vector<Value>& args) -> std::shared_ptr<void> {
+      return std::static_pointer_cast<void>(std::make_shared<T>(args));
+    };
+  }
+  ActorRegistrar& Method(const std::string& mname,
+                         Value (T::*fn)(const std::vector<Value>&)) {
+    type_.methods[mname] = [fn](void* self,
+                                const std::vector<Value>& args) {
+      return (static_cast<T*>(self)->*fn)(args);
+    };
+    return *this;
+  }
+  bool Done() {
+    ActorTypeRegistry()[name_] = std::move(type_);
+    return true;
+  }
+
+ private:
+  std::string name_;
+  ActorType type_;
+};
+
+template <typename T>
+ActorRegistrar<T> RegisterActor(const std::string& name) {
+  return ActorRegistrar<T>(name);
+}
 
 // ---------------------------------------------------------------------------
 // server
@@ -120,6 +208,86 @@ inline Value AppError(const std::string& msg) {
   return inner;
 }
 
+inline Value HandleCreateActor(const Value& kwargs) {
+  static std::atomic<int64_t> next_actor_id{1};
+  const Value* tname = kwargs.Get("type");
+  if (tname == nullptr || tname->kind != Value::Kind::Str) {
+    return AppError("create_actor needs a string 'type'");
+  }
+  auto it = ActorTypeRegistry().find(tname->s);
+  if (it == ActorTypeRegistry().end()) {
+    return AppError("no registered C++ actor type " + tname->s);
+  }
+  const Value* args = kwargs.Get("args");
+  auto inst = std::make_shared<ActorInstance>();
+  inst->type = &it->second;
+  try {
+    inst->self = it->second.ctor(
+        args != nullptr ? args->items : std::vector<Value>{});
+  } catch (const std::exception& e) {
+    return AppError(std::string("C++ actor ") + tname->s +
+                    " constructor raised: " + e.what());
+  }
+  int64_t id = next_actor_id.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(ActorTableMu());
+    ActorTable()[id] = std::move(inst);
+  }
+  return AppResult(Value::Int(id));
+}
+
+inline Value HandleCallActor(const Value& kwargs) {
+  const Value* aid = kwargs.Get("actor_id");
+  const Value* mname = kwargs.Get("name");
+  if (aid == nullptr || aid->kind != Value::Kind::Int ||
+      mname == nullptr || mname->kind != Value::Kind::Str) {
+    return AppError("call_actor needs int 'actor_id' + string 'name'");
+  }
+  std::shared_ptr<ActorInstance> inst;
+  {
+    std::lock_guard<std::mutex> lk(ActorTableMu());
+    auto it = ActorTable().find(aid->i);
+    if (it != ActorTable().end()) inst = it->second;
+  }
+  if (!inst) {
+    return AppError("no such C++ actor " + std::to_string(aid->i) +
+                    " (dead or never created)");
+  }
+  auto mit = inst->type->methods.find(mname->s);
+  if (mit == inst->type->methods.end()) {
+    return AppError("C++ actor has no method " + mname->s);
+  }
+  const Value* args = kwargs.Get("args");
+  std::vector<Value> argv;
+  if (args != nullptr) argv = args->items;
+  // Serial per-instance execution: the Python-actor ordering contract.
+  std::lock_guard<std::mutex> lk(inst->mu);
+  try {
+    return AppResult(mit->second(inst->self.get(), argv));
+  } catch (const std::exception& e) {
+    return AppError(std::string("C++ actor method ") + mname->s +
+                    " raised: " + e.what());
+  }
+}
+
+inline Value HandleKillActor(const Value& kwargs) {
+  const Value* aid = kwargs.Get("actor_id");
+  if (aid == nullptr || aid->kind != Value::Kind::Int) {
+    return AppError("kill_actor needs int 'actor_id'");
+  }
+  std::shared_ptr<ActorInstance> inst;  // destroyed outside the lock —
+  {                                     // an in-flight call may hold it
+    std::lock_guard<std::mutex> lk(ActorTableMu());
+    auto it = ActorTable().find(aid->i);
+    if (it == ActorTable().end()) {
+      return AppError("no such C++ actor " + std::to_string(aid->i));
+    }
+    inst = std::move(it->second);
+    ActorTable().erase(it);
+  }
+  return AppResult(Value::Bool(true));
+}
+
 inline Value HandleRequest(const Value& req) {
   // req = (service, method, kwargs)
   if (req.items.size() != 3) return AppError("malformed request tuple");
@@ -133,6 +301,16 @@ inline Value HandleRequest(const Value& req) {
     }
     return AppResult(Value::List(std::move(names)));
   }
+  if (method == "list_actor_types") {
+    std::vector<Value> names;
+    for (const auto& kv : ActorTypeRegistry()) {
+      names.push_back(Value::Str(kv.first));
+    }
+    return AppResult(Value::List(std::move(names)));
+  }
+  if (method == "create_actor") return HandleCreateActor(kwargs);
+  if (method == "call_actor") return HandleCallActor(kwargs);
+  if (method == "kill_actor") return HandleKillActor(kwargs);
   if (method != "invoke") return AppError("no such method " + method);
   const Value* fn_name = kwargs.Get("fn");
   const Value* args = kwargs.Get("args");

@@ -13,6 +13,14 @@ data model (None/bool/int/float/bytes/str/list/dict).
     worker.invoke("Add", 2.0, 3.0)          # -> 5.0, blocking
     fut = worker.submit("Add", 1, 2)        # concurrent.futures.Future
     worker.functions()                      # registered names
+
+C++ ACTORS (stateful; ref: cpp/include/ray/api/actor_handle.h —
+ActorHandle<T>.Task(&T::Method) with serial per-actor execution):
+
+    h = worker.create_actor("Counter", 10)
+    h.call("Inc", 5)                        # -> 15, blocking
+    fut = h.submit("Inc", 1)                # ordered: per-handle FIFO
+    h.kill()                                # state destroyed
     worker.close()
 """
 from __future__ import annotations
@@ -20,6 +28,7 @@ from __future__ import annotations
 import os
 import subprocess
 import threading
+import weakref
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional
 
@@ -28,6 +37,89 @@ from ray_tpu.core.distributed.rpc import EventLoopThread, SyncRpcClient
 
 class CppFunctionError(Exception):
     """A C++ remote function raised / was not found."""
+
+
+def _unwrap(reply: dict) -> Any:
+    """Unpack the app-level {'ok', 'value'|'error'} envelope."""
+    if not reply.get("ok"):
+        raise CppFunctionError(reply.get("error", "unknown error"))
+    return reply.get("value")
+
+
+def _reap_actor(worker_ref, actor_id: int, serial) -> None:
+    """GC finalizer for a dropped handle: C++ actors die with their
+    last handle, like Python actors (must not reference the handle)."""
+    serial.shutdown(wait=False)
+    w = worker_ref()
+    if w is None or w._closed:
+        return
+    try:
+        w._client.call("CppWorker", "kill_actor", timeout=5,
+                       actor_id=actor_id)
+    except Exception:  # noqa: BLE001 worker already gone
+        pass
+
+
+class CppActorHandle:
+    """Handle to a stateful actor living in the C++ worker process.
+
+    Method calls execute SERIALLY on the instance (C++ side holds a
+    per-instance mutex) and `submit()` preserves per-handle submission
+    order with a single dispatch thread — the same ordering contract
+    Python actor handles give their callers. A method that raises keeps
+    the actor alive (matching Python actors: task errors are not actor
+    deaths); `kill()` destroys the instance, after which every call
+    fails with a clear "no such C++ actor" error.
+    """
+
+    def __init__(self, worker: "CppWorker", actor_id: int,
+                 type_name: str):
+        self._worker = worker
+        self._id = actor_id
+        self._type = type_name
+        self._serial = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"cpp-actor-{actor_id}")
+        self._finalizer = weakref.finalize(
+            self, _reap_actor, weakref.ref(worker), actor_id,
+            self._serial)
+
+    @property
+    def actor_id(self) -> int:
+        return self._id
+
+    def _call_rpc(self, method: str, args: tuple,
+                  timeout: float) -> Any:
+        return _unwrap(self._worker._client.call(
+            "CppWorker", "call_actor", timeout=timeout,
+            actor_id=self._id, name=method, args=list(args)))
+
+    def call(self, method: str, *args: Any,
+             timeout: float = 60.0) -> Any:
+        """Invoke an actor method; blocks for the result. Rides the
+        same serial dispatch thread as submit(), so a blocking call
+        always observes every earlier submission from this handle."""
+        return self.submit(method, *args, timeout=timeout).result()
+
+    def submit(self, method: str, *args: Any,
+               timeout: float = 60.0) -> "Future":
+        """Async call; per-handle FIFO ordering is guaranteed."""
+        return self._serial.submit(self._call_rpc, method, args,
+                                   timeout)
+
+    def kill(self, timeout: float = 30.0) -> None:
+        """Destroy the actor instance (idempotence is an error: a
+        second kill raises, mirroring ray.kill on a dead actor)."""
+        self._serial.shutdown(wait=True)
+        self._serial = ThreadPoolExecutor(   # handle stays usable for
+            max_workers=1,                   # error-path calls
+            thread_name_prefix=f"cpp-actor-{self._id}")
+        self._finalizer.detach()             # kill is explicit now
+        _unwrap(self._worker._client.call(
+            "CppWorker", "kill_actor", timeout=timeout,
+            actor_id=self._id))
+
+    def __repr__(self) -> str:
+        return f"CppActorHandle({self._type}#{self._id})"
 
 
 class CppWorker:
@@ -59,11 +151,9 @@ class CppWorker:
     # -- calls ----------------------------------------------------------
     def invoke(self, fn: str, *args: Any, timeout: float = 60.0) -> Any:
         """Call a registered C++ function; blocks for the result."""
-        reply = self._client.call("CppWorker", "invoke", timeout=timeout,
-                                  fn=fn, args=list(args))
-        if not reply.get("ok"):
-            raise CppFunctionError(reply.get("error", "unknown error"))
-        return reply.get("value")
+        return _unwrap(self._client.call("CppWorker", "invoke",
+                                         timeout=timeout, fn=fn,
+                                         args=list(args)))
 
     def submit(self, fn: str, *args: Any,
                timeout: float = 60.0) -> "Future":
@@ -71,15 +161,25 @@ class CppWorker:
         return self._pool.submit(self.invoke, fn, *args, timeout=timeout)
 
     def functions(self, timeout: float = 10.0) -> List[str]:
-        reply = self._client.call("CppWorker", "list_functions",
-                                  timeout=timeout)
-        if not reply.get("ok"):
-            raise CppFunctionError(reply.get("error", ""))
-        return sorted(reply.get("value") or [])
+        return sorted(_unwrap(self._client.call(
+            "CppWorker", "list_functions", timeout=timeout)) or [])
 
     def ping(self, timeout: float = 10.0) -> bool:
         reply = self._client.call("CppWorker", "ping", timeout=timeout)
         return reply.get("value") == "pong"
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(self, type_name: str, *args: Any,
+                     timeout: float = 60.0) -> CppActorHandle:
+        """Construct a registered C++ actor; returns its handle."""
+        actor_id = _unwrap(self._client.call(
+            "CppWorker", "create_actor", timeout=timeout,
+            type=type_name, args=list(args)))
+        return CppActorHandle(self, int(actor_id), type_name)
+
+    def actor_types(self, timeout: float = 10.0) -> List[str]:
+        return sorted(_unwrap(self._client.call(
+            "CppWorker", "list_actor_types", timeout=timeout)) or [])
 
     # -- lifecycle ------------------------------------------------------
     def close(self) -> None:

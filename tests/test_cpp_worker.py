@@ -5,6 +5,7 @@ Compiles the example worker with g++ at test time, spawns it, and
 drives it through ray_tpu.util.cross_lang.CppWorker."""
 import os
 import subprocess
+import time
 
 import pytest
 
@@ -67,6 +68,92 @@ def test_concurrent_submissions(cpp_worker):
     futs = [cpp_worker.submit("Add", i, i) for i in range(32)]
     assert [f.result(timeout=60) for f in futs] == [2.0 * i
                                                    for i in range(32)]
+
+
+def test_actor_create_call_state_kill(cpp_worker):
+    """Stateful C++ actor: ordered mutation, state observation, kill
+    (ref: cpp/include/ray/api/actor_handle.h — ActorHandle<T>.Task)."""
+    assert "Counter" in cpp_worker.actor_types()
+    h = cpp_worker.create_actor("Counter", 10)
+    assert h.call("Inc", 5) == 15
+    assert h.call("Inc") == 16          # default increment
+    assert h.call("Get") == 16          # state persisted across calls
+    h.kill()
+    with pytest.raises(CppFunctionError, match="no such C\\+\\+ actor"):
+        h.call("Get")
+    with pytest.raises(CppFunctionError, match="no such C\\+\\+ actor"):
+        h.kill()                        # double-kill is an error
+
+
+def test_actor_ordered_async_dispatch(cpp_worker):
+    """submit() preserves per-handle FIFO: increments observe strictly
+    increasing values, and the final state is their sum."""
+    h = cpp_worker.create_actor("Counter")
+    futs = [h.submit("Inc", 1) for _ in range(64)]
+    seen = [f.result(timeout=60) for f in futs]
+    assert seen == list(range(1, 65))
+    assert h.call("Get") == 64
+    h.kill()
+
+
+def test_actor_blocking_call_observes_prior_submissions(cpp_worker):
+    """call() rides the same serial dispatch thread as submit(): a
+    blocking call issued right after async submissions must see all of
+    them applied (the Python-actor ordering contract)."""
+    h = cpp_worker.create_actor("Counter")
+    for _ in range(16):
+        h.submit("Inc", 1)              # fire-and-forget
+    assert h.call("Get") == 16          # call ordered after them
+    h.kill()
+
+
+def test_actor_dies_when_handle_dropped(cpp_worker):
+    """Dropping the last handle reaps the C++ instance, like Python
+    actors — a long-lived worker must not leak actor state."""
+    import gc
+
+    h = cpp_worker.create_actor("Counter", 5)
+    actor_id = h.actor_id
+    assert h.call("Get") == 5
+    del h
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        reply = cpp_worker._client.call(
+            "CppWorker", "call_actor", timeout=10,
+            actor_id=actor_id, name="Get", args=[])
+        if not reply.get("ok"):
+            break
+        time.sleep(0.1)
+    assert "no such C++ actor" in reply.get("error", "")
+
+
+def test_actor_instances_are_independent(cpp_worker):
+    a = cpp_worker.create_actor("Counter", 100)
+    b = cpp_worker.create_actor("Counter", 200)
+    assert a.actor_id != b.actor_id
+    a.call("Inc", 1)
+    assert a.call("Get") == 101
+    assert b.call("Get") == 200         # untouched by a's mutation
+    a.kill()
+    assert b.call("Get") == 200         # killing a leaves b alive
+    b.kill()
+
+
+def test_actor_errors_propagate_and_do_not_kill(cpp_worker):
+    h = cpp_worker.create_actor("Counter", 7)
+    with pytest.raises(CppFunctionError, match="counter failure"):
+        h.call("Fail")
+    assert h.call("Get") == 7           # still alive, state intact
+    with pytest.raises(CppFunctionError, match="no method"):
+        h.call("NoSuchMethod")
+    h.kill()
+    # Constructor errors and unknown types surface at creation.
+    with pytest.raises(CppFunctionError, match="constructor raised"):
+        cpp_worker.create_actor("Counter", -5)
+    with pytest.raises(CppFunctionError, match="no registered C\\+\\+ "
+                                               "actor type"):
+        cpp_worker.create_actor("NoSuchType")
 
 
 def test_worker_dies_with_owner(worker_binary):
