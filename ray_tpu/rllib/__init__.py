@@ -15,6 +15,14 @@ evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
   analogue of LearnerGroup weight sync (core/learner/learner_group.py:60).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core import (
+    DiscreteQModule,
+    Learner,
+    LearnerGroup,
+    MLPPolicyModule,
+    MultiRLModule,
+    RLModule,
+)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.env import register_env
@@ -36,6 +44,12 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DiscreteQModule",
+    "Learner",
+    "LearnerGroup",
+    "MLPPolicyModule",
+    "MultiRLModule",
+    "RLModule",
     "PPO",
     "PPOConfig",
     "DQN",

@@ -27,6 +27,8 @@ class AlgorithmConfig:
         self.seed = 0
         self.model_hidden: Tuple[int, ...] = (64, 64)
         self.learner_mesh = None  # jax Mesh with a "dp" axis, or None
+        self.num_learners = 0     # 0 = single inline learner
+        self.remote_learners = False
         self.evaluation_interval = 0          # iterations; 0 = disabled
         self.evaluation_num_env_runners = 0   # 0 = evaluate locally
         self.evaluation_duration = 5          # episodes per evaluation
@@ -65,6 +67,19 @@ class AlgorithmConfig:
                   ) -> "AlgorithmConfig":
         if learner_mesh is not None:
             self.learner_mesh = learner_mesh
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 remote_learners: Optional[bool] = None
+                 ) -> "AlgorithmConfig":
+        """Data-parallel learner group (ref: AlgorithmConfig.learners /
+        core/learner/learner_group.py:60). num_learners>0 builds a
+        LearnerGroup: by default N devices of a dp mesh running the one
+        fused program; remote_learners=True uses N learner actors."""
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if remote_learners is not None:
+            self.remote_learners = remote_learners
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -140,12 +155,44 @@ class Algorithm:
         self._eval_workers: List[Any] = []
 
         obs_dim, num_actions = self._spaces
+        self._made_learner_group = False
         self.learner = self._setup_learner(obs_dim, num_actions)
+        if (getattr(config, "num_learners", 0) > 0
+                and not self._made_learner_group):
+            raise ValueError(
+                f"{type(self).__name__} has not been ported to the "
+                f"Learner/LearnerGroup stack; num_learners>0 would be "
+                f"silently ignored (supported: PPO, SAC)")
         self._broadcast_weights()
 
     # -- subclass hooks -----------------------------------------------------
     def _setup_learner(self, obs_dim: int, num_actions: int):
         raise NotImplementedError
+
+    def _build_learner(self, factory):
+        """Wrap a `factory(mesh) -> Learner` into the configured learner
+        topology: a LearnerGroup when num_learners>0, else one inline
+        learner on config.learner_mesh. Conflicting or no-op configs
+        are errors, not silent reinterpretations."""
+        cfg = self.config
+        if getattr(cfg, "num_learners", 0) > 0:
+            if cfg.learner_mesh is not None:
+                raise ValueError(
+                    "learner_mesh and num_learners are mutually "
+                    "exclusive: num_learners builds its own dp mesh. "
+                    "Pass the mesh via resources(learner_mesh=...) "
+                    "alone, or let learners(num_learners=N) claim N "
+                    "devices")
+            from ray_tpu.rllib.core.learner_group import LearnerGroup
+
+            self._made_learner_group = True
+            return LearnerGroup(factory,
+                                num_learners=cfg.num_learners,
+                                remote=cfg.remote_learners)
+        if getattr(cfg, "remote_learners", False):
+            raise ValueError(
+                "remote_learners=True needs num_learners > 0")
+        return factory(cfg.learner_mesh)
 
     def training_step(self) -> Dict[str, float]:
         raise NotImplementedError

@@ -1,0 +1,88 @@
+"""Learner: owns params + optimizer state + ONE jitted fused update.
+
+ref: rllib/core/learner/learner.py:107 — the reference Learner holds an
+RLModule and optimizers and runs `update_from_batch`; gradient transport
+between learners is torch-DDP.
+
+TPU-first divergence: a Learner subclass compiles its ENTIRE training
+iteration (loss, every SGD epoch/minibatch, optimizer moves, target
+nets) into one jitted SPMD program. Data parallelism is then a mesh
+sharding annotation on the batch arguments — XLA inserts the gradient
+psums inside the program — rather than a gradient-hook wrapper class
+(see LearnerGroup). Multi-host scale runs the SAME program under
+`jax.distributed` instead of wiring NCCL process groups.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Learner:
+    """Base: shared state plumbing; subclasses build the fused update.
+
+    Contract: set `_state_attrs` to the attribute names making up the
+    full training state (leading underscores are stripped in the
+    serialized keys), keep the mesh (or None) in `self.mesh`, implement
+    `update(batch) -> metrics` calling the jitted program.
+    """
+
+    _state_attrs: Tuple[str, ...] = ()
+    mesh: Optional[Mesh] = None
+
+    # -- update ---------------------------------------------------------
+    def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        raise NotImplementedError
+
+    # -- jit wiring -----------------------------------------------------
+    def _jit_update(self, update_fn, num_state_args: int,
+                    batch_keys: Tuple[str, ...]):
+        """Compile the fused update with donated state and, under a
+        mesh, replicated-state / dp-sharded-batch shardings. Argument
+        convention: `num_state_args` state pytrees, then the batch
+        dict, then an rng key; outputs are the new state pytrees plus
+        a metrics dict."""
+        donate = tuple(range(num_state_args))
+        if self.mesh is None:
+            return jax.jit(update_fn, donate_argnums=donate)
+        rep = NamedSharding(self.mesh, P())
+        dp = NamedSharding(self.mesh, P("dp"))
+        batch_sh = {k: dp for k in batch_keys}
+        return jax.jit(
+            update_fn, donate_argnums=donate,
+            in_shardings=(rep,) * num_state_args + (batch_sh, rep),
+            out_shardings=(rep,) * (num_state_args + 1))
+
+    # -- device placement ----------------------------------------------
+    def _replicate(self, tree: Any) -> Any:
+        """Put a pytree on-device, replicated over the mesh if any."""
+        if self.mesh is not None:
+            return jax.device_put(tree, NamedSharding(self.mesh, P()))
+        return jax.device_put(tree)
+
+    def _shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Shard batch leaves along axis 0 over the mesh `dp` axis."""
+        if self.mesh is None:
+            return batch
+        dp = NamedSharding(self.mesh, P("dp"))
+        return {k: jax.device_put(v, dp) for k, v in batch.items()}
+
+    # -- weights (what rollout/eval workers need) -----------------------
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, params: Any) -> None:
+        self.params = self._replicate(params)
+
+    # -- full training state (exact resume; ref: Learner.get_state) -----
+    def get_state(self) -> Dict[str, Any]:
+        return {attr.lstrip("_"): jax.device_get(getattr(self, attr))
+                for attr in self._state_attrs}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for attr in self._state_attrs:
+            key = attr.lstrip("_")
+            if key in state:
+                setattr(self, attr, self._replicate(state[key]))

@@ -1,0 +1,219 @@
+"""LearnerGroup: data-parallel training across N learners.
+
+ref: rllib/core/learner/learner_group.py:60 — the reference manages N
+learner *actors*, shards each batch across them, and relies on torch
+DDP for gradient sync.
+
+TPU-first design — two modes:
+
+**In-process SPMD (default).** `num_learners=N` claims N local devices
+as a `dp` mesh axis and runs the learner's ONE fused pjit program over
+it. The batch is sharded on axis 0, params are replicated, and XLA
+inserts the gradient psums *inside* the program — per minibatch, per
+epoch, wherever the math needs them. This is bit-identical to a single
+learner on the concatenated batch (the psum of shard-means IS the
+global mean), with zero host round-trips per sync. "DDP" is a sharding
+annotation here, not a wrapper class; multi-host scale runs the same
+program under `jax.distributed` over a host-spanning mesh.
+
+**Remote actors (`remote=True`).** N `ray_tpu` actors each own a full
+learner; per update the batch splits on axis 0, every actor runs the
+fused update on its shard, then float state (params + optimizer
+moments) tree-averages across actors — weighted by shard rows, so the
+weighted mean of per-shard means IS the global mean — and is pushed
+back: local-update parameter synchronization in two host RPC rounds
+per update (update+collect, then broadcast) rather than one per
+gradient. The weighted average of per-shard Adam updates is not
+bitwise the global-batch update (same class of approximation as the
+reference's per-minibatch advantage normalization), but actors stay
+exactly synchronized after every update. Use this mode when learners
+must live on different hosts without a shared jax runtime.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _tree_avg(trees: List[Any], weights: List[float]) -> Any:
+    """Row-weighted elementwise mean over float leaves; first tree wins
+    elsewhere (optimizer step counters must stay integral)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = float(sum(weights))
+    frac = [w / total for w in weights]
+
+    def avg(*leaves):
+        if jnp.issubdtype(np.asarray(leaves[0]).dtype, jnp.floating):
+            return sum(f * np.asarray(x, dtype=np.float64)
+                       for f, x in zip(frac, leaves))
+        return leaves[0]
+
+    return jax.tree_util.tree_map(avg, *trees)
+
+
+class _LearnerActor:
+    """Runs one learner in a worker process (wrapped by ray_tpu.remote)."""
+
+    def __init__(self, factory: Callable, index: int):
+        self.index = index
+        self.learner = factory(None)
+        self._decorrelate_rng()
+
+    def _decorrelate_rng(self) -> None:
+        """Fork per-actor stochasticity (e.g. SAC action noise) while
+        param init stays identical (the factory seed fixes init; only
+        the running rng forks). Actor 0 keeps the canonical stream."""
+        import jax
+
+        if self.index and hasattr(self.learner, "_rng"):
+            self.learner._rng = jax.random.fold_in(
+                self.learner._rng, self.index)
+
+    def update_and_collect(self, shard: Dict[str, np.ndarray]):
+        """One fused update + the post-update sync state (folds the
+        collect RPC into the update round)."""
+        metrics = self.learner.update(shard)
+        state = self.learner.get_state()
+        state.pop("rng", None)  # each actor keeps its own stream
+        return metrics, state
+
+    def set_sync_state(self, state: Dict[str, Any]) -> None:
+        self.learner.set_state(state)
+
+    def get_weights(self) -> Any:
+        return self.learner.get_weights()
+
+    def set_weights(self, w: Any) -> None:
+        self.learner.set_weights(w)
+
+    def get_state(self) -> Dict[str, Any]:
+        return self.learner.get_state()
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner.set_state(state)
+        # A broadcast restore ships ONE rng to every actor; re-fork so
+        # actors don't degenerate into N identically-noised copies.
+        self._decorrelate_rng()
+
+
+class LearnerGroup:
+    """Drop-in for a single learner: update/get/set weights+state."""
+
+    def __init__(self, factory: Callable, num_learners: int = 1,
+                 remote: bool = False,
+                 resources_per_learner: Optional[dict] = None):
+        self._remote = remote and num_learners > 0
+        self.num_learners = max(1, num_learners)
+        if not self._remote:
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            if len(devs) < self.num_learners:
+                raise ValueError(
+                    f"num_learners={self.num_learners} > "
+                    f"{len(devs)} visible devices; use remote=True for "
+                    f"learners beyond one host's devices")
+            mesh = Mesh(np.array(devs[:self.num_learners]), ("dp",))
+            self._learner = factory(mesh)
+            if self._learner.mesh is not mesh:
+                raise ValueError(
+                    "learner factory ignored the group mesh; pass "
+                    "mesh through to the Learner so the fused program "
+                    "shards over dp")
+        else:
+            import ray_tpu
+
+            opts = dict(resources_per_learner or {"num_cpus": 1})
+            cls = ray_tpu.remote(**opts)(_LearnerActor)
+            self._actors = [cls.remote(factory, i)
+                            for i in range(self.num_learners)]
+            # Surface constructor failures now, not at first update.
+            ray_tpu.get([a.get_weights.remote() for a in self._actors],
+                        timeout=300)
+
+    # -- update ---------------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if not self._remote:
+            return self._learner.update(batch)
+        import ray_tpu
+
+        shards = self._split(batch)
+        rows = [len(next(iter(s.values()))) for s in shards]
+        # Round 1: update + collect state; round 2: broadcast average.
+        outs = ray_tpu.get(
+            [a.update_and_collect.remote(s)
+             for a, s in zip(self._actors, shards)], timeout=600)
+        metrics = [m for m, _ in outs]
+        avg = _tree_avg([s for _, s in outs], rows)
+        ref = ray_tpu.put(avg)
+        ray_tpu.get([a.set_sync_state.remote(ref) for a in self._actors],
+                    timeout=600)
+        total = float(sum(rows))
+        return {k: float(sum(r * m[k] for r, m in zip(rows, metrics))
+                         / total)
+                for k in metrics[0]}
+
+    def _split(self, batch: Dict[str, np.ndarray]) -> List[Dict]:
+        n = self.num_learners
+        shards: List[Dict] = [{} for _ in range(n)]
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.ndim == 0 or len(v) < n:
+                raise ValueError(
+                    f"batch[{k!r}] has leading dim {v.shape} — cannot "
+                    f"shard across {n} learners")
+            for i, piece in enumerate(np.array_split(v, n)):
+                shards[i][k] = piece
+        return shards
+
+    # -- weights / state ------------------------------------------------
+    def get_weights(self) -> Any:
+        if not self._remote:
+            return self._learner.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_weights.remote(),
+                           timeout=300)
+
+    def set_weights(self, w: Any) -> None:
+        if not self._remote:
+            self._learner.set_weights(w)
+            return
+        import ray_tpu
+
+        ref = ray_tpu.put(w)
+        ray_tpu.get([a.set_weights.remote(ref) for a in self._actors],
+                    timeout=300)
+
+    def get_state(self) -> Dict[str, Any]:
+        if not self._remote:
+            return self._learner.get_state()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_state.remote(),
+                           timeout=300)
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        if not self._remote:
+            self._learner.set_state(state)
+            return
+        import ray_tpu
+
+        ref = ray_tpu.put(state)
+        ray_tpu.get([a.set_state.remote(ref) for a in self._actors],
+                    timeout=300)
+
+    def shutdown(self) -> None:
+        if self._remote:
+            import ray_tpu
+
+            for a in self._actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._actors = []

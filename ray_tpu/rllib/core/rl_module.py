@@ -1,0 +1,153 @@
+"""RLModule: the network abstraction of the RLlib new stack.
+
+ref: rllib/core/rl_module/rl_module.py — a module owns the neural nets
+and exposes forward_train / forward_inference / forward_exploration;
+learners own optimization, modules own computation.
+
+TPU-first divergence: a module here holds NO parameters. `init(rng)`
+returns a pytree and every forward is a pure function of (params, ...),
+so the same module object can be closed over inside a jitted, donated,
+mesh-sharded update program without host state sneaking into the trace
+(the reference's torch modules carry their weights; ours are functional
+like everything else in ray_tpu/models).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.models import (
+    apply_mlp_policy,
+    apply_mlp_q,
+    init_mlp_policy,
+    init_mlp_q,
+)
+
+Params = Any  # pytree
+
+
+class RLModule:
+    """Pure-function network bundle (ref: rl_module.py RLModule API)."""
+
+    def init(self, rng: jax.Array) -> Params:
+        raise NotImplementedError
+
+    def forward_train(self, params: Params, obs: jnp.ndarray):
+        """Everything the loss needs (e.g. logits AND value)."""
+        raise NotImplementedError
+
+    def forward_inference(self, params: Params, obs: jnp.ndarray):
+        """Greedy/deterministic head for serving and evaluation."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params: Params, obs: jnp.ndarray,
+                            rng: jax.Array):
+        """Stochastic head for rollout collection; defaults to
+        inference (deterministic modules)."""
+        return self.forward_inference(params, obs)
+
+
+class MLPPolicyModule(RLModule):
+    """Separate pi/v towers for actor-critic algorithms (PPO/IMPALA)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Params:
+        return init_mlp_policy(rng, self.obs_dim, self.num_actions,
+                               self.hidden)
+
+    def forward_train(self, params: Params, obs: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return apply_mlp_policy(params, obs)  # (logits [B,A], value [B])
+
+    def forward_inference(self, params: Params, obs: jnp.ndarray
+                          ) -> jnp.ndarray:
+        logits, _ = apply_mlp_policy(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_exploration(self, params: Params, obs: jnp.ndarray,
+                            rng: jax.Array) -> jnp.ndarray:
+        logits, _ = apply_mlp_policy(params, obs)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+
+class DiscreteQModule(RLModule):
+    """Q(s, .) MLP for value-based algorithms (DQN family)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Params:
+        return init_mlp_q(rng, self.obs_dim, self.num_actions, self.hidden)
+
+    def forward_train(self, params: Params, obs: jnp.ndarray) -> jnp.ndarray:
+        return apply_mlp_q(params, obs)  # Q [B, A]
+
+    def forward_inference(self, params: Params, obs: jnp.ndarray
+                          ) -> jnp.ndarray:
+        return jnp.argmax(apply_mlp_q(params, obs), axis=-1)
+
+    def forward_exploration(self, params: Params, obs: jnp.ndarray,
+                            rng: jax.Array, epsilon: float = 0.05
+                            ) -> jnp.ndarray:
+        q = apply_mlp_q(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(rng)
+        rand = jax.random.randint(k1, greedy.shape, 0, self.num_actions)
+        explore = jax.random.uniform(k2, greedy.shape) < epsilon
+        return jnp.where(explore, rand, greedy)
+
+
+class MultiRLModule(RLModule):
+    """Container of named sub-modules — the multi-agent / multi-policy
+    module (ref: rl_module.py MultiRLModule). `init` returns a dict of
+    per-module pytrees; forwards take the module id."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def module_ids(self):
+        return sorted(self._modules)
+
+    def init(self, rng: jax.Array) -> Params:
+        keys = jax.random.split(rng, len(self._modules))
+        return {mid: self._modules[mid].init(k)
+                for mid, k in zip(sorted(self._modules), keys)}
+
+    def forward_train(self, params: Params, obs, module_id: str = None):
+        if module_id is not None:
+            return self._modules[module_id].forward_train(
+                params[module_id], obs)
+        return {mid: m.forward_train(params[mid], obs[mid])
+                for mid, m in self._modules.items()}
+
+    def forward_inference(self, params: Params, obs, module_id: str = None):
+        if module_id is not None:
+            return self._modules[module_id].forward_inference(
+                params[module_id], obs)
+        return {mid: m.forward_inference(params[mid], obs[mid])
+                for mid, m in self._modules.items()}
+
+    def forward_exploration(self, params: Params, obs, rng: jax.Array,
+                            module_id: str = None):
+        """Dispatch to submodules with a per-module rng fork (the base
+        default would silently drop the rng and explore greedily)."""
+        if module_id is not None:
+            return self._modules[module_id].forward_exploration(
+                params[module_id], obs, rng)
+        keys = jax.random.split(rng, len(self._modules))
+        return {mid: self._modules[mid].forward_exploration(
+                    params[mid], obs[mid], k)
+                for mid, k in zip(sorted(self._modules), keys)}

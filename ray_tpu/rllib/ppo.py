@@ -11,17 +11,17 @@ over a mesh `dp` axis (params replicated, batch sharded).
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.models import apply_mlp_policy, init_mlp_policy
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import MLPPolicyModule, RLModule
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,30 +37,30 @@ class PPOHyperparams:
     grad_clip: float = 0.5
 
 
-class PPOLearner:
-    """Holds params+optimizer and the jitted update (ref: Learner,
-    core/learner/learner.py:107; a mesh makes it the LearnerGroup
-    equivalent — DP over the `dp` axis instead of N learner actors)."""
+class PPOLearner(Learner):
+    """Params + optimizer + the ONE jitted update (ref: Learner,
+    core/learner/learner.py:107). Ported onto the core Learner base:
+    state plumbing is inherited; a mesh (usually handed in by
+    LearnerGroup) shards the batch over `dp` for in-program DDP."""
+
+    _state_attrs = ("params", "opt_state", "_rng")
 
     def __init__(self, obs_dim: int, num_actions: int, hp: PPOHyperparams,
                  seed: int = 0, mesh: Optional[Mesh] = None,
-                 hidden=(64, 64)):
+                 hidden=(64, 64), module: Optional[RLModule] = None):
         self.hp = hp
         self.mesh = mesh
+        self.module = module or MLPPolicyModule(obs_dim, num_actions,
+                                                hidden)
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_key = jax.random.split(self._rng)
-        self.params = init_mlp_policy(init_key, obs_dim, num_actions, hidden)
+        self.params = self._replicate(self.module.init(init_key))
         self._tx = optax.chain(
             optax.clip_by_global_norm(hp.grad_clip),
             optax.adam(hp.lr),
         )
-        self.opt_state = self._tx.init(self.params)
+        self.opt_state = self._replicate(self._tx.init(self.params))
         self._update = self._build_update()
-        if mesh is not None:
-            # Replicate params/opt state onto the mesh once.
-            rep = NamedSharding(mesh, P())
-            self.params = jax.device_put(self.params, rep)
-            self.opt_state = jax.device_put(self.opt_state, rep)
 
     # -- the jitted program -------------------------------------------------
     def _build_update(self):
@@ -81,8 +81,10 @@ class PPOLearner:
                                    reverse=True)
             return advs.T  # back to [E, T]
 
+        module = self.module
+
         def loss_fn(params, mb):
-            logits, value = apply_mlp_policy(params, mb["obs"])
+            logits, value = module.forward_train(params, mb["obs"])
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, mb["actions"][:, None], axis=1)[:, 0]
@@ -144,18 +146,10 @@ class PPOLearner:
             metrics = jax.tree_util.tree_map(lambda m: m[-1].mean(), metrics)
             return params, opt_state, metrics
 
-        if self.mesh is None:
-            return jax.jit(update, donate_argnums=(0, 1))
-
-        rep = NamedSharding(self.mesh, P())
-        dp = NamedSharding(self.mesh, P("dp"))
-        batch_sh = {
-            "obs": dp, "actions": dp, "logp": dp, "rewards": dp,
-            "dones": dp, "values": dp, "final_value": dp,
-        }
-        return jax.jit(update, donate_argnums=(0, 1),
-                       in_shardings=(rep, rep, batch_sh, rep),
-                       out_shardings=(rep, rep, rep))
+        return self._jit_update(
+            update, num_state_args=2,
+            batch_keys=("obs", "actions", "logp", "rewards", "dones",
+                        "values", "final_value"))
 
     # -- public -------------------------------------------------------------
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
@@ -165,35 +159,11 @@ class PPOLearner:
         dones [E,T], values [E,T], final_value [E].
         """
         self._rng, key = jax.random.split(self._rng)
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-        if self.mesh is not None:
-            dp = NamedSharding(self.mesh, P("dp"))
-            jbatch = {k: jax.device_put(v, dp) for k, v in jbatch.items()}
+        jbatch = self._shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()})
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, jbatch, key)
         return {k: float(v) for k, v in metrics.items()}
-
-    def get_weights(self) -> Any:
-        return jax.device_get(self.params)
-
-    def set_weights(self, params: Any) -> None:
-        self.params = jax.device_put(params)
-
-    def get_state(self) -> Dict[str, Any]:
-        """Full training state (weights + optimizer moments + rng), so a
-        restored run continues exactly (ref: Learner.get_state)."""
-        return {"params": jax.device_get(self.params),
-                "opt_state": jax.device_get(self.opt_state),
-                "rng": jax.device_get(self._rng)}
-
-    def set_state(self, state: Dict[str, Any]) -> None:
-        put = (functools.partial(
-                   jax.device_put,
-                   device=NamedSharding(self.mesh, P()))
-               if self.mesh is not None else jax.device_put)
-        self.params = put(state["params"])
-        self.opt_state = put(state["opt_state"])
-        self._rng = jnp.asarray(state["rng"])
 
 
 class PPOConfig(AlgorithmConfig):
@@ -236,12 +206,16 @@ class PPO(Algorithm):
     """ref: rllib/algorithms/ppo/ppo.py — training_step = sample rollouts
     from workers, one learner update, broadcast weights."""
 
-    def _setup_learner(self, obs_dim: int, num_actions: int) -> PPOLearner:
-        return PPOLearner(obs_dim, num_actions,
-                          self.config.hyperparams(),
-                          seed=self.config.seed,
-                          mesh=self.config.learner_mesh,
-                          hidden=self.config.model_hidden)
+    def _setup_learner(self, obs_dim: int, num_actions: int):
+        cfg = self.config
+        hp = cfg.hyperparams()
+        seed, hidden = cfg.seed, cfg.model_hidden
+
+        def factory(mesh=None):
+            return PPOLearner(obs_dim, num_actions, hp, seed=seed,
+                              mesh=mesh, hidden=hidden)
+
+        return self._build_learner(factory)
 
     def training_step(self) -> Dict[str, float]:
         batch, episode_returns = self._sample_rollouts()

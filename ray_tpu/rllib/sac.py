@@ -12,14 +12,16 @@ runs three torch optimizer steps with host round-trips in between).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import Mesh
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.models import (
     apply_sac_actor,
     apply_twin_q,
@@ -42,24 +44,33 @@ class SACHyperparams:
     init_alpha: float = 0.1
 
 
-class SACLearner:
-    """All three optimizers + the target move in one jitted update."""
+class SACLearner(Learner):
+    """All three optimizers + the target move in one jitted update
+    (ported onto the core Learner base, ref: learner.py:107; a mesh —
+    usually from LearnerGroup — shards the batch over `dp`)."""
+
+    _state_attrs = ("actor", "critic", "target_critic", "log_alpha",
+                    "actor_opt", "critic_opt", "alpha_opt", "_rng")
 
     def __init__(self, obs_dim: int, act_dim: int, hp: SACHyperparams,
-                 seed: int = 0, hidden=(64, 64)):
+                 seed: int = 0, hidden=(64, 64),
+                 mesh: Optional[Mesh] = None):
         self.hp = hp
+        self.mesh = mesh
         rng = jax.random.PRNGKey(seed)
         r1, r2, self._rng = jax.random.split(rng, 3)
-        self.actor = init_sac_actor(r1, obs_dim, act_dim, hidden)
-        self.critic = init_twin_q(r2, obs_dim, act_dim, hidden)
+        self.actor = self._replicate(
+            init_sac_actor(r1, obs_dim, act_dim, hidden))
+        self.critic = self._replicate(
+            init_twin_q(r2, obs_dim, act_dim, hidden))
         self.target_critic = jax.tree_util.tree_map(jnp.copy, self.critic)
-        self.log_alpha = jnp.log(jnp.float32(hp.init_alpha))
+        self.log_alpha = self._replicate(jnp.log(jnp.float32(hp.init_alpha)))
         self._actor_tx = optax.adam(hp.actor_lr)
         self._critic_tx = optax.adam(hp.critic_lr)
         self._alpha_tx = optax.adam(hp.alpha_lr)
-        self.actor_opt = self._actor_tx.init(self.actor)
-        self.critic_opt = self._critic_tx.init(self.critic)
-        self.alpha_opt = self._alpha_tx.init(self.log_alpha)
+        self.actor_opt = self._replicate(self._actor_tx.init(self.actor))
+        self.critic_opt = self._replicate(self._critic_tx.init(self.critic))
+        self.alpha_opt = self._replicate(self._alpha_tx.init(self.log_alpha))
         self._update = self._build_update()
 
     def _build_update(self):
@@ -124,12 +135,16 @@ class SACLearner:
             return (actor, critic, target_critic, log_alpha,
                     actor_opt, critic_opt, alpha_opt, metrics)
 
-        return jax.jit(update, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        return self._jit_update(
+            update, num_state_args=7,
+            batch_keys=("obs", "actions", "rewards", "next_obs",
+                        "terminals"))
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         self._rng, key = jax.random.split(self._rng)
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
-                  if k != "batch_indexes"}
+        jbatch = self._shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()
+             if k != "batch_indexes"})
         (self.actor, self.critic, self.target_critic, self.log_alpha,
          self.actor_opt, self.critic_opt, self.alpha_opt,
          metrics) = self._update(
@@ -142,16 +157,7 @@ class SACLearner:
         return jax.device_get(self.actor)
 
     def set_weights(self, actor: Any) -> None:
-        self.actor = jax.device_put(actor)
-
-    def get_state(self) -> Dict[str, Any]:
-        return {k: jax.device_get(getattr(self, k)) for k in (
-            "actor", "critic", "target_critic", "log_alpha",
-            "actor_opt", "critic_opt", "alpha_opt")}
-
-    def set_state(self, state: Dict[str, Any]) -> None:
-        for k, v in state.items():
-            setattr(self, k, jax.device_put(v))
+        self.actor = self._replicate(actor)
 
 
 class SACConfig(AlgorithmConfig):
@@ -208,8 +214,13 @@ class SAC(Algorithm):
         self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
                                    seed=cfg.seed)
         self._env_steps = 0
-        return SACLearner(obs_dim, act_dim, hp, seed=cfg.seed,
-                          hidden=cfg.model_hidden)
+        seed, hidden = cfg.seed, cfg.model_hidden
+
+        def factory(mesh=None):
+            return SACLearner(obs_dim, act_dim, hp, seed=seed,
+                              hidden=hidden, mesh=mesh)
+
+        return self._build_learner(factory)
 
     def _collect(self, uniform: bool):
         T = self.config.rollout_fragment_length
