@@ -1,17 +1,36 @@
-"""Streaming executor: bounded in-flight tasks over the block stream.
+"""Operator-graph streaming executor.
 
-The reference's streaming executor runs operators concurrently with
-backpressure policies (ref: python/ray/data/_internal/execution/
-streaming_executor.py:55, scheduling step :262; backpressure_policy/).
-Equivalent mechanics here: read+fused-map work is submitted as remote
-tasks with a sliding in-flight window (`max_in_flight`); completed block
-refs stream to the consumer as soon as they finish (out-of-order), so
-downstream iteration overlaps upstream compute.  Stateful UDF stages run
-on a small actor pool with least-loaded dispatch.
+The reference runs each dataset as a graph of concurrent operators with
+per-operator resource budgets, a scheduling step that picks which
+operator to advance, and pluggable backpressure
+(ref: python/ray/data/_internal/execution/streaming_executor.py:55,
+streaming_executor_state.py:494 `select_operator_to_run`,
+backpressure_policy/). This module is the equivalent:
+
+- Each map segment becomes a linear graph of operators (a read source,
+  fused task-map operators, actor-pool operators). Every operator owns a
+  BOUNDED input queue, an in-flight task budget, and a bounded output
+  queue.
+- A scheduling step harvests completions, propagates blocks between
+  queues, then advances the RUNNABLE operator with the most headroom
+  (free budget fraction; ties drain downstream-most first) — one task
+  per step, so all operators genuinely overlap instead of running as
+  chained sliding windows.
+- Backpressure composes three ways: the in-flight budget (shrunk under
+  object-store pressure, ref: concurrency_cap/streaming_output
+  backpressure policies), the bounded inter-operator queues, and the
+  consumer itself — the executor is a generator, so when the caller
+  stops pulling, scheduling pauses.
+
+Blocks stay ordered (completions are harvested in submission order per
+operator), matching the reference's default preserve_order=False cost
+model conservatively. All-to-all stages remain barriers between
+segments, as in the reference's plan segmentation.
 """
 from __future__ import annotations
 
 import logging
+from collections import deque
 from typing import Any, Callable, Iterator, List, Optional
 
 import ray_tpu
@@ -25,7 +44,7 @@ DEFAULT_MAX_IN_FLIGHT = 16
 
 
 def _default_window() -> int:
-    """Resource-aware base window (ref: backpressure_policy/
+    """Resource-aware per-operator budget (ref: backpressure_policy/
     concurrency_cap_backpressure_policy.py): enough in-flight tasks to
     cover the cluster's CPUs twice, bounded."""
     try:
@@ -36,7 +55,7 @@ def _default_window() -> int:
 
 
 def _effective_window(base: int) -> int:
-    """Shrink the window under object-store pressure (ref:
+    """Shrink a budget under object-store pressure (ref:
     backpressure_policy/streaming_output_backpressure_policy.py — the
     executor must not outrun consumers into an overflowing store)."""
     try:
@@ -100,38 +119,110 @@ class _ActorPool:
                 pass
 
 
-def execute(read_tasks: List[ReadTask], stages: List[Any], *,
-            max_in_flight: Optional[int] = None,
-            stats: Optional[DatasetStats] = None) -> Iterator[Any]:
-    """Yield block refs for the fully-applied plan, streaming."""
-    if max_in_flight is None:
-        max_in_flight = _default_window()
-    if stats is None:
-        stats = DatasetStats()
-    # Split the stage list into segments separated by all-to-all barriers.
-    segments: List[List[Any]] = [[]]
-    for st in stages:
-        if isinstance(st, AllToAllStage):
-            segments.append(st)
-            segments.append([])
-        else:
-            segments[-1].append(st)
+class _Operator:
+    """One node of the operator graph: bounded inqueue -> budgeted
+    in-flight remote tasks -> bounded outqueue (ref: execution/
+    interfaces/physical_operator.py — an operator owns its task pool
+    and exposes readiness to the scheduling loop)."""
 
-    stream: Iterator[Any] = _stream_source(read_tasks, segments[0],
-                                           max_in_flight, stats)
-    i = 1
-    while i < len(segments):
-        barrier: AllToAllStage = segments[i]
-        bstat = stats.new_stage(barrier.name)
-        bstat.on_submit()
-        # ref_fn receives the (lazy) upstream ref iterator; most barriers
-        # list() it, but streaming ones (Limit) can stop pulling early.
-        refs = barrier.ref_fn(stream)
-        bstat.on_output()
-        map_seg = segments[i + 1]
-        stream = _stream_maps(iter(refs), map_seg, max_in_flight, stats)
-        i += 2
-    yield from stream
+    def __init__(self, name: str, budget: int, stats: StageStats,
+                 depth: int):
+        self.name = name
+        self.budget = budget
+        self.max_queue = 2 * budget   # inter-op queue bound
+        self.stats = stats
+        self.depth = depth
+        self.inqueue: deque = deque()
+        self.in_flight: deque = deque()   # (ref, extra) submission order
+        self.outqueue: deque = deque()
+        self.upstream_done = False
+
+    # -- source feeding -------------------------------------------------
+    def feed(self, item: Any) -> None:
+        self.inqueue.append(item)
+        self.stats.on_queue(len(self.inqueue))
+
+    # -- scheduling interface -------------------------------------------
+    def runnable(self) -> bool:
+        return (bool(self.inqueue)
+                and len(self.in_flight) < _effective_window(self.budget)
+                and len(self.in_flight) + len(self.outqueue)
+                < self.max_queue)
+
+    def headroom(self) -> float:
+        return 1.0 - len(self.in_flight) / max(1, self.budget)
+
+    def submit_one(self) -> None:
+        item = self.inqueue.popleft()
+        ref, extra = self._launch(item)
+        self.in_flight.append((ref, extra))
+        self.stats.on_submit()
+        self.stats.on_active(len(self.in_flight))
+
+    def _launch(self, item):
+        raise NotImplementedError
+
+    def _on_done(self, extra) -> None:
+        pass
+
+    # -- completion harvest (in submission order) -----------------------
+    def harvest(self) -> bool:
+        progressed = False
+        while self.in_flight:
+            ref, extra = self.in_flight[0]
+            done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not done:
+                break
+            self.in_flight.popleft()
+            self._on_done(extra)
+            self.outqueue.append(ref)
+            self.stats.on_output()
+            progressed = True
+        return progressed
+
+    @property
+    def finished(self) -> bool:
+        return (self.upstream_done and not self.inqueue
+                and not self.in_flight and not self.outqueue)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class _TaskMapOp(_Operator):
+    def __init__(self, name, fused_fn, budget, stats, depth,
+                 remote_fn=None, pack=None):
+        super().__init__(name, budget, stats, depth)
+        self._fn = fused_fn
+        self._remote = remote_fn or ray_tpu.remote(_run_map)
+        self._pack = pack or (lambda item, fn: (item, fn))
+
+    def _launch(self, item):
+        return self._remote.remote(*self._pack(item, self._fn)), None
+
+
+class _ActorMapOp(_Operator):
+    def __init__(self, name, stage: MapStage, stats, depth):
+        self._stage = stage
+        self._pool: Optional[_ActorPool] = None
+        self._size = max(1, stage.num_actors)
+        super().__init__(name, budget=2 * self._size, stats=stats,
+                         depth=depth)
+
+    def _launch(self, item):
+        if self._pool is None:   # lazy: actors spawn on first block
+            self._pool = _ActorPool(self._stage.actor_fn_maker,
+                                    self._size)
+        i, ref = self._pool.submit(item)
+        return ref, i
+
+    def _on_done(self, i) -> None:
+        self._pool.done(i)
+
+    def shutdown(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
 
 
 def _split_actor_stages(stages: List[MapStage]):
@@ -157,100 +248,143 @@ def _group_name(group) -> str:
     return group.name
 
 
-def _stream_source(read_tasks, map_stages, max_in_flight,
-                   stats: DatasetStats) -> Iterator[Any]:
+def _build_graph(map_stages, max_in_flight, stats: DatasetStats,
+                 with_source: bool = False) -> List[_Operator]:
+    """Linear operator graph for one barrier-free segment. With
+    `with_source`, the head operator executes ReadTasks (fed lazily by
+    _run_graph through the same bounded inqueue as every other op, so
+    its queue stats reflect real backpressure, not the parallelism)."""
+    ops: List[_Operator] = []
     groups = _split_actor_stages(map_stages)
-    head_fused = None
-    head_name = "Read"
-    if groups and isinstance(groups[0], list):
-        head_fused = fuse_map_chain([s.block_fn for s in groups[0]])
-        head_name = "Read+" + _group_name(groups[0])
-        groups = groups[1:]
 
-    run_read = ray_tpu.remote(_run_read)
-    stream = _windowed(
-        ((run_read, (t.fn, head_fused)) for t in read_tasks), max_in_flight,
-        stats.new_stage(head_name))
+    if with_source:
+        head_fused = None
+        head_name = "Read"
+        if groups and isinstance(groups[0], list):
+            head_fused = fuse_map_chain([s.block_fn for s in groups[0]])
+            head_name = "Read+" + _group_name(groups[0])
+            groups = groups[1:]
+        ops.append(_TaskMapOp(head_name, head_fused,
+                              budget=max_in_flight,
+                              stats=stats.new_stage(head_name), depth=0,
+                              remote_fn=ray_tpu.remote(_run_read),
+                              pack=lambda task, fn: (task.fn, fn)))
+
     for g in groups:
-        stream = _apply_group(stream, g, max_in_flight, stats)
-    return stream
+        depth = len(ops)
+        name = _group_name(g)
+        if isinstance(g, list):
+            fused = fuse_map_chain([s.block_fn for s in g])
+            ops.append(_TaskMapOp(name, fused, budget=max_in_flight,
+                                  stats=stats.new_stage(name),
+                                  depth=depth))
+        else:
+            ops.append(_ActorMapOp(name, g, stats=stats.new_stage(name),
+                                   depth=depth))
+    return ops
 
 
-def _stream_maps(refs: Iterator[Any], map_stages, max_in_flight,
-                 stats: DatasetStats):
-    groups = _split_actor_stages(map_stages)
-    stream = refs
-    for g in groups:
-        stream = _apply_group(stream, g, max_in_flight, stats)
-    return stream
+def _run_graph(ops: List[_Operator],
+               feed: Optional[Iterator[Any]] = None) -> Iterator[Any]:
+    """The scheduling loop (ref: streaming_executor_state.py:494).
 
-
-def _apply_group(stream: Iterator[Any], group, max_in_flight,
-                 stats: DatasetStats):
-    stage_stats = stats.new_stage(_group_name(group))
-    if isinstance(group, list):
-        fused = fuse_map_chain([s.block_fn for s in group])
-        run_map = ray_tpu.remote(_run_map)
-        return _windowed(((run_map, (ref, fused)) for ref in stream),
-                         max_in_flight, stage_stats)
-    return _actor_stream(stream, group, max_in_flight, stage_stats)
-
-
-def _windowed(submissions, max_in_flight,
-              stage_stats: Optional[StageStats] = None) -> Iterator[Any]:
-    """Submit (remote_fn, args) lazily, keep <= max_in_flight running,
-    yield refs in submission order (blocks stay ordered like the
-    reference's default; the window still overlaps execution). The
-    window shrinks under object-store pressure (backpressure policy)."""
-    in_flight: List[Any] = []
-    submissions = iter(submissions)
-    exhausted = False
-    while True:
-        window = _effective_window(max_in_flight)
-        while not exhausted and len(in_flight) < window:
-            try:
-                fn, args = next(submissions)
-            except StopIteration:
-                exhausted = True
-                break
-            in_flight.append(fn.remote(*args))
-            if stage_stats is not None:
-                stage_stats.on_submit()
-        if not in_flight:
-            return
-        head = in_flight.pop(0)
-        ray_tpu.wait([head], num_returns=1, timeout=None)
-        if stage_stats is not None:
-            stage_stats.on_output()
-        yield head
-
-
-def _actor_stream(stream: Iterator[Any], stage: MapStage, max_in_flight,
-                  stage_stats: Optional[StageStats] = None):
-    pool = _ActorPool(stage.actor_fn_maker, max(1, stage.num_actors))
+    Repeats: harvest completions -> propagate between bounded queues ->
+    yield sink output -> advance the runnable operator with the most
+    headroom. Blocks on the head in-flight refs only when no step can
+    make progress. `feed` lazily supplies the first operator's input
+    (refs from an upstream barrier)."""
+    if not ops:
+        if feed is not None:
+            yield from feed
+        return
+    feed_done = feed is None
     try:
-        pending: List[Any] = []  # (ref, actor_idx) in submission order
-        stream = iter(stream)
-        exhausted = False
-        cap = max(len(pool.actors) * 2, 2)
         while True:
-            while not exhausted and len(pending) < cap:
+            progressed = False
+            # Pull upstream refs into the head inqueue (bounded).
+            while (not feed_done
+                   and len(ops[0].inqueue) < ops[0].max_queue):
                 try:
-                    block_ref = next(stream)
+                    ops[0].feed(next(feed))
+                    progressed = True
                 except StopIteration:
-                    exhausted = True
-                    break
-                i, ref = pool.submit(block_ref)
-                if stage_stats is not None:
-                    stage_stats.on_submit()
-                pending.append((ref, i))
-            if not pending:
+                    feed_done = True
+                    ops[0].upstream_done = True
+            for op in ops:
+                progressed |= op.harvest()
+            for up, down in zip(ops, ops[1:]):
+                while (up.outqueue
+                       and len(down.inqueue) < down.max_queue):
+                    down.feed(up.outqueue.popleft())
+                    progressed = True
+                if up.finished and not down.upstream_done:
+                    down.upstream_done = True
+                    progressed = True
+            while ops[-1].outqueue:
+                yield ops[-1].outqueue.popleft()
+                progressed = True
+            runnable = [op for op in ops if op.runnable()]
+            if runnable:
+                # THE scheduling step: most free budget wins; ties go
+                # downstream-most so the pipeline drains.
+                best = max(runnable,
+                           key=lambda op: (op.headroom(), op.depth))
+                best.submit_one()
+                progressed = True
+            if progressed:
+                continue
+            if all(op.finished for op in ops) and feed_done:
                 return
-            ref, i = pending.pop(0)
-            ray_tpu.wait([ref], num_returns=1, timeout=None)
-            pool.done(i)
-            if stage_stats is not None:
-                stage_stats.on_output()
-            yield ref
+            heads = [op.in_flight[0][0] for op in ops if op.in_flight]
+            if not heads:
+                # Unreachable by construction: an op with queued input
+                # and zero in-flight is always runnable (the sink
+                # outqueue is drained above). Fail loudly rather than
+                # busy-spin if a future runnable() change breaks that.
+                raise RuntimeError(
+                    "operator-graph deadlock: no progress, nothing in "
+                    "flight, not finished — "
+                    + ", ".join(
+                        f"{op.name}(in={len(op.inqueue)} "
+                        f"out={len(op.outqueue)} done={op.upstream_done})"
+                        for op in ops))
+            ray_tpu.wait(heads, num_returns=1, timeout=None)
     finally:
-        pool.shutdown()
+        for op in ops:
+            op.shutdown()
+
+
+def execute(read_tasks: List[ReadTask], stages: List[Any], *,
+            max_in_flight: Optional[int] = None,
+            stats: Optional[DatasetStats] = None) -> Iterator[Any]:
+    """Yield block refs for the fully-applied plan, streaming."""
+    if max_in_flight is None:
+        max_in_flight = _default_window()
+    if stats is None:
+        stats = DatasetStats()
+    # Split the stage list into segments separated by all-to-all barriers.
+    segments: List[List[Any]] = [[]]
+    for st in stages:
+        if isinstance(st, AllToAllStage):
+            segments.append(st)
+            segments.append([])
+        else:
+            segments[-1].append(st)
+
+    stream: Iterator[Any] = _run_graph(
+        _build_graph(segments[0], max_in_flight, stats,
+                     with_source=True),
+        feed=iter(read_tasks))
+    i = 1
+    while i < len(segments):
+        barrier: AllToAllStage = segments[i]
+        bstat = stats.new_stage(barrier.name)
+        bstat.on_submit()
+        # ref_fn receives the (lazy) upstream ref iterator; most barriers
+        # list() it, but streaming ones (Limit) can stop pulling early.
+        refs = barrier.ref_fn(stream)
+        bstat.on_output()
+        ops = _build_graph(segments[i + 1], max_in_flight, stats)
+        stream = _run_graph(ops, feed=iter(refs))
+        i += 2
+    yield from stream

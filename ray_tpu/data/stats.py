@@ -19,6 +19,8 @@ class StageStats:
     tasks: int = 0
     first_submit: Optional[float] = None
     last_output: Optional[float] = None
+    peak_queue: int = 0       # max observed operator input-queue depth
+    peak_in_flight: int = 0   # max concurrently running tasks
 
     def on_submit(self) -> None:
         self.tasks += 1
@@ -28,11 +30,26 @@ class StageStats:
     def on_output(self) -> None:
         self.last_output = time.monotonic()
 
+    def on_queue(self, depth: int) -> None:
+        self.peak_queue = max(self.peak_queue, depth)
+
+    def on_active(self, n: int) -> None:
+        self.peak_in_flight = max(self.peak_in_flight, n)
+
     @property
     def wall_s(self) -> float:
         if self.first_submit is None or self.last_output is None:
             return 0.0
         return self.last_output - self.first_submit
+
+    def overlaps(self, other: "StageStats") -> bool:
+        """True when the two stages' execution windows intersect —
+        the observable signature of pipelined operators."""
+        if None in (self.first_submit, self.last_output,
+                    other.first_submit, other.last_output):
+            return False
+        return (self.first_submit < other.last_output
+                and other.first_submit < self.last_output)
 
 
 class DatasetStats:
@@ -52,7 +69,8 @@ class DatasetStats:
         for st in self.stages:
             lines.append(
                 f"  {st.name}: {st.tasks} tasks, {st.wall_s * 1000:.0f} ms"
-                f" wall")
+                f" wall, peak in-flight {st.peak_in_flight}, "
+                f"peak queue {st.peak_queue}")
         lines.append(
             f"  consumed: {self.consumed_rows} rows, "
             f"{self.consumed_bytes / 1e6:.2f} MB")
