@@ -460,6 +460,167 @@ inline Value PickleLoads(const std::string& data) {
 }
 
 // ---------------------------------------------------------------------------
+// typed wire codec + protocol version (ray_tpu/core/distributed/wire.py)
+// ---------------------------------------------------------------------------
+//
+// The control plane's cross-language codec: a self-describing binary
+// schema over the Value model, replacing the pickle subset on every RPC
+// payload (pickle remains only inside Python object blobs,
+// FrameObject/UnframeObject below). Little-endian throughout.
+//
+//   value := 0x00 | 0x01 | 0x02          (None / True / False)
+//          | 0x03 i64 | 0x04 f64
+//          | 0x05 u32 raw | 0x06 u32 utf8 (bytes / str)
+//          | 0x07 u32 value*              (list; tuples encode as list)
+//          | 0x08 u32 (value value)*      (dict)
+
+// Outside 1..6 deliberately: the previous unversioned format carried
+// the frame-TYPE byte at this offset (REQ=1..CANCEL=6), so a version
+// equal to a frame type would let an old-generation peer pass the
+// check and be misparsed instead of cleanly rejected.
+constexpr uint8_t kProtocolVersion = 16;
+constexpr uint8_t kCodecPickle = 0;
+constexpr uint8_t kCodecTyped = 1;
+constexpr uint32_t kMaxFrame = 512u * 1024 * 1024;
+// u32 length | u8 version | u8 type | u64 req_id; length counts
+// version+type+id+payload.
+constexpr size_t kFrameHeaderSize = 14;
+constexpr size_t kFramePostLen = 10;
+
+namespace detail {
+
+inline void TypedEncode(const Value& v, std::string* out) {
+  switch (v.kind) {
+    case Value::Kind::None:
+      out->push_back('\x00');
+      break;
+    case Value::Kind::Bool:
+      out->push_back(v.b ? '\x01' : '\x02');
+      break;
+    case Value::Kind::Int:
+      out->push_back('\x03');
+      out->append(reinterpret_cast<const char*>(&v.i), 8);
+      break;
+    case Value::Kind::Float:
+      out->push_back('\x04');
+      out->append(reinterpret_cast<const char*>(&v.f), 8);
+      break;
+    case Value::Kind::Bytes:
+      out->push_back('\x05');
+      PutU32(out, static_cast<uint32_t>(v.s.size()));
+      out->append(v.s);
+      break;
+    case Value::Kind::Str:
+      out->push_back('\x06');
+      PutU32(out, static_cast<uint32_t>(v.s.size()));
+      out->append(v.s);
+      break;
+    case Value::Kind::List:
+    case Value::Kind::Tuple:
+      out->push_back('\x07');
+      PutU32(out, static_cast<uint32_t>(v.items.size()));
+      for (const auto& it : v.items) TypedEncode(it, out);
+      break;
+    case Value::Kind::Dict:
+      out->push_back('\x08');
+      PutU32(out, static_cast<uint32_t>(v.entries.size()));
+      for (const auto& kv : v.entries) {
+        TypedEncode(kv.first, out);
+        TypedEncode(kv.second, out);
+      }
+      break;
+  }
+}
+
+class TypedDecoder {
+ public:
+  explicit TypedDecoder(const std::string& data, size_t start = 0)
+      : d_(data), pos_(start) {}
+
+  Value Load() {
+    Value v = Next();
+    if (pos_ != d_.size()) throw PickleError("trailing typed bytes");
+    return v;
+  }
+
+ private:
+  Value Next() {
+    uint8_t tag = Byte();
+    switch (tag) {
+      case 0x00: return Value::None();
+      case 0x01: return Value::Bool(true);
+      case 0x02: return Value::Bool(false);
+      case 0x03: {
+        int64_t v;
+        Read(&v, 8);
+        return Value::Int(v);
+      }
+      case 0x04: {
+        double v;
+        Read(&v, 8);
+        return Value::Float(v);
+      }
+      case 0x05: return Value::Bytes(Take(U32()));
+      case 0x06: return Value::Str(Take(U32()));
+      case 0x07: {
+        uint32_t n = U32();
+        std::vector<Value> items;
+        items.reserve(n);
+        for (uint32_t k = 0; k < n; ++k) items.push_back(Next());
+        return Value::List(std::move(items));
+      }
+      case 0x08: {
+        uint32_t n = U32();
+        Value d = Value::Dict();
+        d.entries.reserve(n);
+        for (uint32_t k = 0; k < n; ++k) {
+          Value key = Next();
+          Value val = Next();
+          d.entries.emplace_back(std::move(key), std::move(val));
+        }
+        return d;
+      }
+      default:
+        throw PickleError("unknown typed tag " + std::to_string(tag));
+    }
+  }
+  uint8_t Byte() {
+    if (pos_ >= d_.size()) throw PickleError("truncated typed payload");
+    return static_cast<uint8_t>(d_[pos_++]);
+  }
+  uint32_t U32() {
+    uint32_t v;
+    Read(&v, 4);
+    return v;
+  }
+  void Read(void* out, size_t n) {
+    if (pos_ + n > d_.size()) throw PickleError("truncated typed payload");
+    std::memcpy(out, d_.data() + pos_, n);
+    pos_ += n;
+  }
+  std::string Take(size_t n) {
+    if (pos_ + n > d_.size()) throw PickleError("truncated typed payload");
+    std::string out = d_.substr(pos_, n);
+    pos_ += n;
+    return out;
+  }
+  const std::string& d_;
+  size_t pos_ = 0;
+};
+
+}  // namespace detail
+
+inline std::string TypedDumps(const Value& v) {
+  std::string out;
+  detail::TypedEncode(v, &out);
+  return out;
+}
+
+inline Value TypedLoads(const std::string& data, size_t start = 0) {
+  return detail::TypedDecoder(data, start).Load();
+}
+
+// ---------------------------------------------------------------------------
 // RTPU object framing (serialization.py: header <IBBHQ> + pickle)
 // ---------------------------------------------------------------------------
 
@@ -543,36 +704,60 @@ class Connection {
              const Value& kwargs) {
     Value req = Value::Tuple(
         {Value::Str(service), Value::Str(method), kwargs});
-    std::string payload = PickleDumps(req);
+    // Typed codec on every control-plane payload; the server echoes it.
+    std::string payload;
+    payload.push_back(static_cast<char>(kCodecTyped));
+    payload.append(TypedDumps(req));
     uint64_t req_id = ++req_counter_;
     std::string frame;
-    uint32_t len = static_cast<uint32_t>(9 + payload.size());
+    uint32_t len = static_cast<uint32_t>(kFramePostLen + payload.size());
     frame.append(reinterpret_cast<const char*>(&len), 4);
+    frame.push_back(static_cast<char>(kProtocolVersion));
     frame.push_back(1);  // REQ
     frame.append(reinterpret_cast<const char*>(&req_id), 8);
     frame.append(payload);
     SendAll(frame);
 
     for (;;) {
-      std::string head = RecvExactly(13);
+      std::string head = RecvExactly(kFrameHeaderSize);
       uint32_t flen;
       std::memcpy(&flen, head.data(), 4);
-      unsigned char ftype = static_cast<unsigned char>(head[4]);
+      unsigned char version = static_cast<unsigned char>(head[4]);
+      unsigned char ftype = static_cast<unsigned char>(head[5]);
       uint64_t rid;
-      std::memcpy(&rid, head.data() + 5, 8);
-      std::string body = RecvExactly(flen - 9);
+      std::memcpy(&rid, head.data() + 6, 8);
+      if (flen < kFramePostLen || flen > kMaxFrame) {
+        // An undersized length would underflow the unsigned subtraction
+        // below into a ~4GB read; either way the stream is garbage.
+        throw RpcError("malformed frame length " + std::to_string(flen));
+      }
+      std::string body = RecvExactly(flen - kFramePostLen);
+      if (version != kProtocolVersion) {
+        throw RpcError("protocol version mismatch: peer sent v" +
+                       std::to_string(version) + ", this client speaks v" +
+                       std::to_string(kProtocolVersion));
+      }
       if (ftype != 2 /*RES*/ || rid != req_id) continue;
-      Value reply = PickleLoads(body);
+      if (body.empty()) throw RpcError("empty reply payload");
+      unsigned char codec = static_cast<unsigned char>(body[0]);
+      Value reply = codec == kCodecTyped
+                        ? TypedLoads(body, 1)  // offset: no copy
+                        : PickleLoads(body.substr(1));
       const Value* ok = reply.Get("ok");
       if (ok == nullptr) throw RpcError("malformed reply");
       if (!ok->IsTruthy()) {
-        // The error value is an arbitrary pickled exception; the
-        // traceback string is decodable.
+        // Typed-codec errors are clear "Type: message" strings; keep
+        // the traceback when the server attached one.
+        const Value* err = reply.Get("error");
         const Value* tb = reply.Get("traceback");
-        throw RpcError(service + "." + method + " failed" +
-                       (tb != nullptr && tb->kind == Value::Kind::Str
-                            ? ":\n" + tb->s
-                            : ""));
+        std::string detail;
+        if (err != nullptr && err->kind == Value::Kind::Str) {
+          detail = ": " + err->s;
+        }
+        if (tb != nullptr && tb->kind == Value::Kind::Str) {
+          detail += "\n" + tb->s;
+        }
+        throw RpcError(service + "." + method + " failed" + detail);
       }
       const Value* result = reply.Get("result");
       return result != nullptr ? *result : Value::None();

@@ -166,8 +166,9 @@ namespace detail {
 inline void SendFrame(int fd, unsigned char ftype, uint64_t req_id,
                       const std::string& payload) {
   std::string frame;
-  uint32_t len = static_cast<uint32_t>(9 + payload.size());
+  uint32_t len = static_cast<uint32_t>(kFramePostLen + payload.size());
   frame.append(reinterpret_cast<const char*>(&len), 4);
+  frame.push_back(static_cast<char>(kProtocolVersion));
   frame.push_back(static_cast<char>(ftype));
   frame.append(reinterpret_cast<const char*>(&req_id), 8);
   frame.append(payload);
@@ -334,19 +335,47 @@ inline Value HandleRequest(const Value& req) {
 inline void ServeConn(int fd) {
   for (;;) {
     std::string head;
-    if (!RecvExactly(fd, 13, &head)) break;
+    if (!RecvExactly(fd, kFrameHeaderSize, &head)) break;
     uint32_t flen;
     std::memcpy(&flen, head.data(), 4);
-    unsigned char ftype = static_cast<unsigned char>(head[4]);
+    unsigned char version = static_cast<unsigned char>(head[4]);
+    unsigned char ftype = static_cast<unsigned char>(head[5]);
     uint64_t req_id;
-    std::memcpy(&req_id, head.data() + 5, 8);
-    if (flen < 9) break;  // malformed framing: drop the connection
+    std::memcpy(&req_id, head.data() + 6, 8);
+    if (flen < kFramePostLen || flen > kMaxFrame) break;  // malformed
     std::string body;
-    if (!RecvExactly(fd, flen - 9, &body)) break;
+    if (!RecvExactly(fd, flen - kFramePostLen, &body)) break;
+    // Echo the request's codec in the reply (the rule rpc.py's server
+    // follows); version-mismatch errors use typed, the one codec a
+    // foreign-generation peer most plausibly decodes.
+    unsigned char req_codec =
+        body.empty() ? kCodecTyped
+                     : static_cast<unsigned char>(body[0]);
+    std::string reply_payload;
+    if (version != kProtocolVersion) {
+      reply_payload.push_back(static_cast<char>(kCodecTyped));
+      // Answer clearly, never decode a foreign-generation payload.
+      Value reply = Value::Dict();
+      reply.Set("ok", Value::Bool(false));
+      reply.Set("error", Value::Str(
+          "protocol version mismatch: peer sent v" +
+          std::to_string(version) + ", this worker speaks v" +
+          std::to_string(kProtocolVersion)));
+      reply_payload.append(TypedDumps(reply));
+      try {
+        SendFrame(fd, 2 /*RES*/, req_id, reply_payload);
+      } catch (const std::exception&) {
+      }
+      break;
+    }
     if (ftype != 1 /*REQ*/) continue;  // streams/cancel unsupported
     Value app;
     try {
-      app = HandleRequest(PickleLoads(body));
+      if (body.empty()) throw RpcError("empty payload");
+      Value req = req_codec == kCodecTyped
+                      ? TypedLoads(body, 1)  // offset: no copy
+                      : PickleLoads(body.substr(1));
+      app = HandleRequest(req);
     } catch (const std::exception& e) {
       app = AppError(std::string("bad request: ") + e.what());
     }
@@ -354,7 +383,12 @@ inline void ServeConn(int fd) {
     reply.Set("ok", Value::Bool(true));
     reply.Set("result", std::move(app));
     try {
-      SendFrame(fd, 2 /*RES*/, req_id, PickleDumps(reply));
+      reply_payload.push_back(static_cast<char>(
+          req_codec == kCodecPickle ? kCodecPickle : kCodecTyped));
+      reply_payload.append(req_codec == kCodecPickle
+                               ? PickleDumps(reply)
+                               : TypedDumps(reply));
+      SendFrame(fd, 2 /*RES*/, req_id, reply_payload);
     } catch (const std::exception&) {
       break;
     }

@@ -15,8 +15,16 @@ in this environment: 657µs vs 47µs round-trip). Since every control-plane
 hop (lease, push, heartbeat, directory update) rides this layer, the
 framing IS the scheduler latency floor. Wire format:
 
-    frame  := u32 length | u8 type | u64 req_id | payload (pickle)
+    frame  := u32 length | u8 version | u8 type | u64 req_id | payload
+    payload:= u8 codec | body    (codec 0 = pickle, 1 = typed; wire.py)
     types:    REQ, RES, STREAM_REQ, STREAM_ITEM, STREAM_END, CANCEL
+
+The version byte is the schema seam the reference gets from proto3
+(ref: src/ray/protobuf/core_worker.proto:425): a peer from a different
+protocol generation receives a clear "protocol version mismatch" error
+instead of a deserialize crash. The codec byte keeps pickle for
+Python<->Python payloads while C++ peers speak the typed codec
+(wire.py); the server always answers in the codec the request used.
 
 Cancellation parity with gRPC deadlines: a client timeout sends CANCEL
 (async) or drops the connection (sync), and the server cancels the
@@ -35,8 +43,20 @@ from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu.core.distributed.wire import (
+    CODEC_PICKLE,
+    CODEC_TYPED,
+    PROTOCOL_VERSION,
+    typed_dumps,
+    typed_loads,
+    typed_safe,
+)
+
 MAX_FRAME = 512 * 1024 * 1024
-_HEADER = struct.Struct("<IBQ")     # length (of type+id+payload), type, id
+# length (of version+type+id+payload), version, type, id
+_HEADER = struct.Struct("<IBBQ")
+_POST_LEN = 10  # bytes counted by `length` before the payload
+
 
 REQ = 1
 RES = 2
@@ -46,37 +66,81 @@ STREAM_END = 5
 CANCEL = 6
 
 
-def _ser(obj: Any) -> bytes:
-    """Plain pickle first (RPC messages are dicts of primitives/bytes —
-    functions and user objects ride inside pre-serialized blobs),
-    cloudpickle as the fallback. ~3-5x faster on the hot path."""
+def _ser(obj: Any, codec: int = CODEC_PICKLE, safe: bool = False) -> bytes:
+    """Codec-tagged payload. Pickle (the Python<->Python default) tries
+    plain pickle first (RPC messages are dicts of primitives/bytes),
+    cloudpickle as the fallback — ~3-5x faster on the hot path. Under
+    the typed codec, `safe=True` (server REPLIES) projects exceptions
+    and foreign objects onto the cross-language model via
+    wire.typed_safe; REQUESTS stay strict so an out-of-model argument
+    raises clearly instead of silently arriving as its repr string."""
+    if codec == CODEC_TYPED:
+        return b"\x01" + typed_dumps(typed_safe(obj) if safe else obj)
     try:
-        return pickle.dumps(obj, protocol=5)
+        return b"\x00" + pickle.dumps(obj, protocol=5)
     except Exception:  # noqa: BLE001 — closures, local classes, ...
-        return cloudpickle.dumps(obj, protocol=5)
+        return b"\x00" + cloudpickle.dumps(obj, protocol=5)
+
+
+def _de_codec(data: bytes) -> Tuple[Any, int]:
+    if not data:
+        raise RpcError("empty RPC payload")
+    codec = data[0]
+    view = memoryview(data)[1:]  # zero-copy past the codec byte
+    if codec == CODEC_PICKLE:
+        return pickle.loads(view), CODEC_PICKLE
+    if codec == CODEC_TYPED:
+        try:
+            return typed_loads(view), CODEC_TYPED
+        except Exception as e:  # noqa: BLE001 — corrupt payload must
+            # surface as RpcError so client read loops classify it as
+            # a transport fault, not an unhandled crash.
+            raise RpcError(f"corrupt typed payload: {e}") from e
+    raise RpcError(f"unknown payload codec {codec}")
 
 
 def _de(data: bytes) -> Any:
-    return pickle.loads(data)
+    return _de_codec(data)[0]
 
 
 class RpcError(Exception):
     pass
 
 
+def _as_exception(err: Any) -> Exception:
+    """Error field of a reply: a real exception under the pickle codec,
+    a 'Type: message' string under the typed codec."""
+    return err if isinstance(err, Exception) else RpcError(str(err))
+
+
+class ProtocolVersionError(RpcError):
+    """Peer speaks a different protocol generation."""
+
+    def __init__(self, peer_version: int, req_id: int = 0):
+        self.peer_version = peer_version
+        self.req_id = req_id
+        super().__init__(
+            f"protocol version mismatch: peer sent v{peer_version}, "
+            f"this node speaks v{PROTOCOL_VERSION}")
+
+
 def _frame(ftype: int, req_id: int, payload: bytes) -> bytes:
-    return _HEADER.pack(9 + len(payload), ftype, req_id) + payload
+    return _HEADER.pack(_POST_LEN + len(payload), PROTOCOL_VERSION,
+                        ftype, req_id) + payload
 
 
 async def _read_frame(reader: asyncio.StreamReader
                       ) -> Tuple[int, int, bytes]:
     head = await reader.readexactly(_HEADER.size)
-    length, ftype, req_id = _HEADER.unpack(head)
-    if length < 9 or length > MAX_FRAME:
-        # < 9 would make readexactly() below receive a negative count;
-        # either way the stream is garbage and must be dropped.
+    length, version, ftype, req_id = _HEADER.unpack(head)
+    if length < _POST_LEN or length > MAX_FRAME:
+        # < _POST_LEN would make readexactly() below receive a negative
+        # count; either way the stream is garbage and must be dropped.
         raise RpcError(f"malformed frame length {length}")
-    payload = await reader.readexactly(length - 9)
+    payload = await reader.readexactly(length - _POST_LEN)
+    if version != PROTOCOL_VERSION:
+        # Frame fully consumed, so the caller may answer before closing.
+        raise ProtocolVersionError(version, req_id)
     return ftype, req_id, payload
 
 
@@ -133,17 +197,21 @@ class RpcServer:
         wlock = asyncio.Lock()
         inflight: Dict[int, asyncio.Task] = {}
 
-        async def send(ftype: int, req_id: int, obj: Any) -> None:
+        async def send(ftype: int, req_id: int, obj: Any,
+                       codec: int = CODEC_PICKLE) -> None:
             try:
-                payload = _ser(obj)
+                payload = _ser(obj, codec, safe=True)
             except Exception as e:  # noqa: BLE001
                 payload = _ser({"ok": False,
-                                "error": RpcError(f"unpicklable: {e!r}")})
+                                "error": RpcError(f"unpicklable: {e!r}")
+                                if codec == CODEC_PICKLE
+                                else f"unencodable reply: {e!r}"}, codec)
             async with wlock:
                 writer.write(_frame(ftype, req_id, payload))
                 await writer.drain()
 
-        async def run_unary(req_id: int, fn, kwargs: dict) -> None:
+        async def run_unary(req_id: int, fn, kwargs: dict,
+                            codec: int) -> None:
             try:
                 result = fn(**kwargs)
                 if inspect.isawaitable(result):
@@ -159,14 +227,15 @@ class RpcServer:
             finally:
                 inflight.pop(req_id, None)
             try:
-                await send(RES, req_id, reply)
+                await send(RES, req_id, reply, codec)
             except (ConnectionError, OSError):
                 pass  # client hung up mid-reply; nothing to tell it
 
-        async def run_stream(req_id: int, fn, kwargs: dict) -> None:
+        async def run_stream(req_id: int, fn, kwargs: dict,
+                             codec: int) -> None:
             try:
                 async for item in fn(**kwargs):
-                    await send(STREAM_ITEM, req_id, item)
+                    await send(STREAM_ITEM, req_id, item, codec)
                 end: Any = {"ok": True}
             except asyncio.CancelledError:
                 inflight.pop(req_id, None)
@@ -179,7 +248,7 @@ class RpcServer:
             finally:
                 inflight.pop(req_id, None)
             try:
-                await send(STREAM_END, req_id, end)
+                await send(STREAM_END, req_id, end, codec)
             except (ConnectionError, OSError):
                 pass
 
@@ -187,6 +256,18 @@ class RpcServer:
             while True:
                 try:
                     ftype, req_id, payload = await _read_frame(reader)
+                except ProtocolVersionError as e:
+                    # Answer with a clear typed error (the one codec a
+                    # foreign-generation peer most plausibly decodes),
+                    # then drop the connection — never unpickle bytes
+                    # from a different protocol generation.
+                    try:
+                        await send(RES, e.req_id,
+                                   {"ok": False, "error": str(e)},
+                                   CODEC_TYPED)
+                    except (ConnectionError, OSError):
+                        pass
+                    return
                 except (asyncio.IncompleteReadError, ConnectionError,
                         OSError, RpcError):
                     return
@@ -196,7 +277,7 @@ class RpcServer:
                         task.cancel()
                     continue
                 try:
-                    service, method, kwargs = _de(payload)
+                    (service, method, kwargs), codec = _de_codec(payload)
                 except Exception:  # noqa: BLE001
                     continue
                 svc = self._services.get(service)
@@ -206,10 +287,11 @@ class RpcServer:
                     await send(RES, req_id, {
                         "ok": False,
                         "error": RpcError(
-                            f"no such RPC {service}.{method}")})
+                            f"no such RPC {service}.{method}")}, codec)
                     continue
                 runner = (run_stream if ftype == STREAM_REQ else run_unary)
-                task = asyncio.ensure_future(runner(req_id, fn, kwargs))
+                task = asyncio.ensure_future(
+                    runner(req_id, fn, kwargs, codec))
                 inflight[req_id] = task
                 self._conn_tasks.add(task)
                 task.add_done_callback(self._conn_tasks.discard)
@@ -231,8 +313,9 @@ class AsyncRpcClient:
     All I/O happens on the event loop the first call runs on (one loop
     per process, the EventLoopThread)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, codec: int = CODEC_PICKLE):
         self.address = address
+        self.codec = codec
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock: Optional[asyncio.Lock] = None
@@ -310,7 +393,8 @@ class AsyncRpcClient:
 
     async def _send(self, ftype: int, req_id: int, obj: Any) -> None:
         async with self._wlock:
-            self._writer.write(_frame(ftype, req_id, _ser(obj)))
+            self._writer.write(
+                _frame(ftype, req_id, _ser(obj, self.codec)))
             await self._writer.drain()
 
     async def call(self, service: str, method: str,
@@ -327,6 +411,9 @@ class AsyncRpcClient:
             raise RpcError(
                 f"RPC {service}.{method} to {self.address} failed: "
                 f"{e!r}") from e
+        except Exception:  # encode error (e.g. WireError): not sent
+            self._pending.pop(req_id, None)
+            raise
         try:
             if timeout is not None:
                 reply = await asyncio.wait_for(fut, timeout)
@@ -350,7 +437,7 @@ class AsyncRpcClient:
                 pass
             raise
         if not reply["ok"]:
-            raise reply["error"]
+            raise _as_exception(reply.get("error"))
         return reply["result"]
 
     def stream(self, service: str, method: str,
@@ -373,9 +460,7 @@ class AsyncRpcClient:
                         yield value
                         continue
                     if not value.get("ok"):
-                        err = value.get("error")
-                        raise err if isinstance(err, Exception) \
-                            else RpcError(repr(err))
+                        raise _as_exception(value.get("error"))
                     return
             except (TimeoutError, asyncio.TimeoutError):
                 raise RpcError(
@@ -556,10 +641,10 @@ class _BlockingConn:
             if not chunk:
                 raise ConnectionError("peer closed")
             self._buf += chunk
-        length, ftype, req_id = _HEADER.unpack_from(self._buf, 0)
-        if length < 9 or length > MAX_FRAME:
+        length, version, ftype, req_id = _HEADER.unpack_from(self._buf, 0)
+        if length < _POST_LEN or length > MAX_FRAME:
             raise RpcError(f"malformed frame length {length}")
-        total = _HEADER.size + length - 9
+        total = _HEADER.size + length - _POST_LEN
         while len(self._buf) < total:
             chunk = self.sock.recv(1024 * 1024)
             if not chunk:
@@ -567,6 +652,8 @@ class _BlockingConn:
             self._buf += chunk
         payload = bytes(self._buf[_HEADER.size:total])
         del self._buf[:total]
+        if version != PROTOCOL_VERSION:
+            raise ProtocolVersionError(version, req_id)
         return ftype, req_id, payload
 
     def close(self) -> None:
@@ -585,8 +672,10 @@ class SyncRpcClient:
 
     MAX_POOL = 16
 
-    def __init__(self, address: str, loop_thread: EventLoopThread = None):
+    def __init__(self, address: str, loop_thread: EventLoopThread = None,
+                 codec: int = CODEC_PICKLE):
         self.address = address
+        self.codec = codec
         self._loop = loop_thread        # kept for API compatibility
         self._pool: list = []
         self._lock = threading.Lock()
@@ -607,7 +696,7 @@ class SyncRpcClient:
         the same rule) — unless the caller declares the method
         `idempotent=True` (reads, status polls, overwriting KV puts).
         """
-        payload = _ser((service, method, kwargs))
+        payload = _ser((service, method, kwargs), self.codec)
         with self._lock:
             self._req_id += 1
             req_id = self._req_id
@@ -690,7 +779,7 @@ class SyncRpcClient:
                 conn.close()
             self._sem.release()
         if not reply["ok"]:
-            raise reply["error"]
+            raise _as_exception(reply.get("error"))
         return reply["result"]
 
     def close(self):

@@ -33,6 +33,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional
 
 from ray_tpu.core.distributed.rpc import EventLoopThread, SyncRpcClient
+from ray_tpu.core.distributed.wire import CODEC_TYPED
 
 
 class CppFunctionError(Exception):
@@ -141,7 +142,11 @@ class CppWorker:
                                "C++ worker")
         self.address = f"127.0.0.1:{info['port']}"
         self._loop = EventLoopThread("cpp-worker")
-        self._client = SyncRpcClient(self.address, self._loop)
+        # The typed wire codec is the cross-language contract: C++
+        # workers never see pickle (wire.py; ref: the reference's
+        # proto3 cross-language seam).
+        self._client = SyncRpcClient(self.address, self._loop,
+                                     codec=CODEC_TYPED)
         self._pool = ThreadPoolExecutor(
             max_workers=max_concurrency,
             thread_name_prefix="cpp-worker-call")

@@ -109,14 +109,14 @@ class _ExecuteThenDropServer:
                         break
                     head += chunk
                 if len(head) == _HEADER.size:
-                    length, _, _ = _HEADER.unpack(head)
+                    length, _, _, _ = _HEADER.unpack(head)
                     body = b""
-                    while len(body) < length - 9:
-                        chunk = conn.recv(length - 9 - len(body))
+                    while len(body) < length - 10:
+                        chunk = conn.recv(length - 10 - len(body))
                         if not chunk:
                             break
                         body += chunk
-                    if len(body) == length - 9:
+                    if len(body) == length - 10:
                         self.executions += 1  # "handler ran"
             finally:
                 conn.close()  # ...but the reply never arrives
@@ -198,4 +198,115 @@ def test_malformed_frame_drops_connection_server_survives(loop_thread):
     assert client.call("svc", "bump", timeout=5) == 1
     bad.close()
     client.close()
+    loop_thread.run(server.stop())
+
+# ---------------------------------------------------------------------------
+# Typed codec + protocol versioning (ref: the reference's proto3 seam,
+# src/ray/protobuf/core_worker.proto — version skew and non-Python peers
+# must fail with clear errors, not deserialize crashes)
+# ---------------------------------------------------------------------------
+
+def test_typed_codec_roundtrip():
+    from ray_tpu.core.distributed.wire import (
+        WireError, typed_dumps, typed_loads, typed_safe)
+
+    cases = [None, True, False, 0, -1, 2**62, 3.5, b"\x00\xff", "héllo",
+             [1, [2, "x"]], {"k": b"v", "n": None},
+             {"nested": {"a": [1.0, False]}}]
+    for obj in cases:
+        assert typed_loads(typed_dumps(obj)) == obj
+    # tuples encode as lists (the cross-language model has no tuple)
+    assert typed_loads(typed_dumps((1, 2))) == [1, 2]
+    with pytest.raises(WireError, match="outside the typed wire model"):
+        typed_dumps(object())
+    with pytest.raises(WireError, match="int .* exceeds int64"):
+        typed_dumps(2**70)
+    with pytest.raises(WireError):
+        typed_loads(b"\xff")          # unknown tag
+    with pytest.raises(WireError):
+        typed_loads(typed_dumps([1]) + b"junk")  # trailing bytes
+    # exceptions/foreign objects project to strings for non-Python peers
+    assert typed_safe(ValueError("boom")) == "ValueError: boom"
+    assert typed_safe({"e": [KeyError("k")]}) == {"e": ["KeyError: 'k'"]}
+
+
+def test_typed_codec_end_to_end_rpc(loop_thread):
+    """A typed-codec client round-trips calls and receives errors as
+    clear strings (never a pickled Python exception)."""
+    from ray_tpu.core.distributed.wire import CODEC_TYPED
+
+    class Svc:
+        def echo(self, x):
+            return {"got": x, "n": 3}
+
+        def boom(self):
+            raise ValueError("typed boom")
+
+    server = _start_server(loop_thread, Svc())
+    client = SyncRpcClient(server.address, codec=CODEC_TYPED)
+    assert client.call("svc", "echo", x=[1, "a", b"b"]) == {
+        "got": [1, "a", b"b"], "n": 3}
+    with pytest.raises(RpcError, match="ValueError: typed boom"):
+        client.call("svc", "boom")
+    # Async client speaks typed too (codec echo covers streaming).
+    ac = AsyncRpcClient(server.address, codec=CODEC_TYPED)
+    assert loop_thread.run(ac.call("svc", "echo", x=7)) == {
+        "got": 7, "n": 3}
+    loop_thread.run(ac.close())
+    client.close()
+    loop_thread.run(server.stop())
+
+
+def test_protocol_version_mismatch_is_a_clear_error(loop_thread):
+    """A frame from a different protocol generation produces a clear
+    'protocol version mismatch' error on BOTH sides — the server never
+    unpickles it, the client never misparses the reply."""
+    from ray_tpu.core.distributed.rpc import _POST_LEN
+    from ray_tpu.core.distributed.wire import typed_loads
+
+    server = _start_server(loop_thread, Counter())
+    host, port = server.address.rsplit(":", 1)
+
+    # Hand-craft a v99 REQ frame.
+    payload = b"\x01" + b"\x00"  # typed codec, None body (irrelevant)
+    frame = _HEADER.pack(_POST_LEN + len(payload), 99, 1, 7) + payload
+    with socket.create_connection((host, int(port)), timeout=10) as s:
+        s.sendall(frame)
+        # Server answers with a typed error RES, then closes.
+        head = b""
+        while len(head) < _HEADER.size:
+            chunk = s.recv(_HEADER.size - len(head))
+            assert chunk, "server closed without answering"
+            head += chunk
+        length, version, ftype, req_id = _HEADER.unpack(head)
+        body = b""
+        while len(body) < length - _POST_LEN:
+            body += s.recv(4096)
+        assert ftype == 2 and req_id == 7
+        assert body[0] == 1  # typed codec
+        reply = typed_loads(body[1:])
+        assert reply["ok"] is False
+        assert "protocol version mismatch" in reply["error"]
+        assert "v99" in reply["error"]
+
+    # Client side: a server speaking another version yields the same
+    # clear error instead of a deserialize crash.
+    def bad_server(sock):
+        conn, _ = sock.accept()
+        with conn:
+            conn.recv(1 << 16)
+            bad = _HEADER.pack(_POST_LEN + 1, 42, 2, 1) + b"\x00"
+            conn.sendall(bad)
+            time.sleep(0.2)
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    t = threading.Thread(target=bad_server, args=(lsock,), daemon=True)
+    t.start()
+    client = SyncRpcClient(f"127.0.0.1:{lsock.getsockname()[1]}")
+    with pytest.raises(RpcError, match="protocol version mismatch"):
+        client.call("svc", "get", timeout=5)
+    client.close()
+    lsock.close()
     loop_thread.run(server.stop())
