@@ -13,14 +13,8 @@ _proxy_handle = None
 _proxy_port: Optional[int] = None
 
 
-def run(app: Application | Deployment, *, name: str = "default",
-        route_prefix: Optional[str] = "/", blocking: bool = False,
-        _http: bool = False) -> DeploymentHandle:
-    """Deploy an application; returns a handle (ref: serve/api.py:537)."""
-    if isinstance(app, Deployment):
-        app = app.bind()
-    dep = app.deployment
-    controller = get_or_create_controller()
+def _deploy_one(controller, name: str, dep: Deployment, init_args,
+                init_kwargs) -> None:
     cfg = {
         "num_replicas": dep.config.num_replicas,
         "max_ongoing_requests": dep.config.max_ongoing_requests,
@@ -30,8 +24,90 @@ def run(app: Application | Deployment, *, name: str = "default",
             if dep.config.autoscaling_config else None),
     }
     ray_tpu.get(controller.deploy.remote(
-        name, dep.func_or_class, app.init_args, app.init_kwargs, cfg),
+        name, dep.func_or_class, init_args, init_kwargs, cfg),
         timeout=60)
+
+
+def _deploy_graph(controller, app: Application, name: str) -> None:
+    """Deployment-graph composition (ref: serve/_private/
+    deployment_graph_build.py:1, serve/dag.py): an Application whose
+    init args contain OTHER bound Applications is a DAG with `app` as
+    the ingress node. Children deploy first (post-order) under
+    '{name}#{deployment}' and each graph edge is replaced by a
+    DeploymentHandle, so a request to the ingress flows through the
+    whole graph via ordinary handle calls."""
+    deployed = {}          # id(Application) -> deployed app name
+    on_stack = set()       # cycle detection
+    used_names = {name}
+
+    def child_name(dep_name: str) -> str:
+        base = f"{name}#{dep_name}"
+        cand, k = base, 2
+        while cand in used_names:
+            cand = f"{base}~{k}"
+            k += 1
+        used_names.add(cand)
+        return cand
+
+    def convert(v):
+        if isinstance(v, Application):
+            return DeploymentHandle(deploy_node(v))
+        if isinstance(v, Deployment):
+            raise TypeError(
+                f"deployment {v.name!r} passed unbound into a graph — "
+                f"pass {v.name}.bind(...) nodes, not bare Deployments")
+        if isinstance(v, (list, tuple)):
+            vals = [convert(x) for x in v]
+            if hasattr(v, "_fields"):       # namedtuple: positional ctor
+                return type(v)(*vals)
+            return type(v)(vals)
+        if isinstance(v, dict):
+            return {k: convert(x) for k, x in v.items()}
+        return v
+
+    def deploy_node(node: Application) -> str:
+        if id(node) in deployed:
+            return deployed[id(node)]       # shared node: deploy once
+        if id(node) in on_stack:
+            raise ValueError("cycle in the deployment graph")
+        on_stack.add(id(node))
+        try:
+            args = tuple(convert(a) for a in node.init_args)
+            kwargs = {k: convert(v) for k, v in node.init_kwargs.items()}
+        finally:
+            on_stack.discard(id(node))
+        node_name = (name if node is app
+                     else child_name(node.deployment.name))
+        _deploy_one(controller, node_name, node.deployment, args, kwargs)
+        deployed[id(node)] = node_name
+        return node_name
+
+    deploy_node(app)
+    # Declarative reconcile: children from a PREVIOUS graph under this
+    # name that the new graph no longer contains must not leak replicas.
+    try:
+        live = ray_tpu.get(controller.list_applications.remote(),
+                           timeout=30)
+    except Exception:  # noqa: BLE001
+        live = []
+    for a in live:
+        if a.startswith(name + "#") and a not in used_names:
+            ray_tpu.get(controller.delete_app.remote(a), timeout=30)
+
+
+def run(app: Application | Deployment, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _http: bool = False) -> DeploymentHandle:
+    """Deploy an application (possibly a graph of bound deployments —
+    see _deploy_graph); returns a handle (ref: serve/api.py:537)."""
+    if "#" in name:
+        raise ValueError(
+            f"app name {name!r} may not contain '#' (reserved for "
+            f"deployment-graph child namespacing)")
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = get_or_create_controller()
+    _deploy_graph(controller, app, name)
     # wait for at least one replica
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
@@ -122,8 +198,17 @@ def status() -> Dict[str, dict]:
 
 
 def delete(app_name: str) -> None:
+    """Delete an app AND its deployment-graph children (named
+    '{app}#...')."""
     controller = get_or_create_controller()
-    ray_tpu.get(controller.delete_app.remote(app_name), timeout=30)
+    apps = ray_tpu.get(controller.list_applications.remote(), timeout=30)
+    doomed = [a for a in apps
+              if a == app_name or a.startswith(app_name + "#")]
+    # Ingress first: once it is gone no request can route into the
+    # children, so their teardown never strands an in-flight call.
+    doomed.sort(key=lambda a: (a != app_name, a))
+    for a in doomed:
+        ray_tpu.get(controller.delete_app.remote(a), timeout=30)
 
 
 def shutdown() -> None:

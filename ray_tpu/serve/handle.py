@@ -58,6 +58,14 @@ class DeploymentResponse:
         return self._ref
 
 
+def _rebuild_handle(app: str, method: str, model_id, stream
+                    ) -> "DeploymentHandle":
+    h = DeploymentHandle(app, method)
+    h._model_id = model_id
+    h._stream = bool(stream)
+    return h
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, method_name: str = "__call__"):
         self._app = app_name
@@ -75,6 +83,16 @@ class DeploymentHandle:
         # model_id -> replica name that recently served it (multiplexed
         # locality, ref: pow_2_scheduler.py multiplex-aware candidates).
         self._model_affinity: Dict[str, str] = {}
+
+    def __reduce__(self):
+        # Handles cross process boundaries by RECONSTRUCTION, not state
+        # copy: a replica receiving a handle as an init arg (deployment
+        # graph composition) resolves the controller and routing table
+        # in its own process (ref: serve handles pickle the same way).
+        # Options set via .options() (model affinity, streaming) are
+        # part of the handle's contract and must survive the trip.
+        return (_rebuild_handle, (self._app, self._method,
+                                  self._model_id, self._stream))
 
     # handle.method_name.remote(...) sugar
     def __getattr__(self, item):

@@ -1,0 +1,23 @@
+"""Importable builder for the config-deploy graph test: returns a
+two-stage Application graph (preprocess -> model)."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Cleaner:
+    def __call__(self, text):
+        return text.strip().lower()
+
+
+@serve.deployment
+class Decorator:
+    def __init__(self, cleaner, suffix):
+        self.cleaner = cleaner
+        self.suffix = suffix
+
+    def __call__(self, text):
+        return self.cleaner.remote(text).result(timeout=30) + self.suffix
+
+
+def build():
+    return Decorator.bind(Cleaner.bind(), "?")

@@ -208,3 +208,108 @@ def test_declarative_config_deploy(tmp_path):
         serve.delete("upper_built")
     finally:
         os.unlink(mod_path)
+
+
+# ---------------------------------------------------------------------------
+# Deployment-graph composition (ref: serve/_private/
+# deployment_graph_build.py:1, serve/dag.py — an app built from a DAG of
+# bound deployments with an ingress node)
+# ---------------------------------------------------------------------------
+
+def test_deployment_graph_two_stage():
+    """preprocess -> model from ONE graph object: serve.run deploys
+    both nodes and wires the handle edge; a request to the ingress
+    flows through both stages."""
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, text):
+            return text.strip().lower()
+
+    @serve.deployment
+    class Model:
+        def __init__(self, preproc, suffix):
+            self.preproc = preproc          # a DeploymentHandle
+            self.suffix = suffix
+
+        def __call__(self, text):
+            clean = self.preproc.remote(text).result(timeout=30)
+            return clean + self.suffix
+
+    graph = Model.bind(Preprocessor.bind(), "!")
+    h = serve.run(graph, name="two_stage")
+    assert h.remote("  HeLLo ").result(timeout=60) == "hello!"
+    # Both nodes are live apps; the child is namespaced under the app.
+    st = serve.status()
+    assert "two_stage" in st and "two_stage#Preprocessor" in st
+    # delete() tears down the whole graph.
+    serve.delete("two_stage")
+    st = serve.status()
+    assert "two_stage" not in st and "two_stage#Preprocessor" not in st
+
+
+def test_deployment_graph_shared_node_deploys_once():
+    """A diamond: two stages share one child node object — it deploys
+    exactly once and both edges route to it."""
+    @serve.deployment
+    class Shared:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Left:
+        def __init__(self, shared):
+            self.shared = shared
+
+        def __call__(self, x):
+            return self.shared.remote(x).result(timeout=30) * 10
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, left, shared):
+            self.left = left
+            self.shared = shared
+
+        def __call__(self, x):
+            a = self.left.remote(x).result(timeout=30)
+            b = self.shared.remote(x).result(timeout=30)
+            return a + b
+
+    shared = Shared.bind()
+    graph = Ingress.bind(Left.bind(shared), shared)
+    h = serve.run(graph, name="diamond")
+    # left: (x+1)*10, shared: x+1 -> (x+1)*11
+    assert h.remote(4).result(timeout=60) == 55
+    st = serve.status()
+    shared_apps = [a for a in st if a.startswith("diamond#Shared")]
+    assert len(shared_apps) == 1        # deployed once, not twice
+    serve.delete("diamond")
+
+
+def test_deployment_graph_cycle_rejected():
+    @serve.deployment
+    class A:
+        def __init__(self, other=None):
+            pass
+
+    app_a = A.bind()
+    app_a.init_args = (app_a,)          # self-cycle
+    with pytest.raises(ValueError, match="cycle"):
+        serve.run(app_a, name="cyclic")
+
+
+def test_config_deploy_supports_graphs(tmp_path):
+    """The declarative config path deploys a builder-returned graph."""
+    import json as _json
+
+    import tests.serve_graph_app  # noqa: F401  (importable builder)
+
+    cfg = {"applications": [{
+        "name": "cfg_graph",
+        "import_path": "tests.serve_graph_app:build",
+    }]}
+    p = tmp_path / "app.json"
+    p.write_text(_json.dumps(cfg))
+    handles = serve.deploy_config(str(p))
+    assert handles["cfg_graph"].remote(
+        " ABC ").result(timeout=60) == "abc?"
+    serve.delete("cfg_graph")
