@@ -49,21 +49,41 @@ def _deploy_graph(controller, app: Application, name: str) -> None:
         used_names.add(cand)
         return cand
 
+    def contains_node(v) -> bool:
+        if isinstance(v, (Application, Deployment)):
+            return True
+        if isinstance(v, (list, tuple, set, frozenset)):
+            return any(contains_node(x) for x in v)
+        if isinstance(v, dict):
+            return any(contains_node(x)
+                       for kv in v.items() for x in kv)
+        return False
+
     def convert(v):
+        # Values with NO graph nodes pass through UNTOUCHED — plain
+        # apps (the common path) must not have their defaultdicts/
+        # OrderedDicts/custom containers quietly rebuilt as plain types.
+        if not contains_node(v):
+            return v
         if isinstance(v, Application):
             return DeploymentHandle(deploy_node(v))
         if isinstance(v, Deployment):
             raise TypeError(
                 f"deployment {v.name!r} passed unbound into a graph — "
                 f"pass {v.name}.bind(...) nodes, not bare Deployments")
-        if isinstance(v, (list, tuple)):
+        if type(v) in (list, tuple) or hasattr(v, "_fields"):
             vals = [convert(x) for x in v]
             if hasattr(v, "_fields"):       # namedtuple: positional ctor
                 return type(v)(*vals)
             return type(v)(vals)
-        if isinstance(v, dict):
-            return {k: convert(x) for k, x in v.items()}
-        return v
+        if type(v) in (set, frozenset):
+            return type(v)(convert(x) for x in v)
+        if type(v) is dict:
+            return {convert(k): convert(x) for k, x in v.items()}
+        raise TypeError(
+            f"graph nodes inside a {type(v).__name__} init arg are not "
+            f"supported — pass bound deployments in plain "
+            f"list/tuple/dict/set containers")
 
     def deploy_node(node: Application) -> str:
         if id(node) in deployed:

@@ -5,14 +5,14 @@ ASHA / median-stopping / PBT schedulers, shared session+checkpoint
 machinery with ray_tpu.train.
 """
 from ray_tpu.train.session import get_checkpoint, report
-from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
-                                     HyperBandScheduler,
+from ray_tpu.tune.schedulers import (PB2, AsyncHyperBandScheduler,
+                                     FIFOScheduler, HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
-                                 Searcher, TPESearcher, choice, grid_search,
-                                 loguniform, quniform, randint, sample_from,
-                                 uniform)
+from ray_tpu.tune.search import (AskTellSearcher, BasicVariantGenerator,
+                                 ConcurrencyLimiter, Searcher, TPESearcher,
+                                 choice, grid_search, loguniform, quniform,
+                                 randint, sample_from, uniform)
 from ray_tpu.tune.callbacks import (Callback, CSVLoggerCallback,
                                     JsonLoggerCallback,
                                     MLflowLoggerCallback,
@@ -29,6 +29,7 @@ __all__ = [
     "FIFOScheduler", "AsyncHyperBandScheduler", "ASHAScheduler",
     "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
     "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "AskTellSearcher", "PB2",
     "ConcurrencyLimiter",
     "Callback", "JsonLoggerCallback", "CSVLoggerCallback",
     "WandbLoggerCallback", "MLflowLoggerCallback",

@@ -161,6 +161,112 @@ class PopulationBasedTraining(FIFOScheduler):
         return out
 
 
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (ref: tune/schedulers/pb2.py; Parker-
+    Holder et al., NeurIPS 2020): PBT where the EXPLORE step is chosen
+    by a time-varying GP-UCB bandit over the continuous hyperparameters
+    instead of random perturbation — data-efficient with small
+    populations, where random mutations mostly wander.
+
+    Every reported result contributes a datapoint (hyperparams, time,
+    reward change); on exploit, the victim copies a top trial's weights
+    and its new hyperparams maximize the GP's upper confidence bound
+    over `hyperparam_bounds` (a {key: (low, high)} dict — PB2 is for
+    continuous axes; non-bounded keys pass through unchanged).
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Dict[str, tuple],
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0, n_candidates: int = 64,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds "
+                             "{key: (low, high)}")
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._keys = sorted(self.bounds)
+        self._cfgs: Dict[str, dict] = {}      # trial -> live config
+        self._prev: Dict[str, tuple] = {}     # trial -> (t, metric)
+        self._rows: List[tuple] = []          # (xvec, t, reward_delta)
+        self._max_rows = 256
+
+    # Tuner hook: fires on every (re)launch, including post-exploit.
+    def on_trial_config(self, trial_id: str, config: dict) -> None:
+        self._cfgs[trial_id] = dict(config)
+        self._prev.pop(trial_id, None)        # new lineage, new deltas
+
+    def _xvec(self, config: dict) -> List[float]:
+        out = []
+        for k in self._keys:
+            lo, hi = self.bounds[k]
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        y = result.get(self.metric)
+        cfg = self._cfgs.get(trial_id)
+        if t is not None and y is not None and cfg is not None:
+            prev = self._prev.get(trial_id)
+            if prev is not None and t > prev[0]:
+                dy = (y - prev[1]) / (t - prev[0])
+                if self.mode == "min":
+                    dy = -dy
+                self._rows.append((self._xvec(cfg), float(t), dy))
+                if len(self._rows) > self._max_rows:
+                    self._rows = self._rows[-self._max_rows:]
+            self._prev[trial_id] = (t, y)
+        return super().on_result(trial_id, result)
+
+    # -- the bandit: GP-UCB over (hyperparams, time) --------------------
+    def mutate(self, config: dict) -> dict:
+        import numpy as np
+
+        out = dict(config)
+        rng = np.random.default_rng(self.rng.randrange(2 ** 31))
+        cand = rng.uniform(size=(self.n_candidates, len(self._keys)))
+        if len(self._rows) >= 4:
+            X = np.array([r[0] for r in self._rows])
+            ts = np.array([r[1] for r in self._rows])
+            y = np.array([r[2] for r in self._rows])
+            t_scale = max(1.0, float(ts.max()))
+            Xt = np.hstack([X, (ts / t_scale)[:, None]])
+            y_std = y.std() or 1.0
+            yn = (y - y.mean()) / y_std
+            ls = 0.3
+            now = (ts.max() / t_scale)
+            Ct = np.hstack([cand, np.full((len(cand), 1), now)])
+
+            def k(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = k(Xt, Xt) + 1e-2 * np.eye(len(Xt))
+            Ks = k(Ct, Xt)
+            alpha = np.linalg.solve(K, yn)
+            mu = Ks @ alpha
+            v = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - (Ks * v.T).sum(1), 1e-9, None)
+            score = mu + self.kappa * np.sqrt(var)
+            best = cand[int(score.argmax())]
+        else:
+            best = cand[0]                    # cold start: random
+        for i, key in enumerate(self._keys):
+            lo, hi = self.bounds[key]
+            out[key] = lo + float(best[i]) * (hi - lo)
+        return out
+
+
 class HyperBandScheduler(FIFOScheduler):
     """Synchronous successive halving (ref: hyperband.py HyperBand — one
     bracket, simplified): every live trial PAUSES at each rung milestone;

@@ -173,6 +173,61 @@ class BasicVariantGenerator(Searcher):
         return self._random_config()
 
 
+class AskTellSearcher(Searcher):
+    """Adapter for external ask/tell optimizers (ref: the role the
+    Optuna/Ax/BayesOpt adapters fill, tune/search/optuna/
+    optuna_search.py:1 — each wraps a library behind the Searcher
+    surface; this is the ONE seam they all reduce to).
+
+    The wrapped optimizer needs exactly two methods:
+
+        ask() -> config dict            (next point to evaluate)
+        tell(config, value) -> None     (observed objective; maximized)
+
+    The adapter handles metric extraction, min/max sign, and config
+    bookkeeping per trial, so a scikit-optimize/nevergrad/CMA-style
+    optimizer plugs into the Tuner in ~5 lines.
+    """
+
+    def __init__(self, optimizer: Any):
+        for attr in ("ask", "tell"):
+            if not callable(getattr(optimizer, attr, None)):
+                raise TypeError(
+                    f"ask/tell optimizer needs a callable {attr}()")
+        self._opt = optimizer
+        self._live: Dict[str, Dict[str, Any]] = {}
+
+    def set_space(self, param_space, metric, mode, seed=None) -> None:
+        if metric is None:
+            # Without a metric, tell() would never fire and the
+            # optimizer silently degrades to random — misconfiguration,
+            # not a mode.
+            raise ValueError(
+                "AskTellSearcher needs TuneConfig.metric set — the "
+                "wrapped optimizer learns from tell(config, value)")
+        super().set_space(param_space, metric, mode, seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        cfg = self._opt.ask()
+        if cfg is None:
+            return None                 # optimizer exhausted
+        cfg = dict(cfg)
+        self._live[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None:
+            return
+        value = (result or {}).get(self.metric)
+        if value is None:
+            return                      # failed trial: nothing to learn
+        if self.mode == "min":
+            value = -value
+        self._opt.tell(cfg, float(value))
+
+
 class TPESearcher(Searcher):
     """Native adaptive searcher in the TPE spirit (ref: the role Optuna's
     TPE fills behind search/optuna.py): after `n_initial` random trials,

@@ -249,6 +249,9 @@ class Tuner:
                 timeout=60)
             trial.state = "RUNNING"
             running.append(trial)
+            # Config-aware schedulers (PB2's bandit) hear every (re)launch.
+            if hasattr(scheduler, "on_trial_config"):
+                scheduler.on_trial_config(trial.trial_id, trial.config)
 
         def fill_slots():
             nonlocal spawned

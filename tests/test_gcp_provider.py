@@ -229,3 +229,188 @@ def test_detached_up_then_down(tmp_path):
             return
         time.sleep(0.5)
     raise AssertionError("detached launcher still running after down")
+
+
+# ---------------------------------------------------------------------------
+# GcpApiTransport — the REAL REST path, driven against canned HTTP
+# (zero egress; ref: the reference tests its provider against a mocked
+# cloud surface, autoscaler/batching_node_provider.py pattern)
+# ---------------------------------------------------------------------------
+
+class _CannedHttp:
+    """urllib.request.urlopen stand-in: records every Request, serves
+    canned JSON, optionally raising HTTPError for matching URLs."""
+
+    def __init__(self):
+        self.requests = []
+        self.token_payload = {"access_token": "tok-123",
+                              "expires_in": 3600}
+        self.responses = {}   # substring -> dict (canned body)
+        self.errors = {}      # substring -> (code, body)
+
+    def __call__(self, req, timeout=None):
+        import io
+        import json as _json
+        import urllib.error
+
+        url = req.full_url
+        self.requests.append(req)
+        for frag, (code, body) in self.errors.items():
+            if frag in url:
+                raise urllib.error.HTTPError(
+                    url, code, "error", hdrs=None,
+                    fp=io.BytesIO(_json.dumps(body).encode()))
+        if "metadata.google.internal" in url:
+            payload = self.token_payload
+        else:
+            payload = {}
+            for frag, body in self.responses.items():
+                if frag in url:
+                    payload = body
+                    break
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return _Resp(_json.dumps(payload).encode())
+
+
+@pytest.fixture()
+def canned_http(monkeypatch):
+    import urllib.request
+
+    fake = _CannedHttp()
+    monkeypatch.setattr(urllib.request, "urlopen", fake)
+    return fake
+
+
+def test_api_transport_url_body_auth(canned_http):
+    """URL base selection (TPU vs compute roots), bearer-token auth from
+    the metadata server, JSON body encoding, and token caching."""
+    import json as _json
+
+    from ray_tpu.autoscaler.gcp import GcpApiTransport
+
+    t = GcpApiTransport()
+    t.request("POST", "projects/p/locations/z/nodes?nodeId=n1",
+              {"acceleratorType": "v5litepod-16"})
+
+    token_req, api_req = canned_http.requests
+    assert "metadata.google.internal" in token_req.full_url
+    assert token_req.headers["Metadata-flavor"] == "Google"
+    assert api_req.full_url == ("https://tpu.googleapis.com/v2/"
+                                "projects/p/locations/z/nodes?nodeId=n1")
+    assert api_req.get_method() == "POST"
+    assert api_req.headers["Authorization"] == "Bearer tok-123"
+    assert api_req.headers["Content-type"] == "application/json"
+    assert _json.loads(api_req.data.decode()) == {
+        "acceleratorType": "v5litepod-16"}
+
+    # Compute root for plain instances; GET carries no body; the cached
+    # token is reused (no second metadata hit).
+    t.request("GET", "projects/p/zones/z/instances")
+    assert len(canned_http.requests) == 3
+    vm_req = canned_http.requests[-1]
+    assert vm_req.full_url.startswith(
+        "https://compute.googleapis.com/compute/v1/projects/p/zones/")
+    assert vm_req.data is None
+
+
+def test_api_transport_token_refresh_on_expiry(canned_http):
+    from ray_tpu.autoscaler.gcp import GcpApiTransport
+
+    canned_http.token_payload = {"access_token": "tok-old",
+                                 "expires_in": 0}   # expires instantly
+    t = GcpApiTransport()
+    t.request("GET", "projects/p/zones/z/instances")
+    canned_http.token_payload = {"access_token": "tok-new",
+                                 "expires_in": 3600}
+    t.request("GET", "projects/p/zones/z/instances")
+    metadata_hits = [r for r in canned_http.requests
+                     if "metadata" in r.full_url]
+    assert len(metadata_hits) == 2          # expired token re-fetched
+    assert canned_http.requests[-1].headers["Authorization"] \
+        == "Bearer tok-new"
+
+
+def test_provider_quota_and_stockout_errors(canned_http):
+    """Cloud-side failures (quota 403, slice stockout 429) surface to
+    the caller AND leave no phantom instance in the provider view."""
+    import urllib.error
+
+    from ray_tpu.autoscaler.gcp import GcpApiTransport, GcpTpuNodeProvider
+
+    t = GcpApiTransport()
+    provider = GcpTpuNodeProvider("c", "p", "z", t)
+
+    canned_http.errors["/nodes"] = (429, {"error": {
+        "status": "RESOURCE_EXHAUSTED",
+        "message": "No v5litepod-16 capacity in zone z"}})
+    with pytest.raises(urllib.error.HTTPError):
+        provider.create_node("tpu_worker",
+                             {"accelerator_type": "v5litepod-16"})
+    canned_http.errors.clear()
+    canned_http.errors["/instances"] = (403, {"error": {
+        "status": "QUOTA_EXCEEDED", "message": "CPUS quota exceeded"}})
+    with pytest.raises(urllib.error.HTTPError):
+        provider.create_node("cpu_worker", {"machine_type": "n2-standard-8"})
+    canned_http.errors.clear()
+    # Failed creations never became tracked instances.
+    assert provider.non_terminated_nodes() == {}
+
+
+def test_provider_list_failure_falls_back_to_cached_view(canned_http):
+    """A cloud list outage (500) must not wipe the autoscaler's view —
+    the provider serves its cached instances instead (the reference's
+    batching provider has the same resilience seam)."""
+    from ray_tpu.autoscaler.gcp import GcpApiTransport, GcpTpuNodeProvider
+
+    t = GcpApiTransport()
+    provider = GcpTpuNodeProvider("c", "p", "z", t)
+    iid = provider.create_node("tpu_worker",
+                               {"accelerator_type": "v5litepod-16"})
+    canned_http.errors["/nodes"] = (500, {"error": {"message": "boom"}})
+    view = provider.non_terminated_nodes()
+    assert iid in view                      # cached, not lost
+    canned_http.errors.clear()
+    # Recovered cloud now reports nothing with our label: the provider
+    # reconciles the (preempted) node away.
+    canned_http.responses["/nodes"] = {"nodes": []}
+    canned_http.responses["/instances"] = {"items": []}
+    assert provider.non_terminated_nodes() == {}
+
+
+def test_provider_terminate_rollback_paths(canned_http):
+    """Terminate hits the right API root per node kind, and a DELETE
+    failure (already-gone node) does not resurrect the instance."""
+    import urllib.error
+
+    from ray_tpu.autoscaler.gcp import GcpApiTransport, GcpTpuNodeProvider
+
+    t = GcpApiTransport()
+    provider = GcpTpuNodeProvider("c", "p", "z", t)
+    tpu_id = provider.create_node("tpu_worker",
+                                  {"accelerator_type": "v5litepod-16"})
+    vm_id = provider.create_node("cpu_worker", {})
+    provider.terminate_node(tpu_id)
+    provider.terminate_node(vm_id)
+    deletes = [r for r in canned_http.requests
+               if r.get_method() == "DELETE"]
+    assert f"locations/z/nodes/{tpu_id}" in deletes[0].full_url
+    assert f"zones/z/instances/{vm_id}" in deletes[1].full_url
+
+    # Partial-failure rollback: already-deleted-on-cloud (404) keeps the
+    # local view consistent (instance stays dropped).
+    iid = provider.create_node("cpu_worker", {})
+    canned_http.errors["/instances"] = (404, {"error": {
+        "message": "not found"}})
+    with pytest.raises(urllib.error.HTTPError):
+        provider.terminate_node(iid)
+    canned_http.errors.clear()
+    canned_http.responses["/nodes"] = {"nodes": []}
+    canned_http.responses["/instances"] = {"items": []}
+    assert iid not in provider.non_terminated_nodes()
