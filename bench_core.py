@@ -9,6 +9,7 @@ Usage: python bench_core.py [--quick]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -27,13 +28,22 @@ BASELINE = {
 
 
 def emit(metric: str, value: float, unit: str) -> None:
+    """ops/s headline + µs/op: per-op CPU cost is the host-size-neutral
+    number (the recorded baseline ran on 64 vCPUs; this box has
+    len(sched_getaffinity) — ratios of ops/s conflate the two)."""
     base = BASELINE.get(metric)
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / base, 3) if base else None,
-    }), flush=True)
+    }
+    if unit.endswith("/s") and value > 0 and "gigabytes" not in metric:
+        rec["us_per_op"] = round(1e6 / value, 1)
+        if base:
+            rec["baseline_us_per_op"] = round(1e6 / base, 1)
+    rec["host_cpus"] = len(os.sched_getaffinity(0))
+    print(json.dumps(rec), flush=True)
 
 
 def timeit(fn, number: int) -> float:

@@ -27,7 +27,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny")
     ap.add_argument("--requests", type=int, default=64)
-    ap.add_argument("--concurrency", type=int, default=16)
+    # TTFT is only interpretable when every in-flight request holds an
+    # engine slot: at concurrency > num_slots half the requests queue
+    # behind slot admission and p50 TTFT measures queueing, not prefill
+    # (round-3 artifact pitfall). Default concurrency == num_slots;
+    # push it higher only to measure saturation throughput.
+    ap.add_argument("--concurrency", type=int, default=None,
+                    help="default: num-slots (admission-free TTFT)")
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--num-slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=256)
@@ -35,6 +41,8 @@ def main() -> None:
                     help="also write a committed artifact JSON "
                          "(metrics + engine config + host context)")
     args = ap.parse_args()
+    if args.concurrency is None:
+        args.concurrency = args.num_slots
 
     import os
 
@@ -150,6 +158,12 @@ def main() -> None:
                 "max_len": args.max_len, "max_tokens": args.max_tokens,
                 "requests": args.requests,
                 "concurrency": args.concurrency,
+                "ttft_regime": (
+                    "admission-free (concurrency <= num_slots): TTFT "
+                    "measures prefill" if args.concurrency
+                    <= args.num_slots else
+                    "saturated (concurrency > num_slots): TTFT "
+                    "includes slot-admission queueing"),
                 "path": ("async HTTP proxy, chunked token streaming, "
                          "continuous-batching engine; prefill/decode "
                          "compiled once per replica and reused across "

@@ -35,10 +35,13 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import pickle
+import random
 import socket
 import struct
 import threading
+import time as _time
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
@@ -105,6 +108,36 @@ def _de(data: bytes) -> Any:
 
 class RpcError(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Schedule-perturbation harness (race detection for the control plane)
+# ---------------------------------------------------------------------------
+#
+# The reference catches ordering bugs in its C++ control plane with
+# TSAN + randomized test schedules; our control plane is asyncio, where
+# the realistic race surface is MESSAGE TIMING — actor seqnos, lease
+# time-slicing, pubsub and pull-manager ordering all depend on when
+# frames land relative to each other. With RAY_TPU_SCHED_FUZZ_MAX_MS
+# set, every frame send sleeps a seeded pseudo-random delay first,
+# perturbing cross-process interleavings the way a loaded host does —
+# but reproducibly (RAY_TPU_SCHED_FUZZ_SEED, xor'd with the pid so each
+# process gets a distinct stream). Child daemons inherit the env, so
+# one setting fuzzes the whole cluster. Anything that breaks under it
+# is a latent race, not a harness artifact: networks already reorder.
+
+_fuzz_rng: Optional[random.Random] = None
+
+
+def _sched_fuzz_delay() -> float:
+    max_ms = os.environ.get("RAY_TPU_SCHED_FUZZ_MAX_MS")
+    if not max_ms:
+        return 0.0
+    global _fuzz_rng
+    if _fuzz_rng is None:
+        seed = int(os.environ.get("RAY_TPU_SCHED_FUZZ_SEED", "0"))
+        _fuzz_rng = random.Random(seed ^ os.getpid())
+    return _fuzz_rng.random() * float(max_ms) / 1000.0
 
 
 def _as_exception(err: Any) -> Exception:
@@ -206,6 +239,9 @@ class RpcServer:
                                 "error": RpcError(f"unpicklable: {e!r}")
                                 if codec == CODEC_PICKLE
                                 else f"unencodable reply: {e!r}"}, codec)
+            d = _sched_fuzz_delay()
+            if d:
+                await asyncio.sleep(d)
             async with wlock:
                 writer.write(_frame(ftype, req_id, payload))
                 await writer.drain()
@@ -392,6 +428,9 @@ class AsyncRpcClient:
                     pass
 
     async def _send(self, ftype: int, req_id: int, obj: Any) -> None:
+        d = _sched_fuzz_delay()
+        if d:
+            await asyncio.sleep(d)
         async with self._wlock:
             self._writer.write(
                 _frame(ftype, req_id, _ser(obj, self.codec)))
@@ -622,6 +661,9 @@ class _BlockingConn:
 
     def send_request(self, req_id: int, payload: bytes,
                      timeout: Optional[float]) -> None:
+        d = _sched_fuzz_delay()
+        if d:
+            _time.sleep(d)
         self.sock.settimeout(timeout)
         self.sock.sendall(_frame(REQ, req_id, payload))
 

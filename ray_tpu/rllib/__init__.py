@@ -15,6 +15,14 @@ evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
   analogue of LearnerGroup weight sync (core/learner/learner_group.py:60).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.connectors import (
+    ActionClip,
+    Connector,
+    ConnectorPipeline,
+    ObsClip,
+    ObsNormalizer,
+    RewardScale,
+)
 from ray_tpu.rllib.core import (
     DiscreteQModule,
     Learner,
@@ -28,7 +36,9 @@ from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.env import register_env
 from ray_tpu.rllib.offline import (
     BC,
+    MARWIL,
     BCConfig,
+    MARWILConfig,
     SampleWriter,
     read_samples,
     record_rollouts,
@@ -44,6 +54,12 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "ActionClip",
+    "Connector",
+    "ConnectorPipeline",
+    "ObsClip",
+    "ObsNormalizer",
+    "RewardScale",
     "DiscreteQModule",
     "Learner",
     "LearnerGroup",
@@ -62,6 +78,8 @@ __all__ = [
     "CQLConfig",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "SampleWriter",
     "read_samples",
     "record_rollouts",

@@ -29,6 +29,11 @@ class AlgorithmConfig:
         self.learner_mesh = None  # jax Mesh with a "dp" axis, or None
         self.num_learners = 0     # 0 = single inline learner
         self.remote_learners = False
+        # Connector factories (ref: rllib/connectors/connector_v2.py;
+        # see ray_tpu/rllib/connectors.py). Called once per rollout/eval
+        # worker; each worker owns its connector instance + state.
+        self.env_to_module_connector = None   # () -> Connector
+        self.module_to_env_connector = None   # () -> Connector
         self.evaluation_interval = 0          # iterations; 0 = disabled
         self.evaluation_num_env_runners = 0   # 0 = evaluate locally
         self.evaluation_duration = 5          # episodes per evaluation
@@ -41,7 +46,9 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
-                    num_cpus_per_env_runner: Optional[float] = None
+                    num_cpus_per_env_runner: Optional[float] = None,
+                    env_to_module_connector: Optional[Callable] = None,
+                    module_to_env_connector: Optional[Callable] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -51,7 +58,30 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if num_cpus_per_env_runner is not None:
             self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
+
+    def _worker_connectors(self) -> dict:
+        """Fresh connector instances for one worker (factories may
+        return a single Connector or a list to pipeline)."""
+        from ray_tpu.rllib.connectors import Connector, ConnectorPipeline
+
+        def make(factory):
+            if factory is None:
+                return None
+            c = factory()
+            if isinstance(c, (list, tuple)):
+                c = ConnectorPipeline(list(c))
+            if not isinstance(c, Connector):
+                raise TypeError("connector factory must return a "
+                                "Connector (or list of them)")
+            return c
+
+        return {"obs_connector": make(self.env_to_module_connector),
+                "action_connector": make(self.module_to_env_connector)}
 
     def training(self, **kwargs) -> "AlgorithmConfig":
         for k, v in kwargs.items():
@@ -140,7 +170,8 @@ class Algorithm:
                 cls.remote(config.env,
                            num_envs=config.num_envs_per_env_runner,
                            seed=config.seed + 1000 * (i + 1),
-                           bootstrap_gamma=gamma)
+                           bootstrap_gamma=gamma,
+                           **config._worker_connectors())
                 for i in range(config.num_env_runners)
             ]
             self.space_info = ray_tpu.get(
@@ -148,7 +179,8 @@ class Algorithm:
         else:
             self.workers = [RolloutWorker(
                 config.env, num_envs=config.num_envs_per_env_runner,
-                seed=config.seed, bootstrap_gamma=gamma)]
+                seed=config.seed, bootstrap_gamma=gamma,
+                **config._worker_connectors())]
             self.space_info = self.workers[0].get_space_info()
         self._spaces = (self.space_info["obs_dim"],
                         self.space_info["num_actions"])
@@ -247,17 +279,48 @@ class Algorithm:
             self._eval_workers = [
                 cls.remote(cfg.env, num_envs=cfg.num_envs_per_env_runner,
                            seed=cfg.seed + 9000 + i,
-                           bootstrap_gamma=gamma)
+                           bootstrap_gamma=gamma,
+                           **cfg._worker_connectors())
                 for i in range(n)]
         else:
             self._eval_workers = [RolloutWorker(
                 cfg.env, num_envs=cfg.num_envs_per_env_runner,
-                seed=cfg.seed + 9000, bootstrap_gamma=gamma)]
+                seed=cfg.seed + 9000, bootstrap_gamma=gamma,
+                **cfg._worker_connectors())]
+
+    def _connector_state(self):
+        """Training worker 0's obs-filter state (None when stateless)."""
+        m = self.workers[0].get_connector_state
+        if hasattr(m, "remote"):
+            import ray_tpu
+
+            return ray_tpu.get(m.remote(), timeout=60)
+        return m()
+
+    def _push_connector_state(self, workers, state) -> None:
+        if state is None or not workers:
+            return
+        refs = []
+        for w in workers:
+            m = w.set_connector_state
+            if hasattr(m, "remote"):
+                refs.append(m.remote(state))
+            else:
+                m(state)
+        if refs:
+            import ray_tpu
+
+            ray_tpu.get(refs, timeout=60)
 
     def evaluate(self) -> Dict[str, float]:
-        """Deterministic episodes on the separate eval worker set."""
+        """Deterministic episodes on the separate eval worker set.
+        Stateful obs filters sync from training worker 0 first — the
+        policy must be evaluated on the observation space it was
+        trained on, not a fresh count=0 filter."""
         self._ensure_eval_workers()
         cfg = self.config
+        self._push_connector_state(self._eval_workers,
+                                   self._connector_state())
         weights = self.learner.get_weights()
         episodes = max(1, cfg.evaluation_duration)
         if cfg.evaluation_num_env_runners > 0:
@@ -309,7 +372,11 @@ class Algorithm:
                          else {"params": self.learner.get_weights()})
         with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "wb") as f:
             pickle.dump({"learner_state": learner_state,
-                         "iteration": self._iteration}, f)
+                         "iteration": self._iteration,
+                         # Stateful obs filters are part of the policy's
+                         # input contract; a restore without them feeds
+                         # the net a different observation scale.
+                         "connector_state": self._connector_state()}, f)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:
@@ -320,6 +387,8 @@ class Algorithm:
             self.learner.set_state(state["learner_state"])
         else:
             self.learner.set_weights(state["learner_state"]["params"])
+        self._push_connector_state(self.workers,
+                                   state.get("connector_state"))
         self._broadcast_weights()
 
     def stop(self) -> None:

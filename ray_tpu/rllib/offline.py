@@ -210,6 +210,144 @@ class BC(Algorithm):
                 "num_offline_rows": float(n)}
 
 
+def discounted_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-row Monte-Carlo returns over recorded episodes (trailing
+    partial episodes bootstrap 0 — offline data has no value net yet)."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        out[i] = acc
+    return out
+
+
+class MARWILConfig(BCConfig):
+    """Monotonic Advantage Re-Weighted Imitation Learning (ref:
+    rllib/algorithms/marwil/marwil.py): behavior cloning where each
+    action's log-likelihood is weighted by exp(beta * advantage), so
+    good recorded behavior is imitated harder than bad. beta=0 reduces
+    exactly to BC (the reference documents the same identity)."""
+
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0
+        self.gamma = 0.99
+        self.vf_coeff = 1.0
+
+    def training(self, *, beta=None, gamma=None, vf_coeff=None,
+                 **kwargs) -> "MARWILConfig":
+        for k, v in dict(beta=beta, gamma=gamma,
+                         vf_coeff=vf_coeff).items():
+            if v is not None:
+                setattr(self, k, v)
+        return super().training(**kwargs)
+
+
+class MARWILLearner:
+    """ONE jitted update: value regression to Monte-Carlo returns +
+    advantage-exponentiated NLL through the shared pi/v towers
+    (the reference runs separate torch losses; fused here)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 beta: float, vf_coeff: float, seed: int = 0,
+                 hidden=(64, 64)):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import apply_mlp_policy, init_mlp_policy
+
+        rng = jax.random.PRNGKey(seed)
+        self.params = init_mlp_policy(rng, obs_dim, num_actions, hidden)
+        self._tx = optax.adam(lr)
+        self.opt_state = self._tx.init(self.params)
+
+        def loss_fn(params, obs, actions, returns):
+            logits, value = apply_mlp_policy(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None],
+                                       axis=1)[:, 0]
+            vf = jnp.square(value - returns)
+            adv = jax.lax.stop_gradient(returns - value)
+            # Batch-normalized advantage inside the exp keeps the
+            # weights scale-free (the reference tracks a running moment
+            # for the same purpose, marwil.py moving-average c^2).
+            a_norm = adv / (jnp.std(adv) + 1e-6)
+            w = jnp.minimum(jnp.exp(beta * a_norm), 20.0)  # clip blowup
+            return (w * nll).mean() + vf_coeff * vf.mean(), (
+                nll.mean(), vf.mean())
+
+        def update(params, opt_state, obs, actions, returns):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions, returns)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    loss, aux)
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def update(self, obs, actions, returns) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        self.params, self.opt_state, loss, (nll, vf) = self._update(
+            self.params, self.opt_state, jnp.asarray(obs),
+            jnp.asarray(actions.astype(np.int32)), jnp.asarray(returns))
+        return {"marwil_loss": float(loss), "policy_nll": float(nll),
+                "vf_loss": float(vf)}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        import jax
+
+        self.params = jax.device_put(params)
+
+
+class MARWIL(Algorithm):
+    """Offline training_step like BC, but with per-row Monte-Carlo
+    returns feeding the advantage weights."""
+
+    def _setup_learner(self, obs_dim: int, num_actions: int
+                       ) -> MARWILLearner:
+        cfg: MARWILConfig = self.config
+        if not cfg.input_path:
+            raise ValueError(
+                "MARWILConfig.offline_data(input_path=...) first")
+        ds = read_samples(cfg.input_path)
+        data = _columnar(ds.take_all())
+        self._obs = data["obs"].astype(np.float32)
+        self._actions = data["actions"].astype(np.int64)
+        self._returns = discounted_returns(
+            data["rewards"].astype(np.float32),
+            data["dones"].astype(bool), cfg.gamma)
+        self._rng = np.random.default_rng(cfg.seed)
+        return MARWILLearner(obs_dim, num_actions, cfg.lr,
+                             beta=cfg.beta, vf_coeff=cfg.vf_coeff,
+                             seed=cfg.seed, hidden=cfg.model_hidden)
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: MARWILConfig = self.config
+        agg: Dict[str, list] = {}
+        n = len(self._obs)
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            m = self.learner.update(self._obs[idx], self._actions[idx],
+                                    self._returns[idx])
+            for k, v in m.items():
+                agg.setdefault(k, []).append(v)
+        self._broadcast_weights()
+        out = {k: float(np.mean(v)) for k, v in agg.items()}
+        out["num_offline_rows"] = float(n)
+        return out
+
+
 def record_rollouts(algo: Algorithm, path: str, num_iterations: int = 4,
                     fmt: str = "parquet") -> str:
     """Record an algorithm's on-policy rollouts to offline shards

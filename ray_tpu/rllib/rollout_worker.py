@@ -70,19 +70,45 @@ def _q_policy_step(params, obs, key, epsilon):
 class RolloutWorker:
     def __init__(self, env: Union[str, Callable[..., VectorEnv]],
                  num_envs: int = 8, seed: int = 0,
-                 bootstrap_gamma: float = 0.99):
+                 bootstrap_gamma: float = 0.99,
+                 obs_connector=None, action_connector=None):
         if callable(env):
             self.env = env(num_envs=num_envs, seed=seed)
         else:
             self.env = make_env(env, num_envs=num_envs, seed=seed)
         self.obs_dim = self.env.obs_dim
         self.num_actions = self.env.num_actions
-        self._obs = self.env.reset()
+        # env->module / module->env connector pipelines (ref:
+        # rllib/connectors/connector_v2.py; see rllib/connectors.py).
+        # The module only ever sees FILTERED observations — including
+        # bootstrap-value calls on final_obs — so train and act spaces
+        # stay consistent.
+        self._obs_connector = obs_connector
+        self._action_connector = action_connector
+        self._obs = self._filter(self.env.reset())
         self._params = None
         self._rng = jax.random.PRNGKey(seed + 1)
         # Time-limit cuts bootstrap the truncated state's value into the
         # reward (done=1 with no bootstrap would bias V targets low).
         self._gamma = bootstrap_gamma
+
+    def _filter(self, obs: np.ndarray) -> np.ndarray:
+        return obs if self._obs_connector is None else \
+            self._obs_connector(obs)
+
+    def _act(self, actions: np.ndarray) -> np.ndarray:
+        return actions if self._action_connector is None else \
+            self._action_connector(actions)
+
+    def get_connector_state(self):
+        return (self._obs_connector.get_state()
+                if self._obs_connector is not None else None)
+
+    def set_connector_state(self, state) -> None:
+        """Restore the obs filter (checkpoint restore / eval sync) —
+        the policy was trained on THIS filter's output space."""
+        if self._obs_connector is not None and state is not None:
+            self._obs_connector.set_state(state)
 
     def get_space_info(self) -> Dict[str, Any]:
         return {
@@ -118,12 +144,15 @@ class RolloutWorker:
             act_buf[:, t] = actions
             logp_buf[:, t] = np.asarray(logp)
             val_buf[:, t] = np.asarray(value)
-            obs, rewards, dones, ep_ret = self.env.step(actions)
+            obs, rewards, dones, ep_ret = self.env.step(
+                self._act(actions))
+            obs = self._filter(obs)
             trunc = getattr(self.env, "truncateds", None)
             if trunc is not None and trunc.any():
                 # Full-batch value call keeps the jit shape static.
                 vals = np.asarray(_value_only(
-                    self._params, self.env.final_obs), np.float32)
+                    self._params, self._filter(self.env.final_obs)),
+                    np.float32)
                 rewards = rewards.copy()
                 rewards[trunc] += self._gamma * vals[trunc]
             rew_buf[:, t] = rewards
@@ -171,9 +200,11 @@ class RolloutWorker:
             lo, hi = t * E, (t + 1) * E
             obs_buf[lo:hi] = obs
             act_buf[lo:hi] = actions
-            obs, rewards, dones, ep_ret = self.env.step(actions)
+            obs, rewards, dones, ep_ret = self.env.step(
+                self._act(actions))
+            obs = self._filter(obs)
             rew_buf[lo:hi] = rewards
-            next_buf[lo:hi] = self.env.final_obs
+            next_buf[lo:hi] = self._filter(self.env.final_obs)
             trunc = getattr(self.env, "truncateds", None)
             terminal = dones.astype(np.float32)
             if trunc is not None:
@@ -201,7 +232,7 @@ class RolloutWorker:
         assert self._params is not None, "set_weights() before evaluate()"
         limit = float(getattr(self.env, "act_limit", 1.0))
         returns: List[float] = []
-        obs = self.env.reset()
+        obs = self._filter(self.env.reset())
         guard = 0
         while len(returns) < num_episodes and guard < 100_000:
             guard += 1
@@ -216,11 +247,12 @@ class RolloutWorker:
             else:
                 logits, _ = _policy_logits(self._params, obs)
                 actions = np.asarray(jnp.argmax(logits, axis=1))
-            obs, _, _, ep_ret = self.env.step(actions)
+            obs, _, _, ep_ret = self.env.step(self._act(actions))
+            obs = self._filter(obs)
             done = ~np.isnan(ep_ret)
             if done.any():
                 returns.extend(ep_ret[done].tolist())
-        self._obs = self.env.reset()  # leave training state fresh
+        self._obs = self._filter(self.env.reset())  # training state fresh
         return returns[:num_episodes]
 
     def sample_transitions(self, num_steps: int,
@@ -248,10 +280,12 @@ class RolloutWorker:
             lo, hi = t * E, (t + 1) * E
             obs_buf[lo:hi] = obs
             act_buf[lo:hi] = actions
-            obs, rewards, dones, ep_ret = self.env.step(actions)
-            rew_buf[lo:hi] = rewards
+            obs, rewards, dones, ep_ret = self.env.step(
+                self._act(actions))
+            obs = self._filter(obs)
             # final_obs is every env's TRUE successor state this step.
-            next_buf[lo:hi] = self.env.final_obs
+            rew_buf[lo:hi] = rewards
+            next_buf[lo:hi] = self._filter(self.env.final_obs)
             trunc = getattr(self.env, "truncateds", None)
             terminal = dones.astype(np.float32)
             if trunc is not None:
