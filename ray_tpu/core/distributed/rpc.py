@@ -127,16 +127,21 @@ class RpcError(Exception):
 # is a latent race, not a harness artifact: networks already reorder.
 
 _fuzz_rng: Optional[random.Random] = None
+_fuzz_seed: Optional[str] = None
 
 
 def _sched_fuzz_delay() -> float:
     max_ms = os.environ.get("RAY_TPU_SCHED_FUZZ_MAX_MS")
     if not max_ms:
         return 0.0
-    global _fuzz_rng
-    if _fuzz_rng is None:
-        seed = int(os.environ.get("RAY_TPU_SCHED_FUZZ_SEED", "0"))
-        _fuzz_rng = random.Random(seed ^ os.getpid())
+    global _fuzz_rng, _fuzz_seed
+    seed_s = os.environ.get("RAY_TPU_SCHED_FUZZ_SEED", "0")
+    if _fuzz_rng is None or seed_s != _fuzz_seed:
+        # Re-seed when the env seed changes mid-process (a test sweep
+        # over seeds in one driver) — reproducibility demands the
+        # driver replay the same stream as a standalone run.
+        _fuzz_seed = seed_s
+        _fuzz_rng = random.Random(int(seed_s) ^ os.getpid())
     return _fuzz_rng.random() * float(max_ms) / 1000.0
 
 

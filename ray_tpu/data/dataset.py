@@ -281,7 +281,9 @@ class Dataset:
 
         return self._with(AllToAllStage("Sort", ref_fn))
 
-    def groupby(self, key: str) -> "GroupedData":
+    def groupby(self, key) -> "GroupedData":
+        """Group by one column or a LIST of columns (ref:
+        python/ray/data/grouped_data.py multi-key groupby)."""
         return GroupedData(self, key)
 
     def union(self, other: "Dataset") -> "Dataset":
@@ -455,6 +457,49 @@ class Dataset:
             total += s or 0
             cnt += b.num_rows
         return total / cnt if cnt else float("nan")
+
+    def std(self, col: str, ddof: int = 1):
+        """Streaming standard deviation (Chan parallel-variance merge
+        across blocks — no global materialization)."""
+        import pyarrow.compute as pc
+
+        count, mean, m2 = 0, 0.0, 0.0
+        for b in self.iter_blocks():
+            # Weight by VALID values — nulls carry no mass (an all-null
+            # block contributes nothing; pc.mean would return None).
+            n = pc.count(b.column(col), mode="only_valid").as_py()
+            if not n:
+                continue
+            bm = pc.mean(b.column(col)).as_py()
+            bv = pc.variance(b.column(col), ddof=0).as_py() or 0.0
+            delta = bm - mean
+            total = count + n
+            m2 += bv * n + delta * delta * count * n / total
+            mean += delta * n / total
+            count = total
+        if count <= ddof:
+            return float("nan")
+        return float(np.sqrt(m2 / (count - ddof)))
+
+    def quantile(self, col: str, q: float = 0.5):
+        """Exact quantile; pulls only the ONE column to the driver."""
+        import pyarrow.compute as pc
+
+        chunks = [b.column(col) for b in self.iter_blocks()
+                  if b.num_rows]
+        if not chunks:
+            return float("nan")
+        combined = pa.chunked_array(chunks)
+        return pc.quantile(combined, q=q).to_pylist()[0]
+
+    def unique(self, col: str) -> List[Any]:
+        """Distinct values of a column, streamed block by block."""
+        import pyarrow.compute as pc
+
+        seen: set = set()
+        for b in self.iter_blocks():
+            seen.update(pc.unique(b.column(col)).to_pylist())
+        return sorted(seen, key=lambda v: (v is None, v))
 
     def schema(self) -> Optional[pa.Schema]:
         for b in self.iter_blocks():
@@ -632,31 +677,58 @@ class StreamingSplitIterator:
 
 
 class GroupedData:
-    """Minimal groupby-aggregate (ref: python/ray/data/grouped_data.py)."""
+    """Groupby-aggregate over one or many key columns (ref:
+    python/ray/data/grouped_data.py — multi-key groupby, named
+    aggregations, map_groups)."""
 
-    def __init__(self, ds: Dataset, key: str):
+    def __init__(self, ds: Dataset, key):
         self._ds = ds
-        self._key = key
+        self._keys: List[str] = [key] if isinstance(key, str) else \
+            list(key)
+        if not self._keys:
+            raise ValueError("groupby needs at least one key column")
 
     def _agg(self, aggs: List[tuple]) -> Dataset:
-        key = self._key
+        keys = self._keys
 
         def ref_fn(refs):
             refs = list(refs)
 
             @ray_tpu.remote
             def agg_all(*blocks):
+                import pyarrow.compute as pc
+
+                # Options ride as ("OptionsClassName", kwargs) specs —
+                # pyarrow FunctionOptions instances don't pickle.
+                real = [
+                    (a[0], a[1], getattr(pc, a[2][0])(**a[2][1]))
+                    if len(a) == 3 and isinstance(a[2], tuple) else a
+                    for a in aggs
+                ]
                 t = B.concat(list(blocks))
-                tbl = t.group_by(key).aggregate(aggs)
+                tbl = t.group_by(keys).aggregate(real)
                 # pyarrow names output "<col>_<fn>"; keep as-is
-                return tbl.sort_by(key)
+                return tbl.sort_by([(k, "ascending") for k in keys])
 
             return [agg_all.remote(*refs)]
 
         return self._ds._with(AllToAllStage("GroupByAgg", ref_fn))
 
+    def aggregate(self, *aggs: tuple) -> Dataset:
+        """Named aggregations: (col, fn) pairs with any pyarrow
+        group-by function — 'sum', 'mean', 'min', 'max', 'count',
+        'stddev', 'variance', 'count_distinct', ... — or
+        (col, fn, ("OptionsClassName", kwargs)) triples for pyarrow
+        FunctionOptions, e.g. ("v", "stddev", ("VarianceOptions",
+        {"ddof": 1})) — specs, because FunctionOptions instances don't
+        pickle across workers. Multiple at once produce one row per
+        group with a column per aggregate."""
+        if not aggs:
+            raise ValueError("aggregate() needs (col, fn) pairs")
+        return self._agg(list(aggs))
+
     def count(self) -> Dataset:
-        return self._agg([(self._key, "count")])
+        return self._agg([(self._keys[0], "count")])
 
     def sum(self, col: str) -> Dataset:
         return self._agg([(col, "sum")])
@@ -670,8 +742,14 @@ class GroupedData:
     def max(self, col: str) -> Dataset:
         return self._agg([(col, "max")])
 
+    def std(self, col: str, ddof: int = 1) -> Dataset:
+        # pyarrow's grouped stddev defaults to ddof=0; match
+        # Dataset.std's sample-std default explicitly.
+        return self._agg([(col, "stddev",
+                           ("VarianceOptions", {"ddof": ddof}))])
+
     def map_groups(self, fn, *, batch_format: Optional[str] = None) -> Dataset:
-        key = self._key
+        keys = self._keys
 
         def ref_fn(refs):
             refs = list(refs)
@@ -681,9 +759,15 @@ class GroupedData:
                 import pyarrow.compute as pc
 
                 t = B.concat(list(blocks))
+                # Distinct key combos via an empty aggregation, then
+                # one conjunctive filter per group.
+                combos = t.group_by(keys).aggregate([])
                 outs = []
-                for val in pc.unique(t.column(key)).to_pylist():
-                    mask = pc.equal(t.column(key), pa.scalar(val))
+                for i in range(combos.num_rows):
+                    mask = None
+                    for k in keys:
+                        m = pc.equal(t.column(k), combos.column(k)[i])
+                        mask = m if mask is None else pc.and_(mask, m)
                     grp = t.filter(mask)
                     res = fn(B.to_batch(grp, batch_format))
                     outs.append(B.from_batch(res))

@@ -30,10 +30,12 @@ class AlgorithmConfig:
         self.num_learners = 0     # 0 = single inline learner
         self.remote_learners = False
         # Connector factories (ref: rllib/connectors/connector_v2.py;
-        # see ray_tpu/rllib/connectors.py). Called once per rollout/eval
-        # worker; each worker owns its connector instance + state.
+        # see ray_tpu/rllib/connectors.py). env/module ones are called
+        # once per rollout/eval worker; the learner connector runs
+        # driver-side on every training batch before the update.
         self.env_to_module_connector = None   # () -> Connector
         self.module_to_env_connector = None   # () -> Connector
+        self.learner_connector = None         # () -> Connector (batch)
         self.evaluation_interval = 0          # iterations; 0 = disabled
         self.evaluation_num_env_runners = 0   # 0 = evaluate locally
         self.evaluation_duration = 5          # episodes per evaluation
@@ -48,7 +50,8 @@ class AlgorithmConfig:
                     rollout_fragment_length: Optional[int] = None,
                     num_cpus_per_env_runner: Optional[float] = None,
                     env_to_module_connector: Optional[Callable] = None,
-                    module_to_env_connector: Optional[Callable] = None
+                    module_to_env_connector: Optional[Callable] = None,
+                    learner_connector: Optional[Callable] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -62,6 +65,8 @@ class AlgorithmConfig:
             self.env_to_module_connector = env_to_module_connector
         if module_to_env_connector is not None:
             self.module_to_env_connector = module_to_env_connector
+        if learner_connector is not None:
+            self.learner_connector = learner_connector
         return self
 
     def _worker_connectors(self) -> dict:
@@ -258,7 +263,18 @@ class Algorithm:
         episode_returns: List[float] = []
         for o in outs:
             episode_returns.extend(o["episode_returns"])
-        return batch, episode_returns
+        return self._apply_learner_connector(batch), episode_returns
+
+    def _apply_learner_connector(self, batch):
+        """Driver-side batch transform before the learner update (ref:
+        the learner connector pipeline, rllib/connectors/learner/);
+        built lazily from config.learner_connector."""
+        factory = getattr(self.config, "learner_connector", None)
+        if factory is None:
+            return batch
+        if not hasattr(self, "_learner_conn"):
+            self._learner_conn = factory()
+        return self._learner_conn(batch)
 
     # -- evaluation (ref: Algorithm.evaluate + worker_set.py:82) -------------
     _eval_mode = "greedy_pi"   # subclasses: greedy_q (DQN), sac_mean (SAC)
@@ -290,6 +306,8 @@ class Algorithm:
 
     def _connector_state(self):
         """Training worker 0's obs-filter state (None when stateless)."""
+        if getattr(self.config, "env_to_module_connector", None) is None:
+            return None     # no filter: skip the remote round-trip
         m = self.workers[0].get_connector_state
         if hasattr(m, "remote"):
             import ray_tpu

@@ -120,8 +120,10 @@ class ActionClip(Connector):
 
 
 class RewardScale(Connector):
-    """learner connector: scale rewards in the training batch (a dict
-    transform — operates on the 'rewards' key, leaves the rest)."""
+    """learner connector (wire via
+    `.env_runners(learner_connector=lambda: RewardScale(s))`): scales
+    rewards in the training batch — a dict transform operating on the
+    'rewards' key, leaving the rest untouched."""
 
     def __init__(self, scale: float):
         self.scale = scale

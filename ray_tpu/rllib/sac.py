@@ -236,7 +236,7 @@ class SAC(Algorithm):
         batch = {k: np.concatenate([o["batch"][k] for o in outs])
                  for k in outs[0]["batch"]}
         returns = [r for o in outs for r in o["episode_returns"]]
-        return batch, returns
+        return self._apply_learner_connector(batch), returns
 
     def training_step(self) -> Dict[str, float]:
         cfg: SACConfig = self.config

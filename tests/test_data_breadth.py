@@ -158,3 +158,54 @@ def test_backpressure_window_shrinks_under_store_pressure(monkeypatch):
     assert execution._effective_window(32) == 8
     FakeStore.used = 10
     assert execution._effective_window(32) == 32
+
+
+def test_aggregate_depth_std_quantile_unique():
+    """Streaming std (Chan merge), exact quantile, distinct values
+    (ref: python/ray/data/aggregate.py Std/AbsMax et al.)."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+
+    vals = np.arange(100, dtype=np.float64)
+    ds = rd.from_items([{"v": float(v), "g": int(v) % 3}
+                        for v in vals], parallelism=7)
+    assert abs(ds.std("v") - np.std(vals, ddof=1)) < 1e-9
+    # Nulls carry no mass (an all-null block must not crash or skew).
+    withnulls = rd.from_items(
+        [{"v": None}] * 10 + [{"v": float(v)} for v in vals],
+        parallelism=6)
+    assert abs(withnulls.std("v") - np.std(vals, ddof=1)) < 1e-9
+    assert ds.quantile("v", 0.5) == np.quantile(vals, 0.5)
+    assert ds.unique("g") == [0, 1, 2]
+
+
+def test_multi_key_groupby_and_named_aggregates():
+    from ray_tpu import data as rd
+
+    rows = [{"a": i % 2, "b": i % 3, "v": float(i)} for i in range(60)]
+    ds = rd.from_items(rows, parallelism=5)
+    out = ds.groupby(["a", "b"]).aggregate(
+        ("v", "sum"), ("v", "mean"), ("v", "stddev")).take_all()
+    assert len(out) == 6                      # 2 x 3 key combos
+    import numpy as np
+
+    for r in out:
+        grp = [x["v"] for x in rows
+               if x["a"] == r["a"] and x["b"] == r["b"]]
+        assert abs(r["v_sum"] - sum(grp)) < 1e-9
+        assert abs(r["v_mean"] - np.mean(grp)) < 1e-9
+
+    # grouped std matches numpy's sample std per group (ddof=1)
+    s = ds.groupby("a").std("v").take_all()
+    assert len(s) == 2
+    for r in s:
+        grp = [x["v"] for x in rows if x["a"] == r["a"]]
+        assert abs(r["v_stddev"] - np.std(grp, ddof=1)) < 1e-9
+
+    # multi-key map_groups applies per key-combo
+    out = ds.groupby(["a", "b"]).map_groups(
+        lambda batch: {"a": batch["a"][:1], "b": batch["b"][:1],
+                       "n": np.array([len(batch["v"])])},
+        batch_format="numpy").take_all()
+    assert sorted(r["n"] for r in out) == [10] * 6

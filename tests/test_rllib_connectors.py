@@ -77,6 +77,26 @@ def test_ppo_trains_with_obs_normalizer_connector():
     algo.stop()
 
 
+def test_learner_connector_transforms_training_batch():
+    """The learner seam: batches are transformed driver-side before
+    reaching the learner (ref: rllib/connectors/learner/)."""
+    from ray_tpu.rllib import PPOConfig, RewardScale
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=4,
+                     rollout_fragment_length=16,
+                     learner_connector=lambda: RewardScale(0.0))
+        .training(minibatch_size=32, num_epochs=1)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    batch, _ = algo._sample_rollouts()
+    assert float(np.abs(batch["rewards"]).sum()) == 0.0  # scaled away
+    algo.stop()
+
+
 def test_connector_state_survives_save_restore(tmp_path):
     """The obs filter is part of the policy's input contract: restore
     must carry its statistics, not restart at count=0."""
