@@ -109,6 +109,24 @@ def shutdown() -> None:
     global _worker
     with _worker_lock:
         if _worker is not None:
+            # Persist the usage snapshot for this driver session (ref:
+            # usage_lib writes usage_stats.json at session end; local
+            # file only — report_usage() is a no-op unless the user
+            # explicitly opted in).
+            try:
+                import os as _os
+                import tempfile as _tf
+
+                from ray_tpu.util import usage_stats as _us
+
+                path = _os.environ.get(
+                    "RAY_TPU_USAGE_STATS_PATH",
+                    _os.path.join(_tf.gettempdir(),
+                                  f"raytpu_usage_{_os.getpid()}.json"))
+                _us.write_usage_snapshot(path)
+                _us.report_usage()
+            except Exception:  # noqa: BLE001 — never block shutdown
+                pass
             _worker.shutdown()
             _worker = None
 

@@ -19,3 +19,10 @@ __all__ = [
     "read_binary_files", "read_images", "read_numpy", "read_tfrecords",
     "read_webdataset", "read_sql", "read_mongo", "read_bigquery",
 ]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

@@ -87,3 +87,10 @@ __all__ = [
     "PrioritizedReplayBuffer",
     "register_env",
 ]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("rllib")
+del _rlu

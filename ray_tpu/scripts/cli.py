@@ -181,6 +181,32 @@ def cmd_timeline(gcs: _Gcs, args) -> None:
           f"(open in chrome://tracing)")
 
 
+def cmd_grafana_out(args) -> None:
+    """Generate importable Grafana dashboards + provisioning config
+    (ref: grafana_dashboard_factory.py). Metric metadata comes from a
+    live node's Prometheus dump when a cluster is reachable, else from
+    the known daemon metric set — so this works air-gapped."""
+    from ray_tpu.dashboard.grafana import (
+        metrics_from_prometheus_text,
+        write_dashboards,
+    )
+
+    metrics = None
+    try:
+        gcs = _Gcs(_resolve_address(args))
+        for n in gcs.call("NodeInfo", "list_nodes"):
+            if not n["alive"]:
+                continue
+            text = gcs.daemon(n["address"]).call(
+                "NodeDaemon", "get_metrics", timeout=10)
+            metrics = metrics_from_prometheus_text(text)
+            break
+    except Exception:  # noqa: BLE001 — no cluster: static fallback
+        pass
+    for path in write_dashboards(args.grafana_out, metrics=metrics):
+        print(path)
+
+
 def cmd_metrics(gcs: _Gcs, args) -> None:
     for n in gcs.call("NodeInfo", "list_nodes"):
         if not n["alive"]:
@@ -408,6 +434,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     tp.add_argument("--out", default="timeline.json")
     tp.add_argument("--limit", type=int, default=10000)
     mp = sub.add_parser("metrics")
+    mp.add_argument("--grafana-out", default=None,
+                    help="write generated Grafana dashboards + "
+                         "provisioning config to this dir and exit")
     mp.add_argument("--node", help="node id prefix filter")
     sp = sub.add_parser("start")
     sp.add_argument("--head", action="store_true")
@@ -463,6 +492,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
     if args.cmd == "dashboard":
         cmd_dashboard(args)
+        return
+    if args.cmd == "metrics" and args.grafana_out:
+        # Pure file generation — must work with NO cluster (falls back
+        # to the known daemon metric set); uses live cluster metadata
+        # when one is reachable.
+        cmd_grafana_out(args)
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,

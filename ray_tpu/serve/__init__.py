@@ -25,3 +25,10 @@ __all__ = [
     "multiplexed", "get_multiplexed_model_id",
     "batch",
 ]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

@@ -18,3 +18,10 @@ __all__ = [
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
 ]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

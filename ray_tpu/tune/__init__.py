@@ -34,3 +34,10 @@ __all__ = [
     "Callback", "JsonLoggerCallback", "CSVLoggerCallback",
     "WandbLoggerCallback", "MLflowLoggerCallback",
 ]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("tune")
+del _rlu

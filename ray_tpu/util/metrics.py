@@ -197,6 +197,16 @@ def _fmt_tags(key: _TagKey, le=None) -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def registry_snapshot() -> List[dict]:
+    """Metadata of every registered metric (name/description/kind) —
+    the input the Grafana dashboard factory renders panels from."""
+    reg = get_registry()
+    with reg._lock:
+        metrics = list(reg._metrics.values())
+    return [{"name": m.name, "description": m.description,
+             "kind": m.kind()} for m in metrics]
+
+
 _registry: Optional[MetricsRegistry] = None
 _registry_lock = threading.Lock()
 

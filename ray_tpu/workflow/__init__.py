@@ -22,3 +22,10 @@ from ray_tpu.workflow.api import (
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
            "list_all", "event", "send_event", "catch"]
+
+# Usage tagging (ref: usage_lib.record_library_usage; local-only,
+# see ray_tpu/util/usage_stats.py)
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+
+_rlu("workflow")
+del _rlu

@@ -134,3 +134,101 @@ def test_cli_status_and_lists(obs_cluster):
     assert "RUNNING" in out
     out = run("metrics")
     assert "raytpu_workers" in out
+
+
+# ---------------------------------------------------------------------------
+# Grafana dashboard generation (ref: dashboard/modules/metrics/
+# grafana_dashboard_factory.py) + usage stats (ref: _private/usage/)
+# ---------------------------------------------------------------------------
+
+def test_grafana_dashboard_generation(tmp_path):
+    import json
+
+    from ray_tpu.dashboard.grafana import (
+        generate_dashboard,
+        write_dashboards,
+    )
+
+    metrics = [
+        {"name": "raytpu_tasks_submitted", "description": "t",
+         "kind": "counter"},
+        {"name": "raytpu_store_used_bytes", "description": "b",
+         "kind": "gauge"},
+        {"name": "raytpu_rpc_latency", "description": "l",
+         "kind": "histogram"},
+    ]
+    dash = generate_dashboard("test board", metrics=metrics)
+    assert len(dash["panels"]) == 3
+    kinds = {p["title"]: p for p in dash["panels"]}
+    assert "rate(raytpu_tasks_submitted[1m])" in \
+        kinds["raytpu_tasks_submitted"]["targets"][0]["expr"]
+    hist = kinds["raytpu_rpc_latency"]["targets"]
+    assert any("histogram_quantile(0.95" in t["expr"] for t in hist)
+
+    from ray_tpu.dashboard.grafana import KNOWN_METRICS
+
+    files = write_dashboards(str(tmp_path), metrics=KNOWN_METRICS)
+    names = {f.rsplit("/", 1)[-1] for f in files}
+    assert "provisioning.yaml" in names
+    core = json.load(open(str(tmp_path / "raytpu_core.json")))
+    assert core["uid"] == "raytpu-core"
+    # Real daemon metrics land on the curated boards (prefixes must
+    # track node_daemon.py's registrations).
+    core_titles = {p["title"] for p in core["panels"]}
+    assert "raytpu_workers" in core_titles
+    assert "raytpu_lease_grant_seconds" in core_titles
+    store = json.load(open(str(tmp_path / "raytpu_store.json")))
+    assert any(p["title"].startswith("raytpu_object_store")
+               for p in store["panels"])
+
+    # Prometheus-text metadata path (what the CLI pulls from a live
+    # daemon) parses HELP/TYPE into the same shape.
+    from ray_tpu.dashboard.grafana import metrics_from_prometheus_text
+
+    text = ("# HELP raytpu_workers live workers\n"
+            "# TYPE raytpu_workers gauge\n"
+            "raytpu_workers 3\n"
+            "# HELP raytpu_lease_grant_seconds latency\n"
+            "# TYPE raytpu_lease_grant_seconds histogram\n")
+    parsed = metrics_from_prometheus_text(text)
+    assert {"name": "raytpu_workers", "description": "live workers",
+            "kind": "gauge"} in parsed
+
+
+def test_usage_stats_local_and_optin(tmp_path, monkeypatch):
+    import json
+    import urllib.request
+
+    from ray_tpu.util import usage_stats as us
+
+    us.record_library_usage("data")
+    us.record_extra_usage_tag("experiment", "r4")
+    snap = us.collect_usage_snapshot()
+    assert "data" in snap["libraries_used"]
+    assert snap["extra_tags"]["experiment"] == "r4"
+    assert snap["ray_tpu_version"]
+
+    p = us.write_usage_snapshot(str(tmp_path / "usage.json"))
+    assert json.load(open(p))["schema_version"] == 1
+
+    # Reporting is OPT-IN: disabled by default even with a URL set.
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_URL", "http://example/x")
+    monkeypatch.delenv("RAY_TPU_USAGE_STATS_ENABLED", raising=False)
+    posted = []
+    monkeypatch.setattr(
+        urllib.request, "urlopen",
+        lambda req, timeout=None: posted.append(req) or _FakeResp())
+    assert us.report_usage() is False
+    assert not posted
+    # Explicit opt-in sends exactly the inspectable snapshot.
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    assert us.report_usage() is True
+    assert json.loads(posted[0].data.decode())["schema_version"] == 1
+
+
+class _FakeResp:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
