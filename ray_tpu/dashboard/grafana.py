@@ -49,7 +49,7 @@ KNOWN_METRICS = [
     {"name": "raytpu_object_store_objects",
      "description": "objects in store", "kind": "gauge"},
     {"name": "raytpu_object_store_spilled_bytes",
-     "description": "bytes spilled", "kind": "counter"},
+     "description": "bytes spilled", "kind": "gauge"},
     {"name": "raytpu_oom_worker_kills_total",
      "description": "workers killed by memory monitor",
      "kind": "counter"},

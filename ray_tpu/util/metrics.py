@@ -155,6 +155,14 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[Metric]:
         return self._metrics.get(name)
 
+    def snapshot_meta(self) -> List[dict]:
+        """Metadata of every registered metric (name/description/kind)
+        — the input the Grafana dashboard factory renders panels from."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [{"name": m.name, "description": m.description,
+                 "kind": m.kind()} for m in metrics]
+
     def prometheus_text(self) -> str:
         """Prometheus exposition format (text/plain; version 0.0.4)."""
         out: List[str] = []
@@ -198,13 +206,7 @@ def _fmt_tags(key: _TagKey, le=None) -> str:
 
 
 def registry_snapshot() -> List[dict]:
-    """Metadata of every registered metric (name/description/kind) —
-    the input the Grafana dashboard factory renders panels from."""
-    reg = get_registry()
-    with reg._lock:
-        metrics = list(reg._metrics.values())
-    return [{"name": m.name, "description": m.description,
-             "kind": m.kind()} for m in metrics]
+    return get_registry().snapshot_meta()
 
 
 _registry: Optional[MetricsRegistry] = None

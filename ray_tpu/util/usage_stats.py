@@ -44,6 +44,11 @@ def get_library_usages() -> List[str]:
         return sorted(_library_usages)
 
 
+def _get_extra_tags() -> Dict[str, str]:
+    with _lock:
+        return dict(_extra_tags)
+
+
 def collect_usage_snapshot() -> Dict[str, Any]:
     """Everything a report would contain — inspectable by the user
     BEFORE anything leaves the machine."""
@@ -51,12 +56,12 @@ def collect_usage_snapshot() -> Dict[str, Any]:
 
     snap: Dict[str, Any] = {
         "schema_version": 1,
-        "ray_tpu_version": getattr(_version, "__version__", "unknown"),
+        "ray_tpu_version": getattr(_version, "version", "unknown"),
         "python_version": platform.python_version(),
         "os": platform.system().lower(),
         "uptime_s": round(time.time() - _start_time, 1),
         "libraries_used": get_library_usages(),
-        "extra_tags": dict(_extra_tags),
+        "extra_tags": _get_extra_tags(),
     }
     try:
         import ray_tpu

@@ -126,12 +126,20 @@ def main() -> None:
             continue
 
         log(f"probe: UP ({n} chip) — recording")
-        out = run_recorded(
-            [sys.executable, "bench.py", "--record"], 1800,
-            {"RAY_TPU_BENCH_PROBE_TIMEOUT_S": "90",
-             "RAY_TPU_BENCH_PROBE_RETRIES": "1"})
-        log(f"bench.py --record: {out.strip().splitlines()[-1][:300] if out.strip() else 'no output'}")
-        append_history("train", out)
+        # Sweep batch sizes for the best MFU; save_last_good keeps the
+        # best of the sweep, BENCH_TPU_HISTORY keeps every point.
+        for batch in ("8", "16", "12"):
+            out = run_recorded(
+                [sys.executable, "bench.py", "--record"], 1800,
+                {"RAY_TPU_BENCH_PROBE_TIMEOUT_S": "90",
+                 "RAY_TPU_BENCH_PROBE_RETRIES": "1",
+                 "RAY_TPU_BENCH_BATCH": batch})
+            tail = (out.strip().splitlines()[-1][:300]
+                    if out.strip() else "no output")
+            log(f"bench.py --record (batch={batch}): {tail}")
+            append_history(f"train_b{batch}", out)
+            if '"recorded": false' in out:
+                break   # tunnel dropped mid-window: stop the sweep
 
         sout = run_recorded(
             [sys.executable, "bench_serve.py", "--out",
