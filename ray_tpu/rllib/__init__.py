@@ -15,6 +15,7 @@ evaluation/rollout_worker.py:159 RolloutWorker). Design split, TPU-style:
   analogue of LearnerGroup weight sync (core/learner/learner_group.py:60).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.connectors import (
     ActionClip,
     Connector,
@@ -72,6 +73,8 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "ImpalaConfig",
+    "APPO",
+    "APPOConfig",
     "SAC",
     "SACConfig",
     "CQL",

@@ -1,0 +1,62 @@
+"""APPO: asynchronous PPO — IMPALA's decoupled sampling/learning with
+PPO's clipped surrogate objective.
+
+ref: rllib/algorithms/appo/appo.py — the reference layers the PPO clip
+(and optional KL) on top of the IMPALA architecture so stale-but-cheap
+async rollouts get both V-trace off-policy correction AND the
+trust-region-ish update clamp. TPU-first shape inherited from
+ImpalaLearner: the entire update (v-trace scan + surrogate + optimizer)
+is one jitted program; only the policy-gradient term differs.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.impala import (
+    IMPALA,
+    ImpalaConfig,
+    ImpalaHyperparams,
+    ImpalaLearner,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AppoHyperparams(ImpalaHyperparams):
+    clip_param: float = 0.2
+
+
+class AppoLearner(ImpalaLearner):
+    """V-trace advantages through the PPO clipped surrogate (ref:
+    appo_torch_learner.py loss; here fused into the IMPALA jit)."""
+
+    def _pg_loss(self, target_logp, behavior_logp, pg_adv):
+        eps = self.hp.clip_param
+        ratio = jnp.exp(target_logp - behavior_logp)
+        return -jnp.mean(jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - eps, 1.0 + eps) * pg_adv))
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+
+    def training(self, *, clip_param=None, **kwargs) -> "APPOConfig":
+        if clip_param is not None:
+            self.clip_param = clip_param
+        return super().training(**kwargs)
+
+    def hyperparams(self) -> AppoHyperparams:
+        base = super().hyperparams()
+        return AppoHyperparams(**dataclasses.asdict(base),
+                               clip_param=self.clip_param)
+
+
+class APPO(IMPALA):
+    """Same async training_step as IMPALA; the learner clamps updates."""
+
+    _learner_cls = AppoLearner

@@ -91,7 +91,7 @@ class ImpalaLearner:
             vs, pg_adv = vtrace(batch["logp"], target_logp,
                                 batch["rewards"], batch["dones"], value,
                                 batch["final_value"])
-            pg_loss = -jnp.mean(target_logp * pg_adv)
+            pg_loss = self._pg_loss(target_logp, batch["logp"], pg_adv)
             vf_loss = 0.5 * jnp.mean(jnp.square(value - vs))
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
@@ -110,6 +110,11 @@ class ImpalaLearner:
             return params, opt_state, metrics
 
         return jax.jit(update, donate_argnums=(0, 1))
+
+    def _pg_loss(self, target_logp, behavior_logp, pg_adv):
+        """Policy-gradient term; APPO overrides with the clipped
+        surrogate (traced inside _build_update's jit)."""
+        return -jnp.mean(target_logp * pg_adv)
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()
@@ -171,14 +176,16 @@ class IMPALA(Algorithm):
     under stale weights — V-trace corrects), update, refill the in-flight
     queue, broadcast weights on the configured cadence."""
 
+    _learner_cls = ImpalaLearner   # APPO swaps in AppoLearner
+
     def _setup_learner(self, obs_dim: int, num_actions: int
                        ) -> ImpalaLearner:
         cfg: ImpalaConfig = self.config
         self._pending: List[Any] = []
         self._updates_since_broadcast = 0
         self._next_worker = 0
-        return ImpalaLearner(obs_dim, num_actions, cfg.hyperparams(),
-                             seed=cfg.seed, hidden=cfg.model_hidden)
+        return self._learner_cls(obs_dim, num_actions, cfg.hyperparams(),
+                                 seed=cfg.seed, hidden=cfg.model_hidden)
 
     def _refill(self) -> None:
         cfg: ImpalaConfig = self.config

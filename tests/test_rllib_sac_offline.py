@@ -253,3 +253,4 @@ def test_marwil_weights_good_behavior_over_bad(tmp_path):
     assert abs(p_bc - 0.5) < 0.15, p_bc    # BC copies the 50/50 data
     assert p_marwil > p_bc + 0.2
     marwil.stop(), bc.stop()
+
