@@ -199,7 +199,8 @@ class Algorithm:
             raise ValueError(
                 f"{type(self).__name__} has not been ported to the "
                 f"Learner/LearnerGroup stack; num_learners>0 would be "
-                f"silently ignored (supported: PPO, SAC)")
+                f"silently ignored (supported: PPO, SAC, DQN, CQL, "
+                f"IMPALA, APPO)")
         self._broadcast_weights()
 
     # -- subclass hooks -----------------------------------------------------

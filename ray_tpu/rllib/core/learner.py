@@ -38,22 +38,35 @@ class Learner:
 
     # -- jit wiring -----------------------------------------------------
     def _jit_update(self, update_fn, num_state_args: int,
-                    batch_keys: Tuple[str, ...]):
+                    batch_keys: Tuple[str, ...],
+                    has_rng: bool = True,
+                    out_spec: Optional[Tuple[str, ...]] = None,
+                    donate: Optional[Tuple[int, ...]] = None):
         """Compile the fused update with donated state and, under a
         mesh, replicated-state / dp-sharded-batch shardings. Argument
         convention: `num_state_args` state pytrees, then the batch
-        dict, then an rng key; outputs are the new state pytrees plus
-        a metrics dict."""
-        donate = tuple(range(num_state_args))
+        dict, then (when has_rng) an rng key. Outputs default to the
+        new state pytrees plus a metrics dict (all replicated);
+        `out_spec` overrides with per-output "rep"/"dp" markers (e.g.
+        DQN returns per-sample TD errors, which stay dp-sharded).
+        `donate` overrides which positional args are donated (default:
+        every state arg; DQN keeps its target params undonated)."""
+        if donate is None:
+            donate = tuple(range(num_state_args))
         if self.mesh is None:
             return jax.jit(update_fn, donate_argnums=donate)
         rep = NamedSharding(self.mesh, P())
         dp = NamedSharding(self.mesh, P("dp"))
         batch_sh = {k: dp for k in batch_keys}
+        tail = (batch_sh, rep) if has_rng else (batch_sh,)
+        if out_spec is None:
+            outs = (rep,) * (num_state_args + 1)
+        else:
+            outs = tuple(rep if s == "rep" else dp for s in out_spec)
         return jax.jit(
             update_fn, donate_argnums=donate,
-            in_shardings=(rep,) * num_state_args + (batch_sh, rep),
-            out_shardings=(rep,) * (num_state_args + 1))
+            in_shardings=(rep,) * num_state_args + tail,
+            out_shardings=outs)
 
     # -- device placement ----------------------------------------------
     def _replicate(self, tree: Any) -> Any:

@@ -28,11 +28,12 @@ class CQLLearner(SACLearner):
 
     def __init__(self, obs_dim: int, act_dim: int, hp: SACHyperparams,
                  *, cql_alpha: float = 1.0, cql_n_actions: int = 4,
-                 seed: int = 0, hidden=(64, 64)):
+                 seed: int = 0, hidden=(64, 64), mesh=None):
         self._cql_alpha = cql_alpha
         self._cql_n = cql_n_actions
         self._act_dim = act_dim
-        super().__init__(obs_dim, act_dim, hp, seed=seed, hidden=hidden)
+        super().__init__(obs_dim, act_dim, hp, seed=seed, hidden=hidden,
+                         mesh=mesh)
 
     def _build_update(self):
         import jax
@@ -133,9 +134,12 @@ class CQLLearner(SACLearner):
             return (actor, critic, target_critic, log_alpha,
                     actor_opt, critic_opt, alpha_opt, metrics)
 
-        import jax as _jax
-
-        return _jax.jit(update, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        # Same mesh wiring as the SAC parent: replicated state,
+        # dp-sharded batch (plain jit when meshless).
+        return self._jit_update(
+            update, num_state_args=7,
+            batch_keys=("obs", "actions", "rewards", "next_obs",
+                        "terminals"))
 
 
 class CQLConfig(SACConfig):
@@ -194,10 +198,15 @@ class CQL(Algorithm):
                             if cfg.target_entropy is not None
                             else -float(info["act_dim"])),
             act_limit=info["act_limit"])
-        return CQLLearner(obs_dim, info["act_dim"], hp,
-                          cql_alpha=cfg.cql_alpha,
-                          cql_n_actions=cfg.cql_n_actions,
-                          seed=cfg.seed, hidden=cfg.model_hidden)
+        act_dim, seed, hidden = info["act_dim"], cfg.seed, cfg.model_hidden
+        alpha, n_act = cfg.cql_alpha, cfg.cql_n_actions
+
+        def factory(mesh=None):
+            return CQLLearner(obs_dim, act_dim, hp, cql_alpha=alpha,
+                              cql_n_actions=n_act, seed=seed,
+                              hidden=hidden, mesh=mesh)
+
+        return self._build_learner(factory)
 
     def training_step(self) -> Dict[str, float]:
         cfg: CQLConfig = self.config

@@ -18,6 +18,7 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.models import apply_mlp_q, init_mlp_q
 from ray_tpu.rllib.replay_buffer import (
     PrioritizedReplayBuffer,
@@ -36,18 +37,27 @@ class DQNHyperparams:
     grad_clip: float = 10.0
 
 
-class DQNLearner:
+class DQNLearner(Learner):
+    """Ported onto the core Learner base (ref: learner.py:107): a mesh
+    (from LearnerGroup's in-process SPMD mode) shards the batch over
+    `dp` with replicated params — per-sample TD errors come back for
+    prioritized-replay priorities in both modes."""
+
+    _state_attrs = ("params", "target_params", "opt_state")
+
     def __init__(self, obs_dim: int, num_actions: int, hp: DQNHyperparams,
-                 seed: int = 0, hidden=(64, 64)):
+                 seed: int = 0, hidden=(64, 64), mesh=None):
         self.hp = hp
+        self.mesh = mesh
         rng = jax.random.PRNGKey(seed)
-        self.params = init_mlp_q(rng, obs_dim, num_actions, hidden)
+        self.params = self._replicate(
+            init_mlp_q(rng, obs_dim, num_actions, hidden))
         self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
         self._tx = optax.chain(
             optax.clip_by_global_norm(hp.grad_clip),
             optax.adam(hp.lr),
         )
-        self.opt_state = self._tx.init(self.params)
+        self.opt_state = self._replicate(self._tx.init(self.params))
         self._updates = 0
         self._update = self._build_update()
 
@@ -81,11 +91,19 @@ class DQNLearner:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, td
 
-        return jax.jit(update, donate_argnums=(0, 2))
+        # Donation diverges from the base convention ((0,2): target
+        # params are NOT donated — they outlive the step), and the td
+        # output stays dp-sharded for prioritized-replay priorities.
+        return self._jit_update(
+            update, num_state_args=3, has_rng=False, donate=(0, 2),
+            batch_keys=("obs", "actions", "rewards", "next_obs",
+                        "terminals", "weights"),
+            out_spec=("rep", "rep", "rep", "dp"))
 
     def update(self, batch: Dict[str, np.ndarray]) -> tuple:
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
-                  if k != "batch_indexes"}
+        jbatch = self._shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()
+             if k != "batch_indexes"})
         self.params, self.opt_state, loss, td = self._update(
             self.params, self.target_params, self.opt_state, jbatch)
         self._updates += 1
@@ -94,23 +112,14 @@ class DQNLearner:
                                                         self.params)
         return float(loss), np.asarray(td)
 
-    def get_weights(self) -> Any:
-        return jax.device_get(self.params)
-
-    def set_weights(self, params: Any) -> None:
-        self.params = jax.device_put(params)
-
     def get_state(self) -> Dict[str, Any]:
-        return {"params": jax.device_get(self.params),
-                "target_params": jax.device_get(self.target_params),
-                "opt_state": jax.device_get(self.opt_state),
-                "updates": self._updates}
+        state = super().get_state()
+        state["updates"] = self._updates   # plain int, not a pytree
+        return state
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        self.params = jax.device_put(state["params"])
-        self.target_params = jax.device_put(state["target_params"])
-        self.opt_state = jax.device_put(state["opt_state"])
-        self._updates = state["updates"]
+        super().set_state(state)
+        self._updates = int(state.get("updates", self._updates))
 
 
 class DQNConfig(AlgorithmConfig):
@@ -176,8 +185,19 @@ class DQN(Algorithm):
             self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
                                        seed=cfg.seed)
         self._env_steps = 0
-        return DQNLearner(obs_dim, num_actions, cfg.hyperparams(),
-                          seed=cfg.seed, hidden=cfg.model_hidden)
+        if getattr(cfg, "remote_learners", False) \
+                and getattr(cfg, "num_learners", 0) > 0:
+            raise ValueError(
+                "DQN supports num_learners only in in-process mesh "
+                "mode (remote actors would need ordered per-sample TD "
+                "errors for prioritized replay)")
+        hp, seed, hidden = cfg.hyperparams(), cfg.seed, cfg.model_hidden
+
+        def factory(mesh=None):
+            return DQNLearner(obs_dim, num_actions, hp, seed=seed,
+                              hidden=hidden, mesh=mesh)
+
+        return self._build_learner(factory)
 
     def _epsilon(self) -> float:
         cfg: DQNConfig = self.config

@@ -21,6 +21,7 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.models import apply_mlp_policy, init_mlp_policy
 
 
@@ -35,17 +36,25 @@ class ImpalaHyperparams:
     grad_clip: float = 40.0
 
 
-class ImpalaLearner:
+class ImpalaLearner(Learner):
+    """Ported onto the core Learner base: state plumbing inherited;
+    a mesh (from LearnerGroup) shards the [E, T] batch over `dp`."""
+
+    _state_attrs = ("params", "opt_state")
+
     def __init__(self, obs_dim: int, num_actions: int,
-                 hp: ImpalaHyperparams, seed: int = 0, hidden=(64, 64)):
+                 hp: ImpalaHyperparams, seed: int = 0, hidden=(64, 64),
+                 mesh=None):
         self.hp = hp
+        self.mesh = mesh
         rng = jax.random.PRNGKey(seed)
-        self.params = init_mlp_policy(rng, obs_dim, num_actions, hidden)
+        self.params = self._replicate(
+            init_mlp_policy(rng, obs_dim, num_actions, hidden))
         self._tx = optax.chain(
             optax.clip_by_global_norm(hp.grad_clip),
             optax.rmsprop(hp.lr, decay=0.99, eps=0.1),
         )
-        self.opt_state = self._tx.init(self.params)
+        self.opt_state = self._replicate(self._tx.init(self.params))
         self._update = self._build_update()
 
     def _build_update(self):
@@ -109,7 +118,10 @@ class ImpalaLearner:
             params = optax.apply_updates(params, updates)
             return params, opt_state, metrics
 
-        return jax.jit(update, donate_argnums=(0, 1))
+        return self._jit_update(
+            update, num_state_args=2, has_rng=False,
+            batch_keys=("obs", "actions", "logp", "rewards", "dones",
+                        "final_value"))
 
     def _pg_loss(self, target_logp, behavior_logp, pg_adv):
         """Policy-gradient term; APPO overrides with the clipped
@@ -117,25 +129,12 @@ class ImpalaLearner:
         return -jnp.mean(target_logp * pg_adv)
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
-                  if k != "values"}
+        jbatch = self._shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()
+             if k != "values"})
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, jbatch)
         return {k: float(v) for k, v in metrics.items()}
-
-    def get_weights(self) -> Any:
-        return jax.device_get(self.params)
-
-    def set_weights(self, params: Any) -> None:
-        self.params = jax.device_put(params)
-
-    def get_state(self) -> Dict[str, Any]:
-        return {"params": jax.device_get(self.params),
-                "opt_state": jax.device_get(self.opt_state)}
-
-    def set_state(self, state: Dict[str, Any]) -> None:
-        self.params = jax.device_put(state["params"])
-        self.opt_state = jax.device_put(state["opt_state"])
 
 
 class ImpalaConfig(AlgorithmConfig):
@@ -184,8 +183,14 @@ class IMPALA(Algorithm):
         self._pending: List[Any] = []
         self._updates_since_broadcast = 0
         self._next_worker = 0
-        return self._learner_cls(obs_dim, num_actions, cfg.hyperparams(),
-                                 seed=cfg.seed, hidden=cfg.model_hidden)
+        cls, hp = self._learner_cls, cfg.hyperparams()
+        seed, hidden = cfg.seed, cfg.model_hidden
+
+        def factory(mesh=None):
+            return cls(obs_dim, num_actions, hp, seed=seed,
+                       hidden=hidden, mesh=mesh)
+
+        return self._build_learner(factory)
 
     def _refill(self) -> None:
         cfg: ImpalaConfig = self.config

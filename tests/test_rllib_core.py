@@ -186,3 +186,51 @@ def test_ppo_trains_with_learner_group():
                     jax.tree_util.tree_leaves(algo.get_weights())):
         np.testing.assert_array_equal(a, np.asarray(b))
     algo.stop()
+
+
+def test_dqn_and_impala_learner_group_mesh_modes():
+    """DQN/IMPALA (and APPO via inheritance) run under
+    num_learners mesh mode: batch dp-sharded, state replicated."""
+    from ray_tpu.rllib import DQNConfig, ImpalaConfig
+
+    dqn = (DQNConfig().environment("CartPole-v1")
+           .env_runners(num_envs_per_env_runner=4,
+                        rollout_fragment_length=16)
+           .training(train_batch_size=64, learning_starts=32,
+                     num_updates_per_iteration=2)
+           .learners(num_learners=2).debugging(seed=0)).build()
+    for _ in range(4):
+        m = dqn.train()
+    assert "num_env_steps_sampled" in m
+    dqn.stop()
+
+    imp = (ImpalaConfig().environment("CartPole-v1")
+           .env_runners(num_envs_per_env_runner=4,
+                        rollout_fragment_length=16)
+           .learners(num_learners=2).debugging(seed=0)).build()
+    m = imp.train()
+    assert np.isfinite(m["policy_loss"])
+    imp.stop()
+
+    # Remote-learner DQN is refused with a clear reason (per-sample TD
+    # ordering for prioritized replay).
+    with pytest.raises(ValueError, match="mesh mode"):
+        (DQNConfig().environment("CartPole-v1")
+         .learners(num_learners=2, remote_learners=True)
+         .debugging(seed=0)).build()
+
+
+def test_cql_learner_mesh_mode_unit():
+    """CQLLearner compiles its overridden update with the group's mesh
+    shardings (replicated state, dp batch) — one update on a synthetic
+    batch stays finite and on-mesh."""
+    from ray_tpu.rllib.cql import CQLLearner
+    from ray_tpu.rllib.sac import SACHyperparams
+
+    group = LearnerGroup(
+        lambda mesh=None: CQLLearner(3, 1, SACHyperparams(), seed=0,
+                                     mesh=mesh),
+        num_learners=2)
+    m = group.update(_sac_batch(B=64, seed=3))
+    assert np.isfinite(m["critic_loss"])
+    assert np.isfinite(m["cql_penalty"])
