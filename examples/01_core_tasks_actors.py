@@ -1,0 +1,39 @@
+"""Tasks, actors, objects — the core API (mirrors Ray's quickstart)."""
+import numpy as np
+
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k=1):
+        self.n += k
+        return self.n
+
+
+# Parallel tasks + object store round-trip.
+print("squares:", ray_tpu.get([square.remote(i) for i in range(8)]))
+big = ray_tpu.put(np.arange(1_000_000))
+print("put/get sum:", int(ray_tpu.get(big).sum()))
+
+# Stateful actor with ordered calls.
+c = Counter.remote()
+futs = [c.add.remote() for _ in range(10)]
+print("counter:", ray_tpu.get(futs)[-1])
+
+# wait() for partial results.
+done, rest = ray_tpu.wait([square.remote(i) for i in range(4)],
+                          num_returns=2)
+print("first done:", ray_tpu.get(done))
+
+ray_tpu.shutdown()

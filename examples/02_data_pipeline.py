@@ -1,0 +1,32 @@
+"""Streaming data pipeline: read -> task map -> actor map -> aggregate.
+
+The operator-graph executor overlaps every stage; ds.stats() shows it.
+"""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+
+ray_tpu.init(num_cpus=4)
+
+
+def normalize(batch):
+    v = np.asarray(batch["id"], np.float64)
+    return {"id": batch["id"], "z": (v - v.mean()) / (v.std() + 1e-9)}
+
+
+class Enricher:                      # class UDF -> actor pool
+    def __call__(self, batch):
+        return {**batch, "bucket": np.asarray(batch["id"]) % 3}
+
+
+ds = (rd.range(1000, parallelism=16)
+      .map_batches(normalize)
+      .map_batches(Enricher, concurrency=2))
+
+agg = ds.groupby("bucket").aggregate(("z", "mean"), ("id", "count"))
+for row in agg.take_all():
+    print(row)
+print("std(z):", round(ds.std("z"), 3), "p50(id):", ds.quantile("id"))
+print(ds.stats())
+ray_tpu.shutdown()

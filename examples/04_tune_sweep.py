@@ -1,0 +1,30 @@
+"""Hyperparameter sweep: ASHA early stopping over a toy objective."""
+import tempfile
+
+import ray_tpu
+from ray_tpu import tune
+
+ray_tpu.init(num_cpus=4)
+
+
+def trainable(config):
+    w = 0.0
+    for i in range(20):
+        w += config["lr"] * (1.0 - w)        # converges faster w/ high lr
+        tune.report({"score": w, "training_iteration": i + 1})
+
+
+with tempfile.TemporaryDirectory() as storage:
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=8,
+            scheduler=tune.ASHAScheduler(metric="score", mode="max",
+                                         grace_period=2)),
+        run_config=ray_tpu.train.RunConfig(name="sweep",
+                                           storage_path=storage))
+    best = tuner.fit().get_best_result("score", "max")
+    print("best lr:", round(best.config["lr"], 4),
+          "score:", round(best.metrics["score"], 4))
+ray_tpu.shutdown()

@@ -1,0 +1,35 @@
+"""Serve a two-stage deployment graph over HTTP."""
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4)
+
+
+@serve.deployment
+class Tokenizer:
+    def __call__(self, text):
+        return text.lower().split()
+
+
+@serve.deployment(num_replicas=2)
+class WordCount:
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer          # DeploymentHandle
+
+    def __call__(self, text):
+        words = self.tokenizer.remote(text).result(timeout=30)
+        return {"words": len(words), "unique": len(set(words))}
+
+
+handle = serve.run(WordCount.bind(Tokenizer.bind()), name="wc",
+                   _http=True, route_prefix="/wc")
+print("handle:", handle.remote("the quick brown fox the").result(30))
+port = serve.http_port()
+body = json.dumps("To be or not to be").encode()
+print("http:", urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/wc", data=body, timeout=30).read().decode())
+serve.shutdown()
+ray_tpu.shutdown()

@@ -1,0 +1,18 @@
+"""PPO on CartPole with a 2-learner mesh group + obs normalization."""
+import ray_tpu
+from ray_tpu.rllib import ObsNormalizer, PPOConfig
+
+algo = (PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=16,
+                     rollout_fragment_length=128,
+                     env_to_module_connector=ObsNormalizer)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=4)
+        .learners(num_learners=2)          # dp mesh over local devices
+        .debugging(seed=0))
+trainer = algo.build()
+for i in range(10):
+    m = trainer.train()
+    if "episode_return_mean" in m:
+        print(f"iter {i}: return={m['episode_return_mean']:.1f}")
+trainer.stop()

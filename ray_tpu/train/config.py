@@ -73,6 +73,10 @@ class Result:
     checkpoint: Optional["Checkpoint"]  # noqa: F821 (train.checkpoint)
     error: Optional[BaseException] = None
     metrics_history: list = dataclasses.field(default_factory=list)
+    # The run's hyperparameter/train-loop config (ref: air/result.py
+    # Result.config) — a real field set by both Tune and Trainer, not
+    # smuggled through the metrics namespace.
+    config: Optional[Dict[str, Any]] = None
 
     @property
     def best_checkpoint(self):

@@ -97,7 +97,8 @@ class DataParallelTrainer:
                 history.extend(history_part)
                 ckpt = Checkpoint(latest_ckpt) if latest_ckpt else None
                 return Result(metrics=last_metrics, checkpoint=ckpt,
-                              metrics_history=history)
+                              metrics_history=history,
+                              config=self._config)
             except _WorkerGroupFailure as e:
                 attempt += 1
                 history.extend(e.history)
@@ -107,7 +108,8 @@ class DataParallelTrainer:
                     ckpt = Checkpoint(latest_ckpt) if latest_ckpt else None
                     return Result(metrics=last_metrics, checkpoint=ckpt,
                                   error=RuntimeError(e.error),
-                                  metrics_history=history)
+                                  metrics_history=history,
+                                  config=self._config)
                 logger.warning("train attempt %d failed, restarting from %s",
                                attempt, latest_ckpt)
             finally:

@@ -423,9 +423,10 @@ class Tuner:
             err = RuntimeError(t.error) if t.error else None
             ckpt = Checkpoint(t.checkpoint) if t.checkpoint else None
             metrics = dict(t.last_metrics)
-            metrics["config"] = t.config
+            metrics["config"] = t.config   # kept for dict-style access
             results.append(Result(metrics=metrics, checkpoint=ckpt,
-                                  error=err, metrics_history=t.history))
+                                  error=err, metrics_history=t.history,
+                                  config=dict(t.config)))
         grid = ResultGrid(results, trials)
         for cb in self.run_config.callbacks:
             try:
