@@ -37,6 +37,12 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--num-slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=256)
+    # The bench sends ONE repeated prompt, so the engine's prefix cache
+    # (default-on in production) would turn every measured TTFT into an
+    # HBM copy instead of prefill — exactly what the ttft_regime claim
+    # says this measures. Off by default HERE; pass >0 to measure the
+    # hit path explicitly.
+    ap.add_argument("--prefix-cache-size", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="also write a committed artifact JSON "
                          "(metrics + engine config + host context)")
@@ -61,7 +67,8 @@ def main() -> None:
     ray_tpu.init(num_cpus=4)
     serve.run(
         serve.deployment(LLMDeployment).bind(
-            args.model, num_slots=args.num_slots, max_len=args.max_len),
+            args.model, num_slots=args.num_slots, max_len=args.max_len,
+            prefix_cache_size=args.prefix_cache_size),
         name="llm", _http=True, route_prefix="/llm")
     port = serve.http_port()
     url = f"http://127.0.0.1:{port}/llm?stream=1&method=stream"
@@ -158,6 +165,7 @@ def main() -> None:
                 "max_len": args.max_len, "max_tokens": args.max_tokens,
                 "requests": args.requests,
                 "concurrency": args.concurrency,
+                "prefix_cache_size": args.prefix_cache_size,
                 "ttft_regime": (
                     "admission-free (concurrency <= num_slots): TTFT "
                     "measures prefill" if args.concurrency

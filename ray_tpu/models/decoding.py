@@ -232,10 +232,46 @@ def decode_and_sample(params, cache: KVCache, tokens, active, temps, rng,
 
 def prefill_and_sample(params, cache: KVCache, tokens, slot, length, temp,
                        rng, cfg: TransformerConfig):
+    """Returns (cache, first_token, last_logits, rng) — the logits ride
+    back so the engine's prefix cache can re-sample them under a
+    different temperature on a later hit."""
     cache, last_logits = prefill(params, cache, tokens, slot, length, cfg)
     rng, sub = jax.random.split(rng)
     tok = sample_per_slot(last_logits[None], sub, temp[None])[0]
-    return cache, tok, rng
+    return cache, tok, last_logits, rng
+
+
+def extract_prefix(cache: KVCache, slot, t: int):
+    """Snapshot the first `t` positions of one slot's KV
+    (L, t, Hkv, D) — `t` is the prompt's prefill bucket (static: one
+    compile per bucket, like prefill itself), so an entry costs
+    t/max_len of a slot's HBM rather than a whole slot. Jit outputs
+    are fresh buffers, so the snapshot survives later donation of
+    `cache`."""
+    k = jax.lax.dynamic_index_in_dim(cache.k, slot, 1, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache.v, slot, 1, keepdims=False)
+    return k[:, :t], v[:, :t]
+
+
+def insert_prefix(cache: KVCache, k_slice, v_slice, slot, length
+                  ) -> KVCache:
+    """Write a snapshotted prefix back into `slot` (prefix-cache hit:
+    replaces the whole prefill computation with one HBM copy). Only
+    the snapshot's positions are written; staler KV beyond `length`
+    is masked out by the per-slot length exactly as prefill padding
+    is."""
+    zero = jnp.zeros((), jnp.int32)
+    start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    return KVCache(
+        k=jax.lax.dynamic_update_slice(cache.k, k_slice[:, None], start),
+        v=jax.lax.dynamic_update_slice(cache.v, v_slice[:, None], start),
+        lengths=cache.lengths.at[slot].set(length))
+
+
+def sample_one(last_logits, temp, rng):
+    """Re-sample a stored last-logits vector (prefix-cache hit path)."""
+    rng, sub = jax.random.split(rng)
+    return sample_per_slot(last_logits[None], sub, temp[None])[0], rng
 
 
 def decode_burst(params, cache: KVCache, tokens, active, temps, rng,
@@ -266,3 +302,14 @@ def make_engine_fns(cfg: TransformerConfig, *, num_slots: int,
     decode_jit = jax.jit(df, static_argnames=("n_steps",),
                          donate_argnums=(1,) if donate else ())
     return prefill_jit, decode_jit
+
+
+def make_prefix_cache_fns(donate: bool = True):
+    """Jitted (extract, insert, sample) for the engine's prefix cache.
+    Insert donates the live cache (it is immediately replaced); extract
+    never donates — its output must outlive the donated original."""
+    extract_jit = jax.jit(extract_prefix, static_argnames=("t",))
+    insert_jit = jax.jit(insert_prefix,
+                         donate_argnums=(0,) if donate else ())
+    sample_jit = jax.jit(sample_one)
+    return extract_jit, insert_jit, sample_jit

@@ -50,10 +50,14 @@ class LLMEngine:
     def __init__(self, cfg, params, *, num_slots: int = 8,
                  max_len: int = 1024, prefill_buckets=(64, 128, 256, 512),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 max_burst: int = 8):
+                 max_burst: int = 8, prefix_cache_size: int = 4):
         import jax
 
-        from ray_tpu.models.decoding import init_cache, make_engine_fns
+        from ray_tpu.models.decoding import (
+            init_cache,
+            make_engine_fns,
+            make_prefix_cache_fns,
+        )
 
         self.cfg = cfg
         self.params = params
@@ -72,6 +76,18 @@ class LLMEngine:
         self.cache = init_cache(cfg, num_slots, max_len)
         self._prefill, self._decode = make_engine_fns(
             cfg, num_slots=num_slots, max_len=max_len)
+        # Prefix cache (the vLLM automatic-prefix-caching analogue,
+        # scoped to WHOLE prompts): repeated prompts — shared system
+        # prompts, retries, bench warmups — skip prefill entirely; a
+        # hit costs one HBM slot-write + one sampling call instead of
+        # the full prompt forward. LRU-bounded; 0 disables.
+        self._prefix_cache_size = max(0, prefix_cache_size)
+        # Insertion-ordered dict IS the LRU: re-insert on hit, pop the
+        # oldest key on overflow.
+        self._prefix_cache: "Dict[tuple, dict]" = {}
+        if self._prefix_cache_size:
+            (self._px_extract, self._px_insert,
+             self._px_sample) = make_prefix_cache_fns()
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._last_tokens = np.zeros((num_slots,), np.int32)
@@ -79,7 +95,8 @@ class LLMEngine:
         self._stop = False
         self._lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
-                      "ttft_sum": 0.0, "completed": 0}
+                      "ttft_sum": 0.0, "completed": 0,
+                      "prefix_hits": 0, "prefix_misses": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -162,13 +179,41 @@ class LLMEngine:
             return False
         try:
             n = len(req.prompt)
-            bucket = self._bucket_for(n)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = req.prompt
-            self.cache, tok, self._rng = self._prefill(
-                self.params, self.cache, jnp.asarray(toks),
-                jnp.int32(slot), jnp.int32(n),
-                jnp.float32(req.temperature), self._rng)
+            key = tuple(req.prompt)
+            entry = (self._prefix_cache.get(key)
+                     if self._prefix_cache_size else None)
+            if entry is not None:
+                # Hit: HBM copy of the snapshotted KV + re-sample the
+                # stored last-token logits under THIS request's
+                # temperature — no prompt forward at all.
+                self.cache = self._px_insert(
+                    self.cache, entry["k"], entry["v"],
+                    jnp.int32(slot), jnp.int32(n))
+                tok, self._rng = self._px_sample(
+                    entry["logits"], jnp.float32(req.temperature),
+                    self._rng)
+                self._prefix_cache[key] = self._prefix_cache.pop(key)
+                self.stats["prefix_hits"] += 1
+            else:
+                bucket = self._bucket_for(n)
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :n] = req.prompt
+                self.cache, tok, last_logits, self._rng = self._prefill(
+                    self.params, self.cache, jnp.asarray(toks),
+                    jnp.int32(slot), jnp.int32(n),
+                    jnp.float32(req.temperature), self._rng)
+                self.stats["prefix_misses"] += 1
+                if self._prefix_cache_size:
+                    # Snapshot only the prompt's bucket worth of KV.
+                    k_slice, v_slice = self._px_extract(
+                        self.cache, jnp.int32(slot), t=bucket)
+                    self._prefix_cache[key] = {
+                        "k": k_slice, "v": v_slice,
+                        "logits": last_logits}
+                    while len(self._prefix_cache) > \
+                            self._prefix_cache_size:
+                        self._prefix_cache.pop(
+                            next(iter(self._prefix_cache)))
             req.first_token_at = time.perf_counter()
             req.emit(int(tok))
             req.slot = slot
@@ -257,6 +302,7 @@ class LLMDeployment:
 
     def __init__(self, cfg_name: str, *, num_slots: int = 8,
                  max_len: int = 512, seed: int = 0,
+                 prefix_cache_size: int = 4,
                  params_loader: Optional[Callable] = None):
         import jax
 
@@ -266,7 +312,8 @@ class LLMDeployment:
         params = (params_loader() if params_loader
                   else init_params(jax.random.key(seed), cfg))
         self.engine = LLMEngine(cfg, params, num_slots=num_slots,
-                                max_len=max_len)
+                                max_len=max_len,
+                                prefix_cache_size=prefix_cache_size)
 
     def __call__(self, request: dict) -> dict:
         toks = self.engine.generate(

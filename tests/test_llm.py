@@ -108,3 +108,42 @@ def test_engine_determinism_matches_decode(params):
                                     jnp.asarray([True]), CFG)
         ref.append(int(jnp.argmax(logits[0])))
     assert out == ref
+
+
+def test_prefix_cache_hit_skips_prefill_and_matches(params):
+    """Second generation of the SAME prompt is a prefix-cache hit (no
+    prompt forward) and, under greedy decoding, produces the identical
+    continuation. Distinct prompts miss; LRU bounds the entries
+    (the vLLM automatic-prefix-caching analogue)."""
+    eng = LLMEngine(CFG, params, num_slots=2, max_len=64,
+                    prefill_buckets=(16,), prefix_cache_size=2)
+    prompt = [5, 6, 7, 8]
+    first = eng.generate(prompt, max_tokens=6)
+    assert eng.stats["prefix_misses"] == 1
+    second = eng.generate(prompt, max_tokens=6)
+    assert eng.stats["prefix_hits"] == 1
+    assert second == first                  # greedy: bitwise-identical
+
+    other = eng.generate([9, 10], max_tokens=4)
+    assert eng.stats["prefix_misses"] == 2
+    assert len(other) == 4
+
+    # LRU eviction at capacity 2: a third prompt evicts the oldest.
+    eng.generate([11, 12, 13], max_tokens=2)
+    assert len(eng._prefix_cache) == 2
+    assert tuple(prompt) not in eng._prefix_cache
+    # Hit path still interleaves correctly with fresh admissions.
+    assert eng.generate([9, 10], max_tokens=4) == other
+    assert eng.stats["prefix_hits"] == 2
+    eng.shutdown()
+
+
+def test_prefix_cache_disabled(params):
+    eng = LLMEngine(CFG, params, num_slots=2, max_len=64,
+                    prefill_buckets=(16,), prefix_cache_size=0)
+    p = [1, 2, 3]
+    a = eng.generate(p, max_tokens=4)
+    b = eng.generate(p, max_tokens=4)
+    assert a == b
+    assert eng.stats["prefix_hits"] == 0
+    eng.shutdown()
