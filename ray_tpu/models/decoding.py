@@ -139,6 +139,57 @@ def prefill(params, cache: KVCache, tokens: jax.Array, slot: jax.Array,
     return new_cache, last
 
 
+def _wide_decode(params, cache: KVCache, tokens: jax.Array,
+                 cfg: TransformerConfig):
+    """Shared width-K decode core: process `tokens` (S, K) at positions
+    lengths[s]..lengths[s]+K-1, writing their KV into each slot and
+    attending to cache[:len] plus the in-window causal prefix. Returns
+    (logits (S, K, vocab), new_k, new_v) — callers decide how far
+    `lengths` advances (decode: +1; speculative verify: +accepted+1).
+    decode_step is exactly the K=1 case."""
+    cd = cfg.compute_dtype
+    s_count, k_w = tokens.shape
+    t_cache = cache.k.shape[2]
+    start = cache.lengths                                  # (S,)
+    positions = start[:, None] + jnp.arange(k_w)           # (S, K)
+    x = params["embed"].astype(cd)[tokens]                 # (S, K, d)
+
+    kv_pos = jnp.arange(t_cache)
+    # window token i attends to cache[:len] plus window tokens 0..i.
+    attn_mask = kv_pos[None, None, :] <= positions[:, :, None]  # (S,K,T)
+
+    def layer(carry, layer_in):
+        x = carry
+        bp, k_cache, v_cache = layer_in
+        q, k, v = _qkv(bp, x, cfg, positions)              # (S,K,H,D)
+        k_cache = jax.vmap(
+            lambda kc, kn, p: jax.lax.dynamic_update_slice(
+                kc, kn.astype(kc.dtype), (p, 0, 0)))(k_cache, k, start)
+        v_cache = jax.vmap(
+            lambda vc, vn, p: jax.lax.dynamic_update_slice(
+                vc, vn.astype(vc.dtype), (p, 0, 0)))(v_cache, v, start)
+        kh, vh = k_cache, v_cache
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        s = jnp.einsum("sqhd,sthd->sqht", q.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(attn_mask[:, :, None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("sqht,sthd->sqhd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(s_count, k_w, cfg.n_heads * cfg.head_dim)
+        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
+                           bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k_cache, v_cache)
+
+    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    new_k, new_v = new_kv
+    logits = _final_logits(params, x, cfg)                 # (S, K, vocab)
+    return logits, new_k, new_v
+
+
 def decode_step(params, cache: KVCache, tokens: jax.Array,
                 active: jax.Array, cfg: TransformerConfig
                 ) -> Tuple[KVCache, jax.Array]:
@@ -147,52 +198,63 @@ def decode_step(params, cache: KVCache, tokens: jax.Array,
 
     Inactive slots still flow through the matmuls (fixed shapes) but their
     cache/lengths are left untouched."""
-    cd = cfg.compute_dtype
-    s_count = tokens.shape[0]
-    t_cache = cache.k.shape[2]
-    positions = cache.lengths                            # (S,) next index
-    x = params["embed"].astype(cd)[tokens][:, None]      # (S, 1, d)
-    pos_b = positions[:, None]                           # (S, 1)
-
-    kv_pos = jnp.arange(t_cache)
-    # slot s attends to cache[:len] plus its own new token at index len.
-    attn_mask = kv_pos[None, :] <= positions[:, None]    # (S, T)
-
-    def layer(carry, layer_in):
-        x = carry
-        bp, k_cache, v_cache = layer_in
-        q, k, v = _qkv(bp, x, cfg, pos_b)                # q (S,1,H,D)
-        k_cache = jax.vmap(
-            lambda kc, kn, p: jax.lax.dynamic_update_index_in_dim(
-                kc, kn.astype(kc.dtype), p, 0))(k_cache, k[:, 0], positions)
-        v_cache = jax.vmap(
-            lambda vc, vn, p: jax.lax.dynamic_update_index_in_dim(
-                vc, vn.astype(vc.dtype), p, 0))(v_cache, v[:, 0], positions)
-        kh, vh = k_cache, v_cache
-        if cfg.n_kv_heads != cfg.n_heads:
-            rep = cfg.n_heads // cfg.n_kv_heads
-            kh = jnp.repeat(kh, rep, axis=2)
-            vh = jnp.repeat(vh, rep, axis=2)
-        s = jnp.einsum("sohd,sthd->soht", q.astype(jnp.float32),
-                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
-        s = jnp.where(attn_mask[:, None, None, :], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("soht,sthd->sohd", p, vh.astype(jnp.float32))
-        attn = attn.reshape(s_count, 1, cfg.n_heads * cfg.head_dim)
-        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
-                           bp["wo"].astype(cd))
-        x = x + _mlp(bp, x, cfg)
-        return x, (k_cache, v_cache)
-
-    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
-    new_k, new_v = new_kv
+    logits, new_k, new_v = _wide_decode(params, cache, tokens[:, None],
+                                        cfg)
     keep = active[None, :, None, None, None]
     new_cache = KVCache(
         k=jnp.where(keep, new_k, cache.k),
         v=jnp.where(keep, new_v, cache.v),
         lengths=jnp.where(active, cache.lengths + 1, cache.lengths))
-    logits = _final_logits(params, x, cfg)[:, 0]         # (S, vocab)
-    return new_cache, logits
+    return new_cache, logits[:, 0]
+
+
+def verify_step(params, cache: KVCache, cand_tokens: jax.Array,
+                active: jax.Array, temps: jax.Array, rng: jax.Array,
+                cfg: TransformerConfig):
+    """Speculative verification: K candidate tokens PER SLOT in one
+    call (prompt-lookup decoding — the draft comes from n-gram matches
+    in the slot's own context, no draft model; ref: the role vLLM's
+    ngram speculator fills).
+
+    cand_tokens (S, K): column 0 is each slot's last sampled token
+    (whose KV is not yet written), columns 1..K-1 are the proposals.
+    Returns (cache, tok_out (S, K), accepted (S,)):
+      - tok_out[s, i] = the model's token at position len+i+1 (greedy;
+        for temps>0 column 0 is properly sampled and acceptance is
+        forced to 0, degenerating to an exact normal decode step)
+      - accepted[s] = a — proposals 1..a matched, so the engine emits
+        tok_out[s, :a+1] (a accepted + 1 bonus) and lengths advance by
+        a+1. KV for ALL K candidates is written; positions beyond the
+        new length hold stale values that every attention mask already
+        ignores — acceptance is just length arithmetic, no rollback
+        copy.
+
+    Cost intuition: decode is HBM-bandwidth-bound; widening the query
+    from 1 to K reuses the same weight/cache streams, so a verify call
+    costs about one decode step while advancing up to K tokens.
+    """
+    start = cache.lengths                                  # (S,)
+    logits, new_k, new_v = _wide_decode(params, cache, cand_tokens, cfg)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S,K)
+    # Proposal i (column i of cand) is correct iff the model's greedy
+    # token at the PREVIOUS position equals it; acceptance is the run
+    # of correct proposals. Sampling slots accept nothing.
+    match = (cand_tokens[:, 1:] == greedy[:, :-1])
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    accepted = jnp.where(temps > 0.0, 0, acc.sum(axis=1))   # (S,)
+    rng, sub = jax.random.split(rng)
+    first_sampled = sample_per_slot(logits[:, 0], sub, temps)
+    tok_out = greedy.at[:, 0].set(first_sampled)
+
+    keep = active[None, :, None, None, None]
+    new_lengths = jnp.where(
+        active, start + 1 + accepted.astype(jnp.int32), start)
+    new_cache = KVCache(
+        k=jnp.where(keep, new_k, cache.k),
+        v=jnp.where(keep, new_v, cache.v),
+        lengths=new_lengths)
+    return new_cache, tok_out, accepted, rng
 
 
 def sample_logits(logits: jax.Array, rng: jax.Array, *,
@@ -302,6 +364,29 @@ def make_engine_fns(cfg: TransformerConfig, *, num_slots: int,
     decode_jit = jax.jit(df, static_argnames=("n_steps",),
                          donate_argnums=(1,) if donate else ())
     return prefill_jit, decode_jit
+
+
+def ngram_propose(context, k_minus_1: int, ngram: int = 2):
+    """Host-side draft: match the trailing `ngram` tokens against the
+    earlier context; propose the tokens that followed the most recent
+    match. Returns a list of <= k_minus_1 proposals (possibly empty)."""
+    n = len(context)
+    if n < ngram + 1:
+        return []
+    tail = tuple(context[n - ngram:])
+    # scan backwards for the most recent earlier occurrence
+    for i in range(n - ngram - 1, -1, -1):
+        if tuple(context[i:i + ngram]) == tail:
+            j = i + ngram
+            return list(context[j:j + k_minus_1])
+    return []
+
+
+def make_spec_fns(cfg: TransformerConfig, donate: bool = True):
+    """Jitted speculative verifier (K rides in the candidate shape:
+    one compile per K, same discipline as prefill buckets)."""
+    return jax.jit(functools.partial(verify_step, cfg=cfg),
+                   donate_argnums=(1,) if donate else ())
 
 
 def make_prefix_cache_fns(donate: bool = True):

@@ -50,13 +50,15 @@ class LLMEngine:
     def __init__(self, cfg, params, *, num_slots: int = 8,
                  max_len: int = 1024, prefill_buckets=(64, 128, 256, 512),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 max_burst: int = 8, prefix_cache_size: int = 4):
+                 max_burst: int = 8, prefix_cache_size: int = 4,
+                 speculation_k: int = 0, speculation_ngram: int = 2):
         import jax
 
         from ray_tpu.models.decoding import (
             init_cache,
             make_engine_fns,
             make_prefix_cache_fns,
+            make_spec_fns,
         )
 
         self.cfg = cfg
@@ -88,6 +90,19 @@ class LLMEngine:
         if self._prefix_cache_size:
             (self._px_extract, self._px_insert,
              self._px_sample) = make_prefix_cache_fns()
+        # Prompt-lookup speculative decoding (opt-in): each tick
+        # verifies K candidate tokens per slot in one call; drafts come
+        # from n-gram matches in the slot's own context. Exact under
+        # greedy decoding; sampling slots degrade to normal decode.
+        self._spec_k = speculation_k if speculation_k >= 2 else 0
+        self._spec_ngram = max(1, speculation_ngram)
+        # The cache margin _maybe_finish keeps free must cover whichever
+        # advance is larger — a burst OR a spec window — WITHOUT
+        # inflating the actual burst depth (the EOS-overshoot cap on
+        # max_burst stays meaningful).
+        self._advance_margin = max(self.max_burst, self._spec_k)
+        if self._spec_k:
+            self._verify = make_spec_fns(cfg)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._last_tokens = np.zeros((num_slots,), np.int32)
@@ -96,7 +111,8 @@ class LLMEngine:
         self._lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
                       "ttft_sum": 0.0, "completed": 0,
-                      "prefix_hits": 0, "prefix_misses": 0}
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -233,10 +249,12 @@ class LLMEngine:
             return
         tok = req.out_tokens[-1] if req.out_tokens else None
         hit_eos = self.eos_id is not None and tok == self.eos_id
-        # Margin of one burst below max_len so a fixed-size burst can never
-        # run the cache past its capacity.
+        # Margin of one full advance (burst or spec window) below
+        # max_len so a fixed-size tick can never run the cache past
+        # its capacity.
         full = (len(req.prompt) + len(req.out_tokens)
-                >= self.max_len - 1 - self.max_burst)
+                >= self.max_len - 1 - getattr(self, "_advance_margin",
+                                              self.max_burst))
         if hit_eos or full or len(req.out_tokens) >= req.max_tokens:
             self.stats["completed"] += 1
             self.stats["ttft_sum"] += (req.first_token_at
@@ -245,6 +263,68 @@ class LLMEngine:
             if req.token_q is not None:
                 req.token_q.put(None)  # stream sentinel
             req.done.set()
+
+    def _spec_tick(self, active_mask, temps) -> bool:
+        """One speculative verify tick. Returns False when NO slot has
+        a draft (caller falls back to the plain burst — no wasted
+        K-wide call). Greedy acceptance is exact; any accidentally-
+        accepted padding token is by definition the true greedy
+        continuation, so padding needs no masking."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import ngram_propose
+
+        k = self._spec_k
+        cand = np.zeros((self.num_slots, k), np.int32)
+        drafted = 0
+        greedy_active = 0
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            cand[i, 0] = self._last_tokens[i]
+            props = []
+            if req.temperature == 0.0:
+                greedy_active += 1
+                ctx = req.prompt + req.out_tokens
+                props = ngram_propose(ctx, k - 1, self._spec_ngram)
+            for j in range(1, k):
+                cand[i, j] = (props[j - 1] if j - 1 < len(props)
+                              else self._last_tokens[i])
+            if props:
+                drafted += 1
+        # Run the verify tick only when a MAJORITY of active greedy
+        # slots carry a draft: slots without one (and sampling slots)
+        # advance a single token per spec tick, so a lone drafted slot
+        # must not preempt the max_burst-deep decode for everyone else.
+        total_active = int(active_mask.sum())
+        if drafted == 0 or 2 * drafted < greedy_active \
+                or 2 * greedy_active < total_active:
+            return False
+        # All k-1 candidate columns of every GREEDY slot count as
+        # proposed — padding (last-token repeats) can legitimately
+        # accept too, and accepted must never exceed proposed.
+        self.stats["spec_proposed"] += (k - 1) * greedy_active
+        self.cache, tok_out, accepted, self._rng = self._verify(
+            self.params, self.cache, jnp.asarray(cand),
+            jnp.asarray(active_mask), jnp.asarray(temps), self._rng)
+        tok_out = np.asarray(tok_out)
+        accepted = np.asarray(accepted)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            a = int(accepted[i])
+            self.stats["spec_accepted"] += a
+            for tok in tok_out[i, :a + 1]:
+                tok = int(tok)
+                if len(req.out_tokens) >= req.max_tokens:
+                    break  # over-generated tail: trim
+                req.emit(tok)
+                self._last_tokens[i] = tok
+                self.stats["tokens_generated"] += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    break
+            self._maybe_finish(i)
+        return True
 
     def _loop(self):
         import jax.numpy as jnp
@@ -261,6 +341,8 @@ class LLMEngine:
                 temps = np.array(
                     [r.temperature if r else 0.0 for r in self._slots],
                     np.float32)
+                if self._spec_k and self._spec_tick(active_mask, temps):
+                    continue
                 # Fixed burst size: exactly ONE decode executable (compiles
                 # are expensive, especially via remote-compile).  Slots that
                 # hit max_tokens mid-burst over-generate and are trimmed;
@@ -302,7 +384,7 @@ class LLMDeployment:
 
     def __init__(self, cfg_name: str, *, num_slots: int = 8,
                  max_len: int = 512, seed: int = 0,
-                 prefix_cache_size: int = 4,
+                 prefix_cache_size: int = 4, speculation_k: int = 0,
                  params_loader: Optional[Callable] = None):
         import jax
 
@@ -313,7 +395,8 @@ class LLMDeployment:
                   else init_params(jax.random.key(seed), cfg))
         self.engine = LLMEngine(cfg, params, num_slots=num_slots,
                                 max_len=max_len,
-                                prefix_cache_size=prefix_cache_size)
+                                prefix_cache_size=prefix_cache_size,
+                                speculation_k=speculation_k)
 
     def __call__(self, request: dict) -> dict:
         toks = self.engine.generate(

@@ -147,3 +147,86 @@ def test_prefix_cache_disabled(params):
     assert a == b
     assert eng.stats["prefix_hits"] == 0
     eng.shutdown()
+
+
+def test_verify_step_exact_speculative_acceptance(params):
+    """Speculative verification is EXACT under greedy decoding: correct
+    proposals accept (advancing several tokens in one call), the first
+    wrong proposal rejects, and the continuation equals sequential
+    decode bit-for-bit."""
+    from ray_tpu.models.decoding import verify_step
+
+    prompt = [5, 6, 7, 8]
+    # Reference: sequential greedy decode of 6 tokens.
+    cache = init_cache(CFG, num_slots=1, max_len=64)
+    padded = jnp.zeros((1, 16), jnp.int32).at[:, :4].set(
+        jnp.asarray([prompt]))
+    cache, last = prefill(params, cache, padded, jnp.int32(0),
+                          jnp.int32(4), CFG)
+    ref = [int(jnp.argmax(last))]
+    for _ in range(5):
+        cache, logits = decode_step(params, cache,
+                                    jnp.asarray([ref[-1]], jnp.int32),
+                                    jnp.asarray([True]), CFG)
+        ref.append(int(jnp.argmax(logits[0])))
+
+    # Speculative: candidates = [t0, ref[1], ref[2], WRONG].
+    cache2 = init_cache(CFG, num_slots=1, max_len=64)
+    cache2, last2 = prefill(params, cache2, padded, jnp.int32(0),
+                            jnp.int32(4), CFG)
+    t0 = int(jnp.argmax(last2))
+    assert t0 == ref[0]
+    wrong = (ref[3] + 1) % CFG.vocab_size
+    cand = jnp.asarray([[t0, ref[1], ref[2], wrong]], jnp.int32)
+    rng = jax.random.key(0)
+    cache2, tok_out, accepted, rng = verify_step(
+        params, cache2, cand, jnp.asarray([True]),
+        jnp.asarray([0.0], jnp.float32), rng, CFG)
+    a = int(accepted[0])
+    assert a == 2                        # two correct proposals
+    emitted = [int(t) for t in np.asarray(tok_out[0, :a + 1])]
+    assert emitted == ref[1:4]           # accepted + bonus == reference
+    assert int(cache2.lengths[0]) == 4 + 1 + a   # prompt+t0+accepted
+
+    # Continue decoding after the verify call: still exact.
+    cont = [emitted[-1]]
+    for _ in range(2):
+        cache2, logits = decode_step(params, cache2,
+                                     jnp.asarray([cont[-1]], jnp.int32),
+                                     jnp.asarray([True]), CFG)
+        cont.append(int(jnp.argmax(logits[0])))
+    assert cont[1:] == ref[4:6]
+
+    # A sampling slot (temp>0) accepts nothing — exact fallback.
+    cache3 = init_cache(CFG, num_slots=1, max_len=64)
+    cache3, _ = prefill(params, cache3, padded, jnp.int32(0),
+                        jnp.int32(4), CFG)
+    cache3, tok_out3, accepted3, _ = verify_step(
+        params, cache3, cand, jnp.asarray([True]),
+        jnp.asarray([0.7], jnp.float32), jax.random.key(1), CFG)
+    assert int(accepted3[0]) == 0
+    assert int(cache3.lengths[0]) == 5   # advanced exactly one
+
+
+def test_engine_speculative_matches_plain_greedy(params):
+    """With prompt-lookup speculation on, greedy generation must be
+    BIT-IDENTICAL to the plain engine (speculation is exact — only
+    faster), and drafts must actually be proposed on a repetitive
+    prompt."""
+    prompt = [1, 2, 3, 1, 2, 3, 1, 2]   # n-gram lookup has matches
+    plain = LLMEngine(CFG, params, num_slots=2, max_len=64,
+                      prefill_buckets=(16,), prefix_cache_size=0)
+    ref = plain.generate(prompt, max_tokens=12)
+    plain.shutdown()
+
+    spec = LLMEngine(CFG, params, num_slots=2, max_len=64,
+                     prefill_buckets=(16,), prefix_cache_size=0,
+                     speculation_k=4)
+    out = spec.generate(prompt, max_tokens=12)
+    assert out == ref
+    st = spec.engine_stats()
+    assert st["spec_proposed"] > 0
+    # Sampling path still works alongside (falls back per slot).
+    sampled = spec.generate(prompt, max_tokens=6, temperature=0.8)
+    assert len(sampled) == 6
+    spec.shutdown()
