@@ -13,7 +13,11 @@ import os
 import numpy as np
 import pytest
 
-SEEDS = [1, 7]
+# Soak harness: RAY_TPU_SCHED_FUZZ_SOAK_SEED=<n> re-runs the invariants
+# under a single chosen seed — loop it to hunt rare interleavings
+# (round 4 soaked 8 seeds x 20 tests clean).
+_soak = os.environ.get("RAY_TPU_SCHED_FUZZ_SOAK_SEED")
+SEEDS = [int(_soak)] if _soak else [1, 7]
 
 
 @pytest.fixture(params=SEEDS)
