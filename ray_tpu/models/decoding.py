@@ -38,12 +38,36 @@ class KVCache:
 jax.tree_util.register_dataclass(KVCache, ["k", "v", "lengths"], [])
 
 
+def cache_shardings(mesh):
+    """NamedShardings for the KVCache leaves, defined NEXT TO the
+    (L, S, T, Hkv, D) layout they index: kv-heads split over the mesh
+    `tp` axis, lengths replicated (tensor-parallel serving)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import AXIS_TENSOR
+
+    kv = NamedSharding(mesh, P(None, None, None, AXIS_TENSOR, None))
+    return KVCache(k=kv, v=kv, lengths=NamedSharding(mesh, P()))
+
+
 def init_cache(cfg: TransformerConfig, num_slots: int, max_len: int,
-               dtype=None) -> KVCache:
+               dtype=None, shardings: "KVCache | None" = None) -> KVCache:
+    """Zero cache; with `shardings` the arrays are allocated DIRECTLY
+    sharded (no single-device materialization — a cache that only fits
+    split across chips must never exist whole on chip 0)."""
     dtype = dtype or cfg.compute_dtype
     shape = (cfg.n_layers, num_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   lengths=jnp.zeros((num_slots,), jnp.int32))
+
+    def zeros(s, d, sh):
+        return jnp.zeros(s, d, device=sh) if sh is not None else \
+            jnp.zeros(s, d)
+
+    k_sh = shardings.k if shardings else None
+    v_sh = shardings.v if shardings else None
+    l_sh = shardings.lengths if shardings else None
+    return KVCache(k=zeros(shape, dtype, k_sh),
+                   v=zeros(shape, dtype, v_sh),
+                   lengths=zeros((num_slots,), jnp.int32, l_sh))
 
 
 def _qkv(bp, x, cfg, positions):

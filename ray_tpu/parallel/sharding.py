@@ -37,6 +37,18 @@ DEFAULT_RULES: LogicalRules = {
     "layers": None,
 }
 
+# Inference layout: params split over tp on their head/mlp/vocab axes,
+# everything else replicated — the serving analogue (decode has no
+# batch axis worth sharding; a model too big for one chip splits over
+# tp and XLA inserts the all-reduces after wo / w_down). Experts stay
+# replicated so the rules work on a tp-only mesh.
+TP_RULES: LogicalRules = {
+    "batch": None, "seq": None, "embed": None,
+    "heads": AXIS_TENSOR, "kv_heads": AXIS_TENSOR, "head_dim": None,
+    "mlp": AXIS_TENSOR, "vocab": AXIS_TENSOR, "expert": None,
+    "layers": None,
+}
+
 # Pure data-parallel: replicate every parameter (DDP-equivalent).
 DDP_RULES: LogicalRules = {
     "batch": (AXIS_DATA, AXIS_FSDP),

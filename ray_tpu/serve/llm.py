@@ -51,7 +51,8 @@ class LLMEngine:
                  max_len: int = 1024, prefill_buckets=(64, 128, 256, 512),
                  eos_id: Optional[int] = None, seed: int = 0,
                  max_burst: int = 8, prefix_cache_size: int = 4,
-                 speculation_k: int = 0, speculation_ngram: int = 2):
+                 speculation_k: int = 0, speculation_ngram: int = 2,
+                 mesh=None):
         import jax
 
         from ray_tpu.models.decoding import (
@@ -62,7 +63,7 @@ class LLMEngine:
         )
 
         self.cfg = cfg
-        self.params = params
+        # self.params is assigned below, after optional tp resharding.
         self.num_slots = num_slots
         self.max_len = max_len
         self.buckets = tuple(b for b in sorted(prefill_buckets)
@@ -75,7 +76,47 @@ class LLMEngine:
                              min(max_burst, 4))
         self._jax = jax
         self._rng = jax.random.key(seed)
-        self.cache = init_cache(cfg, num_slots, max_len)
+        if mesh is not None:
+            # Tensor-parallel serving: params split over the mesh `tp`
+            # axis (TP_RULES), KV cache split on its kv-heads axis —
+            # the SAME jitted engine programs run unchanged; GSPMD
+            # propagates the shardings and inserts the all-reduces
+            # after wo/w_down. This is how a model too big for one
+            # chip serves: a sharding annotation, not an engine fork.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.models.decoding import cache_shardings
+            from ray_tpu.models.transformer import param_logical_axes
+            from ray_tpu.parallel.mesh import AXIS_TENSOR
+            from ray_tpu.parallel.sharding import (
+                TP_RULES,
+                param_shardings,
+                shard_pytree,
+            )
+
+            tp = int(mesh.shape.get(AXIS_TENSOR, 1))
+            for dim_name, dim in (("n_kv_heads", cfg.n_kv_heads),
+                                  ("n_heads", cfg.n_heads),
+                                  ("d_ff", cfg.d_ff),
+                                  ("vocab_size", cfg.vocab_size)):
+                if dim % tp:
+                    raise ValueError(
+                        f"tensor parallelism {tp} does not divide "
+                        f"{dim_name}={dim} for model {cfg.name!r} — "
+                        f"pick a tp that divides all sharded dims")
+            shardings = param_shardings(param_logical_axes(cfg), mesh,
+                                        TP_RULES)
+            # Shard from HOST copies so the unsharded model never has
+            # to fit on one chip (pass host arrays from params_loader
+            # for models that genuinely don't).
+            params = shard_pytree(jax.device_get(params), shardings)
+            self.cache = init_cache(cfg, num_slots, max_len,
+                                    shardings=cache_shardings(mesh))
+            self._rng = jax.device_put(
+                self._rng, NamedSharding(mesh, P()))
+        else:
+            self.cache = init_cache(cfg, num_slots, max_len)
+        self.params = params
         self._prefill, self._decode = make_engine_fns(
             cfg, num_slots=num_slots, max_len=max_len)
         # Prefix cache (the vLLM automatic-prefix-caching analogue,
@@ -385,6 +426,7 @@ class LLMDeployment:
     def __init__(self, cfg_name: str, *, num_slots: int = 8,
                  max_len: int = 512, seed: int = 0,
                  prefix_cache_size: int = 4, speculation_k: int = 0,
+                 tensor_parallel: int = 0,
                  params_loader: Optional[Callable] = None):
         import jax
 
@@ -393,10 +435,26 @@ class LLMDeployment:
         cfg = configs.get(cfg_name)
         params = (params_loader() if params_loader
                   else init_params(jax.random.key(seed), cfg))
+        mesh = None
+        if tensor_parallel > 1:
+            # Claim N local chips as a tp mesh for this replica (the
+            # router still spreads requests across replicas).
+            # build_mesh permutes devices so the tp axis sits on
+            # contiguous ICI neighborhoods — exactly where per-token
+            # all-reduces must live.
+            from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+            devs = jax.devices()[:tensor_parallel]
+            if len(devs) < tensor_parallel:
+                raise ValueError(
+                    f"tensor_parallel={tensor_parallel} > "
+                    f"{len(jax.devices())} visible devices")
+            mesh = build_mesh(MeshConfig(tp=tensor_parallel, fsdp=1),
+                              devices=devs)
         self.engine = LLMEngine(cfg, params, num_slots=num_slots,
                                 max_len=max_len,
                                 prefix_cache_size=prefix_cache_size,
-                                speculation_k=speculation_k)
+                                speculation_k=speculation_k, mesh=mesh)
 
     def __call__(self, request: dict) -> dict:
         toks = self.engine.generate(
