@@ -419,6 +419,29 @@ class LLMEngine:
                         self._slots[i] = None
 
 
+def dryrun_tp_serving(cfg, tp: int, *, timeout: float = 45.0) -> None:
+    """Compile-and-run check for tensor-parallel serving on the current
+    devices (the serving analogue of parallel.pipeline.dryrun_pipeline;
+    the driver's multichip dry-run calls this). The short timeout keeps
+    a stalled sharded compile failing INSIDE an external ~60s budget
+    with a clear error rather than an opaque external kill."""
+    import jax
+
+    from ray_tpu.models import init_params
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tp=tp, fsdp=1),
+                      devices=jax.devices()[:tp])
+    eng = LLMEngine(cfg, init_params(jax.random.key(1), cfg),
+                    num_slots=2, max_len=64, prefill_buckets=(16,),
+                    prefix_cache_size=0, mesh=mesh)
+    try:
+        out = eng.generate([1, 2, 3], max_tokens=4, timeout=timeout)
+        assert len(out) == 4, out
+    finally:
+        eng.shutdown()
+
+
 class LLMDeployment:
     """Serve-deployable wrapper: __call__({"tokens": [...], ...}) →
     {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...)."""
