@@ -1,0 +1,21 @@
+"""Tensor-parallel LLM serving: one replica, model sharded over 2
+devices. On a TPU slice the same flag splits a model too big for one
+chip; XLA inserts the all-reduces (run with
+XLA_FLAGS=--xla_force_host_platform_device_count=2 on CPU)."""
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.llm import LLMDeployment
+
+ray_tpu.init(num_cpus=4)
+serve.run(serve.deployment(LLMDeployment).bind(
+    "tiny", num_slots=4, max_len=128,
+    tensor_parallel=2,          # params + KV cache sharded over tp
+    speculation_k=4),           # prompt-lookup speculative decoding
+    name="llm")
+h = serve.get_app_handle("llm")
+out = h.remote({"tokens": [1, 2, 3, 1, 2, 3], "max_tokens": 16}).result(
+    timeout=300)
+print("generated:", out["tokens"])
+print("engine stats:", h.stats.remote().result(timeout=60))
+serve.shutdown()
+ray_tpu.shutdown()
