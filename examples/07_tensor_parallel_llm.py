@@ -2,6 +2,11 @@
 devices. On a TPU slice the same flag splits a model too big for one
 chip; XLA inserts the all-reduces (run with
 XLA_FLAGS=--xla_force_host_platform_device_count=2 on CPU)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=2")
+
 import ray_tpu
 from ray_tpu import serve
 from ray_tpu.serve.llm import LLMDeployment
