@@ -92,11 +92,18 @@ def measured_peak_flops() -> float:
 
     r = mm(a, b)
     float(r[0, 0].astype(jnp.float32))  # warm + sync
-    t0 = time.perf_counter()
-    r = mm(a, b)
-    float(r[0, 0].astype(jnp.float32))
-    dt = time.perf_counter() - t0
-    return 8 * 2 * n ** 3 / dt
+    # The tunnel chip's deliverable rate varies run to run (shared-link
+    # contention): a single sample under-measures peak and inflates MFU
+    # (or vice versa).  Take the best of several samples — peak is a
+    # capability, not an average.
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = mm(a, b)
+        float(r[0, 0].astype(jnp.float32))
+        dt = time.perf_counter() - t0
+        best = max(best, 8 * 2 * n ** 3 / dt)
+    return best
 
 
 def run_bench(on_tpu: bool, diagnostics: str) -> dict:
@@ -118,6 +125,16 @@ def run_bench(on_tpu: bool, diagnostics: str) -> dict:
         batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
         seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
         steps = int(os.environ.get("RAY_TPU_BENCH_STEPS", "20"))
+        remat = os.environ.get("RAY_TPU_BENCH_REMAT", "")
+        if remat:
+            if remat not in ("full", "dots", "ff", "none"):
+                raise ValueError(
+                    f"RAY_TPU_BENCH_REMAT={remat!r}: expected "
+                    f"full|dots|ff|none (a typo would silently run "
+                    f"full remat while the artifact claims otherwise)")
+            import dataclasses
+            cfg = dataclasses.replace(cfg, remat=remat != "none",
+                                      remat_policy=remat)
         peak = measured_peak_flops()
     else:  # local smoke path
         cfg = configs.TINY
@@ -161,6 +178,8 @@ def run_bench(on_tpu: bool, diagnostics: str) -> dict:
         "vs_baseline": vs_baseline,
         "extra": {
             "backend": backend, "devices": n_dev, "batch": batch, "seq": seq,
+            "remat": getattr(cfg, "remat_policy", "full")
+            if cfg.remat else "none",
             "measured_peak_tflops": (None if peak != peak
                                      else round(peak / 1e12, 1)),
             "mfu_vs_measured_peak": None if mfu != mfu else round(mfu, 4),

@@ -88,6 +88,10 @@ def commit_artifacts(msg: str) -> None:
     if not present:
         return
     for attempt in range(5):  # ride out .git/index.lock contention
+        # `commit --only <path>` rejects paths git has never seen —
+        # stage them first so first-ever evidence files commit too.
+        subprocess.run(["git", "add", "--", *present], cwd=REPO,
+                       capture_output=True, text=True)
         r = subprocess.run(
             ["git", "commit", "--only", *present, "-m", msg],
             cwd=REPO, capture_output=True, text=True)
@@ -98,6 +102,10 @@ def commit_artifacts(msg: str) -> None:
             log("commit: artifacts unchanged")
             return
         time.sleep(3 * (attempt + 1))
+    # Unstage on the failure path: staged-but-uncommitted artifacts
+    # would ride along silently in someone else's next plain commit.
+    subprocess.run(["git", "reset", "--", *present], cwd=REPO,
+                   capture_output=True, text=True)
     log(f"commit FAILED: {r.stderr[-300:]}")
 
 
@@ -126,18 +134,23 @@ def main() -> None:
             continue
 
         log(f"probe: UP ({n} chip) — recording")
-        # Sweep batch sizes for the best MFU; save_last_good keeps the
-        # best of the sweep, BENCH_TPU_HISTORY keeps every point.
-        for batch in ("8", "16", "12"):
+        # Sweep (batch, remat) for the best throughput; save_last_good
+        # keeps the best of the sweep, BENCH_TPU_HISTORY keeps every
+        # point.  The grid includes every config that has ever held the
+        # record (full@b8, dots@b4) so automated windows can refresh it.
+        for batch, remat in (("8", "full"), ("16", "full"),
+                             ("4", "dots")):
             out = run_recorded(
                 [sys.executable, "bench.py", "--record"], 1800,
                 {"RAY_TPU_BENCH_PROBE_TIMEOUT_S": "90",
                  "RAY_TPU_BENCH_PROBE_RETRIES": "1",
-                 "RAY_TPU_BENCH_BATCH": batch})
+                 "RAY_TPU_BENCH_BATCH": batch,
+                 "RAY_TPU_BENCH_REMAT": remat,
+                 "RAY_TPU_BENCH_STEPS": "40"})
             tail = (out.strip().splitlines()[-1][:300]
                     if out.strip() else "no output")
-            log(f"bench.py --record (batch={batch}): {tail}")
-            append_history(f"train_b{batch}", out)
+            log(f"bench.py --record (batch={batch},{remat}): {tail}")
+            append_history(f"train_b{batch}_{remat}", out)
             if '"recorded": false' in out:
                 break   # tunnel dropped mid-window: stop the sweep
 
