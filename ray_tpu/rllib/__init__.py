@@ -33,6 +33,7 @@ from ray_tpu.rllib.core import (
     RLModule,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.impala import IMPALA, ImpalaConfig
 from ray_tpu.rllib.env import register_env
 from ray_tpu.rllib.offline import (
@@ -71,6 +72,8 @@ __all__ = [
     "PPOConfig",
     "DQN",
     "DQNConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "IMPALA",
     "ImpalaConfig",
     "APPO",

@@ -1,0 +1,717 @@
+"""DreamerV3: model-based RL with a categorical-latent world model.
+
+ref: rllib/algorithms/dreamerv3/ (the reference's torch/tf port of
+Hafner et al. 2023, "Mastering Diverse Domains through World Models") —
+RSSM world model (sequence GRU + categorical latents), actor and critic
+trained entirely on imagined rollouts, symlog predictions with two-hot
+reward/value heads, percentile return normalization, EMA-regularized
+critic.
+
+TPU-first shape: the ENTIRE training iteration — world-model scan over
+the replay window, H-step imagination scan, all three optimizers, the
+slow-critic EMA, the return-scale EMA — is ONE jitted program built on
+the core Learner base (`rllib/core/learner.py`). The recurrent pieces
+are `lax.scan`s (no Python-loop unrolling), so the program stays one
+XLA computation with static shapes; under a mesh the replay batch
+shards over `dp` like every other learner here.
+
+Collection diverges from the other algorithms' stateless RolloutWorker:
+the policy is recurrent (posterior state carried across env steps), so
+DreamerV3 owns its env stepping with a jitted recurrent policy step —
+the same split the reference makes (DreamerV3 has its own EnvRunner,
+rllib/algorithms/dreamerv3/utils/env_runner.py).
+
+Discrete action spaces only (the reference's continuous head can land
+later); replay uses on-arrival records: a step's `reward`/`cont`
+describe ARRIVING at its observation, `prev_action` is the action that
+led there — terminal observations are stored (cont=0), auto-reset
+starts carry `is_first=1`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.models import Params, _apply_mlp, _init_mlp
+from ray_tpu.rllib.replay_buffer import SequenceReplayBuffer
+
+# ---------------------------------------------------------------------------
+# symlog / two-hot (Hafner et al. 2023 §"Robust predictions")
+# ---------------------------------------------------------------------------
+
+
+def symlog(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot(y: jnp.ndarray, bins: jnp.ndarray) -> jnp.ndarray:
+    """Scalar y (any shape) -> distribution over `bins` [K] putting mass
+    on the two neighbours proportionally to proximity (exact expectation
+    preservation for in-range y; clamped at the edges)."""
+    k = jnp.clip(jnp.searchsorted(bins, y), 1, bins.shape[0] - 1)
+    lo, hi = bins[k - 1], bins[k]
+    w_hi = jnp.clip((y - lo) / (hi - lo), 0.0, 1.0)
+    return (jax.nn.one_hot(k - 1, bins.shape[0]) * (1.0 - w_hi)[..., None]
+            + jax.nn.one_hot(k, bins.shape[0]) * w_hi[..., None])
+
+
+def twohot_decode(logits: jnp.ndarray, bins: jnp.ndarray) -> jnp.ndarray:
+    return (jax.nn.softmax(logits, axis=-1) * bins).sum(-1)
+
+
+# ---------------------------------------------------------------------------
+# hyperparams
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DreamerV3Hyperparams:
+    deter_dim: int = 256
+    num_categoricals: int = 16
+    num_classes: int = 16
+    units: int = 256            # width of every MLP (2 hidden layers)
+    num_bins: int = 41          # two-hot bins for reward/value, symlog space
+    batch_size: int = 16
+    batch_length: int = 16
+    horizon: int = 15
+    gamma: float = 0.997
+    lam: float = 0.95
+    unimix: float = 0.01
+    free_bits: float = 1.0
+    kl_dyn_scale: float = 0.5
+    kl_rep_scale: float = 0.1
+    ent_coef: float = 3e-4
+    lr_world: float = 1e-3
+    lr_actor: float = 3e-4
+    lr_critic: float = 3e-4
+    grad_clip: float = 100.0
+    return_norm_decay: float = 0.99
+    slow_critic_decay: float = 0.98
+    slow_reg_scale: float = 1.0
+
+    @property
+    def stoch_dim(self) -> int:
+        return self.num_categoricals * self.num_classes
+
+    @property
+    def feat_dim(self) -> int:
+        return self.deter_dim + self.stoch_dim
+
+
+# ---------------------------------------------------------------------------
+# networks (pure-pytree params, models.py conventions)
+# ---------------------------------------------------------------------------
+
+
+def _init_gru(rng: jax.Array, prefix: str, in_dim: int, hid: int,
+              params: Params) -> jax.Array:
+    for gate in ("r", "z", "n"):
+        rng, key = jax.random.split(rng)
+        params[f"{prefix}_w{gate}"] = jax.random.normal(
+            key, (in_dim + hid, hid)) * jnp.sqrt(1.0 / (in_dim + hid))
+        params[f"{prefix}_b{gate}"] = jnp.zeros((hid,))
+    return rng
+
+
+def _apply_gru(params: Params, prefix: str, h: jnp.ndarray,
+               x: jnp.ndarray) -> jnp.ndarray:
+    hx = jnp.concatenate([h, x], -1)
+    r = jax.nn.sigmoid(hx @ params[f"{prefix}_wr"] + params[f"{prefix}_br"])
+    z = jax.nn.sigmoid(hx @ params[f"{prefix}_wz"] + params[f"{prefix}_bz"])
+    rx = jnp.concatenate([r * h, x], -1)
+    n = jnp.tanh(rx @ params[f"{prefix}_wn"] + params[f"{prefix}_bn"])
+    return (1.0 - z) * n + z * h
+
+
+def init_world_model(rng: jax.Array, obs_dim: int, num_actions: int,
+                     hp: DreamerV3Hyperparams) -> Params:
+    p: Params = {}
+    u, d, s = hp.units, hp.deter_dim, hp.stoch_dim
+    rng = _init_mlp(rng, "enc", [obs_dim, u, u], p)
+    rng = _init_gru(rng, "gru", s + num_actions, d, p)
+    rng = _init_mlp(rng, "prior", [d, u, s], p)
+    rng = _init_mlp(rng, "post", [d + u, u, s], p)
+    rng = _init_mlp(rng, "dec", [hp.feat_dim, u, u, obs_dim], p)
+    rng = _init_mlp(rng, "rew", [hp.feat_dim, u, u, hp.num_bins], p,
+                    final_scale=0.0)   # zero-init: predict 0 at start
+    _init_mlp(rng, "cont", [hp.feat_dim, u, u, 1], p)
+    return p
+
+
+def init_actor(rng: jax.Array, num_actions: int,
+               hp: DreamerV3Hyperparams) -> Params:
+    p: Params = {}
+    _init_mlp(rng, "actor", [hp.feat_dim, hp.units, hp.units, num_actions],
+              p, final_scale=0.01)
+    return p
+
+
+def init_critic(rng: jax.Array, hp: DreamerV3Hyperparams) -> Params:
+    p: Params = {}
+    _init_mlp(rng, "critic", [hp.feat_dim, hp.units, hp.units, hp.num_bins],
+              p, final_scale=0.0)
+    return p
+
+
+def _mixed_probs(logits: jnp.ndarray, hp: DreamerV3Hyperparams
+                 ) -> jnp.ndarray:
+    """1% uniform mix keeps every class reachable (bounds the KL)."""
+    probs = jax.nn.softmax(logits, -1)
+    return (1.0 - hp.unimix) * probs + hp.unimix / hp.num_classes
+
+
+def _sample_latent(logits: jnp.ndarray, key: jax.Array,
+                   hp: DreamerV3Hyperparams) -> jnp.ndarray:
+    """Straight-through one-hot sample from [.., ncat, ncls] logits."""
+    probs = _mixed_probs(logits, hp)
+    idx = jax.random.categorical(key, jnp.log(probs), axis=-1)
+    onehot = jax.nn.one_hot(idx, hp.num_classes, dtype=probs.dtype)
+    return onehot + probs - jax.lax.stop_gradient(probs)
+
+
+def _kl_cat(p_logits: jnp.ndarray, q_logits: jnp.ndarray,
+            hp: DreamerV3Hyperparams) -> jnp.ndarray:
+    """KL(p || q) summed over categoricals -> [...] (batch dims)."""
+    p = _mixed_probs(p_logits, hp)
+    q = _mixed_probs(q_logits, hp)
+    return (p * (jnp.log(p) - jnp.log(q))).sum((-2, -1))
+
+
+# ---------------------------------------------------------------------------
+# learner
+# ---------------------------------------------------------------------------
+
+
+class DreamerV3Learner(Learner):
+    """World model + actor + critic in one fused jitted update."""
+
+    _state_attrs = ("wm_params", "actor_params", "critic_params",
+                    "slow_critic", "wm_opt", "actor_opt", "critic_opt",
+                    "return_scale")
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hp: DreamerV3Hyperparams, seed: int = 0, mesh=None):
+        self.hp = hp
+        self.mesh = mesh
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.bins = jnp.linspace(-20.0, 20.0, hp.num_bins)  # symlog space
+        rng = jax.random.PRNGKey(seed)
+        k_wm, k_actor, k_critic, self._rng = jax.random.split(rng, 4)
+        self.wm_params = self._replicate(
+            init_world_model(k_wm, obs_dim, num_actions, hp))
+        self.actor_params = self._replicate(init_actor(k_actor, num_actions,
+                                                       hp))
+        self.critic_params = self._replicate(init_critic(k_critic, hp))
+        self.slow_critic = jax.tree_util.tree_map(jnp.copy,
+                                                  self.critic_params)
+        self._wm_tx = optax.chain(optax.clip_by_global_norm(hp.grad_clip),
+                                  optax.adam(hp.lr_world))
+        self._actor_tx = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip),
+            optax.adam(hp.lr_actor))
+        self._critic_tx = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip),
+            optax.adam(hp.lr_critic))
+        self.wm_opt = self._replicate(self._wm_tx.init(self.wm_params))
+        self.actor_opt = self._replicate(
+            self._actor_tx.init(self.actor_params))
+        self.critic_opt = self._replicate(
+            self._critic_tx.init(self.critic_params))
+        # EMA of percentile(R,95)-percentile(R,5): advantage denominator.
+        self.return_scale = self._replicate(jnp.ones(()))
+        self._update = self._build_update()
+        self._policy_step = jax.jit(self._policy_step_fn,
+                                    static_argnames=("greedy",))
+
+    # The rollout/eval side needs both wm and actor.
+    def get_weights(self) -> Any:
+        return jax.device_get({"wm": self.wm_params,
+                               "actor": self.actor_params})
+
+    def set_weights(self, weights: Any) -> None:
+        self.wm_params = self._replicate(weights["wm"])
+        self.actor_params = self._replicate(weights["actor"])
+
+    # -- model pieces ---------------------------------------------------
+    def _observe(self, wm: Params, batch: Dict[str, jnp.ndarray],
+                 key: jax.Array) -> Tuple[jnp.ndarray, ...]:
+        """RSSM posterior scan over the [B, L] window (time-major
+        internally). Returns feats [B, L, F] + prior/post logits."""
+        hp = self.hp
+        B, L = batch["obs"].shape[:2]
+        embed = _apply_mlp(wm, "enc", symlog(batch["obs"]))      # [B,L,U]
+        prev_a = jax.nn.one_hot(batch["prev_action"], self.num_actions)
+        # time-major for the scan
+        embed_t = jnp.swapaxes(embed, 0, 1)
+        prev_a_t = jnp.swapaxes(prev_a, 0, 1)
+        first_t = jnp.swapaxes(batch["is_first"].astype(jnp.float32), 0, 1)
+        keys = jax.random.split(key, L)
+
+        def step(carry, xs):
+            h, z = carry
+            emb, pa, first, k = xs
+            keep = (1.0 - first)[:, None]
+            h = h * keep
+            z = z * keep[..., None]
+            pa = pa * keep
+            h = _apply_gru(wm, "gru",
+                           h, jnp.concatenate(
+                               [z.reshape(B, -1), pa], -1))
+            prior_logits = _apply_mlp(wm, "prior", h).reshape(
+                B, hp.num_categoricals, hp.num_classes)
+            post_logits = _apply_mlp(
+                wm, "post", jnp.concatenate([h, emb], -1)).reshape(
+                    B, hp.num_categoricals, hp.num_classes)
+            z = _sample_latent(post_logits, k, hp)
+            return (h, z), (h, z, prior_logits, post_logits)
+
+        h0 = jnp.zeros((B, hp.deter_dim))
+        z0 = jnp.zeros((B, hp.num_categoricals, hp.num_classes))
+        _, (hs, zs, priors, posts) = jax.lax.scan(
+            step, (h0, z0), (embed_t, prev_a_t, first_t, keys))
+        hs = jnp.swapaxes(hs, 0, 1)                    # [B,L,D]
+        zs = jnp.swapaxes(zs, 0, 1)                    # [B,L,ncat,ncls]
+        feats = jnp.concatenate([hs, zs.reshape(B, L, -1)], -1)
+        return feats, hs, zs, jnp.swapaxes(priors, 0, 1), \
+            jnp.swapaxes(posts, 0, 1)
+
+    def _imagine(self, wm: Params, actor: Params, h0, z0, key
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Roll the prior H steps with actor actions (all stop-grad;
+        actor/critic losses re-evaluate their nets on the returned
+        feats). h0/z0: [N, ...] flattened posterior starts."""
+        hp = self.hp
+        N = h0.shape[0]
+
+        def step(carry, k):
+            h, z = carry
+            feat = jnp.concatenate([h, z.reshape(N, -1)], -1)
+            ka, kz = jax.random.split(k)
+            logits = _apply_mlp(actor, "actor", feat)
+            a = jax.random.categorical(ka, logits, axis=-1)
+            a_onehot = jax.nn.one_hot(a, self.num_actions)
+            h = _apply_gru(wm, "gru", h,
+                           jnp.concatenate([z.reshape(N, -1), a_onehot],
+                                           -1))
+            prior_logits = _apply_mlp(wm, "prior", h).reshape(
+                N, hp.num_categoricals, hp.num_classes)
+            z = _sample_latent(prior_logits, kz, hp)
+            return (h, z), (feat, a)
+
+        keys = jax.random.split(key, hp.horizon)
+        (h, z), (feats, actions) = jax.lax.scan(step, (h0, z0), keys)
+        last = jnp.concatenate([h, z.reshape(N, -1)], -1)[None]
+        feats = jnp.concatenate([feats, last], 0)      # [H+1, N, F]
+        return jax.lax.stop_gradient(feats), jax.lax.stop_gradient(actions)
+
+    # -- fused update ---------------------------------------------------
+    def _build_update(self):
+        hp = self.hp
+        bins = self.bins
+
+        def wm_loss_fn(wm, batch, key):
+            feats, hs, zs, priors, posts = self._observe(wm, batch, key)
+            obs_hat = _apply_mlp(wm, "dec", feats)
+            recon = ((obs_hat - symlog(batch["obs"])) ** 2).sum(-1)
+            rew_logits = _apply_mlp(wm, "rew", feats)
+            rew_target = twohot(symlog(batch["reward"]), bins)
+            rew_loss = -(rew_target
+                         * jax.nn.log_softmax(rew_logits, -1)).sum(-1)
+            cont_logit = _apply_mlp(wm, "cont", feats)[..., 0]
+            cont = batch["cont"].astype(jnp.float32)
+            cont_loss = optax.sigmoid_binary_cross_entropy(cont_logit, cont)
+            dyn = jnp.maximum(hp.free_bits, _kl_cat(
+                jax.lax.stop_gradient(posts), priors, hp))
+            rep = jnp.maximum(hp.free_bits, _kl_cat(
+                posts, jax.lax.stop_gradient(priors), hp))
+            loss = jnp.mean(recon + rew_loss + cont_loss
+                            + hp.kl_dyn_scale * dyn + hp.kl_rep_scale * rep)
+            aux = {"hs": hs, "zs": zs,
+                   "recon": recon.mean(), "rew_loss": rew_loss.mean(),
+                   "cont_loss": cont_loss.mean(), "kl_dyn": dyn.mean()}
+            return loss, aux
+
+        def update(wm, actor, critic, slow_critic, wm_opt, actor_opt,
+                   critic_opt, scale, batch, rng):
+            k_wm, k_img = jax.random.split(rng)
+            (wm_loss, aux), wm_grads = jax.value_and_grad(
+                wm_loss_fn, has_aux=True)(wm, batch, k_wm)
+            wm_updates, wm_opt = self._wm_tx.update(wm_grads, wm_opt, wm)
+            wm = optax.apply_updates(wm, wm_updates)
+
+            # ---- imagination from every posterior state (post-update
+            # world model; starts are stop-grads)
+            B, L = batch["obs"].shape[:2]
+            N = B * L
+            h0 = jax.lax.stop_gradient(
+                aux.pop("hs").reshape(N, -1))
+            z0 = jax.lax.stop_gradient(
+                aux.pop("zs").reshape(N, hp.num_categoricals,
+                                      hp.num_classes))
+            feats, actions = self._imagine(wm, actor, h0, z0, k_img)
+
+            rewards = twohot_decode(_apply_mlp(wm, "rew", feats[1:]),
+                                    bins)                     # [H,N] symlog
+            rewards = symexp(rewards)
+            conts = jax.nn.sigmoid(
+                _apply_mlp(wm, "cont", feats[1:])[..., 0])    # [H,N]
+            values = symexp(twohot_decode(
+                _apply_mlp(critic, "critic", feats), bins))   # [H+1,N]
+
+            # lambda returns, reverse scan: R_t over t=0..H-1
+            def ret_step(nxt, xs):
+                r, c, v_next = xs
+                ret = r + hp.gamma * c * ((1.0 - hp.lam) * v_next
+                                          + hp.lam * nxt)
+                return ret, ret
+
+            _, returns = jax.lax.scan(
+                ret_step, values[-1],
+                (rewards[::-1], conts[::-1], values[1:][::-1]))
+            returns = returns[::-1]                           # [H,N]
+
+            # trajectory weights: prob the imagined rollout is alive
+            # ENTERING each state (terminals cut future losses)
+            w = jnp.concatenate(
+                [jnp.ones((1, N)),
+                 jnp.cumprod(conts[:-1], 0)], 0)              # [H,N]
+            w = jax.lax.stop_gradient(w)
+
+            # return normalization (EMA of the 5th..95th percentile range)
+            span = (jnp.percentile(returns, 95)
+                    - jnp.percentile(returns, 5))
+            scale = (hp.return_norm_decay * scale
+                     + (1.0 - hp.return_norm_decay) * span)
+            inv = 1.0 / jnp.maximum(1.0, scale)
+
+            base_values = values[:-1]                         # [H,N]
+            adv = jax.lax.stop_gradient((returns - base_values) * inv)
+
+            def actor_loss_fn(actor_p):
+                logits = _apply_mlp(actor_p, "actor", feats[:-1])
+                logp = jax.nn.log_softmax(logits, -1)
+                probs = jax.nn.softmax(logits, -1)
+                taken = jnp.take_along_axis(
+                    logp, actions[..., None], -1)[..., 0]     # [H,N]
+                entropy = -(probs * logp).sum(-1)
+                loss = -(w * (adv * taken + hp.ent_coef * entropy)).mean()
+                return loss, entropy.mean()
+
+            (actor_loss, entropy), actor_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(actor)
+            actor_updates, actor_opt = self._actor_tx.update(
+                actor_grads, actor_opt, actor)
+            actor = optax.apply_updates(actor, actor_updates)
+
+            ret_target = jax.lax.stop_gradient(
+                twohot(symlog(returns), bins))                # [H,N,K]
+            slow_probs = jax.lax.stop_gradient(jax.nn.softmax(
+                _apply_mlp(slow_critic, "critic", feats[:-1]), -1))
+
+            def critic_loss_fn(critic_p):
+                logits = _apply_mlp(critic_p, "critic", feats[:-1])
+                logp = jax.nn.log_softmax(logits, -1)
+                ce = -(ret_target * logp).sum(-1)
+                reg = -(slow_probs * logp).sum(-1) * hp.slow_reg_scale
+                return (w * (ce + reg)).mean()
+
+            critic_loss, critic_grads = jax.value_and_grad(
+                critic_loss_fn)(critic)
+            critic_updates, critic_opt = self._critic_tx.update(
+                critic_grads, critic_opt, critic)
+            critic = optax.apply_updates(critic, critic_updates)
+            slow_critic = jax.tree_util.tree_map(
+                lambda s, c: hp.slow_critic_decay * s
+                + (1.0 - hp.slow_critic_decay) * c,
+                slow_critic, critic)
+
+            metrics = {
+                "world_model_loss": wm_loss,
+                "recon_loss": aux["recon"], "reward_loss": aux["rew_loss"],
+                "cont_loss": aux["cont_loss"], "kl_dyn": aux["kl_dyn"],
+                "actor_loss": actor_loss, "critic_loss": critic_loss,
+                "entropy": entropy, "return_scale": scale,
+                "imagined_return_mean": returns.mean(),
+            }
+            return (wm, actor, critic, slow_critic, wm_opt, actor_opt,
+                    critic_opt, scale, metrics)
+
+        return self._jit_update(
+            update, num_state_args=8,
+            batch_keys=("obs", "prev_action", "reward", "is_first",
+                        "cont"))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self._rng, key = jax.random.split(self._rng)
+        jbatch = self._shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()})
+        (self.wm_params, self.actor_params, self.critic_params,
+         self.slow_critic, self.wm_opt, self.actor_opt, self.critic_opt,
+         self.return_scale, metrics) = self._update(
+            self.wm_params, self.actor_params, self.critic_params,
+            self.slow_critic, self.wm_opt, self.actor_opt,
+            self.critic_opt, self.return_scale, jbatch, key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- recurrent acting ----------------------------------------------
+    def _policy_step_fn(self, wm, actor, h, z, prev_a, obs, first, key,
+                        greedy=False):
+        """One recurrent policy step for a [N]-env batch."""
+        hp = self.hp
+        N = obs.shape[0]
+        keep = (1.0 - first)[:, None]
+        h = h * keep
+        z = z * keep[..., None]
+        prev_a = prev_a * keep
+        h = _apply_gru(wm, "gru", h,
+                       jnp.concatenate([z.reshape(N, -1), prev_a], -1))
+        emb = _apply_mlp(wm, "enc", symlog(obs))
+        post_logits = _apply_mlp(
+            wm, "post", jnp.concatenate([h, emb], -1)).reshape(
+                N, hp.num_categoricals, hp.num_classes)
+        kz, ka = jax.random.split(key)
+        z = _sample_latent(post_logits, kz, hp)
+        feat = jnp.concatenate([h, z.reshape(N, -1)], -1)
+        logits = _apply_mlp(actor, "actor", feat)
+        if greedy:
+            a = jnp.argmax(logits, -1)
+        else:
+            a = jax.random.categorical(ka, logits, axis=-1)
+        return a, h, z
+
+    def policy_step(self, h, z, prev_a, obs, first, key, greedy=False):
+        return self._policy_step(self.wm_params, self.actor_params, h, z,
+                                 prev_a, obs, first, key, greedy=greedy)
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DreamerV3)
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.deter_dim = 256
+        self.num_categoricals = 16
+        self.num_classes = 16
+        self.units = 256
+        self.num_bins = 41
+        self.batch_size = 16
+        self.batch_length = 16
+        self.horizon = 15
+        self.gamma = 0.997
+        self.lam = 0.95
+        self.ent_coef = 3e-4
+        self.lr_world = 1e-3
+        self.lr_actor = 3e-4
+        self.lr_critic = 3e-4
+        self.num_updates_per_iteration = 8
+        self.replay_capacity_per_env = 16384
+        self.learning_starts = 256          # env steps before updates
+
+    def hyperparams(self) -> DreamerV3Hyperparams:
+        return DreamerV3Hyperparams(
+            deter_dim=self.deter_dim,
+            num_categoricals=self.num_categoricals,
+            num_classes=self.num_classes, units=self.units,
+            num_bins=self.num_bins, batch_size=self.batch_size,
+            batch_length=self.batch_length, horizon=self.horizon,
+            gamma=self.gamma, lam=self.lam, ent_coef=self.ent_coef,
+            lr_world=self.lr_world, lr_actor=self.lr_actor,
+            lr_critic=self.lr_critic)
+
+
+class DreamerV3(Algorithm):
+    """Owns a recurrent collection loop (no stateless RolloutWorker):
+    posterior state is carried across env steps and reset via is_first,
+    mirroring the reference's dedicated DreamerV3 EnvRunner."""
+
+    def __init__(self, config: DreamerV3Config):
+        if config.num_env_runners > 0:
+            raise ValueError(
+                "DreamerV3 collection is driver-local (the policy is "
+                "recurrent); num_env_runners must be 0")
+        if getattr(config, "num_learners", 0) > 0:
+            raise ValueError(
+                "DreamerV3 needs direct learner access for recurrent "
+                "acting (policy_step); use "
+                "resources(learner_mesh=mesh) for data-parallel SPMD "
+                "updates instead of learners(num_learners=...)")
+        self.config = config
+        self._iteration = 0
+        self._remote = False
+        self.workers: list = []
+        self._eval_workers: list = []
+        env = config.env
+        if callable(env):
+            self.env: VectorEnv = env(
+                num_envs=config.num_envs_per_env_runner, seed=config.seed)
+        else:
+            self.env = make_env(env,
+                                num_envs=config.num_envs_per_env_runner,
+                                seed=config.seed)
+        if self.env.continuous:
+            raise NotImplementedError(
+                "DreamerV3 here is discrete-action only (the "
+                "reference's continuous head can follow)")
+        self.space_info = {"obs_dim": self.env.obs_dim,
+                           "num_actions": self.env.num_actions}
+        hp = config.hyperparams()
+        obs_dim, num_actions = self.env.obs_dim, self.env.num_actions
+
+        def factory(mesh=None):
+            return DreamerV3Learner(obs_dim, num_actions, hp,
+                                    seed=config.seed, mesh=mesh)
+
+        self._made_learner_group = False
+        self.learner = self._build_learner(factory)
+        self.replay = SequenceReplayBuffer(config.replay_capacity_per_env,
+                                           seed=config.seed)
+        self._env_steps = 0
+        n = self.env.num_envs
+        self._obs = self.env.reset()
+        self._first = np.ones(n, np.float32)
+        self._prev_a = np.zeros(n, np.int64)
+        self._prev_r = np.zeros(n, np.float32)
+        self._h = jnp.zeros((n, hp.deter_dim))
+        self._z = jnp.zeros((n, hp.num_categoricals, hp.num_classes))
+        self._rng = jax.random.PRNGKey(config.seed + 77)
+        self._eval_env: Optional[VectorEnv] = None
+
+    def _broadcast_weights(self) -> None:
+        pass  # collection reads the learner's params directly
+
+    def _collect(self, num_steps: int) -> list:
+        """Step the vec env `num_steps` times, appending on-arrival
+        records; returns finished-episode returns."""
+        env = self.env
+        n = env.num_envs
+        episode_returns = []
+        for _ in range(num_steps):
+            for i in range(n):
+                self.replay.add(i, {
+                    "obs": self._obs[i].astype(np.float32),
+                    "prev_action": np.int64(self._prev_a[i]),
+                    "reward": np.float32(self._prev_r[i]),
+                    "is_first": np.float32(self._first[i]),
+                    "cont": np.float32(1.0),
+                })
+            self._rng, key = jax.random.split(self._rng)
+            a, self._h, self._z = self.learner.policy_step(
+                self._h, self._z,
+                jax.nn.one_hot(jnp.asarray(self._prev_a),
+                               env.num_actions),
+                jnp.asarray(self._obs, jnp.float32),
+                jnp.asarray(self._first), key)
+            actions = np.asarray(a)
+            obs, rewards, dones, ep_ret = env.step(actions)
+            self._env_steps += n
+            for i in range(n):
+                if dones[i]:
+                    # terminal/truncated observation record (auto-reset
+                    # envs surface it via final_obs)
+                    self.replay.add(i, {
+                        "obs": env.final_obs[i].astype(np.float32),
+                        "prev_action": np.int64(actions[i]),
+                        "reward": np.float32(rewards[i]),
+                        "is_first": np.float32(0.0),
+                        "cont": np.float32(
+                            1.0 if env.truncateds[i] else 0.0),
+                    })
+                    self._first[i] = 1.0
+                    self._prev_a[i] = 0
+                    self._prev_r[i] = 0.0
+                else:
+                    self._first[i] = 0.0
+                    self._prev_a[i] = actions[i]
+                    self._prev_r[i] = rewards[i]
+            self._obs = obs
+            episode_returns.extend(
+                float(r) for r in ep_ret[~np.isnan(ep_ret)])
+        return episode_returns
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: DreamerV3Config = self.config
+        episode_returns = self._collect(cfg.rollout_fragment_length)
+        metrics: Dict[str, float] = {}
+        if (self._env_steps >= cfg.learning_starts
+                and self.replay.can_sample(cfg.batch_length)):
+            accum: Dict[str, list] = {}
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self.replay.sample(cfg.batch_size,
+                                           cfg.batch_length)
+                m = self.learner.update(batch)
+                for k, v in m.items():
+                    accum.setdefault(k, []).append(v)
+            metrics.update(
+                {k: float(np.mean(v)) for k, v in accum.items()})
+        if episode_returns:
+            metrics["episode_return_mean"] = float(
+                np.mean(episode_returns))
+            metrics["num_episodes"] = float(len(episode_returns))
+        metrics["num_env_steps_sampled"] = float(self._env_steps)
+        metrics["replay_size"] = float(len(self.replay))
+        return metrics
+
+    def evaluate(self) -> Dict[str, float]:
+        """Greedy recurrent episodes on a separate env (the base
+        RolloutWorker path is stateless and cannot drive this policy)."""
+        cfg: DreamerV3Config = self.config
+        hp = cfg.hyperparams()
+        episodes = max(1, cfg.evaluation_duration)
+        if self._eval_env is None:
+            env = cfg.env
+            if callable(env):
+                self._eval_env = env(num_envs=1, seed=cfg.seed + 9000)
+            else:
+                self._eval_env = make_env(env, num_envs=1,
+                                          seed=cfg.seed + 9000)
+        env = self._eval_env
+        rng = jax.random.PRNGKey(cfg.seed + 4242)
+        returns = []
+        obs = env.reset()
+        h = jnp.zeros((1, hp.deter_dim))
+        z = jnp.zeros((1, hp.num_categoricals, hp.num_classes))
+        prev_a = np.zeros(1, np.int64)
+        first = np.ones(1, np.float32)
+        steps_cap = 2000 * episodes
+        for _ in range(steps_cap):
+            rng, key = jax.random.split(rng)
+            a, h, z = self.learner.policy_step(
+                h, z, jax.nn.one_hot(jnp.asarray(prev_a),
+                                     env.num_actions),
+                jnp.asarray(obs, jnp.float32), jnp.asarray(first), key,
+                greedy=True)
+            actions = np.asarray(a)
+            obs, _, dones, ep_ret = env.step(actions)
+            if dones[0]:
+                first[0] = 1.0
+                prev_a[0] = 0
+                if not np.isnan(ep_ret[0]):
+                    returns.append(float(ep_ret[0]))
+                if len(returns) >= episodes:
+                    break
+            else:
+                first[0] = 0.0
+                prev_a[0] = actions[0]
+        return {
+            "evaluation/episode_return_mean": float(np.mean(returns))
+            if returns else float("nan"),
+            "evaluation/num_episodes": float(len(returns)),
+        }

@@ -88,3 +88,76 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         pr = np.abs(priorities) + self.eps
         self._prio[idx] = pr ** self.alpha
         self._max_prio = max(self._max_prio, float(pr.max()))
+
+
+class SequenceReplayBuffer:
+    """Contiguous-window replay for recurrent world models.
+
+    ref: rllib/utils/replay_buffers/episode_replay_buffer.py — the
+    reference stores episodes and samples fixed-length chunks for
+    DreamerV3. Here each env stream gets its own time-ring of numpy
+    arrays; `sample(B, L)` returns [B, L, ...] windows drawn uniformly
+    over (env, start) pairs. Windows never cross the ring's write head
+    (they may span episode boundaries — records carry `is_first` so the
+    model resets its recurrent state mid-window, exactly how the
+    reference feeds chunked sequences).
+    """
+
+    def __init__(self, capacity_per_env: int, seed: int = 0):
+        self.capacity = capacity_per_env
+        self._streams: list = []           # env -> field -> [cap, ...]
+        self._len: list = []               # env -> valid records
+        self._pos: list = []               # env -> next write slot
+        self._rng = np.random.default_rng(seed)
+        self._total = 0
+
+    def __len__(self) -> int:
+        return self._total
+
+    def add(self, env_i: int, record: Dict[str, np.ndarray]) -> None:
+        """Append one record (field -> scalar or 1-D array) to env_i's
+        stream."""
+        while len(self._streams) <= env_i:
+            self._streams.append(None)
+            self._len.append(0)
+            self._pos.append(0)
+        if self._streams[env_i] is None:
+            self._streams[env_i] = {
+                k: np.zeros((self.capacity,) + np.shape(v),
+                            np.asarray(v).dtype)
+                for k, v in record.items()}
+        st = self._streams[env_i]
+        pos = self._pos[env_i]
+        for k, v in record.items():
+            st[k][pos] = v
+        self._pos[env_i] = (pos + 1) % self.capacity
+        if self._len[env_i] < self.capacity:
+            self._len[env_i] += 1
+            self._total += 1
+
+    def can_sample(self, length: int) -> bool:
+        return any(n >= length for n in self._len)
+
+    def sample(self, batch_size: int, length: int
+               ) -> Dict[str, np.ndarray]:
+        """[B, L, ...] windows, uniform over (env, start) pairs: each
+        env is weighted by its valid-window count, so records in short
+        streams are not oversampled. Envs with fewer than `length`
+        records are excluded; raises if no env has enough yet."""
+        ok = [i for i, n in enumerate(self._len) if n >= length]
+        if not ok:
+            raise ValueError(
+                f"no env stream has {length} records yet (sizes: "
+                f"{self._len})")
+        windows = np.array([self._len[i] - length + 1 for i in ok],
+                           np.float64)
+        envs = self._rng.choice(ok, batch_size, p=windows / windows.sum())
+        batches = {k: [] for k in self._streams[ok[0]]}
+        for i in envs:
+            n, pos = self._len[i], self._pos[i]
+            start = int(self._rng.integers(0, n - length + 1))
+            # oldest record lives at (pos - n) mod cap
+            idx = (pos - n + start + np.arange(length)) % self.capacity
+            for k, arr in self._streams[i].items():
+                batches[k].append(arr[idx])
+        return {k: np.stack(v) for k, v in batches.items()}
