@@ -32,6 +32,7 @@ from jax.sharding import Mesh
 from ray_tpu.ops.attention import flash_attention, mha_reference
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.ring_attention import make_ring_attention
+from ray_tpu.ops.ulysses import make_ulysses_attention
 from ray_tpu.ops.rotary import apply_rope
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES, LogicalRules, with_logical_constraint)
@@ -57,6 +58,10 @@ class TransformerConfig:
     # "dots" saves matmul outputs and recomputes only elementwise ops,
     # trading HBM for the +2N/6N recompute FLOPs full remat pays.
     remat_policy: str = "full"
+    # Context-parallel attention when seq_shards > 1: "ring" rotates
+    # k/v around the ICI ring; "ulysses" all-to-alls seq<->head
+    # sharding (sp must divide the head count). Both exact.
+    sp_attention: str = "ring"
     # MoE (0 experts = dense MLP; Mixtral-style when > 0)
     n_experts: int = 0
     expert_top_k: int = 2
@@ -229,7 +234,12 @@ def forward(params, tokens, cfg: TransformerConfig, *,
     if seq_shards > 1:
         if mesh is None:
             raise ValueError("sequence parallelism requires a mesh")
-        attn_impl = make_ring_attention(mesh, axis=AXIS_SEQ, causal=True)
+        if cfg.sp_attention == "ulysses":
+            attn_impl = make_ulysses_attention(mesh, axis=AXIS_SEQ,
+                                               causal=True)
+        else:
+            attn_impl = make_ring_attention(mesh, axis=AXIS_SEQ,
+                                            causal=True)
     else:
         attn_impl = lambda q, k, v: flash_attention(q, k, v, True, None)  # noqa: E731
 

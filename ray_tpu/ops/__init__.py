@@ -8,6 +8,7 @@ greenfield part of the TPU build (SURVEY.md §2.4: SP/CP ring attention row).
 """
 from ray_tpu.ops.attention import flash_attention, mha_reference
 from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.ulysses import ulysses_attention
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -15,6 +16,7 @@ __all__ = [
     "flash_attention",
     "mha_reference",
     "ring_attention",
+    "ulysses_attention",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

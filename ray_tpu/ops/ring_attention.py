@@ -93,20 +93,32 @@ def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, *, axis: str = AXIS_SEQ, causal: bool = True,
-                        sm_scale: float | None = None,
-                        batch_axes: Sequence[str] = ("dp", "fsdp"),
-                        head_axis: str | None = "tp"):
-    """Wrap `ring_attention` in shard_map for use inside a pjit program.
+def make_sharded_attention(local_fn, mesh: Mesh, *, axis: str = AXIS_SEQ,
+                           batch_axes: Sequence[str] = ("dp", "fsdp"),
+                           head_axis: str | None = "tp"):
+    """Shared shard_map wrapper for context-parallel attention schemes
+    (`ring_attention`, `ulysses_attention`): one place owns the layout
+    contract so the schemes cannot drift apart.
 
     Layout: (B, T, H, D) with B over `batch_axes`, T over `axis`, H over
-    `head_axis`.  Only axes present in `mesh` are used.
+    `head_axis`.  Only axes present in `mesh` are used.  `local_fn`
+    takes per-device (q, k, v) shards.
     """
     known = set(mesh.axis_names)
     bspec = tuple(a for a in batch_axes if a in known) or None
     hspec = head_axis if head_axis in known else None
     spec = P(bspec, axis, hspec, None)
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+
+
+def make_ring_attention(mesh: Mesh, *, axis: str = AXIS_SEQ, causal: bool = True,
+                        sm_scale: float | None = None,
+                        batch_axes: Sequence[str] = ("dp", "fsdp"),
+                        head_axis: str | None = "tp"):
+    """Wrap `ring_attention` in shard_map for use inside a pjit program."""
     fn = functools.partial(ring_attention, axis=axis, causal=causal,
                            sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    return make_sharded_attention(fn, mesh, axis=axis,
+                                  batch_axes=batch_axes,
+                                  head_axis=head_axis)

@@ -1,0 +1,84 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second context-parallel scheme from SURVEY.md §7 (alongside ring
+attention): instead of rotating k/v shards around the ICI ring, two
+`all_to_all`s re-shard the activations so each device attends over the FULL
+sequence for a SUBSET of heads (DeepSpeed-Ulysses' insight — attention
+is embarrassingly parallel over heads, so trade the sequence sharding
+for a head sharding around exactly the attention op):
+
+    [B, T/sp, H, D]  --all_to_all-->  [B, T, H/sp, D]
+        full-sequence flash attention on local heads (exact, causal OK)
+    [B, T, H/sp, D]  --all_to_all-->  [B, T/sp, H, D]
+
+vs ring attention: Ulysses moves q,k,v,out once each (4 all-to-alls of
+size ~4BTHD/sp) while ring moves k/v (sp-1) times; for sp ≪ H Ulysses
+communicates less and reuses the single-device flash kernel unchanged —
+but it caps sp at the head count and concentrates communication into two
+bursts instead of overlapping it with compute. Both are exact; pick per
+topology (the reference framework has no sequence parallelism at all —
+SURVEY.md §2.4, verified absent).
+
+`ulysses_attention` runs inside `shard_map`; `make_ulysses_attention`
+wraps it for pjit programs with the same layout contract as
+`make_ring_attention` (B over dp/fsdp, T over `sp`, H over `tp`).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+from jax import lax
+from jax.sharding import Mesh
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.ring_attention import make_sharded_attention
+from ray_tpu.parallel.mesh import AXIS_SEQ
+
+
+def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,
+                      causal: bool = True,
+                      sm_scale: float | None = None):
+    """Exact attention over a sequence-sharded axis. Call inside
+    shard_map. q, k, v: local shards (B, T_local, H_local, D); the
+    local head count must divide by the axis size."""
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"Ulysses needs heads divisible by the sequence-parallel "
+            f"degree: {h} local heads over sp={n} (use ring attention "
+            f"when sp exceeds the head count)")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def seq_to_heads(x):   # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):   # [B, T, H/sp, D] -> [B, T/sp, H, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # Device order along `axis` IS sequence order, so the concatenated
+    # sequence is globally ordered and the plain causal mask is exact.
+    out = flash_attention(qg, kg, vg, causal, sm_scale)
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, *, axis: str = AXIS_SEQ,
+                           causal: bool = True,
+                           sm_scale: float | None = None,
+                           batch_axes: Sequence[str] = ("dp", "fsdp"),
+                           head_axis: str | None = "tp"):
+    """Wrap `ulysses_attention` in shard_map for pjit programs (layout
+    contract shared with ring attention via `make_sharded_attention`);
+    head divisibility is checked against the combined tp×sp sharding at
+    trace time."""
+    fn = functools.partial(ulysses_attention, axis=axis, causal=causal,
+                           sm_scale=sm_scale)
+    return make_sharded_attention(fn, mesh, axis=axis,
+                                  batch_axes=batch_axes,
+                                  head_axis=head_axis)

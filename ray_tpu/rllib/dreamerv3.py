@@ -199,7 +199,7 @@ class DreamerV3Learner(Learner):
 
     _state_attrs = ("wm_params", "actor_params", "critic_params",
                     "slow_critic", "wm_opt", "actor_opt", "critic_opt",
-                    "return_scale")
+                    "return_scale", "_rng")
 
     def __init__(self, obs_dim: int, num_actions: int,
                  hp: DreamerV3Hyperparams, seed: int = 0, mesh=None):
@@ -553,19 +553,19 @@ class DreamerV3(Algorithm):
                 "acting (policy_step); use "
                 "resources(learner_mesh=mesh) for data-parallel SPMD "
                 "updates instead of learners(num_learners=...)")
+        if (config.env_to_module_connector is not None
+                or config.module_to_env_connector is not None
+                or config.learner_connector is not None):
+            raise ValueError(
+                "DreamerV3's recurrent collection loop does not run "
+                "connector pipelines; configure the env itself instead")
         self.config = config
         self._iteration = 0
         self._remote = False
         self.workers: list = []
         self._eval_workers: list = []
-        env = config.env
-        if callable(env):
-            self.env: VectorEnv = env(
-                num_envs=config.num_envs_per_env_runner, seed=config.seed)
-        else:
-            self.env = make_env(env,
-                                num_envs=config.num_envs_per_env_runner,
-                                seed=config.seed)
+        self.env: VectorEnv = self._make_env(
+            config.num_envs_per_env_runner, config.seed)
         if self.env.continuous:
             raise NotImplementedError(
                 "DreamerV3 here is discrete-action only (the "
@@ -593,6 +593,12 @@ class DreamerV3(Algorithm):
         self._z = jnp.zeros((n, hp.num_categoricals, hp.num_classes))
         self._rng = jax.random.PRNGKey(config.seed + 77)
         self._eval_env: Optional[VectorEnv] = None
+
+    def _make_env(self, num_envs: int, seed: int) -> VectorEnv:
+        env = self.config.env
+        if callable(env):
+            return env(num_envs=num_envs, seed=seed)
+        return make_env(env, num_envs=num_envs, seed=seed)
 
     def _broadcast_weights(self) -> None:
         pass  # collection reads the learner's params directly
@@ -676,12 +682,7 @@ class DreamerV3(Algorithm):
         hp = cfg.hyperparams()
         episodes = max(1, cfg.evaluation_duration)
         if self._eval_env is None:
-            env = cfg.env
-            if callable(env):
-                self._eval_env = env(num_envs=1, seed=cfg.seed + 9000)
-            else:
-                self._eval_env = make_env(env, num_envs=1,
-                                          seed=cfg.seed + 9000)
+            self._eval_env = self._make_env(1, cfg.seed + 9000)
         env = self._eval_env
         rng = jax.random.PRNGKey(cfg.seed + 4242)
         returns = []

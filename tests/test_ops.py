@@ -86,3 +86,96 @@ def test_rope_rotation_preserves_norm_and_relativity():
     d1 = np.einsum("bthd,bshd->bths", np.asarray(y), np.asarray(y))
     d2 = np.einsum("bthd,bshd->bths", np.asarray(y2), np.asarray(y2))
     np.testing.assert_allclose(d1, d2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+def _ulysses_sharded(mesh, spec, causal=True):
+    from ray_tpu.ops import ulysses_attention
+
+    return jax.shard_map(
+        lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis="sp",
+                                             causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+
+
+def test_ulysses_attention_matches_full():
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=4), devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.key(4), b=2, t=128, h=8, d=16)
+    spec = P(None, "sp", None, None)
+    out = _ulysses_sharded(mesh, spec)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_attention_noncausal():
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=2), devices=jax.devices()[:2])
+    q, k, v = _qkv(jax.random.key(5), b=1, t=64, h=2, d=16)
+    spec = P(None, "sp", None, None)
+    out = _ulysses_sharded(mesh, spec, causal=False)(q, k, v)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    """The two context-parallel schemes are both exact: same numbers."""
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=4), devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.key(6), b=1, t=128, h=4, d=16)
+    spec = P(None, "sp", None, None)
+    ring = jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis="sp",
+                                          causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    np.testing.assert_allclose(
+        np.asarray(_ulysses_sharded(mesh, spec)(q, k, v)),
+        np.asarray(ring(q, k, v)), atol=2e-5)
+
+
+def test_ulysses_grads_match_reference():
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=2), devices=jax.devices()[:2])
+    q, k, v = _qkv(jax.random.key(7), b=1, t=64, h=4, d=16)
+    spec = P(None, "sp", None, None)
+    uly = _ulysses_sharded(mesh, spec)
+
+    gq = jax.grad(lambda q_: jnp.sum(uly(q_, k, v) ** 2))(q)
+    gq_ref = jax.grad(
+        lambda q_: jnp.sum(mha_reference(q_, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(gq_ref),
+                               atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import pytest
+
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=4), devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.key(8), b=1, t=64, h=2, d=16)  # 2 heads, sp=4
+    spec = P(None, "sp", None, None)
+    with pytest.raises(ValueError, match="divisible"):
+        _ulysses_sharded(mesh, spec)(q, k, v)
+
+
+def test_transformer_forward_ulysses_matches_ring():
+    """End-to-end: forward() under sp sharding, both attention modes."""
+    import dataclasses
+
+    from ray_tpu.models import configs
+    from ray_tpu.models.transformer import forward, init_params
+
+    mesh = build_mesh(MeshConfig(fsdp=1, sp=4), devices=jax.devices()[:4])
+    # f32 compute: both schemes are EXACT, so they must agree to fp
+    # noise (bf16 would only measure accumulation rounding).
+    base = dataclasses.replace(configs.TINY, remat=False,
+                               compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), base)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0,
+                              base.vocab_size, dtype=jnp.int32)
+    outs = {}
+    for mode in ("ring", "ulysses"):
+        cfg = dataclasses.replace(base, sp_attention=mode)
+        outs[mode] = forward(params, toks, cfg, mesh=mesh, seq_shards=4)
+    np.testing.assert_allclose(np.asarray(outs["ring"]),
+                               np.asarray(outs["ulysses"]), atol=1e-4)

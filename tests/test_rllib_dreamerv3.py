@@ -112,10 +112,11 @@ def test_learner_update_finite_and_state_roundtrip():
     state = learner.get_state()
     batch = _fake_batch(np.random.default_rng(7))
 
-    learner2 = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=0)
+    # a fresh learner (different seed so its own rng differs) restored
+    # from `state` must replay the exact same update — _rng is part of
+    # the checkpointed state, not reconstructed from the seed
+    learner2 = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=9)
     learner2.set_state(state)
-    learner2._rng = jax.random.PRNGKey(0)
-    learner._rng = jax.random.PRNGKey(0)
     m1 = learner.update(batch)
     m2 = learner2.update(batch)
     for k in m1:
@@ -217,6 +218,9 @@ def test_dreamerv3_rejects_remote_runners_and_continuous():
         (_small_config().env_runners(num_env_runners=2)).build()
     with pytest.raises(NotImplementedError, match="discrete"):
         (_small_config().environment("Pendulum-v1")).build()
+    with pytest.raises(ValueError, match="connector"):
+        (_small_config().env_runners(
+            env_to_module_connector=lambda: None)).build()
 
 
 def test_dreamerv3_replay_records_terminals():
