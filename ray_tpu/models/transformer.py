@@ -221,7 +221,8 @@ def forward(params, tokens, cfg: TransformerConfig, *,
             positions=None, seq_shards: int = 1, return_aux: dict | None = None):
     """tokens (B, T) int32 → logits (B, T, vocab) in compute dtype.
 
-    `seq_shards > 1` switches attention to the ring kernel over the `sp`
+    `seq_shards > 1` switches attention to the context-parallel kernel
+    (`cfg.sp_attention`: ring or ulysses) over the `sp`
     mesh axis (requires `mesh`); positions then carry global offsets — the
     caller passes globally-consistent `positions` or we default to 0..T-1
     of the *global* view (pjit global shapes make this automatic).
@@ -234,6 +235,12 @@ def forward(params, tokens, cfg: TransformerConfig, *,
     if seq_shards > 1:
         if mesh is None:
             raise ValueError("sequence parallelism requires a mesh")
+        if cfg.sp_attention not in ("ring", "ulysses"):
+            # Both schemes are numerically exact, so a typo would
+            # silently benchmark the wrong communication pattern.
+            raise ValueError(
+                f"sp_attention={cfg.sp_attention!r}: expected 'ring' "
+                f"or 'ulysses'")
         if cfg.sp_attention == "ulysses":
             attn_impl = make_ulysses_attention(mesh, axis=AXIS_SEQ,
                                                causal=True)

@@ -21,8 +21,13 @@ DreamerV3 owns its env stepping with a jitted recurrent policy step —
 the same split the reference makes (DreamerV3 has its own EnvRunner,
 rllib/algorithms/dreamerv3/utils/env_runner.py).
 
-Discrete action spaces only (the reference's continuous head can land
-later); replay uses on-arrival records: a step's `reward`/`cont`
+Discrete actions use a categorical actor trained with REINFORCE over a
+stop-gradded imagined rollout (the paper's discrete estimator);
+continuous actions use a tanh-squashed Gaussian trained by DYNAMICS
+BACKPROP — the rollout stays differentiable and reparameterized action
+samples carry gradients through the GRU/prior/heads into the lambda
+returns (the paper's continuous estimator). Replay uses on-arrival
+records: a step's `reward`/`cont`
 describe ARRIVING at its observation, `prev_action` is the action that
 led there — terminal observations are stored (cont=0), auto-reset
 starts carry `is_first=1`.
@@ -110,6 +115,27 @@ class DreamerV3Hyperparams:
         return self.deter_dim + self.stoch_dim
 
 
+@dataclasses.dataclass(frozen=True)
+class ActSpec:
+    """Action-space description. `n` is the action count (discrete) or
+    the action dimension (continuous); continuous actions live in
+    [-limit, limit]^n and are fed to the networks normalized to
+    [-1, 1]."""
+
+    kind: str            # "discrete" | "continuous"
+    n: int
+    limit: float = 1.0
+
+    @property
+    def input_dim(self) -> int:
+        """Width of the action input to the sequence model."""
+        return self.n
+
+    @property
+    def actor_out_dim(self) -> int:
+        return self.n if self.kind == "discrete" else 2 * self.n
+
+
 # ---------------------------------------------------------------------------
 # networks (pure-pytree params, models.py conventions)
 # ---------------------------------------------------------------------------
@@ -135,12 +161,12 @@ def _apply_gru(params: Params, prefix: str, h: jnp.ndarray,
     return (1.0 - z) * n + z * h
 
 
-def init_world_model(rng: jax.Array, obs_dim: int, num_actions: int,
+def init_world_model(rng: jax.Array, obs_dim: int, act_in_dim: int,
                      hp: DreamerV3Hyperparams) -> Params:
     p: Params = {}
     u, d, s = hp.units, hp.deter_dim, hp.stoch_dim
     rng = _init_mlp(rng, "enc", [obs_dim, u, u], p)
-    rng = _init_gru(rng, "gru", s + num_actions, d, p)
+    rng = _init_gru(rng, "gru", s + act_in_dim, d, p)
     rng = _init_mlp(rng, "prior", [d, u, s], p)
     rng = _init_mlp(rng, "post", [d + u, u, s], p)
     rng = _init_mlp(rng, "dec", [hp.feat_dim, u, u, obs_dim], p)
@@ -150,12 +176,24 @@ def init_world_model(rng: jax.Array, obs_dim: int, num_actions: int,
     return p
 
 
-def init_actor(rng: jax.Array, num_actions: int,
+def init_actor(rng: jax.Array, out_dim: int,
                hp: DreamerV3Hyperparams) -> Params:
     p: Params = {}
-    _init_mlp(rng, "actor", [hp.feat_dim, hp.units, hp.units, num_actions],
+    _init_mlp(rng, "actor", [hp.feat_dim, hp.units, hp.units, out_dim],
               p, final_scale=0.01)
     return p
+
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+def _actor_dist(out: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Continuous actor head -> (mu, clipped log_std). The ONE place
+    the parameterization lives — imagination, acting, and the loss all
+    decode through here so they can never sample from one distribution
+    and score under another."""
+    mu, log_std = jnp.split(out, 2, -1)
+    return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
 
 
 def init_critic(rng: jax.Array, hp: DreamerV3Hyperparams) -> Params:
@@ -201,19 +239,21 @@ class DreamerV3Learner(Learner):
                     "slow_critic", "wm_opt", "actor_opt", "critic_opt",
                     "return_scale", "_rng")
 
-    def __init__(self, obs_dim: int, num_actions: int,
+    def __init__(self, obs_dim: int, act_spec: "ActSpec | int",
                  hp: DreamerV3Hyperparams, seed: int = 0, mesh=None):
+        if isinstance(act_spec, int):  # convenience: N discrete actions
+            act_spec = ActSpec("discrete", act_spec)
         self.hp = hp
         self.mesh = mesh
         self.obs_dim = obs_dim
-        self.num_actions = num_actions
+        self.act_spec = act_spec
         self.bins = jnp.linspace(-20.0, 20.0, hp.num_bins)  # symlog space
         rng = jax.random.PRNGKey(seed)
         k_wm, k_actor, k_critic, self._rng = jax.random.split(rng, 4)
         self.wm_params = self._replicate(
-            init_world_model(k_wm, obs_dim, num_actions, hp))
-        self.actor_params = self._replicate(init_actor(k_actor, num_actions,
-                                                       hp))
+            init_world_model(k_wm, obs_dim, act_spec.input_dim, hp))
+        self.actor_params = self._replicate(
+            init_actor(k_actor, act_spec.actor_out_dim, hp))
         self.critic_params = self._replicate(init_critic(k_critic, hp))
         self.slow_critic = jax.tree_util.tree_map(jnp.copy,
                                                   self.critic_params)
@@ -246,6 +286,13 @@ class DreamerV3Learner(Learner):
         self.actor_params = self._replicate(weights["actor"])
 
     # -- model pieces ---------------------------------------------------
+    def _act_input(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Action(s) -> sequence-model input: one-hot for discrete,
+        the normalized [-1, 1] vector unchanged for continuous."""
+        if self.act_spec.kind == "discrete":
+            return jax.nn.one_hot(a, self.act_spec.n)
+        return a
+
     def _observe(self, wm: Params, batch: Dict[str, jnp.ndarray],
                  key: jax.Array) -> Tuple[jnp.ndarray, ...]:
         """RSSM posterior scan over the [B, L] window (time-major
@@ -253,7 +300,7 @@ class DreamerV3Learner(Learner):
         hp = self.hp
         B, L = batch["obs"].shape[:2]
         embed = _apply_mlp(wm, "enc", symlog(batch["obs"]))      # [B,L,U]
-        prev_a = jax.nn.one_hot(batch["prev_action"], self.num_actions)
+        prev_a = self._act_input(batch["prev_action"])
         # time-major for the scan
         embed_t = jnp.swapaxes(embed, 0, 1)
         prev_a_t = jnp.swapaxes(prev_a, 0, 1)
@@ -290,9 +337,14 @@ class DreamerV3Learner(Learner):
 
     def _imagine(self, wm: Params, actor: Params, h0, z0, key
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Roll the prior H steps with actor actions (all stop-grad;
-        actor/critic losses re-evaluate their nets on the returned
-        feats). h0/z0: [N, ...] flattened posterior starts."""
+        """Roll the prior H steps with actor actions. h0/z0: [N, ...]
+        flattened posterior starts (stop-gradded by the caller).
+
+        Gradient contract: DISCRETE returns stop-gradded feats/actions
+        (REINFORCE re-scores the samples); CONTINUOUS returns the LIVE
+        graph — reparameterized actions flow through GRU/prior into the
+        feats, which is the whole dynamics-backprop estimator. Don't
+        add a stop_gradient on the continuous path."""
         hp = self.hp
         N = h0.shape[0]
 
@@ -300,22 +352,40 @@ class DreamerV3Learner(Learner):
             h, z = carry
             feat = jnp.concatenate([h, z.reshape(N, -1)], -1)
             ka, kz = jax.random.split(k)
-            logits = _apply_mlp(actor, "actor", feat)
-            a = jax.random.categorical(ka, logits, axis=-1)
-            a_onehot = jax.nn.one_hot(a, self.num_actions)
+            out = _apply_mlp(actor, "actor", feat)
+            if self.act_spec.kind == "discrete":
+                a = jax.random.categorical(ka, out, axis=-1)
+                a_in = jax.nn.one_hot(a, self.act_spec.n)
+                a_rec = a          # action index, for the logp lookup
+            else:
+                mu, log_std = _actor_dist(out)
+                pre = mu + jnp.exp(log_std) * jax.random.normal(
+                    ka, mu.shape)          # reparameterized
+                a_in = jnp.tanh(pre)
+                # The continuous loss differentiates through the
+                # rollout itself (dynamics backprop) — the recorded
+                # samples are diagnostics, not a REINFORCE input.
+                a_rec = pre
             h = _apply_gru(wm, "gru", h,
-                           jnp.concatenate([z.reshape(N, -1), a_onehot],
-                                           -1))
+                           jnp.concatenate([z.reshape(N, -1), a_in], -1))
             prior_logits = _apply_mlp(wm, "prior", h).reshape(
                 N, hp.num_categoricals, hp.num_classes)
             z = _sample_latent(prior_logits, kz, hp)
-            return (h, z), (feat, a)
+            return (h, z), (feat, a_rec)
 
         keys = jax.random.split(key, hp.horizon)
         (h, z), (feats, actions) = jax.lax.scan(step, (h0, z0), keys)
         last = jnp.concatenate([h, z.reshape(N, -1)], -1)[None]
         feats = jnp.concatenate([feats, last], 0)      # [H+1, N, F]
-        return jax.lax.stop_gradient(feats), jax.lax.stop_gradient(actions)
+        if self.act_spec.kind == "discrete":
+            # REINFORCE: the rollout itself carries no actor gradient.
+            return (jax.lax.stop_gradient(feats),
+                    jax.lax.stop_gradient(actions))
+        # Continuous: keep the graph — the actor trains by dynamics
+        # backprop (reparameterized actions -> GRU/prior/heads ->
+        # returns), the paper's gradient estimator for continuous
+        # control. Straight-through latent samples pass gradients too.
+        return feats, actions
 
     # -- fused update ---------------------------------------------------
     def _build_update(self):
@@ -361,63 +431,88 @@ class DreamerV3Learner(Learner):
             z0 = jax.lax.stop_gradient(
                 aux.pop("zs").reshape(N, hp.num_categoricals,
                                       hp.num_classes))
-            feats, actions = self._imagine(wm, actor, h0, z0, k_img)
 
-            rewards = twohot_decode(_apply_mlp(wm, "rew", feats[1:]),
-                                    bins)                     # [H,N] symlog
-            rewards = symexp(rewards)
-            conts = jax.nn.sigmoid(
-                _apply_mlp(wm, "cont", feats[1:])[..., 0])    # [H,N]
-            values = symexp(twohot_decode(
-                _apply_mlp(critic, "critic", feats), bins))   # [H+1,N]
+            def rollout_scalars(feats):
+                """World-model heads + lambda returns + weights along an
+                imagined trajectory (carries actor gradients when feats
+                do)."""
+                rewards = symexp(twohot_decode(
+                    _apply_mlp(wm, "rew", feats[1:]), bins))      # [H,N]
+                conts = jax.nn.sigmoid(
+                    _apply_mlp(wm, "cont", feats[1:])[..., 0])    # [H,N]
+                values = symexp(twohot_decode(
+                    _apply_mlp(critic, "critic", feats), bins))   # [H+1,N]
 
-            # lambda returns, reverse scan: R_t over t=0..H-1
-            def ret_step(nxt, xs):
-                r, c, v_next = xs
-                ret = r + hp.gamma * c * ((1.0 - hp.lam) * v_next
-                                          + hp.lam * nxt)
-                return ret, ret
+                def ret_step(nxt, xs):
+                    r, c, v_next = xs
+                    ret = r + hp.gamma * c * ((1.0 - hp.lam) * v_next
+                                              + hp.lam * nxt)
+                    return ret, ret
 
-            _, returns = jax.lax.scan(
-                ret_step, values[-1],
-                (rewards[::-1], conts[::-1], values[1:][::-1]))
-            returns = returns[::-1]                           # [H,N]
-
-            # trajectory weights: prob the imagined rollout is alive
-            # ENTERING each state (terminals cut future losses)
-            w = jnp.concatenate(
-                [jnp.ones((1, N)),
-                 jnp.cumprod(conts[:-1], 0)], 0)              # [H,N]
-            w = jax.lax.stop_gradient(w)
-
-            # return normalization (EMA of the 5th..95th percentile range)
-            span = (jnp.percentile(returns, 95)
-                    - jnp.percentile(returns, 5))
-            scale = (hp.return_norm_decay * scale
-                     + (1.0 - hp.return_norm_decay) * span)
-            inv = 1.0 / jnp.maximum(1.0, scale)
-
-            base_values = values[:-1]                         # [H,N]
-            adv = jax.lax.stop_gradient((returns - base_values) * inv)
+                _, returns = jax.lax.scan(
+                    ret_step, values[-1],
+                    (rewards[::-1], conts[::-1], values[1:][::-1]))
+                returns = returns[::-1]                           # [H,N]
+                # trajectory weights: prob the rollout is alive ENTERING
+                # each state (terminals cut future losses)
+                w = jax.lax.stop_gradient(jnp.concatenate(
+                    [jnp.ones((1, N)), jnp.cumprod(conts[:-1], 0)], 0))
+                return returns, values, w
 
             def actor_loss_fn(actor_p):
-                logits = _apply_mlp(actor_p, "actor", feats[:-1])
-                logp = jax.nn.log_softmax(logits, -1)
-                probs = jax.nn.softmax(logits, -1)
-                taken = jnp.take_along_axis(
-                    logp, actions[..., None], -1)[..., 0]     # [H,N]
-                entropy = -(probs * logp).sum(-1)
-                loss = -(w * (adv * taken + hp.ent_coef * entropy)).mean()
-                return loss, entropy.mean()
+                # The rollout runs INSIDE the actor grad: for continuous
+                # actions it is differentiable (dynamics backprop, the
+                # paper's continuous-control estimator); for discrete it
+                # is stop-gradded and REINFORCE scores the samples.
+                feats, actions = self._imagine(wm, actor_p, h0, z0,
+                                               k_img)
+                returns, values, w = rollout_scalars(feats)
+                # return normalization: EMA of the 5th..95th percentile
+                # range (no gradient through the normalizer)
+                sg_ret = jax.lax.stop_gradient(returns)
+                span = (jnp.percentile(sg_ret, 95)
+                        - jnp.percentile(sg_ret, 5))
+                scale_new = (hp.return_norm_decay * scale
+                             + (1.0 - hp.return_norm_decay) * span)
+                inv = 1.0 / jnp.maximum(1.0, scale_new)
+                out = _apply_mlp(actor_p, "actor", feats[:-1])
+                if self.act_spec.kind == "discrete":
+                    logp = jax.nn.log_softmax(out, -1)
+                    probs = jax.nn.softmax(out, -1)
+                    taken = jnp.take_along_axis(
+                        logp, actions[..., None], -1)[..., 0]  # [H,N]
+                    entropy = -(probs * logp).sum(-1)
+                    adv = jax.lax.stop_gradient(
+                        (returns - values[:-1]) * inv)
+                    loss = -(w * (adv * taken
+                                  + hp.ent_coef * entropy)).mean()
+                else:
+                    mu, log_std = _actor_dist(out)
+                    # Gaussian entropy (the tanh correction adds no
+                    # useful gradient to the bonus).
+                    entropy = (log_std
+                               + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e)
+                               ).sum(-1)
+                    # dynamics backprop: maximize normalized lambda
+                    # returns directly through the rollout
+                    loss = -(w * (returns * inv
+                                  + hp.ent_coef * entropy)).mean()
+                saved = {"feats": jax.lax.stop_gradient(feats),
+                         "returns": sg_ret, "w": w,
+                         "scale_new": scale_new,
+                         "entropy": entropy.mean()}
+                return loss, saved
 
-            (actor_loss, entropy), actor_grads = jax.value_and_grad(
+            (actor_loss, saved), actor_grads = jax.value_and_grad(
                 actor_loss_fn, has_aux=True)(actor)
             actor_updates, actor_opt = self._actor_tx.update(
                 actor_grads, actor_opt, actor)
             actor = optax.apply_updates(actor, actor_updates)
+            feats, returns, w = (saved["feats"], saved["returns"],
+                                 saved["w"])
+            scale = saved["scale_new"]
 
-            ret_target = jax.lax.stop_gradient(
-                twohot(symlog(returns), bins))                # [H,N,K]
+            ret_target = twohot(symlog(returns), bins)        # [H,N,K]
             slow_probs = jax.lax.stop_gradient(jax.nn.softmax(
                 _apply_mlp(slow_critic, "critic", feats[:-1]), -1))
 
@@ -443,7 +538,7 @@ class DreamerV3Learner(Learner):
                 "recon_loss": aux["recon"], "reward_loss": aux["rew_loss"],
                 "cont_loss": aux["cont_loss"], "kl_dyn": aux["kl_dyn"],
                 "actor_loss": actor_loss, "critic_loss": critic_loss,
-                "entropy": entropy, "return_scale": scale,
+                "entropy": saved["entropy"], "return_scale": scale,
                 "imagined_return_mean": returns.mean(),
             }
             return (wm, actor, critic, slow_critic, wm_opt, actor_opt,
@@ -485,14 +580,24 @@ class DreamerV3Learner(Learner):
         kz, ka = jax.random.split(key)
         z = _sample_latent(post_logits, kz, hp)
         feat = jnp.concatenate([h, z.reshape(N, -1)], -1)
-        logits = _apply_mlp(actor, "actor", feat)
-        if greedy:
-            a = jnp.argmax(logits, -1)
+        out = _apply_mlp(actor, "actor", feat)
+        if self.act_spec.kind == "discrete":
+            if greedy:
+                a = jnp.argmax(out, -1)
+            else:
+                a = jax.random.categorical(ka, out, axis=-1)
         else:
-            a = jax.random.categorical(ka, logits, axis=-1)
+            mu, log_std = _actor_dist(out)
+            if greedy:
+                a = jnp.tanh(mu)
+            else:
+                a = jnp.tanh(mu + jnp.exp(log_std)
+                             * jax.random.normal(ka, mu.shape))
         return a, h, z
 
     def policy_step(self, h, z, prev_a, obs, first, key, greedy=False):
+        """Returns (action, h, z); continuous actions come back
+        NORMALIZED to [-1, 1] (scale by act_limit before env.step)."""
         return self._policy_step(self.wm_params, self.actor_params, h, z,
                                  prev_a, obs, first, key, greedy=greedy)
 
@@ -567,16 +672,17 @@ class DreamerV3(Algorithm):
         self.env: VectorEnv = self._make_env(
             config.num_envs_per_env_runner, config.seed)
         if self.env.continuous:
-            raise NotImplementedError(
-                "DreamerV3 here is discrete-action only (the "
-                "reference's continuous head can follow)")
+            self.act_spec = ActSpec("continuous", self.env.act_dim,
+                                    float(self.env.act_limit))
+        else:
+            self.act_spec = ActSpec("discrete", self.env.num_actions)
         self.space_info = {"obs_dim": self.env.obs_dim,
                            "num_actions": self.env.num_actions}
         hp = config.hyperparams()
-        obs_dim, num_actions = self.env.obs_dim, self.env.num_actions
+        obs_dim, act_spec = self.env.obs_dim, self.act_spec
 
         def factory(mesh=None):
-            return DreamerV3Learner(obs_dim, num_actions, hp,
+            return DreamerV3Learner(obs_dim, act_spec, hp,
                                     seed=config.seed, mesh=mesh)
 
         self._made_learner_group = False
@@ -587,7 +693,7 @@ class DreamerV3(Algorithm):
         n = self.env.num_envs
         self._obs = self.env.reset()
         self._first = np.ones(n, np.float32)
-        self._prev_a = np.zeros(n, np.int64)
+        self._prev_a = self._zero_actions(n)
         self._prev_r = np.zeros(n, np.float32)
         self._h = jnp.zeros((n, hp.deter_dim))
         self._z = jnp.zeros((n, hp.num_categoricals, hp.num_classes))
@@ -599,6 +705,24 @@ class DreamerV3(Algorithm):
         if callable(env):
             return env(num_envs=num_envs, seed=seed)
         return make_env(env, num_envs=num_envs, seed=seed)
+
+    def _zero_actions(self, n: int) -> np.ndarray:
+        if self.act_spec.kind == "discrete":
+            return np.zeros(n, np.int64)
+        return np.zeros((n, self.act_spec.n), np.float32)
+
+    def _prev_a_input(self, prev_a: np.ndarray) -> jnp.ndarray:
+        """Collection-side prev-action -> network input (normalized)."""
+        if self.act_spec.kind == "discrete":
+            return jax.nn.one_hot(jnp.asarray(prev_a),
+                                  self.act_spec.n)
+        return jnp.asarray(prev_a, jnp.float32)
+
+    def _env_actions(self, a: np.ndarray) -> np.ndarray:
+        """Network action -> env action (scale continuous to limits)."""
+        if self.act_spec.kind == "discrete":
+            return a
+        return a * self.act_spec.limit
 
     def _broadcast_weights(self) -> None:
         pass  # collection reads the learner's params directly
@@ -613,20 +737,19 @@ class DreamerV3(Algorithm):
             for i in range(n):
                 self.replay.add(i, {
                     "obs": self._obs[i].astype(np.float32),
-                    "prev_action": np.int64(self._prev_a[i]),
+                    "prev_action": self._prev_a[i],
                     "reward": np.float32(self._prev_r[i]),
                     "is_first": np.float32(self._first[i]),
                     "cont": np.float32(1.0),
                 })
             self._rng, key = jax.random.split(self._rng)
             a, self._h, self._z = self.learner.policy_step(
-                self._h, self._z,
-                jax.nn.one_hot(jnp.asarray(self._prev_a),
-                               env.num_actions),
+                self._h, self._z, self._prev_a_input(self._prev_a),
                 jnp.asarray(self._obs, jnp.float32),
                 jnp.asarray(self._first), key)
-            actions = np.asarray(a)
-            obs, rewards, dones, ep_ret = env.step(actions)
+            actions = np.asarray(a)   # normalized for continuous
+            obs, rewards, dones, ep_ret = env.step(
+                self._env_actions(actions))
             self._env_steps += n
             for i in range(n):
                 if dones[i]:
@@ -634,7 +757,7 @@ class DreamerV3(Algorithm):
                     # envs surface it via final_obs)
                     self.replay.add(i, {
                         "obs": env.final_obs[i].astype(np.float32),
-                        "prev_action": np.int64(actions[i]),
+                        "prev_action": actions[i],
                         "reward": np.float32(rewards[i]),
                         "is_first": np.float32(0.0),
                         "cont": np.float32(
@@ -689,18 +812,17 @@ class DreamerV3(Algorithm):
         obs = env.reset()
         h = jnp.zeros((1, hp.deter_dim))
         z = jnp.zeros((1, hp.num_categoricals, hp.num_classes))
-        prev_a = np.zeros(1, np.int64)
+        prev_a = self._zero_actions(1)
         first = np.ones(1, np.float32)
         steps_cap = 2000 * episodes
         for _ in range(steps_cap):
             rng, key = jax.random.split(rng)
             a, h, z = self.learner.policy_step(
-                h, z, jax.nn.one_hot(jnp.asarray(prev_a),
-                                     env.num_actions),
+                h, z, self._prev_a_input(prev_a),
                 jnp.asarray(obs, jnp.float32), jnp.asarray(first), key,
                 greedy=True)
             actions = np.asarray(a)
-            obs, _, dones, ep_ret = env.step(actions)
+            obs, _, dones, ep_ret = env.step(self._env_actions(actions))
             if dones[0]:
                 first[0] = 1.0
                 prev_a[0] = 0

@@ -89,20 +89,23 @@ def apply_sac_actor(params: Params, obs: jnp.ndarray
     return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
 
 
-def sample_squashed(mu: jnp.ndarray, log_std: jnp.ndarray, key: jax.Array,
-                    act_limit: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Reparameterized tanh-squashed sample + its log-prob (with the
-    tanh change-of-variables correction)."""
+def squashed_logp(pre: jnp.ndarray, mu: jnp.ndarray,
+                  log_std: jnp.ndarray) -> jnp.ndarray:
+    """log-prob of a = tanh(pre) under Normal(mu, exp(log_std)) with the
+    tanh change-of-variables correction; the softplus form of
+    log det tanh' = sum log(1 - tanh²) is the numerically stable one."""
     std = jnp.exp(log_std)
-    eps = jax.random.normal(key, mu.shape)
-    pre = mu + std * eps
     logp_gauss = (-0.5 * ((pre - mu) / std) ** 2 - log_std
                   - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
-    a = jnp.tanh(pre)
-    # log det of tanh: sum log(1 - tanh²); the softplus form is stable.
-    logp = logp_gauss - (2.0 * (jnp.log(2.0) - pre
+    return logp_gauss - (2.0 * (jnp.log(2.0) - pre
                                 - jax.nn.softplus(-2.0 * pre))).sum(-1)
-    return a * act_limit, logp
+
+
+def sample_squashed(mu: jnp.ndarray, log_std: jnp.ndarray, key: jax.Array,
+                    act_limit: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reparameterized tanh-squashed sample + its log-prob."""
+    pre = mu + jnp.exp(log_std) * jax.random.normal(key, mu.shape)
+    return jnp.tanh(pre) * act_limit, squashed_logp(pre, mu, log_std)
 
 
 def init_twin_q(rng: jax.Array, obs_dim: int, act_dim: int,

@@ -103,7 +103,7 @@ def test_learner_update_finite_and_state_roundtrip():
     from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
 
     hp = _tiny_hp()
-    learner = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=0)
+    learner = DreamerV3Learner(obs_dim=3, act_spec=2, hp=hp, seed=0)
     rng = np.random.default_rng(0)
     m = learner.update(_fake_batch(rng))
     assert all(np.isfinite(v) for v in m.values()), m
@@ -115,7 +115,7 @@ def test_learner_update_finite_and_state_roundtrip():
     # a fresh learner (different seed so its own rng differs) restored
     # from `state` must replay the exact same update — _rng is part of
     # the checkpointed state, not reconstructed from the seed
-    learner2 = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=9)
+    learner2 = DreamerV3Learner(obs_dim=3, act_spec=2, hp=hp, seed=9)
     learner2.set_state(state)
     m1 = learner.update(batch)
     m2 = learner2.update(batch)
@@ -127,7 +127,7 @@ def test_policy_step_resets_state_on_first():
     from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
 
     hp = _tiny_hp()
-    learner = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=0)
+    learner = DreamerV3Learner(obs_dim=3, act_spec=2, hp=hp, seed=0)
     N = 2
     h = jnp.ones((N, hp.deter_dim)) * 5.0
     z = jnp.ones((N, hp.num_categoricals, hp.num_classes))
@@ -150,7 +150,7 @@ def test_world_model_learns_simple_dynamics():
     from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
 
     hp = _tiny_hp()
-    learner = DreamerV3Learner(obs_dim=3, num_actions=2, hp=hp, seed=0)
+    learner = DreamerV3Learner(obs_dim=3, act_spec=2, hp=hp, seed=0)
     rng = np.random.default_rng(3)
 
     def batch():
@@ -211,13 +211,11 @@ def test_dreamerv3_trains_and_checkpoints(tmp_path):
     assert ev["evaluation/num_episodes"] >= 1
 
 
-def test_dreamerv3_rejects_remote_runners_and_continuous():
+def test_dreamerv3_rejects_remote_runners_and_connectors():
     from ray_tpu.rllib import DreamerV3Config
 
     with pytest.raises(ValueError, match="driver-local"):
         (_small_config().env_runners(num_env_runners=2)).build()
-    with pytest.raises(NotImplementedError, match="discrete"):
-        (_small_config().environment("Pendulum-v1")).build()
     with pytest.raises(ValueError, match="connector"):
         (_small_config().env_runners(
             env_to_module_connector=lambda: None)).build()
@@ -241,3 +239,54 @@ def test_dreamerv3_replay_records_terminals():
     # rewards arrive on-arrival: a terminal record carries the last step's
     # reward (CartPole pays 1.0 per step incl. the terminating one)
     assert (st["reward"][ends] == 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# continuous actions (tanh-Gaussian actor)
+# ---------------------------------------------------------------------------
+
+def test_squashed_logp_matches_numeric():
+    """logp of a tanh-Gaussian: change-of-variables vs scipy density
+    (the helper is shared by SAC sampling and RL actors)."""
+    from ray_tpu.rllib.models import squashed_logp
+
+    mu = jnp.array([[0.3, -0.5]])
+    log_std = jnp.array([[-0.2, 0.1]])
+    pre = jnp.array([[0.7, -1.1]])
+    lp = float(squashed_logp(pre, mu, log_std)[0])
+    # numeric: density of a=tanh(pre) via p(pre)/|da/dpre|
+    import scipy.stats as st
+
+    p = 1.0
+    for j in range(2):
+        p *= st.norm.pdf(float(pre[0, j]), float(mu[0, j]),
+                         float(np.exp(log_std[0, j])))
+        p /= (1.0 - np.tanh(float(pre[0, j])) ** 2)
+    assert lp == pytest.approx(np.log(p), rel=1e-4)
+
+
+def test_dreamerv3_continuous_trains():
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = (DreamerV3Config()
+           .environment("Pendulum-v1")
+           .env_runners(num_envs_per_env_runner=4,
+                        rollout_fragment_length=16)
+           .training(deter_dim=32, num_categoricals=4, num_classes=4,
+                     units=32, num_bins=9, batch_size=4, batch_length=8,
+                     horizon=4, num_updates_per_iteration=2,
+                     learning_starts=64)
+           .debugging(seed=0))
+    algo = cfg.build()
+    assert algo.act_spec.kind == "continuous"
+    m = None
+    for _ in range(3):
+        m = algo.train()
+    assert np.isfinite(m["world_model_loss"])
+    assert np.isfinite(m["actor_loss"])
+    # replayed actions are normalized vectors
+    st0 = algo.replay._streams[0]
+    assert st0["prev_action"].shape[1:] == (algo.act_spec.n,)
+    assert np.abs(st0["prev_action"]).max() <= 1.0 + 1e-6
+    ev = algo.evaluate()
+    assert ev["evaluation/num_episodes"] >= 1
