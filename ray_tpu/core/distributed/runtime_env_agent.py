@@ -198,6 +198,43 @@ class RuntimeEnvBuilder:
         conda = env.get("conda")
         if conda:
             python = await self._build_conda(root, conda)
+        def merge_env(add: Dict[str, str]) -> None:
+            # XLA_FLAGS accumulate (user flags + profiling dump +
+            # plugin flags must coexist); everything else overwrites.
+            if "XLA_FLAGS" in add and env_vars.get("XLA_FLAGS"):
+                add = dict(add)
+                add["XLA_FLAGS"] = (env_vars["XLA_FLAGS"] + " "
+                                    + add["XLA_FLAGS"])
+            env_vars.update(add)
+
+        prof = env.get("tpu_profiling")
+        if prof:
+            from ray_tpu.runtime_env import profiling_env_vars
+
+            merge_env(profiling_env_vars(prof))
+        for path, value in (env.get("plugins") or {}).items():
+            from ray_tpu.runtime_env import load_plugin
+
+            # Per-plugin directory: two plugins writing a same-named
+            # artifact must not overwrite each other.
+            plugin_root = os.path.join(
+                root, "plugins", path.replace(":", "_").replace("/", "_"))
+            os.makedirs(plugin_root, exist_ok=True)
+
+            def run_plugin(p=path, v=value, r=plugin_root):
+                return load_plugin(p).build(v, r)
+
+            try:
+                # Off-loop like extract/venv/conda: a slow plugin build
+                # must not stall heartbeats and lease granting.
+                built = await asyncio.get_running_loop().run_in_executor(
+                    None, run_plugin)
+            except Exception as e:  # noqa: BLE001
+                raise RuntimeEnvBuildError(
+                    f"runtime_env plugin {path} failed: {e}") from e
+            merge_env({str(k): str(v)
+                       for k, v in (built or {}).get("env_vars",
+                                                     {}).items()})
         spec = None
         container = env.get("container")
         if container:

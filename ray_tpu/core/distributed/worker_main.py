@@ -667,6 +667,20 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format=f"[worker {args.worker_id[:6]}] %(levelname)s %(message)s")
+    # tpu_profiling runtime env (the nsight analogue): trace the whole
+    # worker process with the JAX profiler, like `nsys profile` wraps
+    # the reference's worker (_private/runtime_env/nsight.py).
+    trace_dir = os.environ.get("RAY_TPU_JAX_TRACE_DIR")
+    if trace_dir:
+        try:
+            import atexit
+
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            atexit.register(jax.profiler.stop_trace)
+        except Exception as e:  # noqa: BLE001 profiling is best-effort
+            logging.warning("jax trace capture unavailable: %s", e)
     try:
         run_worker(args)
     except KeyboardInterrupt:

@@ -20,6 +20,16 @@ Supported fields:
     container:   {"image": ..., "run_options": [...]} -> the worker
                  command is wrapped in podman/docker run
                  (ref: runtime_env/container.py)
+    tpu_profiling: {"xla_dump_to": dir, "jax_trace_dir": dir,
+                 "log_compiles": bool} -> XLA/JAX profiling env on the
+                 worker — the TPU-native analogue of the reference's
+                 nsight plugin (_private/runtime_env/nsight.py wraps
+                 workers in `nsys profile`; on TPU the profiler is
+                 env-driven: XLA_FLAGS dump + JAX trace capture)
+    plugins:     {"pkg.module:PluginClass": config} -> custom plugin
+                 classes loaded BY THE NODE DAEMON and run at build
+                 time (ref: _private/runtime_env/plugin.py — dynamic
+                 plugin classes resolved from a class path)
 """
 from __future__ import annotations
 
@@ -31,8 +41,60 @@ import zipfile
 from typing import Any, Dict, List, Optional
 
 _SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip", "conda",
-              "container")
+              "container", "tpu_profiling", "plugins")
 PKG_NAMESPACE = "pkg"
+
+
+class RuntimeEnvPlugin:
+    """Custom runtime-env plugin interface (ref:
+    _private/runtime_env/plugin.py RuntimeEnvPlugin). Subclass it in an
+    importable module and reference it as "pkg.module:ClassName" under
+    the env's `plugins` field; the NODE DAEMON imports the class and
+    calls `build` while materializing the env.
+
+    `build(value, root)` receives the plugin's config value and the
+    env's build directory; it returns a dict that may contain
+    "env_vars" (merged into the worker environment). Raise ValueError
+    from `validate` to reject bad specs driver-side."""
+
+    @staticmethod
+    def validate(value: Any) -> Any:
+        return value
+
+    def build(self, value: Any, root: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+def load_plugin(path: str) -> RuntimeEnvPlugin:
+    """Resolve "pkg.module:ClassName" to a plugin instance."""
+    import importlib
+
+    mod_name, _, cls_name = path.partition(":")
+    if not cls_name:
+        raise ValueError(
+            f"plugin path {path!r} must be 'pkg.module:ClassName'")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    if not (isinstance(cls, type) and issubclass(cls, RuntimeEnvPlugin)):
+        raise ValueError(f"{path} is not a RuntimeEnvPlugin subclass")
+    return cls()
+
+
+def profiling_env_vars(spec: Dict[str, Any]) -> Dict[str, str]:
+    """tpu_profiling spec -> worker env vars (shared by the agent and
+    tests so the mapping cannot drift)."""
+    out: Dict[str, str] = {}
+    flags = []
+    if spec.get("xla_dump_to"):
+        flags.append(f"--xla_dump_to={spec['xla_dump_to']}")
+    if flags:
+        out["XLA_FLAGS"] = " ".join(flags)
+    if spec.get("jax_trace_dir"):
+        # Consumed by worker_main: it starts a whole-process JAX
+        # profiler trace into this directory (stop_trace at exit).
+        out["RAY_TPU_JAX_TRACE_DIR"] = str(spec["jax_trace_dir"])
+    if spec.get("log_compiles"):
+        out["JAX_LOG_COMPILES"] = "1"
+    return out
 
 
 class RuntimeEnv(dict):
@@ -43,7 +105,9 @@ class RuntimeEnv(dict):
                  py_modules: Optional[List[str]] = None,
                  pip: Optional[List[str]] = None,
                  conda: Optional[Any] = None,
-                 container: Optional[Dict[str, Any]] = None, **extra):
+                 container: Optional[Dict[str, Any]] = None,
+                 tpu_profiling: Optional[Dict[str, Any]] = None,
+                 plugins: Optional[Dict[str, Any]] = None, **extra):
         unknown = set(extra) - set(_SUPPORTED)
         if unknown:
             raise ValueError(f"unsupported runtime_env fields: {unknown}")
@@ -63,6 +127,10 @@ class RuntimeEnv(dict):
             self["conda"] = conda
         if container:
             self["container"] = dict(container)
+        if tpu_profiling:
+            self["tpu_profiling"] = dict(tpu_profiling)
+        if plugins:
+            self["plugins"] = dict(plugins)
 
 
 def _zip_path(path: str) -> bytes:
@@ -155,6 +223,26 @@ def normalize(env: Optional[Dict[str, Any]], kv_put) -> Optional[dict]:
             "run_options": [str(o) for o in
                             container.get("run_options") or ()],
         }
+    prof = env.get("tpu_profiling")
+    if prof:
+        if not isinstance(prof, dict):
+            raise ValueError("tpu_profiling must be a dict")
+        known = {"xla_dump_to", "jax_trace_dir", "log_compiles"}
+        bad = set(prof) - known
+        if bad:
+            raise ValueError(
+                f"tpu_profiling fields {sorted(bad)} not in {sorted(known)}")
+        out["tpu_profiling"] = dict(prof)
+    plugins = env.get("plugins")
+    if plugins:
+        if not isinstance(plugins, dict):
+            raise ValueError(
+                "plugins must map 'pkg.module:ClassName' -> config")
+        for path, value in plugins.items():
+            # Import driver-side too: a typo'd class path should fail
+            # at submission, not on every node daemon.
+            load_plugin(path).validate(value)
+        out["plugins"] = dict(plugins)
     return out or None
 
 

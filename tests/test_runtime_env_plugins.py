@@ -133,3 +133,101 @@ def test_runtime_env_rejects_pip_plus_conda():
 
     with pytest.raises(ValueError, match="conda"):
         normalize({"pip": ["x"], "conda": "envname"}, lambda *a: None)
+
+
+# ---------------------------------------------------------------------------
+# tpu_profiling (nsight analogue) + custom plugin seam
+# (ref: _private/runtime_env/nsight.py, plugin.py)
+# ---------------------------------------------------------------------------
+
+def test_tpu_profiling_env_reaches_worker(plugin_cluster):
+    """Workers under a tpu_profiling env get the XLA/JAX profiling env
+    — the TPU-native analogue of the nsight wrapper (env-driven, no
+    command wrapping needed)."""
+
+    @ray_tpu.remote(runtime_env={"tpu_profiling": {
+        "xla_dump_to": "/tmp/xdump", "log_compiles": True}})
+    def probe():
+        import os as _os
+
+        return (_os.environ.get("XLA_FLAGS"),
+                _os.environ.get("JAX_LOG_COMPILES"))
+
+    flags, logc = ray_tpu.get(probe.remote(), timeout=120)
+    assert "--xla_dump_to=/tmp/xdump" in (flags or "")
+    assert logc == "1"
+
+
+def test_tpu_profiling_appends_to_user_xla_flags():
+    from ray_tpu.runtime_env import profiling_env_vars
+
+    add = profiling_env_vars({"xla_dump_to": "/d", "jax_trace_dir": "/t"})
+    assert add == {"XLA_FLAGS": "--xla_dump_to=/d",
+                   "RAY_TPU_JAX_TRACE_DIR": "/t"}
+
+
+def test_tpu_profiling_rejects_unknown_fields():
+    from ray_tpu.runtime_env import normalize
+
+    with pytest.raises(ValueError, match="nsys"):
+        normalize({"tpu_profiling": {"nsys": True}}, lambda *a: None)
+
+
+from ray_tpu.runtime_env import RuntimeEnvPlugin  # noqa: E402
+
+
+class _StampPlugin(RuntimeEnvPlugin):
+    """Demo custom plugin; the builder imports it by class path exactly
+    as a node daemon would (ref: plugin.py's dynamic class loading)."""
+
+    def build(self, value, root):
+        stamp = os.path.join(root, "stamp.txt")
+        with open(stamp, "w") as f:
+            f.write(str(value))
+        return {"env_vars": {"STAMP_PATH": stamp,
+                             "STAMP_VALUE": str(value)}}
+
+
+def test_custom_plugin_builds_env_vars(tmp_path):
+    """The plugin seam end-to-end against the builder itself (the
+    daemon imports plugin classes exactly like this)."""
+    import asyncio
+
+    from ray_tpu.core.distributed.runtime_env_agent import (
+        RuntimeEnvBuilder,
+    )
+
+    built = asyncio.run(
+        RuntimeEnvBuilder(gcs_client=None, base_dir=str(tmp_path))
+        .ensure_env({"plugins": {
+            "test_runtime_env_plugins:_StampPlugin": 42}}))
+    assert built.env_vars["STAMP_VALUE"] == "42"
+    with open(built.env_vars["STAMP_PATH"]) as f:
+        assert f.read() == "42"
+
+
+def test_failing_plugin_is_a_build_error(tmp_path):
+    """A plugin that raises produces a definitive RuntimeEnvBuildError
+    (negative-cached), not a retry loop."""
+    import asyncio
+
+    from ray_tpu.core.distributed.runtime_env_agent import (
+        RuntimeEnvBuilder,
+        RuntimeEnvBuildError,
+    )
+
+    with pytest.raises(RuntimeEnvBuildError, match="plugin"):
+        asyncio.run(
+            RuntimeEnvBuilder(gcs_client=None, base_dir=str(tmp_path))
+            .ensure_env({"plugins": {
+                "ray_tpu.runtime_env:RuntimeEnvPlugin": None}}))
+
+
+def test_plugin_path_validated_driver_side():
+    from ray_tpu.runtime_env import normalize
+
+    with pytest.raises(ValueError, match="ClassName"):
+        normalize({"plugins": {"no_colon_path": 1}}, lambda *a: None)
+    with pytest.raises(ModuleNotFoundError):
+        normalize({"plugins": {"definitely.missing:Cls": 1}},
+                  lambda *a: None)
