@@ -199,12 +199,17 @@ class RuntimeEnvBuilder:
         if conda:
             python = await self._build_conda(root, conda)
         def merge_env(add: Dict[str, str]) -> None:
-            # XLA_FLAGS accumulate (user flags + profiling dump +
-            # plugin flags must coexist); everything else overwrites.
-            if "XLA_FLAGS" in add and env_vars.get("XLA_FLAGS"):
-                add = dict(add)
-                add["XLA_FLAGS"] = (env_vars["XLA_FLAGS"] + " "
-                                    + add["XLA_FLAGS"])
+            # XLA_FLAGS accumulate (node-process flags + user flags +
+            # profiling dump + plugin flags must coexist — the built
+            # value OVERWRITES the inherited one at spawn, so the
+            # inherited flags must be folded in here); everything else
+            # overwrites.
+            if "XLA_FLAGS" in add:
+                base = (env_vars.get("XLA_FLAGS")
+                        or os.environ.get("XLA_FLAGS"))
+                if base:
+                    add = dict(add)
+                    add["XLA_FLAGS"] = base + " " + add["XLA_FLAGS"]
             env_vars.update(add)
 
         prof = env.get("tpu_profiling")
@@ -229,12 +234,16 @@ class RuntimeEnvBuilder:
                 # must not stall heartbeats and lease granting.
                 built = await asyncio.get_running_loop().run_in_executor(
                     None, run_plugin)
+                # Inside the try: a malformed result (env_vars: None,
+                # non-dict) must carry the plugin's name, not surface
+                # as an anonymous AttributeError.
+                add = {str(k): str(v)
+                       for k, v in ((built or {}).get("env_vars")
+                                    or {}).items()}
             except Exception as e:  # noqa: BLE001
                 raise RuntimeEnvBuildError(
                     f"runtime_env plugin {path} failed: {e}") from e
-            merge_env({str(k): str(v)
-                       for k, v in (built or {}).get("env_vars",
-                                                     {}).items()})
+            merge_env(add)
         spec = None
         container = env.get("container")
         if container:

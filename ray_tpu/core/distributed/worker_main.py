@@ -674,11 +674,25 @@ def main():
     if trace_dir:
         try:
             import atexit
+            import signal
 
             import jax
 
             jax.profiler.start_trace(trace_dir)
-            atexit.register(jax.profiler.stop_trace)
+
+            def stop_trace_once(*_sig):
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 already stopped
+                    pass
+                if _sig:  # invoked as a signal handler, not atexit
+                    os._exit(0)
+
+            atexit.register(stop_trace_once)
+            # The daemon's graceful kill is SIGTERM, which does NOT run
+            # atexit — without this the trace never finalizes for
+            # daemon-terminated workers (SIGKILL remains unhelpable).
+            signal.signal(signal.SIGTERM, stop_trace_once)
         except Exception as e:  # noqa: BLE001 profiling is best-effort
             logging.warning("jax trace capture unavailable: %s", e)
     try:

@@ -39,6 +39,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG_PATH = os.path.join(REPO, "tools", "tpu_hunter.log")
 HISTORY = os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl")
 ARTIFACTS = ("BENCH_TPU_LAST_GOOD.json", "BENCH_SERVE_TPU_LAST_GOOD.json",
+             "BENCH_SERVE_124M_TPU_LAST_GOOD.json",
              "BENCH_TPU_HISTORY.jsonl")
 
 
@@ -159,6 +160,15 @@ def main() -> None:
              "BENCH_SERVE_TPU_LAST_GOOD.json"], 1500, {})
         log(f"bench_serve: {'ok' if 'serve_requests_per_second' in sout else sout[-200:]}")
         append_history("serve", sout)
+        # A REAL-size serve point: the tiny model is dispatch-bound
+        # through the tunnel (~10ms/step), so only a 124M-scale model
+        # shows the TPU's serving advantage.
+        sout = run_recorded(
+            [sys.executable, "bench_serve.py", "--model", "gpt2-124m",
+             "--requests", "32", "--num-slots", "4", "--max-len", "192",
+             "--out", "BENCH_SERVE_124M_TPU_LAST_GOOD.json"], 1500, {})
+        log(f"bench_serve 124m: {'ok' if 'serve_requests_per_second' in sout else sout[-200:]}")
+        append_history("serve_124m", sout)
 
         commit_artifacts(
             "Record real-TPU bench evidence (tunnel-up window)")
