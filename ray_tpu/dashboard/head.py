@@ -47,7 +47,16 @@ _PAGE = """<!doctype html>
  <section><h2>Actors</h2><table id="actors"></table></section>
  <section><h2>Jobs</h2><table id="jobs"></table></section>
  <section><h2>Placement groups</h2><table id="pgs"></table></section>
+ <section><h2>Serve</h2><table id="serve"></table></section>
  <section><h2>Recent tasks</h2><table id="tasks"></table></section>
+ <section><h2>Cluster events</h2><table id="events"></table></section>
+ <section><h2>Logs
+  <input id="logq" placeholder="actor/worker/job id (blank: all)"
+         style="font-size:12px;margin-left:8px;padding:2px 6px">
+  <button id="logb" style="font-size:12px">tail</button></h2>
+  <pre id="logs" style="font-size:11.5px;max-height:260px;overflow:auto;
+    background:#14161a;color:#d7dce2;padding:8px;border-radius:6px;
+    margin:0"></pre></section>
 </main>
 <script>
 const esc=s=>String(s??"").replace(/[&<>]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
@@ -55,9 +64,10 @@ const row=(cells,h)=> "<tr>"+cells.map(c=>`<${h?"th":"td"}>${c}</${h?"th":"td"}>
 async function j(u){const r=await fetch(u);return r.json()}
 async function tick(){
  try{
-  const [nodes,actors,jobs,pgs,tasks,status]=await Promise.all([
+  const [nodes,actors,jobs,pgs,tasks,status,serve,events]=await Promise.all([
     j("/api/nodes"),j("/api/actors"),j("/api/jobs"),j("/api/pgs"),
-    j("/api/tasks?limit=25"),j("/api/cluster_status")]);
+    j("/api/tasks?limit=25"),j("/api/cluster_status"),
+    j("/api/serve"),j("/api/events?limit=15")]);
   document.getElementById("ts").textContent="updated "+new Date().toLocaleTimeString();
   document.getElementById("nodes").innerHTML=row(["node","state","address","cpu","tpu","idle s"],1)+
    status.nodes.map(n=>row([esc(n.node_id.slice(0,12)),
@@ -89,8 +99,27 @@ async function tick(){
      t.state=="FINISHED"?'<span class="ok">FINISHED</span>':esc(t.state),
      ((t.end_ts-t.start_ts)*1000).toFixed(1),
      esc((t.node_id||"").slice(0,12))])).join("");
+  document.getElementById("serve").innerHTML=row(["app","ready","running","target","version"],1)+
+   Object.entries(serve).map(([app,s])=>row([esc(app),
+     s.ready>=s.target?`<span class="ok">${esc(s.ready)}</span>`:`<span class="bad">${esc(s.ready)}</span>`,
+     esc(s.running),esc(s.target),esc(s.version)])).join("");
+  document.getElementById("events").innerHTML=row(["time","severity","source","message"],1)+
+   events.map(e=>row([new Date(e.ts*1000).toLocaleTimeString(),
+     e.severity=="ERROR"?'<span class="bad">ERROR</span>':esc(e.severity),
+     esc(e.source),esc((e.message||"").slice(0,160))])).join("");
  }catch(e){document.getElementById("ts").textContent="error: "+e}
 }
+async function tailLogs(){
+ const q=document.getElementById("logq").value.trim();
+ const p=q?(q.length>20?`worker_id=${q}`:`actor_id=${q}`):"";
+ try{
+  const streams=await j(`/api/logs?lines=200&`+p);
+  document.getElementById("logs").textContent=streams.flatMap(s=>
+    s.lines.map(l=>`[${(s.worker_id||"").slice(0,6)}/${s.stream}] ${l}`)
+  ).join("\n")||"(no matching worker logs)";
+ }catch(e){document.getElementById("logs").textContent="error: "+e}
+}
+document.getElementById("logb").onclick=tailLogs;
 document.getElementById("addr").textContent=location.host;
 tick();setInterval(tick,2000);
 </script></body></html>"""
@@ -195,6 +224,14 @@ class DashboardHead:
         return web.Response(text="\n".join(chunks),
                             content_type="text/plain")
 
+    async def _serve(self, request):
+        """Serve app health from the controller's KV snapshot (ref:
+        dashboard/modules/serve reading controller snapshots) — no
+        actor call into the controller needed."""
+        blob = await self._call("KV", "get", namespace="serve",
+                                key=b"status")
+        return self._json(json.loads(blob) if blob else {})
+
     async def _timeline(self, request):
         from ray_tpu.util.timeline import chrome_trace
 
@@ -229,6 +266,7 @@ class DashboardHead:
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/serve", self._serve)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

@@ -123,6 +123,10 @@ class ServeController:
         with self._lock:
             self._targets.clear()
         self._reconcile_once()
+        # Publish the now-empty snapshot: the loop exits on _stop, so
+        # without this the dashboard would show the dead apps as
+        # healthy forever (no controller left to correct the blob).
+        self._publish_status()
         return True
 
     # ---- reconciliation ----------------------------------------------
@@ -130,9 +134,37 @@ class ServeController:
         while not self._stop:
             try:
                 self._reconcile_once()
+                self._publish_status()
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
             time.sleep(0.25)
+
+    def _publish_status(self) -> None:
+        """Write a compact status blob to the GCS KV ("serve"/"status")
+        so out-of-worker observers — the dashboard head, `ray-tpu
+        status` — see app health without actor calls into this
+        controller (ref: the reference's controller snapshots consumed
+        by dashboard/modules/serve). Published only on change."""
+        import json as _json
+
+        with self._lock:  # RLock: app_status re-enters safely
+            snap = {}
+            for app in self._targets:
+                st = self._state.get(app,
+                                     {"replicas": {}, "version": 0})
+                snap[app] = {**self.app_status(app),
+                             "replicas": sorted(st["replicas"])}
+        if snap == getattr(self, "_last_published", None):
+            return
+        self._last_published = snap
+        try:
+            from ray_tpu.api import _global_worker
+
+            _global_worker().kv_put(
+                "serve", b"status",
+                _json.dumps(snap, sort_keys=True).encode())
+        except Exception:  # noqa: BLE001 best-effort observability
+            pass
 
     def _reconcile_once(self):
         with self._lock:

@@ -71,3 +71,33 @@ def test_dashboard_routes(dash_cluster):
 
     status, body = _get(port, "/api/metrics")
     assert status == 200
+
+
+def test_dashboard_serve_logs_events(dash_cluster):
+    """The serve/logs/events surfaces: serve status comes from the
+    controller's KV snapshot; logs are the LogManager's ring buffers;
+    events are the structured event log."""
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+
+    cluster, port = dash_cluster
+
+    # No serve running: empty object, not an error.
+    status, body = _get(port, "/api/serve")
+    assert status == 200 and json.loads(body) == {}
+
+    # Simulate the controller's snapshot (the publish path itself is
+    # covered in test_serve against a real controller).
+    snap = {"myapp": {"target": 2, "running": 2, "ready": 1,
+                      "version": 3, "replicas": ["a", "b"]}}
+    _global_worker().kv_put("serve", b"status",
+                            json.dumps(snap).encode())
+    status, body = _get(port, "/api/serve")
+    assert json.loads(body) == snap
+
+    status, body = _get(port, "/api/events?limit=10")
+    assert status == 200
+    status, body = _get(port, "/api/logs?lines=5")
+    assert status == 200
+    streams = json.loads(body)
+    assert all("lines" in s for s in streams)

@@ -79,6 +79,23 @@ def test_status_and_reconfigure_scale():
             break
         time.sleep(0.2)
     assert st["running"] == 3
+
+    # The controller publishes its status snapshot into the GCS KV for
+    # out-of-worker observers (dashboard /api/serve).
+    import json
+
+    from ray_tpu.api import _global_worker
+
+    deadline = time.time() + 15
+    snap = {}
+    while time.time() < deadline:
+        blob = _global_worker().kv_get("serve", b"status")
+        snap = json.loads(blob) if blob else {}
+        if snap.get("scale_app", {}).get("running") == 3:
+            break
+        time.sleep(0.2)
+    assert snap["scale_app"]["target"] == 3
+    assert snap["scale_app"]["running"] == 3
     serve.delete("scale_app")
 
 
