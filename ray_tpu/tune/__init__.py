@@ -9,7 +9,8 @@ from ray_tpu.tune.schedulers import (PB2, AsyncHyperBandScheduler,
                                      FIFOScheduler, HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (AskTellSearcher, BasicVariantGenerator,
+from ray_tpu.tune.search import (AskTellSearcher, BOHBSearcher,
+                                 BasicVariantGenerator,
                                  ConcurrencyLimiter, Searcher, TPESearcher,
                                  choice, grid_search, loguniform, quniform,
                                  randint, sample_from, uniform)
@@ -28,7 +29,7 @@ __all__ = [
     "quniform", "sample_from",
     "FIFOScheduler", "AsyncHyperBandScheduler", "ASHAScheduler",
     "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
-    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "Searcher", "BasicVariantGenerator", "TPESearcher", "BOHBSearcher",
     "AskTellSearcher", "PB2",
     "ConcurrencyLimiter",
     "Callback", "JsonLoggerCallback", "CSVLoggerCallback",

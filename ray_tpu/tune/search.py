@@ -61,6 +61,37 @@ class QUniform(Domain):
         return round(v / self.q) * self.q
 
 
+def domain_to_unit(dom, v) -> "Optional[float]":
+    """Map a numeric value into [0, 1] over its domain (None for
+    categorical/grid axes). Shared by model-based searchers so the
+    normalization cannot drift between them."""
+    import math
+
+    if v is None:
+        return None
+    if isinstance(dom, (Uniform, QUniform, Randint)):
+        span = float(dom.high - dom.low) or 1.0
+        return (v - dom.low) / span
+    if isinstance(dom, LogUniform):
+        span = (dom._hi - dom._lo) or 1.0
+        return (math.log(max(v, 1e-300)) - dom._lo) / span
+    return None
+
+
+def domain_from_unit(dom, u: float):
+    """Inverse of domain_to_unit (u clipped to [0, 1] by the caller)."""
+    import math
+
+    if isinstance(dom, LogUniform):
+        return math.exp(dom._lo + u * (dom._hi - dom._lo))
+    if isinstance(dom, Randint):
+        return min(dom.low + int(u * (dom.high - dom.low)), dom.high - 1)
+    v = dom.low + u * (dom.high - dom.low)
+    if isinstance(dom, QUniform):
+        v = round(v / dom.q) * dom.q
+    return v
+
+
 class GridSearch:
     def __init__(self, values):
         self.values = list(values)
@@ -340,6 +371,156 @@ class TPESearcher(Searcher):
             score = d_bad - d_good
             if best_score is None or score > best_score:
                 best, best_score = cand, score
+        return best
+
+
+class BOHBSearcher(Searcher):
+    """Model-based HyperBand companion (ref: tune/search/bohb/bohb_search.py
+    TuneBOHB + schedulers/hb_bohb.py — BOHB, Falkner et al. 2018).
+
+    Observations are grouped by BUDGET (`result[time_attr]`, collected
+    from every intermediate report), exactly BOHB's trick: successive
+    halving produces many cheap low-budget observations and few
+    expensive high-budget ones, and the model always conditions on the
+    LARGEST budget that has enough points. Candidates are sampled
+    around the top-`gamma` configs of that budget and ranked by the
+    TPE density ratio l(x)/g(x) under product kernel-density models
+    (Gaussian kernels on domain-normalized numeric axes, smoothed
+    frequencies on categorical axes). A `random_fraction` of suggests
+    stays uniform so the model never starves exploration.
+
+    Pair with `HyperBandScheduler` (the reference pairs TuneBOHB with
+    HyperBandForBOHB the same way).
+    """
+
+    def set_space(self, param_space, metric, mode, seed=None) -> None:
+        if metric is None:
+            # Same rule as AskTellSearcher: without a metric no
+            # observation is ever recorded and the model silently
+            # degrades to random — misconfiguration, not a mode.
+            raise ValueError(
+                "BOHBSearcher needs TuneConfig.metric set — the KDE "
+                "model learns from reported results")
+        super().set_space(param_space, metric, mode, seed)
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 min_points: int = 6, bandwidth: float = 0.15,
+                 random_fraction: float = 0.2):
+        self.time_attr = time_attr
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.min_points = min_points
+        self.bandwidth = bandwidth
+        self.random_fraction = random_fraction
+        # budget -> {trial_id: (config, best score at that budget)}
+        self._obs: Dict[Any, Dict[str, tuple]] = {}
+        self._live: Dict[str, dict] = {}
+
+    # -- observation intake --------------------------------------------
+    def _record(self, trial_id: str, result: Optional[dict]) -> None:
+        cfg = self._live.get(trial_id)
+        if cfg is None or not result:
+            return
+        val = result.get(self.metric)
+        budget = result.get(self.time_attr)
+        if val is None or budget is None:
+            return
+        score = -val if self.mode == "min" else val
+        rung = self._obs.setdefault(budget, {})
+        prev = rung.get(trial_id)
+        if prev is None or score > prev[1]:
+            rung[trial_id] = (cfg, score)
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        self._record(trial_id, result)
+        self._live.pop(trial_id, None)
+
+    # -- model ----------------------------------------------------------
+    def _log_density(self, unit_points: List[dict], points: List[dict],
+                     cand_units: dict, cand: dict) -> float:
+        """Product-kernel KDE log-density of the candidate under
+        `points` (with `unit_points` their precomputed unit coords)."""
+        import math
+
+        total = 0.0
+        for key, dom in self.param_space.items():
+            u = cand_units.get(key)
+            if u is not None:
+                h = self.bandwidth
+                dens = sum(
+                    math.exp(-0.5 * ((u - up[key]) / h) ** 2)
+                    for up in unit_points) / (len(unit_points) * h)
+                total += math.log(max(dens, 1e-12))
+            else:
+                values = (dom.values if isinstance(dom, GridSearch)
+                          else dom.categories
+                          if isinstance(dom, Categorical) else None)
+                if values is None:
+                    continue
+                n_match = sum(1 for p in points if p[key] == cand[key])
+                total += math.log((n_match + 1.0)
+                                  / (len(points) + len(values)))
+        return total
+
+    def _units(self, cfg: dict) -> dict:
+        return {k: domain_to_unit(dom, cfg[k])
+                for k, dom in self.param_space.items()}
+
+    def _model_budget(self) -> Optional[Any]:
+        eligible = [b for b, rung in self._obs.items()
+                    if len(rung) >= self.min_points]
+        return max(eligible) if eligible else None
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        budget = self._model_budget()
+        ranked = (sorted(self._obs[budget].values(), key=lambda o: -o[1])
+                  if budget is not None else [])
+        k = max(2, int(len(ranked) * self.gamma))
+        good = [cfg for cfg, _ in ranked[:k]]
+        bad = [cfg for cfg, _ in ranked[k:]]
+        if not bad or budget is None \
+                or self.rng.random() < self.random_fraction:
+            # No usable split yet (or the exploration draw): uniform.
+            cfg = self._random_config()
+            self._live[trial_id] = cfg
+            return cfg
+        good_units = [self._units(c) for c in good]
+        bad_units = [self._units(c) for c in bad]
+
+        best, best_ratio = None, None
+        for _ in range(self.n_candidates):
+            # sample around a good config (jittered in unit space)
+            anchor = self.rng.choice(good)
+            cand = {}
+            for key, dom in self.param_space.items():
+                u = domain_to_unit(dom, anchor.get(key))
+                if u is not None:
+                    u = min(max(u + self.rng.gauss(0.0, self.bandwidth),
+                                0.0), 1.0)
+                    cand[key] = domain_from_unit(dom, u)
+                elif isinstance(dom, (Categorical, GridSearch)):
+                    values = (dom.categories if isinstance(dom, Categorical)
+                              else dom.values)
+                    # mostly keep the anchor's choice, sometimes explore
+                    cand[key] = (anchor[key]
+                                 if self.rng.random() > 0.25
+                                 and anchor[key] in values
+                                 else self.rng.choice(values))
+                elif isinstance(dom, Domain):
+                    cand[key] = dom.sample(self.rng)
+                else:
+                    cand[key] = dom
+            cu = self._units(cand)
+            ratio = (self._log_density(good_units, good, cu, cand)
+                     - self._log_density(bad_units, bad, cu, cand))
+            if best_ratio is None or ratio > best_ratio:
+                best, best_ratio = cand, ratio
+        self._live[trial_id] = best
         return best
 
 
