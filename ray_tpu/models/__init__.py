@@ -14,6 +14,7 @@ from ray_tpu.models.transformer import (
     loss_fn,
 )
 from ray_tpu.models import configs
+from ray_tpu.models.hf_convert import from_hf
 
 __all__ = [
     "Transformer",
@@ -23,4 +24,5 @@ __all__ = [
     "forward",
     "loss_fn",
     "configs",
+    "from_hf",
 ]

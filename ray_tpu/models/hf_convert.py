@@ -1,0 +1,149 @@
+"""Hugging Face checkpoint import for Llama-architecture models.
+
+The switching user's bridge (ref: the reference's HF integrations —
+python/ray/train/huggingface/, ray.data `from_huggingface`): load a
+`transformers` Llama-family causal LM (Llama/Mistral/Qwen2-no-bias —
+anything with RMSNorm + half-rotation RoPE + SwiGLU + GQA, which is
+exactly this repo's transformer) and get back a `TransformerConfig` +
+parameter pytree that `ray_tpu.models.forward` / `make_train_step` /
+the serve LLM engine consume directly.
+
+Weight mapping (HF stores Linear weights [out, in]; ours are [in, out],
+per-layer tensors stacked on a leading L axis for `lax.scan`):
+
+    model.embed_tokens.weight [V, d]      -> embed            (as-is)
+    layers.i.self_attn.{q,k,v}_proj       -> wq/wk/wv         (transpose)
+    layers.i.self_attn.o_proj             -> wo               (transpose)
+    layers.i.mlp.{gate,up,down}_proj      -> w_gate/w_up/w_down (transpose)
+    layers.i.input_layernorm              -> attn_norm
+    layers.i.post_attention_layernorm     -> mlp_norm
+    model.norm                            -> final_norm
+    lm_head                               -> lm_head          (transpose)
+
+No permutation is needed: both sides use the half-rotation ("rotate
+half") RoPE layout, verified by the logits-parity test against a
+randomly initialized `LlamaForCausalLM` (tests/test_hf_convert.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.models.transformer import TransformerConfig
+
+
+def config_from_hf(hf_config: Any, *, name: Optional[str] = None,
+                   param_dtype=None) -> TransformerConfig:
+    """Map a transformers Llama-family config onto TransformerConfig."""
+    import jax.numpy as jnp
+
+    get = lambda k, default=None: getattr(hf_config, k, default)  # noqa: E731
+    n_heads = get("num_attention_heads")
+    kwargs = dict(
+        name=name or get("model_type", "hf-import"),
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=n_heads,
+        n_kv_heads=get("num_key_value_heads") or n_heads,
+        d_ff=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 2048),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        param_dtype=param_dtype or jnp.float32,
+    )
+    if get("hidden_act", "silu") not in ("silu", "swish"):
+        raise ValueError(
+            f"unsupported activation {get('hidden_act')!r}: this "
+            f"transformer is SwiGLU (silu) only")
+    if get("attention_bias", False) or get("mlp_bias", False):
+        raise ValueError(
+            "model uses attention/mlp biases; this architecture has "
+            "none (bias-free Llama family only)")
+    scaling = get("rope_scaling")
+    if scaling and (scaling.get("rope_type") or
+                    scaling.get("type", "default")) != "default":
+        # Llama-3.1+ ship non-trivial rope_scaling; importing without
+        # it would be silently wrong at every position.
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: plain RoPE "
+            f"only — importing would produce silently wrong logits")
+    explicit_hd = get("head_dim")
+    if explicit_hd and explicit_hd != kwargs["d_model"] // n_heads:
+        raise ValueError(
+            f"explicit head_dim={explicit_hd} != hidden_size/num_heads"
+            f"={kwargs['d_model'] // n_heads}: unsupported layout")
+    window = get("sliding_window")
+    if window and window < kwargs["max_seq_len"]:
+        raise ValueError(
+            f"sliding_window={window} < max_position_embeddings: this "
+            f"attention is full-causal, logits would diverge beyond "
+            f"the window (import with max_seq_len <= window instead)")
+    return TransformerConfig(**kwargs)
+
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: TransformerConfig):
+    """HF state dict -> stacked parameter pytree (numpy -> jnp)."""
+    import jax.numpy as jnp
+
+    def w(key: str) -> np.ndarray:
+        t = state_dict[key]
+        if hasattr(t, "detach"):
+            # .float() first: torch bf16 (how real checkpoints ship)
+            # has no direct .numpy() conversion.
+            t = t.detach().cpu().float().numpy()
+        return np.asarray(t, np.float32)
+
+    # Any bias tensor would be silently dropped below — refuse instead
+    # (catches e.g. Qwen2's q/k/v biases, whose config lacks the
+    # attention_bias attribute config_from_hf checks).
+    biased = [k for k in state_dict if k.endswith(".bias")]
+    if biased:
+        raise ValueError(
+            f"state dict has bias tensors this bias-free architecture "
+            f"would drop: {biased[:4]}{'...' if len(biased) > 4 else ''}")
+
+    L = cfg.n_layers
+    dt = cfg.param_dtype
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = [w(fmt.format(i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return np.stack(mats)
+
+    p = "model.layers.{}."
+    blocks = {
+        "attn_norm": stack(p + "input_layernorm.weight", False),
+        "wq": stack(p + "self_attn.q_proj.weight", True),
+        "wk": stack(p + "self_attn.k_proj.weight", True),
+        "wv": stack(p + "self_attn.v_proj.weight", True),
+        "wo": stack(p + "self_attn.o_proj.weight", True),
+        "mlp_norm": stack(p + "post_attention_layernorm.weight", False),
+        "w_gate": stack(p + "mlp.gate_proj.weight", True),
+        "w_up": stack(p + "mlp.up_proj.weight", True),
+        "w_down": stack(p + "mlp.down_proj.weight", True),
+    }
+    params = {
+        "embed": jnp.asarray(w("model.embed_tokens.weight"), dt),
+        "blocks": {k: jnp.asarray(v, dt) for k, v in blocks.items()},
+        "final_norm": jnp.asarray(w("model.norm.weight"), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(w("lm_head.weight").T, dt)
+    return params
+
+
+def from_hf(model: Any, *, name: Optional[str] = None,
+            param_dtype=None) -> Tuple[TransformerConfig, Any]:
+    """transformers model (or (config, state_dict) pair) ->
+    (TransformerConfig, params). Accepts `LlamaForCausalLM`-shaped
+    models; pass `param_dtype=jnp.bfloat16` to cast on import."""
+    if isinstance(model, tuple):
+        hf_cfg, sd = model
+    else:
+        hf_cfg, sd = model.config, model.state_dict()
+    cfg = config_from_hf(hf_cfg, name=name, param_dtype=param_dtype)
+    return cfg, params_from_hf(sd, cfg)
