@@ -39,6 +39,15 @@ def config_from_hf(hf_config: Any, *, name: Optional[str] = None,
     import jax.numpy as jnp
 
     get = lambda k, default=None: getattr(hf_config, k, default)  # noqa: E731
+    required = ("vocab_size", "hidden_size", "num_hidden_layers",
+                "num_attention_heads", "intermediate_size")
+    missing = [k for k in required if get(k) is None]
+    if missing:
+        # A clear rejection beats the NoneType arithmetic a GPT-2/BERT
+        # config would hit downstream.
+        raise ValueError(
+            f"not a Llama-family config ({type(hf_config).__name__}): "
+            f"missing {missing}")
     n_heads = get("num_attention_heads")
     kwargs = dict(
         name=name or get("model_type", "hf-import"),
@@ -76,6 +85,11 @@ def config_from_hf(hf_config: Any, *, name: Optional[str] = None,
             f"explicit head_dim={explicit_hd} != hidden_size/num_heads"
             f"={kwargs['d_model'] // n_heads}: unsupported layout")
     window = get("sliding_window")
+    # Qwen-family configs carry sliding_window with use_sliding_window
+    # False (full attention in practice) — only a window actually in
+    # use makes the import diverge.
+    if not get("use_sliding_window", True):
+        window = None
     if window and window < kwargs["max_seq_len"]:
         raise ValueError(
             f"sliding_window={window} < max_position_embeddings: this "
@@ -88,25 +102,22 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: TransformerConfig):
     """HF state dict -> stacked parameter pytree (numpy -> jnp)."""
     import jax.numpy as jnp
 
+    L = cfg.n_layers
+    dt = cfg.param_dtype
+    np_dt = np.dtype(dt)  # ml_dtypes handles bf16 under numpy
+
+    consumed: set = set()
+
     def w(key: str) -> np.ndarray:
+        consumed.add(key)
         t = state_dict[key]
         if hasattr(t, "detach"):
             # .float() first: torch bf16 (how real checkpoints ship)
-            # has no direct .numpy() conversion.
+            # has no direct .numpy() conversion. Cast straight to the
+            # target dtype so peak host RAM stays ~1x the checkpoint,
+            # not f32 copies of everything.
             t = t.detach().cpu().float().numpy()
-        return np.asarray(t, np.float32)
-
-    # Any bias tensor would be silently dropped below — refuse instead
-    # (catches e.g. Qwen2's q/k/v biases, whose config lacks the
-    # attention_bias attribute config_from_hf checks).
-    biased = [k for k in state_dict if k.endswith(".bias")]
-    if biased:
-        raise ValueError(
-            f"state dict has bias tensors this bias-free architecture "
-            f"would drop: {biased[:4]}{'...' if len(biased) > 4 else ''}")
-
-    L = cfg.n_layers
-    dt = cfg.param_dtype
+        return np.asarray(t).astype(np_dt)
 
     def stack(fmt: str, transpose: bool) -> np.ndarray:
         mats = [w(fmt.format(i)) for i in range(L)]
@@ -127,12 +138,26 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: TransformerConfig):
         "w_down": stack(p + "mlp.down_proj.weight", True),
     }
     params = {
-        "embed": jnp.asarray(w("model.embed_tokens.weight"), dt),
-        "blocks": {k: jnp.asarray(v, dt) for k, v in blocks.items()},
-        "final_norm": jnp.asarray(w("model.norm.weight"), dt),
+        "embed": jnp.asarray(w("model.embed_tokens.weight")),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "final_norm": jnp.asarray(w("model.norm.weight")),
     }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = jnp.asarray(w("lm_head.weight").T, dt)
+    if not cfg.tie_embeddings and "lm_head.weight" in state_dict:
+        params["lm_head"] = jnp.asarray(w("lm_head.weight").T)
+    else:
+        # Tied models still list lm_head.weight (it aliases
+        # embed_tokens) — consumed by the tie, not dropped.
+        consumed.add("lm_head.weight")
+    # Refuse to DROP weights: biases (Qwen2), per-head q/k norms
+    # (Qwen3) or any other unread parameter would silently change the
+    # model. Rotary inv_freq buffers are derived, not parameters.
+    leftover = [k for k in state_dict
+                if k not in consumed
+                and not k.endswith("rotary_emb.inv_freq")]
+    if leftover:
+        raise ValueError(
+            f"state dict has tensors this architecture would drop: "
+            f"{leftover[:4]}{'...' if len(leftover) > 4 else ''}")
     return params
 
 

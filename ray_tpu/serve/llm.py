@@ -446,16 +446,21 @@ class LLMDeployment:
     """Serve-deployable wrapper: __call__({"tokens": [...], ...}) →
     {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...)."""
 
-    def __init__(self, cfg_name: str, *, num_slots: int = 8,
+    def __init__(self, cfg_name, *, num_slots: int = 8,
                  max_len: int = 512, seed: int = 0,
                  prefix_cache_size: int = 4, speculation_k: int = 0,
                  tensor_parallel: int = 0,
                  params_loader: Optional[Callable] = None):
+        """`cfg_name`: a registry name (ray_tpu.models.configs) or a
+        TransformerConfig instance — e.g. the config half of
+        `ray_tpu.models.from_hf(...)`, with `params_loader` returning
+        the converted weights (serve real HF checkpoints)."""
         import jax
 
-        from ray_tpu.models import configs, init_params
+        from ray_tpu.models import TransformerConfig, configs, init_params
 
-        cfg = configs.get(cfg_name)
+        cfg = (cfg_name if isinstance(cfg_name, TransformerConfig)
+               else configs.get(cfg_name))
         params = (params_loader() if params_loader
                   else init_params(jax.random.key(seed), cfg))
         mesh = None
