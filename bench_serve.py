@@ -59,6 +59,14 @@ def main() -> None:
         # trigger env makes children honor the requested CPU platform
         # (same guard as tests/conftest.py; bench.py probes instead).
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        # Pin THIS driver too: the axon register hook beats the env var
+        # via the config API, and the artifact-metadata
+        # jax.default_backend() call at the end would otherwise hang
+        # initializing the tunnel backend when it is down (observed:
+        # the whole bench completed, then hung writing metadata).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import ray_tpu
     from ray_tpu import serve

@@ -117,7 +117,7 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: TransformerConfig):
             # target dtype so peak host RAM stays ~1x the checkpoint,
             # not f32 copies of everything.
             t = t.detach().cpu().float().numpy()
-        return np.asarray(t).astype(np_dt)
+        return np.asarray(t).astype(np_dt, copy=False)
 
     def stack(fmt: str, transpose: bool) -> np.ndarray:
         mats = [w(fmt.format(i)) for i in range(L)]
@@ -142,7 +142,11 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: TransformerConfig):
         "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
         "final_norm": jnp.asarray(w("model.norm.weight")),
     }
-    if not cfg.tie_embeddings and "lm_head.weight" in state_dict:
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" not in state_dict:
+            raise ValueError(
+                "config says tie_word_embeddings=False but the state "
+                "dict has no lm_head.weight — mismatched checkpoint")
         params["lm_head"] = jnp.asarray(w("lm_head.weight").T)
     else:
         # Tied models still list lm_head.weight (it aliases

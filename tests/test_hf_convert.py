@@ -1,6 +1,8 @@
 """HF Llama-family checkpoint import: logits parity against
 transformers (ref: the reference's HF integrations; conversion is
 tested on a RANDOMLY INITIALIZED LlamaForCausalLM — no downloads)."""
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -28,7 +30,6 @@ def test_logits_match_transformers():
     model = _tiny_llama()
     cfg, params = from_hf(model, name="tiny-llama-test")
     assert cfg.n_kv_heads == 2 and cfg.n_layers == 2
-    import dataclasses
     cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32, remat=False)
 
     rng = np.random.default_rng(0)
@@ -49,7 +50,6 @@ def test_tied_embeddings_and_generation():
     model = _tiny_llama(tie=True)
     cfg, params = from_hf(model)
     assert cfg.tie_embeddings and "lm_head" not in params
-    import dataclasses
     cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32, remat=False)
     tokens = jnp.asarray([[1, 2, 3, 4]])
     with torch.no_grad():
@@ -80,7 +80,6 @@ def test_bf16_checkpoint_imports():
 
     model = _tiny_llama().to(torch.bfloat16)
     cfg, params = from_hf(model)
-    import dataclasses
     out = forward(params, jnp.asarray([[1, 2, 3]]),
                   dataclasses.replace(cfg, remat=False))
     assert np.isfinite(np.asarray(out)).all()
