@@ -24,6 +24,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.streaming import ObjectRefGenerator
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
@@ -66,6 +67,7 @@ __all__ = [
     "available_resources",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",

@@ -1152,6 +1152,92 @@ class DistributedCoreWorker:
             self.loop_thread.loop.call_soon_threadsafe(self._drain_submits)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
+    def submit_streaming_task(self, func, args, kwargs,
+                              options: TaskOptions):
+        """num_returns="streaming": run a generator task whose yields
+        become refs consumable BEFORE the task finishes (ref:
+        `ObjectRefGenerator`, _raylet.pyx:272). See
+        core/streaming.py for the discovery design."""
+        from ray_tpu.core.streaming import ObjectRefGenerator, StreamState
+
+        fn_key = self._export_function(func)
+        args_blob, deps = protocol.pack_args(args, kwargs,
+                                             self._promote_ref)
+        task_id = TaskID.generate()
+        demand = options.resource_demand(default_cpus=1.0)
+        sched = self._scheduling_fields(options)
+        spec = protocol.make_task_spec(
+            task_id=task_id.binary(), fn_key=fn_key, args_blob=args_blob,
+            num_returns=0, caller_address=self.address,
+            job_id=self.job_id,
+            options={"max_retries": options.max_retries,
+                     "retry_exceptions": options.retry_exceptions,
+                     "streaming": True,
+                     "name": options.name
+                     or getattr(func, "__qualname__", "task")},
+        )
+        if get_config().tracing_enabled:
+            from ray_tpu.util import tracing
+
+            spec["trace_ctx"] = tracing.inject()
+        state = StreamState()
+        fut: Future = Future()   # pins args until the stream completes
+        self._pin_task_deps(deps, fut)
+        self.loop_thread.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(
+                self._run_stream_to_completion(spec, demand, sched,
+                                               state, fut)))
+        return ObjectRefGenerator(self, task_id, state)
+
+    async def _run_stream_to_completion(self, spec, demand, sched, state,
+                                        fut) -> None:
+        """Slow-path-only driver for streaming tasks (no lane batching:
+        streams are long-running and item delivery is via the store +
+        directory, not the reply). Retries restart the generator from
+        scratch — item ObjectIDs are attempt-independent, so re-stored
+        items are identical and already-consumed refs stay valid."""
+        opts = spec["options"]
+        max_retries = max(0, opts.get("max_retries", 3))
+        attempt = 0
+        try:
+            while True:
+                spec["attempt"] = attempt
+                try:
+                    reply = await self._lease_and_push_async(spec, demand,
+                                                             sched)
+                except rexc.TaskError as e:
+                    if opts.get("retry_exceptions") \
+                            and attempt < max_retries:
+                        attempt += 1
+                        continue
+                    state.finish(None, e)
+                    return
+                except asyncio.CancelledError:
+                    state.finish(None, rexc.TaskCancelledError(
+                        "owner shut down mid-stream"))
+                    raise
+                except BaseException as e:  # noqa: BLE001 system failure
+                    if attempt < max_retries:
+                        attempt += 1
+                        # Same blip-survival backoff as the
+                        # non-streaming retry loop.
+                        await asyncio.sleep(min(0.1 * attempt, 1.0))
+                        continue
+                    state.finish(None, e if isinstance(e, rexc.RayTpuError)
+                                 else rexc.TaskError(
+                                     spec["options"].get("name", "task"),
+                                     f"stream failed: {e!r}"))
+                    return
+                results = reply.get("results") or []
+                for r in results:
+                    if r.inline is not None:
+                        self._cache_inline(ObjectID(r.oid), r.inline)
+                state.finish(len(results), None)
+                return
+        finally:
+            if not fut.done():
+                fut.set_result(None)
+
     def _task_submit_on_loop(self, spec, demand, sched, return_ids, fut,
                              deps=()):
         """Fast path: enqueue straight onto the lane (one future + one
@@ -1358,6 +1444,11 @@ class DistributedCoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
                           kwargs, options: TaskOptions) -> List[ObjectRef]:
+        if options.num_returns == "streaming":
+            raise NotImplementedError(
+                "num_returns='streaming' is supported for tasks only; "
+                "actor-method streaming is not implemented (stream from "
+                "a task, or return refs in batches)")
         aid = actor_id.hex()
         args_blob, deps = protocol.pack_args(args, kwargs,
                                              self._promote_ref)

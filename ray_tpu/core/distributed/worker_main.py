@@ -299,6 +299,44 @@ class WorkerService:
                                            is_error=is_error))
         return out
 
+    def _execute_stream(self, spec: dict, result: Any) -> dict:
+        """Streaming task body: each yield is stored + its location
+        registered IMMEDIATELY (consumers discover in-flight items
+        through the directory, core/streaming.py); the reply carries
+        the full item list (with inline copies of small values) so the
+        owner can fix the final count and serve completed-stream gets
+        locally."""
+        from ray_tpu.core.ids import TaskID
+
+        name = spec["options"].get("name", "task")
+        if not inspect.isgenerator(result):
+            return {"results": [], "error": rexc.TaskError(
+                name, f"num_returns='streaming' task returned "
+                      f"{type(result).__name__}, not a generator")}
+        task_id = TaskID(spec["task_id"])
+        results: List[protocol.TaskResult] = []
+        error = None
+        try:
+            for i, v in enumerate(result, start=1):
+                oid = ObjectID.for_task_return(task_id, i)
+                payload = serialization.dumps(v)
+                try:
+                    self.core.store.put_raw(oid, payload)
+                except ObjectExistsError:
+                    pass   # retried stream: identical contents
+                self.core.queue_location(oid, len(payload))
+                inline = (payload if len(payload) <= self._max_inline
+                          else None)
+                results.append(protocol.TaskResult(
+                    oid=oid.binary(), size=len(payload), inline=inline,
+                    is_error=False))
+        except BaseException as e:  # noqa: BLE001
+            error = (e if isinstance(e, rexc.RayTpuError)
+                     else rexc.TaskError.from_exception(
+                         e, name, pid=os.getpid(),
+                         node_id=self.core.node_id))
+        return {"results": results, "error": error}
+
     def _existing_results(self, spec: dict) -> Optional[List[
             protocol.TaskResult]]:
         """Retry memoization: if a prior attempt already stored every
@@ -332,7 +370,11 @@ class WorkerService:
 
     def _execute(self, spec: dict) -> dict:
         name = spec["options"].get("name", "task")
-        if spec.get("attempt", 0) or spec.get("_lane_retries"):
+        if (spec.get("attempt", 0) or spec.get("_lane_retries")) \
+                and not spec["options"].get("streaming"):
+            # (streaming: num_returns==0 would make the empty prior list
+            # read as a memoized success; restarts are idempotent anyway
+            # — item ObjectIDs are attempt-independent.)
             prior = self._existing_results(spec)
             if prior is not None:
                 err = None
@@ -357,6 +399,15 @@ class WorkerService:
                 result = fn(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
+                if spec["options"].get("streaming"):
+                    reply = self._execute_stream(spec, result)
+                    self._record_event(
+                        spec,
+                        "FAILED" if reply["error"] else "FINISHED",
+                        start_ts, _time.time(),
+                        error=(repr(reply["error"])
+                               if reply["error"] else None))
+                    return reply
             reply = {"results": self._store_results(spec, result),
                      "error": None}
             self._record_event(spec, "FINISHED", start_ts, _time.time())

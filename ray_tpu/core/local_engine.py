@@ -318,6 +318,42 @@ class LocalCoreWorker:
         self._pool.submit(run)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
+    def submit_streaming_task(self, func, args, kwargs,
+                              options: TaskOptions):
+        """num_returns="streaming" in local mode: the generator runs on
+        the pool, each yield is stored immediately, and the returned
+        iterator hands out refs as they land (same consumable-before-
+        completion contract as the distributed engine)."""
+        import queue as _queue
+
+        from ray_tpu.core.streaming import LocalRefGenerator
+
+        task_id = TaskID.generate()
+        fname = getattr(func, "__qualname__", str(func))
+        items: "_queue.Queue" = _queue.Queue()
+
+        def run():
+            try:
+                rargs, rkwargs = self._resolve_args(args, kwargs)
+                result = func(*rargs, **rkwargs)
+                if not inspect.isgenerator(result):
+                    raise rexc.TaskError(
+                        fname, f"num_returns='streaming' task returned "
+                               f"{type(result).__name__}, not a generator")
+                n = 0
+                for v in result:
+                    n += 1
+                    oid = ObjectID.for_task_return(task_id, n)
+                    self._store_value(oid, v)
+                    items.put(("item", ObjectRef(oid, self.address)))
+                items.put(("end", None))
+            except BaseException as e:  # noqa: BLE001
+                items.put(("err", e if isinstance(e, rexc.RayTpuError)
+                           else rexc.TaskError.from_exception(e, fname)))
+
+        self._pool.submit(run)
+        return LocalRefGenerator(items)
+
     def _store_returns(self, return_ids, num_returns, result, fname):
         if num_returns == 1:
             self._store_value(return_ids[0], result)

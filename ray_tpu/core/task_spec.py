@@ -68,7 +68,7 @@ class TaskOptions:
     num_gpus: Optional[float] = None  # accepted for API parity; mapped to TPU
     memory: Optional[int] = None
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
-    num_returns: int = 1
+    num_returns: Any = 1    # int, or "streaming" (generator tasks)
     max_retries: int = 3
     retry_exceptions: bool = False
     name: Optional[str] = None

@@ -43,6 +43,11 @@ class RemoteFunction:
         from ray_tpu.api import _global_worker
 
         worker = _global_worker()
+        if self._options.num_returns == "streaming":
+            # Generator task: yields become refs consumable before the
+            # task finishes (ref: ObjectRefGenerator, _raylet.pyx:272).
+            return worker.submit_streaming_task(
+                self._function, list(args), dict(kwargs), self._options)
         refs = worker.submit_task(self._function, list(args), dict(kwargs),
                                   self._options)
         if self._options.num_returns == 1:

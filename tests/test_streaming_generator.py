@@ -1,0 +1,119 @@
+"""Streaming generator returns (num_returns="streaming") — refs are
+consumable BEFORE the task completes (ref: ObjectRefGenerator,
+python/ray/_raylet.pyx:272; python/ray/tests/test_streaming_generator.py
+shapes)."""
+import time
+
+import numpy as np
+import pytest
+
+
+def test_stream_items_arrive_before_completion(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming")
+    def ticker(n, dt):
+        for i in range(n):
+            time.sleep(dt)
+            yield i * 10
+
+    gen = ticker.remote(5, 0.25)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    stamps = []
+    vals = []
+    t0 = time.monotonic()
+    for ref in gen:
+        vals.append(ray_tpu.get(ref, timeout=60))
+        stamps.append(time.monotonic() - t0)
+    assert vals == [0, 10, 20, 30, 40]
+    assert gen.completed()
+    # streaming, not batch-at-end: the first item was consumable well
+    # before the final one was produced
+    assert stamps[0] < stamps[-1] - 0.4, stamps
+
+
+def test_stream_error_after_yields(cluster_ray):
+    """Items yielded before the failure stay consumable; the error
+    surfaces on the next iteration."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def exploder():
+        yield "a"
+        yield "b"
+        raise RuntimeError("mid-stream boom")
+
+    g = exploder.remote()
+    assert ray_tpu.get(next(g), timeout=60) == "a"
+    assert ray_tpu.get(next(g), timeout=60) == "b"
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="boom"):
+        next(g)
+
+
+def test_stream_rejects_non_generator(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def notgen():
+        return 3
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="generator"):
+        next(notgen.remote())
+
+
+def test_stream_large_items_via_store(cluster_ray):
+    """Items beyond the inline cap flow through the object store."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big(n):
+        for i in range(n):
+            yield np.full(150_000, i, np.int64)
+
+    vals = [ray_tpu.get(r, timeout=120) for r in big.remote(3)]
+    assert [int(v[0]) for v in vals] == [0, 1, 2]
+    assert all(v.shape == (150_000,) for v in vals)
+
+
+def test_stream_empty_generator(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield  # pragma: no cover
+
+    assert list(empty.remote()) == []
+
+
+def test_stream_feeds_downstream_tasks(cluster_ray):
+    """Stream refs are ordinary refs: pass them to other tasks while
+    the producer is still running (the pipelining the reference's Data
+    layer builds on)."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming")
+    def produce(n):
+        for i in range(n):
+            yield i
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    out = [double.remote(ref) for ref in produce.remote(4)]
+    assert ray_tpu.get(out, timeout=120) == [0, 2, 4, 6]
+
+
+def test_stream_rejected_for_actor_methods(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            yield 1
+
+    a = A.remote()
+    with pytest.raises(NotImplementedError, match="streaming"):
+        a.m.options(num_returns="streaming").remote()
+    ray_tpu.kill(a)

@@ -1,0 +1,31 @@
+"""Streaming generators under local_mode — in its OWN file: the
+local-mode init/shutdown cycle must not invalidate another module's
+shared cluster fixture (same isolation rule as the runtime-env plugin
+tests)."""
+import pytest
+
+
+def test_stream_local_mode():
+    """num_returns='streaming' works under init(local_mode=True)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i + 100
+
+        vals = [ray_tpu.get(r, timeout=30) for r in gen.remote(3)]
+        assert vals == [100, 101, 102]
+
+        @ray_tpu.remote(num_returns="streaming")
+        def bad():
+            return 1
+
+        with pytest.raises(ray_tpu.exceptions.TaskError,
+                           match="generator"):
+            next(bad.remote())
+    finally:
+        ray_tpu.shutdown()
