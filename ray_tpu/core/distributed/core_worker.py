@@ -1238,6 +1238,15 @@ class DistributedCoreWorker:
             if not fut.done():
                 fut.set_result(None)
 
+    def _finish_stream_on_cancel(self, state):
+        """Done-callback: a cancel sweep (loop shutdown) must release
+        stream consumers instead of leaving them to time out."""
+        def cb(f):
+            if f.cancelled() and not state.done.is_set():
+                state.finish(None, rexc.TaskCancelledError(
+                    "owner shut down mid-stream"))
+        return cb
+
     def _task_submit_on_loop(self, spec, demand, sched, return_ids, fut,
                              deps=()):
         """Fast path: enqueue straight onto the lane (one future + one
@@ -1399,6 +1408,10 @@ class DistributedCoreWorker:
                 oid = ObjectID(r.oid)
                 if r.inline is not None:
                     self._cache_inline(oid, r.inline)
+        state = getattr(fut, "stream_state", None)
+        if state is not None and not state.done.is_set():
+            state.finish(len(results) if error is None
+                         and results is not None else None, error)
         with self._lock:
             for oid in return_ids:
                 self._pending_objects.pop(oid, None)
@@ -1443,17 +1456,13 @@ class DistributedCoreWorker:
         return actor_id
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
-                          kwargs, options: TaskOptions) -> List[ObjectRef]:
-        if options.num_returns == "streaming":
-            raise NotImplementedError(
-                "num_returns='streaming' is supported for tasks only; "
-                "actor-method streaming is not implemented (stream from "
-                "a task, or return refs in batches)")
+                          kwargs, options: TaskOptions):
+        streaming = options.num_returns == "streaming"
         aid = actor_id.hex()
         args_blob, deps = protocol.pack_args(args, kwargs,
                                              self._promote_ref)
         task_id = TaskID.generate()
-        num_returns = options.num_returns
+        num_returns = 0 if streaming else options.num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(1, num_returns + 1)]
         fut: Future = Future()
@@ -1471,12 +1480,28 @@ class DistributedCoreWorker:
             job_id=self.job_id, actor_id=aid, method_name=method_name,
             seq=-1,
             options={"max_retries": options.max_task_retries,
+                     "streaming": streaming,
                      "name": method_name},
         )
         if get_config().tracing_enabled:
             from ray_tpu.util import tracing
 
             spec["trace_ctx"] = tracing.inject()
+        gen = None
+        if streaming:
+            # Same discovery design as streaming tasks
+            # (core/streaming.py); the stream state rides the waiter
+            # future so every completion path — batch reply, push
+            # failure, pending-drain error, cancel sweep — finishes it.
+            from ray_tpu.core.streaming import (
+                ObjectRefGenerator,
+                StreamState,
+            )
+
+            state = StreamState()
+            fut.stream_state = state
+            fut.add_done_callback(self._finish_stream_on_cancel(state))
+            gen = ObjectRefGenerator(self, task_id, state)
         # Batched cross-thread handoff: one loop wakeup per BURST, not
         # per call. A per-call call_soon_threadsafe costs a syscall plus
         # a GIL fight with the busy loop thread (~700µs/submit under a
@@ -1486,6 +1511,8 @@ class DistributedCoreWorker:
         if not self._submit_scheduled:
             self._submit_scheduled = True
             self.loop_thread.loop.call_soon_threadsafe(self._drain_submits)
+        if streaming:
+            return gen
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
     def _drain_submits(self) -> None:
@@ -1674,7 +1701,13 @@ class DistributedCoreWorker:
                 for oid in return_ids:
                     pending.pop(oid, None)
             self._evict_inline_locked()
-        for (aid, spec, return_ids, fut, options), _ in zip(batch, replies):
+        for (aid, spec, return_ids, fut, options), reply in zip(batch,
+                                                                replies):
+            state = getattr(fut, "stream_state", None)
+            if state is not None and not state.done.is_set():
+                err = reply.get("error")
+                state.finish(None if err is not None
+                             else len(reply.get("results") or ()), err)
             if not fut.done():
                 fut.set_result(None)
 

@@ -299,7 +299,21 @@ class WorkerService:
                                            is_error=is_error))
         return out
 
-    def _execute_stream(self, spec: dict, result: Any) -> dict:
+    def _stream_reply(self, spec: dict, result: Any, start_ts: float,
+                      error_cls=None) -> dict:
+        """Run the streaming body + record the task event (shared by
+        the task and actor execution paths)."""
+        import time as _time
+
+        reply = self._execute_stream(spec, result, error_cls=error_cls)
+        self._record_event(
+            spec, "FAILED" if reply["error"] else "FINISHED",
+            start_ts, _time.time(),
+            error=repr(reply["error"]) if reply["error"] else None)
+        return reply
+
+    def _execute_stream(self, spec: dict, result: Any,
+                        error_cls=None) -> dict:
         """Streaming task body: each yield is stored + its location
         registered IMMEDIATELY (consumers discover in-flight items
         through the directory, core/streaming.py); the reply carries
@@ -308,9 +322,10 @@ class WorkerService:
         locally."""
         from ray_tpu.core.ids import TaskID
 
+        error_cls = error_cls or rexc.TaskError
         name = spec["options"].get("name", "task")
         if not inspect.isgenerator(result):
-            return {"results": [], "error": rexc.TaskError(
+            return {"results": [], "error": error_cls(
                 name, f"num_returns='streaming' task returned "
                       f"{type(result).__name__}, not a generator")}
         task_id = TaskID(spec["task_id"])
@@ -332,7 +347,7 @@ class WorkerService:
                     is_error=False))
         except BaseException as e:  # noqa: BLE001
             error = (e if isinstance(e, rexc.RayTpuError)
-                     else rexc.TaskError.from_exception(
+                     else error_cls.from_exception(
                          e, name, pid=os.getpid(),
                          node_id=self.core.node_id))
         return {"results": results, "error": error}
@@ -400,14 +415,7 @@ class WorkerService:
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
                 if spec["options"].get("streaming"):
-                    reply = self._execute_stream(spec, result)
-                    self._record_event(
-                        spec,
-                        "FAILED" if reply["error"] else "FINISHED",
-                        start_ts, _time.time(),
-                        error=(repr(reply["error"])
-                               if reply["error"] else None))
-                    return reply
+                    return self._stream_reply(spec, result, start_ts)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
             self._record_event(spec, "FINISHED", start_ts, _time.time())
@@ -501,6 +509,14 @@ class WorkerService:
             # Async path phase 2: returns an awaitable producing the reply.
             async def run():
                 start_ts = _time.time()
+                if spec["options"].get("streaming"):
+                    # The coroutine path awaits a single value; silently
+                    # discarding it as a 0-item stream would be
+                    # undebuggable — reject loudly.
+                    return {"results": [], "error": rexc.ActorError(
+                        name, "num_returns='streaming' is not supported "
+                              "on async actor methods (use a sync "
+                              "generator method)")}
                 try:
                     method = getattr(self.actor.instance,
                                      spec["method_name"])
@@ -538,6 +554,9 @@ class WorkerService:
                 result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
+                if spec["options"].get("streaming"):
+                    return self._stream_reply(spec, result, start_ts,
+                                              error_cls=rexc.ActorError)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
             self._record_event(spec, "FINISHED", start_ts, _time.time())

@@ -400,6 +400,10 @@ class LocalCoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
                           kwargs, options: TaskOptions) -> List[ObjectRef]:
+        if options.num_returns == "streaming":
+            raise NotImplementedError(
+                "actor-method streaming is not supported in local_mode "
+                "(task streaming is; or run a real cluster)")
         task_id = TaskID.generate()
         num_returns = options.num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)

@@ -61,11 +61,26 @@ class ClientWorker:
         return pickle.loads(blob)
 
     # -- driver API (duck type of DistributedCoreWorker) ----------------
+    @staticmethod
+    def _reject_streaming(options) -> None:
+        # An ObjectRefGenerator holds the server driver's live runtime
+        # (locks, sockets) and cannot cross the proxy; fail BEFORE
+        # submission, not with a pickling error after side effects ran.
+        if getattr(options, "num_returns", 1) == "streaming":
+            raise NotImplementedError(
+                "num_returns='streaming' is not supported through the "
+                "ray-tpu:// client proxy (run the driver in-cluster)")
+
     def submit_task(self, func, args, kwargs, options):
+        self._reject_streaming(options)
         return self._invoke("submit_task", func, args, kwargs, options)
+
+    def submit_streaming_task(self, func, args, kwargs, options):
+        self._reject_streaming(options)
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs,
                           options):
+        self._reject_streaming(options)
         return self._invoke("submit_actor_task", actor_id, method_name,
                             args, kwargs, options)
 

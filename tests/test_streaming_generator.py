@@ -105,15 +105,48 @@ def test_stream_feeds_downstream_tasks(cluster_ray):
     assert ray_tpu.get(out, timeout=120) == [0, 2, 4, 6]
 
 
-def test_stream_rejected_for_actor_methods(cluster_ray):
+def test_stream_actor_method(cluster_ray):
+    """Actor methods stream too (ref: generators on actor methods):
+    yields are consumable mid-call, state persists across calls, and
+    ordered non-streaming calls still work on the same actor."""
     ray_tpu = cluster_ray
 
     @ray_tpu.remote
-    class A:
-        def m(self):
-            yield 1
+    class Chunker:
+        def __init__(self):
+            self.calls = 0
 
-    a = A.remote()
-    with pytest.raises(NotImplementedError, match="streaming"):
-        a.m.options(num_returns="streaming").remote()
+        def chunks(self, n):
+            self.calls += 1
+            for i in range(n):
+                yield (self.calls, i)
+
+        def count(self):
+            return self.calls
+
+    a = Chunker.remote()
+    first = [ray_tpu.get(r, timeout=60)
+             for r in a.chunks.options(num_returns="streaming").remote(3)]
+    assert first == [(1, 0), (1, 1), (1, 2)]
+    second = [ray_tpu.get(r, timeout=60)
+              for r in a.chunks.options(num_returns="streaming").remote(2)]
+    assert second == [(2, 0), (2, 1)]
+    assert ray_tpu.get(a.count.remote(), timeout=60) == 2
+    ray_tpu.kill(a)
+
+
+def test_stream_actor_method_error(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            yield 1
+            raise RuntimeError("actor stream boom")
+
+    a = Bad.remote()
+    g = a.boom.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g), timeout=60) == 1
+    with pytest.raises(ray_tpu.exceptions.RayTpuError, match="boom"):
+        next(g)
     ray_tpu.kill(a)
