@@ -42,6 +42,7 @@ class ActorRuntime:
         self.instance = instance
         self._is_async = any(
             inspect.iscoroutinefunction(m)
+            or inspect.isasyncgenfunction(m)
             for _, m in inspect.getmembers(type(instance),
                                            inspect.isfunction))
         maxc = max(1, max_concurrency)
@@ -126,7 +127,8 @@ class ActorRuntime:
     def _dispatch(self, spec, fut, execute, main_loop) -> None:
         method = getattr(self.instance, spec["method_name"], None)
         if (self._loop is not None and method is not None
-                and inspect.iscoroutinefunction(method)):
+                and (inspect.iscoroutinefunction(method)
+                     or inspect.isasyncgenfunction(method))):
             async def run_async():
                 # Arg resolution may block (remote gets): run it on the pool
                 # and await via wrap_future (works across loops — the future
@@ -333,23 +335,58 @@ class WorkerService:
         error = None
         try:
             for i, v in enumerate(result, start=1):
-                oid = ObjectID.for_task_return(task_id, i)
-                payload = serialization.dumps(v)
-                try:
-                    self.core.store.put_raw(oid, payload)
-                except ObjectExistsError:
-                    pass   # retried stream: identical contents
-                self.core.queue_location(oid, len(payload))
-                inline = (payload if len(payload) <= self._max_inline
-                          else None)
-                results.append(protocol.TaskResult(
-                    oid=oid.binary(), size=len(payload), inline=inline,
-                    is_error=False))
+                results.append(self._store_stream_item(task_id, i, v))
         except BaseException as e:  # noqa: BLE001
             error = (e if isinstance(e, rexc.RayTpuError)
                      else error_cls.from_exception(
                          e, name, pid=os.getpid(),
                          node_id=self.core.node_id))
+        return {"results": results, "error": error}
+
+    def _store_stream_item(self, task_id, i: int,
+                           v: Any) -> protocol.TaskResult:
+        """Store + register one stream yield so consumers discover it
+        immediately (shared by the sync and async-generator paths)."""
+        oid = ObjectID.for_task_return(task_id, i)
+        payload = serialization.dumps(v)
+        try:
+            self.core.store.put_raw(oid, payload)
+        except ObjectExistsError:
+            pass   # retried stream: identical contents
+        self.core.queue_location(oid, len(payload))
+        inline = payload if len(payload) <= self._max_inline else None
+        return protocol.TaskResult(oid=oid.binary(), size=len(payload),
+                                   inline=inline, is_error=False)
+
+    async def _execute_stream_async(self, spec: dict, agen,
+                                    start_ts: float, name: str) -> dict:
+        """Async-generator actor methods: same per-item storage, driven
+        by `async for`. Serialization + store writes are offloaded to
+        the task pool — the actor's event loop (shared by every
+        in-flight coroutine method) must not block on store I/O."""
+        import time as _time
+
+        from ray_tpu.core.ids import TaskID
+
+        loop = asyncio.get_running_loop()
+        task_id = TaskID(spec["task_id"])
+        results: List[protocol.TaskResult] = []
+        error = None
+        try:
+            i = 0
+            async for v in agen:
+                i += 1
+                results.append(await loop.run_in_executor(
+                    self._task_pool, self._store_stream_item, task_id,
+                    i, v))
+        except BaseException as e:  # noqa: BLE001
+            error = (e if isinstance(e, rexc.RayTpuError)
+                     else rexc.ActorError.from_exception(
+                         e, name, pid=os.getpid(),
+                         node_id=self.core.node_id))
+        self._record_event(
+            spec, "FAILED" if error else "FINISHED", start_ts,
+            _time.time(), error=repr(error) if error else None)
         return {"results": results, "error": error}
 
     def _existing_results(self, spec: dict) -> Optional[List[
@@ -509,17 +546,28 @@ class WorkerService:
             # Async path phase 2: returns an awaitable producing the reply.
             async def run():
                 start_ts = _time.time()
-                if spec["options"].get("streaming"):
-                    # The coroutine path awaits a single value; silently
-                    # discarding it as a 0-item stream would be
-                    # undebuggable — reject loudly.
-                    return {"results": [], "error": rexc.ActorError(
-                        name, "num_returns='streaming' is not supported "
-                              "on async actor methods (use a sync "
-                              "generator method)")}
                 try:
                     method = getattr(self.actor.instance,
                                      spec["method_name"])
+                    if spec["options"].get("streaming"):
+                        if not inspect.isasyncgenfunction(method):
+                            # Reject BEFORE invoking: calling a plain
+                            # coroutine method would create a never-
+                            # awaited coroutine and silently skip its
+                            # side effects. (Sync generator methods on
+                            # async actors never reach this path —
+                            # _dispatch routes them to the sync pool.)
+                            err = rexc.ActorError(
+                                name, "num_returns='streaming' async "
+                                      "actor method must be an async "
+                                      "generator (async def + yield)")
+                            self._record_event(
+                                spec, "FAILED", start_ts, _time.time(),
+                                error=repr(err))
+                            return {"results": [], "error": err}
+                        raw = method(*coro_args[0], **coro_args[1])
+                        return await self._execute_stream_async(
+                            spec, raw, start_ts, name)
                     result = await method(*coro_args[0], **coro_args[1])
                     reply = {"results": self._store_results(spec, result),
                              "error": None}

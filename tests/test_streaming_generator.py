@@ -150,3 +150,46 @@ def test_stream_actor_method_error(cluster_ray):
     with pytest.raises(ray_tpu.exceptions.RayTpuError, match="boom"):
         next(g)
     ray_tpu.kill(a)
+
+
+def test_stream_async_actor_method(cluster_ray):
+    """Async-generator actor methods stream (the actor runs an event
+    loop; `async for` drives the same per-item storage path)."""
+    import asyncio as _asyncio
+
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class AsyncFeed:
+        async def ticks(self, n):
+            for i in range(n):
+                await _asyncio.sleep(0.05)
+                yield i * 7
+
+        async def other(self):
+            return "alive"
+
+    a = AsyncFeed.remote()
+    vals = [ray_tpu.get(r, timeout=60)
+            for r in a.ticks.options(num_returns="streaming").remote(4)]
+    assert vals == [0, 7, 14, 21]
+    assert ray_tpu.get(a.other.remote(), timeout=60) == "alive"
+    ray_tpu.kill(a)
+
+
+def test_stream_rejects_plain_coroutine_method(cluster_ray):
+    """A plain `async def` (no yield) with streaming is rejected before
+    invocation — no orphaned never-awaited coroutine."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class C:
+        async def just_async(self):
+            return 1
+
+    a = C.remote()
+    g = a.just_async.options(num_returns="streaming").remote()
+    with pytest.raises(ray_tpu.exceptions.RayTpuError,
+                       match="async generator"):
+        next(g)
+    ray_tpu.kill(a)
