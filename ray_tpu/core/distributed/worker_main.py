@@ -36,7 +36,13 @@ class ActorRuntime:
     """Hosts the single actor instance of this worker; enforces per-caller
     submission-order execution (ref: SequentialActorSubmitQueue +
     actor_scheduling_queue.h), with `max_concurrency` pools and async-actor
-    event-loop concurrency."""
+    event-loop concurrency.
+
+    ANY async method — coroutine or async generator — makes the actor an
+    asyncio actor (the reference's rule): default concurrency becomes
+    1000 and sync methods lose strict serialization. Keep state-mutating
+    methods sync-only in a sync actor, or guard shared state, exactly as
+    with the reference's async actors."""
 
     def __init__(self, instance, max_concurrency: int):
         self.instance = instance
@@ -163,6 +169,11 @@ class WorkerService:
         self.actor_id: Optional[str] = None
         self._task_pool = ThreadPoolExecutor(max_workers=4,
                                              thread_name_prefix="exec")
+        # Async-stream item stores get their OWN thread: offloading to
+        # _task_pool could circular-wait (a pooled task blocked on a
+        # stream item whose store needs a pool slot).
+        self._stream_store_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="stream-store")
         self._max_inline = get_config().max_inline_object_size
         # Deferred store writes for inline-able results: the caller gets
         # the value in the reply NOW; the store copy + location record
@@ -377,9 +388,15 @@ class WorkerService:
             async for v in agen:
                 i += 1
                 results.append(await loop.run_in_executor(
-                    self._task_pool, self._store_stream_item, task_id,
-                    i, v))
+                    self._stream_store_pool, self._store_stream_item,
+                    task_id, i, v))
         except BaseException as e:  # noqa: BLE001
+            # Close promptly: the user generator's finally blocks must
+            # not wait for the loop's asyncgen GC finalizer.
+            try:
+                await agen.aclose()
+            except BaseException:  # noqa: BLE001
+                pass
             error = (e if isinstance(e, rexc.RayTpuError)
                      else rexc.ActorError.from_exception(
                          e, name, pid=os.getpid(),
@@ -568,6 +585,16 @@ class WorkerService:
                         raw = method(*coro_args[0], **coro_args[1])
                         return await self._execute_stream_async(
                             spec, raw, start_ts, name)
+                    if inspect.isasyncgenfunction(method):
+                        # awaiting an async generator is a TypeError —
+                        # diagnose the missing option instead.
+                        err = rexc.ActorError(
+                            name, "async-generator method requires "
+                                  "num_returns='streaming'")
+                        self._record_event(
+                            spec, "FAILED", start_ts, _time.time(),
+                            error=repr(err))
+                        return {"results": [], "error": err}
                     result = await method(*coro_args[0], **coro_args[1])
                     reply = {"results": self._store_results(spec, result),
                              "error": None}

@@ -193,3 +193,20 @@ def test_stream_rejects_plain_coroutine_method(cluster_ray):
                        match="async generator"):
         next(g)
     ray_tpu.kill(a)
+
+
+def test_async_gen_method_without_streaming_is_diagnosed(cluster_ray):
+    """Calling an async-generator method WITHOUT the streaming option
+    gets a clear 'requires num_returns' error, not an await TypeError."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class G:
+        async def agen(self):
+            yield 1
+
+    a = G.remote()
+    with pytest.raises(ray_tpu.exceptions.RayTpuError,
+                       match="requires num_returns"):
+        ray_tpu.get(a.agen.remote(), timeout=60)
+    ray_tpu.kill(a)
