@@ -1,0 +1,165 @@
+"""Decompose the bench train step's time on the real chip.
+
+The headline bench (bench.py) gives ONE number; pushing MFU needs to
+know where the non-peak time goes. This tool times, separately jitted
+at the bench config's shapes:
+
+  1. peak        — chained 8k bf16 matmuls (the chip's deliverable rate)
+  2. attn_fwd    — flash attention forward at bench shapes
+  3. attn_bwd    — flash attention fwd+bwd
+  4. block_fwd   — one transformer block forward
+  5. fwd         — full model forward
+  6. fwd_bwd     — full loss + grad
+  7. step        — full train step (grad + Adam)
+
+and prints one JSON line with per-phase ms and derived shares, appended
+to BENCH_TPU_HISTORY.jsonl by the hunter (kind="decompose") on tunnel-up
+windows. Run manually: `python tools/tpu_decompose_bench.py` (probes
+first; exits with {"decomposed": false} when the tunnel is down).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def timed(fn, *args, reps: int = 8) -> float:
+    """Median-of-reps wall ms; host-transfer sync (block_until_ready is
+    unreliable through the tunnel)."""
+    out = fn(*args)
+    leaf = out[0] if isinstance(out, tuple) else out
+    _sync(leaf)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        leaf = out[0] if isinstance(out, tuple) else out
+        _sync(leaf)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1000.0
+
+
+def _sync(x) -> None:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    float(leaves[0].ravel()[0].astype("float32"))
+
+
+def main() -> None:
+    from ray_tpu.core.distributed.resources import run_tpu_probe
+
+    count, diag = run_tpu_probe(90, compute=True)
+    if count <= 0:
+        print(json.dumps({"decomposed": False, "reason": diag[-200:]}))
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import configs, init_params
+    from ray_tpu.models.training import default_optimizer, make_train_step
+    from ray_tpu.models.transformer import forward, loss_fn
+    from ray_tpu.ops.attention import flash_attention
+    from ray_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = configs.BENCH_350M
+    batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
+    seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+    out: dict = {"decomposed": True, "batch": batch, "seq": seq}
+
+    # 1. peak
+    n = 8192
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        for _ in range(8):
+            a = (a @ b).astype(jnp.bfloat16) * 0.01
+        return a
+
+    peak_ms = timed(mm, a, b)
+    out["peak_tflops"] = round(8 * 2 * n ** 3 / (peak_ms / 1e3) / 1e12, 1)
+
+    # 2/3. attention at bench shapes
+    hd = cfg.head_dim
+    q = jax.random.normal(jax.random.key(2), (batch, seq, cfg.n_heads, hd),
+                          jnp.bfloat16)
+
+    @jax.jit
+    def attn_fwd(q):
+        return flash_attention(q, q, q, True, None)
+
+    @jax.jit
+    def attn_bwd(q):
+        return jax.grad(
+            lambda q_: flash_attention(q_, q_, q_, True, None)
+            .astype(jnp.float32).sum())(q)
+
+    out["attn_fwd_ms_per_layer"] = round(timed(attn_fwd, q), 2)
+    out["attn_fwdbwd_ms_per_layer"] = round(timed(attn_bwd, q), 2)
+
+    # 5/6/7. full model (ONE param copy: reuse the train state's params
+    # for the fwd/fwd_bwd timings — a second 350M pytree would double
+    # parameter HBM on the single chip for no measurement benefit)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    mesh = build_mesh(MeshConfig(fsdp=-1))
+    init_fn, step_fn = make_train_step(
+        cfg, mesh, optimizer=default_optimizer(3e-4, warmup=10,
+                                               total_steps=1000))
+    state = init_fn(jax.random.key(0))
+    batch_data = {"tokens": tokens}
+
+    @jax.jit
+    def fwd(params, toks):
+        return forward(params, toks, cfg)
+
+    @jax.jit
+    def fwd_bwd(params, batch_data):
+        # loss_fn returns a bare scalar
+        return jax.grad(lambda p: loss_fn(p, batch_data, cfg))(params)
+
+    out["fwd_ms"] = round(timed(fwd, state.params, tokens[:, :-1]), 1)
+    out["fwd_bwd_ms"] = round(
+        timed(fwd_bwd, state.params, batch_data), 1)
+
+    # step_fn donates its state arg (buffers deleted per call) — time
+    # by rethreading state like a real training loop does.
+    state, m = step_fn(state, batch_data)
+    _sync(m["loss"])
+    times = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, batch_data)
+        _sync(m["loss"])
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    out["step_ms"] = round(times[len(times) // 2] * 1000.0, 1)
+
+    # derived shares
+    attn_total = out["attn_fwdbwd_ms_per_layer"] * cfg.n_layers
+    out["attn_share_of_step"] = round(attn_total / out["step_ms"], 3)
+    out["optimizer_overhead_ms"] = round(out["step_ms"]
+                                         - out["fwd_bwd_ms"], 1)
+    out["remat_overhead_ms"] = round(
+        out["fwd_bwd_ms"] - out["fwd_ms"] * 3, 1)  # ~2N bwd + 1N recompute
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException:  # noqa: BLE001 one JSON line, always
+        import traceback
+
+        print(json.dumps({"decomposed": False,
+                          "error": traceback.format_exc()[-600:]}))
+    sys.exit(0)

@@ -155,6 +155,11 @@ def main() -> None:
             if '"recorded": false' in out:
                 break   # tunnel dropped mid-window: stop the sweep
 
+        dout = run_recorded(
+            [sys.executable, "tools/tpu_decompose_bench.py"], 1200, {})
+        log(f"decompose: {dout.strip().splitlines()[-1][:200] if dout.strip() else 'no output'}")
+        append_history("decompose", dout)
+
         sout = run_recorded(
             [sys.executable, "bench_serve.py", "--out",
              "BENCH_SERVE_TPU_LAST_GOOD.json"], 1500, {})
