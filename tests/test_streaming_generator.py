@@ -210,3 +210,21 @@ def test_async_gen_method_without_streaming_is_diagnosed(cluster_ray):
                        match="requires num_returns"):
         ray_tpu.get(a.agen.remote(), timeout=60)
     ray_tpu.kill(a)
+
+
+def test_stream_next_ref_timeout(cluster_ray):
+    """next_ref(timeout) bounds the per-item wait without killing the
+    stream: the same item can be awaited again."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow():
+        time.sleep(1.2)
+        yield "late"
+
+    g = slow.remote()
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        g.next_ref(timeout=0.1)
+    # retry with budget: the stream is still alive and delivers
+    ref = g.next_ref(timeout=60)
+    assert ray_tpu.get(ref, timeout=30) == "late"
