@@ -223,10 +223,12 @@ def hunt_evidence() -> "dict | None":
             lines = [ln.strip() for ln in f if ln.strip()]
     except (OSError, ValueError):
         return None
-    # The log is append-only across hunter restarts; count only the
-    # CURRENT daemon's probes (after the last startup marker).
-    for i in range(len(lines) - 1, -1, -1):
-        if "hunter up" in lines[i]:
+    # The log is git-ignored, so it normally spans exactly THIS session
+    # (fresh container per round) — count across daemon restarts
+    # (config pickups are reported, not hidden). Guard the assumption:
+    # anything before the FIRST startup marker is not ours.
+    for i, ln in enumerate(lines):
+        if "hunter up" in ln:
             lines = lines[i:]
             break
     probes = [ln for ln in lines if "probe:" in ln]
@@ -236,6 +238,8 @@ def hunt_evidence() -> "dict | None":
     return {
         "probes_this_session": len(probes),
         "tunnel_up_windows": len(ups),
+        "hunter_restarts": max(
+            0, sum(1 for ln in lines if "hunter up" in ln) - 1),
         "first_probe": probes[0][:10].strip("[]"),
         "last_probe": probes[-1][:10].strip("[]"),
         "last_line": probes[-1][-160:],
