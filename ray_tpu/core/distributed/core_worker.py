@@ -202,7 +202,19 @@ class _TaskLane:
                 return  # time-slice over: re-lease so other lanes rotate
             batch = []
             while self.queue and len(batch) < self.BATCH:
-                batch.append(self.queue.popleft())
+                spec, fut = self.queue.popleft()
+                if spec["task_id"] in self.core._cancelled_tasks:
+                    # Cancelled while queued: never push (ref:
+                    # CancelTask on unleased tasks). Consuming the
+                    # tombstone bounds the set to in-flight cancels.
+                    self.core._cancelled_tasks.discard(spec["task_id"])
+                    if not fut.done():
+                        fut.set_result({
+                            "results": [],
+                            "error": rexc.TaskCancelledError(
+                                spec["options"].get("name", "task"))})
+                    continue
+                batch.append((spec, fut))
             if not batch:
                 # Hold the lease briefly: a follow-up burst reuses the
                 # worker without another raylet round-trip.
@@ -293,6 +305,9 @@ class DistributedCoreWorker:
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._free_batch: List[bytes] = []
         self._inline_cache: Dict[ObjectID, bytes] = {}
+        # Task ids tombstoned by cancel(): queued entries are swept,
+        # retries suppressed (running tasks are not interrupted).
+        self._cancelled_tasks: set = set()
         self._inline_cache_order: deque = deque()
 
         # ---- pending tasks (futures resolve when reply arrives) ----
@@ -1201,6 +1216,11 @@ class DistributedCoreWorker:
         attempt = 0
         try:
             while True:
+                if spec["task_id"] in self._cancelled_tasks:
+                    self._cancelled_tasks.discard(spec["task_id"])
+                    state.finish(None, rexc.TaskCancelledError(
+                        opts.get("name", "task")))
+                    return
                 spec["attempt"] = attempt
                 try:
                     reply = await self._lease_and_push_async(spec, demand,
@@ -1216,6 +1236,9 @@ class DistributedCoreWorker:
                     state.finish(None, rexc.TaskCancelledError(
                         "owner shut down mid-stream"))
                     raise
+                except rexc.TaskCancelledError as e:
+                    state.finish(None, e)
+                    return
                 except BaseException as e:  # noqa: BLE001 system failure
                     if attempt < max_retries:
                         attempt += 1
@@ -1318,6 +1341,9 @@ class DistributedCoreWorker:
                     self._finish_task(return_ids, fut,
                                       results=reply["results"])
                     return
+                if isinstance(err, rexc.TaskCancelledError):
+                    self._finish_task(return_ids, fut, error=err)
+                    return
                 if (isinstance(err, rexc.TaskError)
                         and not spec["options"].get("retry_exceptions")):
                     self._finish_task(return_ids, fut, error=err)
@@ -1341,6 +1367,12 @@ class DistributedCoreWorker:
         attempt = 0
         last_err: Optional[BaseException] = None
         while attempt <= max_retries:
+            if spec["task_id"] in self._cancelled_tasks:
+                self._cancelled_tasks.discard(spec["task_id"])
+                self._finish_task(return_ids, fut,
+                                  error=rexc.TaskCancelledError(
+                                      opts.get("name", "task")))
+                return
             spec["attempt"] = attempt
             try:
                 reply = await self._lease_and_push_async(spec, demand, sched)
@@ -1355,6 +1387,9 @@ class DistributedCoreWorker:
                 if not fut.done():
                     fut.cancel()
                 raise
+            except rexc.TaskCancelledError as e:
+                self._finish_task(return_ids, fut, error=e)
+                return
             except BaseException as e:  # noqa: BLE001 system failure
                 last_err = e
                 attempt += 1
@@ -1774,9 +1809,27 @@ class DistributedCoreWorker:
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True) -> None:
-        # Round-1: cancellation of queued (not yet leased) tasks happens by
-        # the lease timing out; running tasks are not interrupted.
-        logger.warning("cancel() is best-effort in this build")
+        """Cancel the task producing `ref` (ref: CoreWorker::CancelTask).
+
+        Semantics: a task still QUEUED (lane queue or retry loop) is
+        dropped and its getters raise TaskCancelledError; a task already
+        RUNNING is not interrupted (cooperative interruption is not
+        implemented), but its future RETRIES are suppressed. Cancelling
+        a finished task is a no-op. Actor tasks are not cancellable
+        (matching their ordered-queue semantics here)."""
+        oid = ref.id()
+        with self._lock:
+            if oid not in self._pending_objects:
+                return   # already finished (or unknown): no-op
+        self._cancelled_tasks.add(oid.task_id().binary())
+        # Wake lanes so queued entries are swept promptly.
+        def wake():
+            for lane in self._lanes.values():
+                lane.wakeup.set()
+        try:
+            self.loop_thread.loop.call_soon_threadsafe(wake)
+        except Exception:  # noqa: BLE001 loop shutting down
+            pass
 
     # ------------------------------------------------------------------
     # cluster introspection

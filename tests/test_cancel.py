@@ -1,0 +1,50 @@
+"""Task cancellation (ref: CoreWorker::CancelTask semantics — queued
+tasks are dropped; running tasks keep running but retries stop)."""
+import time
+
+import pytest
+
+
+def test_cancel_queued_task(cluster_ray):
+    """Tasks queued behind a long-running one are cancellable: getters
+    raise TaskCancelledError and the work never executes."""
+    ray_tpu = cluster_ray
+
+    marker = []
+
+    @ray_tpu.remote(num_cpus=4)   # holds EVERY cluster CPU
+    def blocker():
+        time.sleep(3.0)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1)
+    def queued(path):
+        import pathlib
+
+        pathlib.Path(path).write_text("ran")
+        return "ran"
+
+    import tempfile, os
+    sentinel = os.path.join(tempfile.mkdtemp(), "ran.txt")
+    b = blocker.remote()          # occupies the CPU
+    q = queued.remote(sentinel)   # waits in the lane queue
+    time.sleep(0.3)
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(b, timeout=60) == "done"   # blocker unaffected
+    time.sleep(0.5)
+    assert not os.path.exists(sentinel)           # never executed
+
+
+def test_cancel_finished_task_is_noop(cluster_ray):
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    def f():
+        return 5
+
+    r = f.remote()
+    assert ray_tpu.get(r, timeout=60) == 5
+    ray_tpu.cancel(r)                  # no-op
+    assert ray_tpu.get(r, timeout=60) == 5   # result still readable
