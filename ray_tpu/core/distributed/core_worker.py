@@ -225,6 +225,9 @@ class _TaskLane:
                     continue
                 except (TimeoutError, asyncio.TimeoutError):
                     return
+            for s, _ in batch:
+                self.core._task_locations[s["task_id"]] = \
+                    grant["worker_address"]
             try:
                 replies = await worker.call(
                     "Worker", "push_tasks",
@@ -252,6 +255,9 @@ class _TaskLane:
                 self.wakeup.set()
                 self._maybe_scale()
                 return  # drop this lease; the worker may be gone
+            finally:
+                for s, _ in batch:
+                    self.core._task_locations.pop(s["task_id"], None)
             batches_run += 1
             for (_, fut), reply in zip(batch, replies):
                 if not fut.done():
@@ -308,6 +314,9 @@ class DistributedCoreWorker:
         # Task ids tombstoned by cancel(): queued entries are swept,
         # retries suppressed (running tasks are not interrupted).
         self._cancelled_tasks: set = set()
+        # task_id -> worker address while a lane batch holding it is in
+        # flight (routes running-task cancels to the right worker).
+        self._task_locations: Dict[bytes, str] = {}
         self._inline_cache_order: deque = deque()
 
         # ---- pending tasks (futures resolve when reply arrives) ----
@@ -1811,23 +1820,40 @@ class DistributedCoreWorker:
                recursive: bool = True) -> None:
         """Cancel the task producing `ref` (ref: CoreWorker::CancelTask).
 
-        Semantics: a task still QUEUED (lane queue or retry loop) is
-        dropped and its getters raise TaskCancelledError; a task already
-        RUNNING is not interrupted (cooperative interruption is not
-        implemented), but its future RETRIES are suppressed. Cancelling
-        a finished task is a no-op. Actor tasks are not cancellable
-        (matching their ordered-queue semantics here)."""
+        Semantics: a task still QUEUED (lane queue, in-flight batch,
+        or retry loop) is dropped and its getters raise
+        TaskCancelledError; a task RUNNING pure-Python code is
+        interrupted at its next bytecode boundary (KeyboardInterrupt
+        injection — a task blocked inside a C call is interrupted when
+        it returns); future RETRIES are suppressed either way.
+        Cancelling a finished task is a no-op. Actor tasks are not
+        cancellable (matching their ordered-queue semantics here)."""
         oid = ref.id()
         with self._lock:
             if oid not in self._pending_objects:
                 return   # already finished (or unknown): no-op
-        self._cancelled_tasks.add(oid.task_id().binary())
-        # Wake lanes so queued entries are swept promptly.
-        def wake():
+        tid = oid.task_id().binary()
+        self._cancelled_tasks.add(tid)
+
+        def on_loop():
+            # Wake lanes so queued entries are swept promptly...
             for lane in self._lanes.values():
                 lane.wakeup.set()
+            # ...and interrupt the task if a worker is RUNNING it
+            # right now (KeyboardInterrupt at the next bytecode
+            # boundary; best-effort).
+            addr = self._task_locations.get(tid)
+            if addr:
+                async def fire():
+                    try:
+                        client = await self._aclient(addr)
+                        await client.call("Worker", "cancel_task",
+                                          task_id=tid, timeout=10)
+                    except Exception:  # noqa: BLE001 best-effort
+                        pass
+                asyncio.ensure_future(fire())
         try:
-            self.loop_thread.loop.call_soon_threadsafe(wake)
+            self.loop_thread.loop.call_soon_threadsafe(on_loop)
         except Exception:  # noqa: BLE001 loop shutting down
             pass
 

@@ -175,6 +175,19 @@ class WorkerService:
         self._stream_store_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="stream-store")
         self._max_inline = get_config().max_inline_object_size
+        # task_id -> executing thread ident, for cooperative
+        # cancellation of RUNNING tasks (ref: CancelTask interrupting
+        # the worker): cancel_task injects KeyboardInterrupt into the
+        # thread at the next bytecode boundary.
+        self._executing: Dict[bytes, int] = {}
+        self._cancelled_here: set = set()
+        # Makes interrupt injection atomic with execution membership:
+        # cancel_task injects ONLY while the target is registered, and
+        # deregistration (finally) takes the same lock — so a pending
+        # KeyboardInterrupt always lands inside _execute's try, never
+        # escaping into the pool's worker loop (which would kill the
+        # pool thread permanently).
+        self._exec_lock = threading.Lock()
         # Deferred store writes for inline-able results: the caller gets
         # the value in the reply NOW; the store copy + location record
         # (needed only by third-party readers of the ref, who poll the
@@ -456,6 +469,14 @@ class WorkerService:
         import time as _time
 
         start_ts = _time.time()
+        if spec["task_id"] in self._cancelled_here:
+            # Cancelled while queued in an in-flight batch on THIS
+            # worker: never execute.
+            self._cancelled_here.discard(spec["task_id"])
+            err = rexc.TaskCancelledError(name)
+            self._record_event(spec, "FAILED", start_ts, _time.time(),
+                               error=repr(err))
+            return {"results": [], "error": err}
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
@@ -465,9 +486,15 @@ class WorkerService:
             with tracing.extract_and_span(spec.get("trace_ctx"),
                                           f"task:{name}",
                                           task_id=spec["task_id"].hex()):
-                result = fn(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    result = asyncio.run(result)
+                self._executing[spec["task_id"]] = \
+                    threading.get_ident()
+                try:
+                    result = fn(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        result = asyncio.run(result)
+                finally:
+                    with self._exec_lock:
+                        self._executing.pop(spec["task_id"], None)
                 if spec["options"].get("streaming"):
                     return self._stream_reply(spec, result, start_ts)
             reply = {"results": self._store_results(spec, result),
@@ -475,10 +502,22 @@ class WorkerService:
             self._record_event(spec, "FINISHED", start_ts, _time.time())
             return reply
         except BaseException as e:  # noqa: BLE001
-            err = (e if isinstance(e, rexc.RayTpuError)
-                   else rexc.TaskError.from_exception(
-                       e, name, pid=os.getpid(),
-                       node_id=self.core.node_id))
+            if isinstance(e, KeyboardInterrupt):
+                if spec["task_id"] in self._cancelled_here:
+                    self._cancelled_here.discard(spec["task_id"])
+                    err = rexc.TaskCancelledError(name)
+                else:
+                    # An injected interrupt that landed AFTER its
+                    # target finished hit this unrelated task: surface
+                    # as a retryable system failure, not an app error.
+                    err = rexc.WorkerCrashedError(
+                        f"task {name} interrupted by a stray cancel")
+            elif isinstance(e, rexc.RayTpuError):
+                err = e
+            else:
+                err = rexc.TaskError.from_exception(
+                    e, name, pid=os.getpid(),
+                    node_id=self.core.node_id)
             try:
                 self._store_results(spec, err, is_error=True)
             except Exception:  # noqa: BLE001
@@ -488,6 +527,30 @@ class WorkerService:
             return {"results": [], "error": err}
 
     # ---- RPC surface --------------------------------------------------
+    async def cancel_task(self, task_id: bytes) -> dict:
+        """Interrupt a RUNNING task (ref: CancelTask): injects
+        KeyboardInterrupt into the executing thread, which lands at the
+        next Python bytecode boundary (a task blocked in a C call —
+        time.sleep, a jitted step — is interrupted when it returns).
+        Best-effort by design."""
+        self._cancelled_here.add(task_id)
+        # Bound the tombstone set: a cancel that misses (task already
+        # finished) would otherwise leak its entry forever.
+        while len(self._cancelled_here) > 4096:
+            self._cancelled_here.pop()
+        import ctypes
+
+        with self._exec_lock:
+            tid = self._executing.get(task_id)
+            if tid is None:
+                return {"interrupted": False}
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(KeyboardInterrupt))
+            if n > 1:   # should not happen; undo rather than spray
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid), None)
+        return {"interrupted": n == 1}
+
     async def push_task(self, spec: dict) -> dict:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._task_pool, self._execute,

@@ -48,3 +48,35 @@ def test_cancel_finished_task_is_noop(cluster_ray):
     assert ray_tpu.get(r, timeout=60) == 5
     ray_tpu.cancel(r)                  # no-op
     assert ray_tpu.get(r, timeout=60) == 5   # result still readable
+
+
+def test_cancel_running_task_interrupts(cluster_ray):
+    """A RUNNING pure-Python task is interrupted at a bytecode boundary
+    (KeyboardInterrupt injection, ref: CancelTask on executing workers)."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(max_retries=0)
+    def spin(path):
+        import pathlib
+        import time as _t
+
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < 30:
+            for _ in range(10000):   # bytecode boundaries for injection
+                pass
+        pathlib.Path(path).write_text("finished")
+        return "finished"
+
+    import os
+    import tempfile
+
+    sentinel = os.path.join(tempfile.mkdtemp(), "done.txt")
+    r = spin.remote(sentinel)
+    time.sleep(2.0)   # let it start executing
+    t0 = time.monotonic()
+    ray_tpu.cancel(r)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(r, timeout=60)
+    # interrupted promptly, not after the 30s spin
+    assert time.monotonic() - t0 < 15
+    assert not os.path.exists(sentinel)
