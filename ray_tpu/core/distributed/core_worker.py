@@ -312,7 +312,8 @@ class DistributedCoreWorker:
         self._free_batch: List[bytes] = []
         self._inline_cache: Dict[ObjectID, bytes] = {}
         # Task ids tombstoned by cancel(): queued entries are swept,
-        # retries suppressed (running tasks are not interrupted).
+        # running tasks interrupted, retries suppressed. Entries are
+        # consumed wherever a cancellation completes.
         self._cancelled_tasks: set = set()
         # task_id -> worker address while a lane batch holding it is in
         # flight (routes running-task cancels to the right worker).
@@ -1351,6 +1352,7 @@ class DistributedCoreWorker:
                                       results=reply["results"])
                     return
                 if isinstance(err, rexc.TaskCancelledError):
+                    self._cancelled_tasks.discard(spec["task_id"])
                     self._finish_task(return_ids, fut, error=err)
                     return
                 if (isinstance(err, rexc.TaskError)
@@ -1397,6 +1399,7 @@ class DistributedCoreWorker:
                     fut.cancel()
                 raise
             except rexc.TaskCancelledError as e:
+                self._cancelled_tasks.discard(spec["task_id"])
                 self._finish_task(return_ids, fut, error=e)
                 return
             except BaseException as e:  # noqa: BLE001 system failure
@@ -1584,6 +1587,14 @@ class DistributedCoreWorker:
         context rides the push queue and the batch sender completes or
         retries entries directly — at 10k+ calls/s the per-call future +
         closure machinery was a measurable slice of the loop thread."""
+        if spec["task_id"] in self._cancelled_tasks:
+            # Cancelled before a seq was assigned: dropping here cannot
+            # desync the actor's contiguous ordering.
+            self._cancelled_tasks.discard(spec["task_id"])
+            self._finish_task(return_ids, fut,
+                              error=rexc.TaskCancelledError(
+                                  spec["options"].get("name", "task")))
+            return
         info = self._actor_cache.get(aid)
         if not (info and info["state"] == "ALIVE"):
             self._park_actor_submit(aid, (spec, return_ids, fut, options))
@@ -1704,6 +1715,10 @@ class DistributedCoreWorker:
 
     async def _send_actor_batch(self, client: AsyncRpcClient,
                                 batch: list) -> None:
+        addr = client.address if hasattr(client, "address") else None
+        if addr:
+            for item in batch:
+                self._task_locations[item[1]["task_id"]] = addr
         try:
             replies = await client.call(
                 "Worker", "push_actor_tasks",
@@ -1720,6 +1735,9 @@ class DistributedCoreWorker:
                 self._handle_push_failure(aid, spec, return_ids, fut,
                                           options, e)
             return
+        finally:
+            for item in batch:
+                self._task_locations.pop(item[1]["task_id"], None)
         self._finish_actor_batch(batch, replies)
 
     def _finish_actor_batch(self, batch: list, replies: list) -> None:
@@ -1733,6 +1751,8 @@ class DistributedCoreWorker:
             for (aid, spec, return_ids, fut, options), reply in zip(
                     batch, replies):
                 err = reply.get("error")
+                if isinstance(err, rexc.TaskCancelledError):
+                    self._cancelled_tasks.discard(spec["task_id"])
                 if err is None:
                     for r in reply["results"]:
                         if r.inline is not None:
@@ -1826,8 +1846,12 @@ class DistributedCoreWorker:
         interrupted at its next bytecode boundary (KeyboardInterrupt
         injection — a task blocked inside a C call is interrupted when
         it returns); future RETRIES are suppressed either way.
-        Cancelling a finished task is a no-op. Actor tasks are not
-        cancellable (matching their ordered-queue semantics here)."""
+        Cancelling a finished task is a no-op. ACTOR tasks are
+        cancellable too: dropped before seq assignment, replied-as-
+        cancelled from the ordered queue (seq contiguity preserved), or
+        interrupted while running a sync method; async actor methods
+        are only cancellable while queued (injecting into the shared
+        event loop would break every other in-flight call)."""
         oid = ref.id()
         with self._lock:
             if oid not in self._pending_objects:

@@ -180,7 +180,9 @@ class WorkerService:
         # the worker): cancel_task injects KeyboardInterrupt into the
         # thread at the next bytecode boundary.
         self._executing: Dict[bytes, int] = {}
-        self._cancelled_here: set = set()
+        # Insertion-ordered (dict) so bounding evicts the OLDEST
+        # tombstones, never a cancel that just arrived.
+        self._cancelled_here: Dict[bytes, None] = {}
         # Makes interrupt injection atomic with execution membership:
         # cancel_task injects ONLY while the target is registered, and
         # deregistration (finally) takes the same lock — so a pending
@@ -472,7 +474,7 @@ class WorkerService:
         if spec["task_id"] in self._cancelled_here:
             # Cancelled while queued in an in-flight batch on THIS
             # worker: never execute.
-            self._cancelled_here.discard(spec["task_id"])
+            self._cancelled_here.pop(spec["task_id"], None)
             err = rexc.TaskCancelledError(name)
             self._record_event(spec, "FAILED", start_ts, _time.time(),
                                error=repr(err))
@@ -486,8 +488,9 @@ class WorkerService:
             with tracing.extract_and_span(spec.get("trace_ctx"),
                                           f"task:{name}",
                                           task_id=spec["task_id"].hex()):
-                self._executing[spec["task_id"]] = \
-                    threading.get_ident()
+                with self._exec_lock:
+                    self._executing[spec["task_id"]] = \
+                        threading.get_ident()
                 try:
                     result = fn(*args, **kwargs)
                     if inspect.iscoroutine(result):
@@ -502,9 +505,15 @@ class WorkerService:
             self._record_event(spec, "FINISHED", start_ts, _time.time())
             return reply
         except BaseException as e:  # noqa: BLE001
+            # An injected interrupt can land BEFORE the inner try or
+            # WHILE its finally acquires the lock, skipping the pop —
+            # deregister again (idempotent) so no stale entry can route
+            # a later injection at an innocent task.
+            with self._exec_lock:
+                self._executing.pop(spec["task_id"], None)
             if isinstance(e, KeyboardInterrupt):
                 if spec["task_id"] in self._cancelled_here:
-                    self._cancelled_here.discard(spec["task_id"])
+                    self._cancelled_here.pop(spec["task_id"], None)
                     err = rexc.TaskCancelledError(name)
                 else:
                     # An injected interrupt that landed AFTER its
@@ -533,11 +542,12 @@ class WorkerService:
         next Python bytecode boundary (a task blocked in a C call —
         time.sleep, a jitted step — is interrupted when it returns).
         Best-effort by design."""
-        self._cancelled_here.add(task_id)
-        # Bound the tombstone set: a cancel that misses (task already
-        # finished) would otherwise leak its entry forever.
+        self._cancelled_here[task_id] = None
+        # Bound the tombstones: a cancel that misses (task already
+        # finished) would otherwise leak its entry forever. Oldest-first
+        # eviction cannot drop the entry just added.
         while len(self._cancelled_here) > 4096:
-            self._cancelled_here.pop()
+            del self._cancelled_here[next(iter(self._cancelled_here))]
         import ctypes
 
         with self._exec_lock:
@@ -626,6 +636,14 @@ class WorkerService:
             # Async path phase 2: returns an awaitable producing the reply.
             async def run():
                 start_ts = _time.time()
+                if spec["task_id"] in self._cancelled_here:
+                    # Cancelled while buffered: reply (keeping seq
+                    # contiguity) without invoking the method.
+                    self._cancelled_here.pop(spec["task_id"], None)
+                    err = rexc.TaskCancelledError(name)
+                    self._record_event(spec, "FAILED", start_ts,
+                                       _time.time(), error=repr(err))
+                    return {"results": [], "error": err}
                 try:
                     method = getattr(self.actor.instance,
                                      spec["method_name"])
@@ -682,6 +700,14 @@ class WorkerService:
         if resolve_only:
             return args, kwargs
         start_ts = _time.time()
+        if spec["task_id"] in self._cancelled_here:
+            # Cancelled while queued in the actor's ordered buffer: the
+            # reply keeps seq contiguity, the method never runs.
+            self._cancelled_here.pop(spec["task_id"], None)
+            err = rexc.TaskCancelledError(name)
+            self._record_event(spec, "FAILED", start_ts, _time.time(),
+                               error=repr(err))
+            return {"results": [], "error": err}
         try:
             method = getattr(self.actor.instance, spec["method_name"])
             from ray_tpu.util import tracing
@@ -689,9 +715,16 @@ class WorkerService:
             with tracing.extract_and_span(spec.get("trace_ctx"),
                                           f"actor:{name}",
                                           task_id=spec["task_id"].hex()):
-                result = method(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    result = asyncio.run(result)
+                with self._exec_lock:
+                    self._executing[spec["task_id"]] = \
+                        threading.get_ident()
+                try:
+                    result = method(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        result = asyncio.run(result)
+                finally:
+                    with self._exec_lock:
+                        self._executing.pop(spec["task_id"], None)
                 if spec["options"].get("streaming"):
                     return self._stream_reply(spec, result, start_ts,
                                               error_cls=rexc.ActorError)
@@ -700,8 +733,19 @@ class WorkerService:
             self._record_event(spec, "FINISHED", start_ts, _time.time())
             return reply
         except BaseException as e:  # noqa: BLE001
-            err = rexc.ActorError.from_exception(
-                e, name, pid=os.getpid(), node_id=self.core.node_id)
+            with self._exec_lock:
+                self._executing.pop(spec["task_id"], None)
+            if isinstance(e, KeyboardInterrupt):
+                if spec["task_id"] in self._cancelled_here:
+                    self._cancelled_here.pop(spec["task_id"], None)
+                    err = rexc.TaskCancelledError(name)
+                else:
+                    err = rexc.WorkerCrashedError(
+                        f"actor method {name} interrupted by a stray "
+                        f"cancel")
+            else:
+                err = rexc.ActorError.from_exception(
+                    e, name, pid=os.getpid(), node_id=self.core.node_id)
             try:
                 self._store_results(spec, err, is_error=True)
             except Exception:  # noqa: BLE001

@@ -80,3 +80,108 @@ def test_cancel_running_task_interrupts(cluster_ray):
     # interrupted promptly, not after the 30s spin
     assert time.monotonic() - t0 < 15
     assert not os.path.exists(sentinel)
+
+
+def test_cancel_running_actor_method(cluster_ray):
+    """A running sync actor method is interrupted; the actor survives
+    and serves later calls in order."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self):
+            self.n = 0
+
+        def spin(self):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                for _ in range(10000):
+                    pass
+            return "finished"
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    a = Worker.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    r = a.spin.remote()
+    time.sleep(1.5)
+    t0 = time.monotonic()
+    ray_tpu.cancel(r)
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(r, timeout=60)
+    assert time.monotonic() - t0 < 15
+    # actor alive, state intact, ordering preserved
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 2
+    ray_tpu.kill(a)
+
+
+def test_cancel_queued_actor_method(cluster_ray):
+    """An actor call queued behind a long one is cancelled without
+    executing; later calls on the same handle still run in order."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self):
+            self.ran = []
+
+        def work(self, tag, dt=0.0):
+            time.sleep(dt)
+            self.ran.append(tag)
+            return tag
+
+        def log(self):
+            return list(self.ran)
+
+    a = Slow.remote()
+    first = a.work.remote("first", 2.5)
+    victim = a.work.remote("victim")
+    time.sleep(0.3)
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(victim, timeout=30)
+    assert ray_tpu.get(first, timeout=60) == "first"
+    assert ray_tpu.get(a.work.remote("after"), timeout=60) == "after"
+    assert ray_tpu.get(a.log.remote(), timeout=60) == ["first", "after"]
+    ray_tpu.kill(a)
+
+
+def test_cancel_queued_async_actor_method(cluster_ray):
+    """Cancelling a buffered async actor call prevents execution (the
+    one cancellable case for async methods)."""
+    import asyncio as _asyncio
+
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote
+    class Async:
+        def __init__(self):
+            self.ran = []
+
+        async def work(self, tag, dt=0.0):
+            await _asyncio.sleep(dt)
+            self.ran.append(tag)
+            return tag
+
+        async def log(self):
+            return list(self.ran)
+
+    a = Async.remote()
+    # async actors run concurrently; cancel must land while 'victim' is
+    # still buffered behind the in-order admission of 'first'
+    first = a.work.remote("first", 2.0)
+    victim = a.work.remote("victim", 1.5)
+    ray_tpu.cancel(victim)
+    try:
+        ray_tpu.get(victim, timeout=30)
+        cancelled = False
+    except ray_tpu.exceptions.RayTpuError:
+        cancelled = True
+    assert ray_tpu.get(first, timeout=60) == "first"
+    log = ray_tpu.get(a.log.remote(), timeout=60)
+    # Either the cancel landed before execution (preferred) or it raced
+    # the admission and the call ran — but never both.
+    assert cancelled == ("victim" not in log), (cancelled, log)
+    ray_tpu.kill(a)
