@@ -1,0 +1,65 @@
+"""Streaming generator returns + task cancellation — the core APIs for
+pipelines that produce incrementally and abandon work early."""
+import time
+
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+
+# --- streaming: consume yields BEFORE the task finishes ---------------
+@ray_tpu.remote(num_returns="streaming")
+def producer(n):
+    for i in range(n):
+        time.sleep(0.2)
+        yield {"step": i, "value": i * i}
+
+
+@ray_tpu.remote
+def enrich(item):
+    return {**item, "doubled": item["value"] * 2}
+
+
+t0 = time.monotonic()
+downstream = []
+for ref in producer.remote(4):
+    # stream refs are ordinary refs: fan them into downstream tasks
+    # while the producer is still running
+    downstream.append(enrich.remote(ref))
+    print(f"t={time.monotonic() - t0:.2f}s scheduled downstream task")
+print("results:", ray_tpu.get(downstream, timeout=120))
+
+# actor methods stream too (state persists across streamed calls)
+@ray_tpu.remote
+class Chunker:
+    def chunks(self, text, size):
+        for i in range(0, len(text), size):
+            yield text[i:i + size]
+
+
+c = Chunker.remote()
+parts = [ray_tpu.get(r, timeout=60)
+         for r in c.chunks.options(num_returns="streaming")
+         .remote("tpu-native streaming", 7)]
+print("chunks:", parts)
+
+# --- cancellation: queued work is dropped, running work interrupted ---
+@ray_tpu.remote(max_retries=0)
+def long_spin():
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60:
+        for _ in range(10_000):
+            pass
+    return "never"
+
+
+r = long_spin.remote()
+time.sleep(1.0)
+ray_tpu.cancel(r)          # interrupts at the next bytecode boundary
+try:
+    ray_tpu.get(r, timeout=60)
+except ray_tpu.exceptions.TaskCancelledError:
+    print("running task cancelled cleanly")
+
+ray_tpu.shutdown()
+print("streaming + cancellation ran end-to-end")
