@@ -92,3 +92,38 @@ def test_node_failure_detected(two_node_cluster):
             return
         time.sleep(0.5)
     pytest.fail("dead node was not detected")
+
+
+def test_streaming_generator_across_nodes():
+    """Stream items produced on ANOTHER node are discovered through the
+    object directory and pulled cross-node while the producer runs."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    second = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes(2)
+    try:
+        @ray_tpu.remote(num_cpus=1,
+                        num_returns="streaming",
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=second.node_id, soft=False))
+        def produce(n):
+            import time as _t
+
+            for i in range(n):
+                _t.sleep(0.15)
+                yield np.full(120_000, i, np.int64)  # beyond inline cap
+
+        vals = [ray_tpu.get(r, timeout=120) for r in produce.remote(4)]
+        assert [int(v[0]) for v in vals] == [0, 1, 2, 3]
+        assert all(v.shape == (120_000,) for v in vals)
+    finally:
+        cluster.shutdown()
