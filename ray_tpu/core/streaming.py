@@ -19,6 +19,13 @@ Error semantics: a generator body that raises AFTER yielding k items
 invalidates the stream at the next `__next__` — the raising exception
 surfaces there (the reference packs it into the (k+1)-th ref instead;
 same information, one hop earlier).
+
+Lifecycle: stream item objects are NOT entered into the distributed
+refcount (the item count is unknown at submission); they live in the
+producing node's store under ordinary LRU eviction and in the owner's
+bounded inline cache. Consume streams promptly or copy items out —
+matching the reference's guidance that generator refs are not meant as
+long-lived storage.
 """
 from __future__ import annotations
 
