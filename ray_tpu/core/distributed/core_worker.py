@@ -259,9 +259,34 @@ class _TaskLane:
                 for s, _ in batch:
                     self.core._task_locations.pop(s["task_id"], None)
             batches_run += 1
-            for (_, fut), reply in zip(batch, replies):
+            requeued = False
+            for (spec, fut), reply in zip(batch, replies):
+                if reply.get("requeue"):
+                    # Worker retiring (max_calls): the spec never ran —
+                    # requeue WITHOUT charging its retry budget, bounded
+                    # like connection-level retries.
+                    n = spec.get("_lane_retries", 0) + 1
+                    spec["_lane_retries"] = n
+                    if n > self.MAX_BATCH_RETRIES:
+                        if not fut.done():
+                            fut.set_result({
+                                "results": [],
+                                "error": rexc.WorkerCrashedError(
+                                    "worker kept retiring under "
+                                    "max_calls pressure")})
+                    else:
+                        self.queue.append((spec, fut))
+                        requeued = True
+                    continue
                 if not fut.done():
                     fut.set_result(reply)
+            if requeued:
+                self.wakeup.set()
+                self._maybe_scale()
+                # Span the retiring worker's exit window so the re-lease
+                # grants a FRESH worker instead of looping on this one.
+                await asyncio.sleep(0.3)
+                return  # drop this lease
 
 
 class DistributedCoreWorker:
@@ -1140,6 +1165,7 @@ class DistributedCoreWorker:
             job_id=self.job_id,
             options={"max_retries": options.max_retries,
                      "retry_exceptions": options.retry_exceptions,
+                     "max_calls": options.max_calls,
                      "name": options.name
                      or getattr(func, "__qualname__", "task")},
         )

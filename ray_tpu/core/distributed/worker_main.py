@@ -180,6 +180,11 @@ class WorkerService:
         # the worker): cancel_task injects KeyboardInterrupt into the
         # thread at the next bytecode boundary.
         self._executing: Dict[bytes, int] = {}
+        # max_calls retirement (ref: worker lifetime bounded per
+        # executed-invocation count OF THAT FUNCTION — bounds leaks
+        # from user/native code without churning mixed workloads).
+        self._exec_counts: Dict[bytes, int] = {}
+        self._retire_after_reply = False
         # Insertion-ordered (dict) so bounding evicts the OLDEST
         # tombstones, never a cancel that just arrived.
         self._cancelled_here: Dict[bytes, None] = {}
@@ -473,12 +478,23 @@ class WorkerService:
         start_ts = _time.time()
         if spec["task_id"] in self._cancelled_here:
             # Cancelled while queued in an in-flight batch on THIS
-            # worker: never execute.
+            # worker: never execute (and never charge max_calls budget).
             self._cancelled_here.pop(spec["task_id"], None)
             err = rexc.TaskCancelledError(name)
             self._record_event(spec, "FAILED", start_ts, _time.time(),
                                error=repr(err))
             return {"results": [], "error": err}
+        if self._retire_after_reply:
+            # Budget exhausted: hand the spec back to the lane (the
+            # `requeue` sentinel re-queues WITHOUT charging the task's
+            # retry budget — the task never executed).
+            return {"requeue": True, "results": [], "error": None}
+        mc = spec["options"].get("max_calls") or 0
+        if mc:
+            n = self._exec_counts.get(spec["fn_key"], 0) + 1
+            self._exec_counts[spec["fn_key"]] = n
+            if n >= mc:
+                self._retire_after_reply = True
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
@@ -536,6 +552,23 @@ class WorkerService:
             return {"results": [], "error": err}
 
     # ---- RPC surface --------------------------------------------------
+    def _maybe_retire(self) -> None:
+        """Exit (after the reply flushes) once a task whose max_calls
+        budget this worker exhausted has completed; the daemon's pool
+        respawns and lease holders ride the ordinary worker-death retry
+        path."""
+        if not self._retire_after_reply:
+            return
+        logger.info("worker retiring (max_calls reached)")
+
+        def die():
+            os._exit(0)
+
+        # Long enough for the (local-socket) reply bytes to flush;
+        # refused specs are requeued by the lane with a delay spanning
+        # this window, so they re-lease a fresh worker.
+        threading.Timer(0.2, die).start()
+
     async def cancel_task(self, task_id: bytes) -> dict:
         """Interrupt a RUNNING task (ref: CancelTask): injects
         KeyboardInterrupt into the executing thread, which lands at the
@@ -563,8 +596,10 @@ class WorkerService:
 
     async def push_task(self, spec: dict) -> dict:
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self._task_pool, self._execute,
-                                          spec)
+        reply = await loop.run_in_executor(self._task_pool, self._execute,
+                                           spec)
+        self._maybe_retire()
+        return reply
 
     async def push_tasks(self, specs: List[dict]) -> List[dict]:
         """Batched task push from a lease-reuse lane. Executes the batch
@@ -577,7 +612,9 @@ class WorkerService:
         def run_all():
             return [self._execute(s) for s in specs]
 
-        return await loop.run_in_executor(self._task_pool, run_all)
+        replies = await loop.run_in_executor(self._task_pool, run_all)
+        self._maybe_retire()
+        return replies
 
     async def create_actor(self, actor_id: str, cls_blob_key: bytes,
                            args_blob: bytes,

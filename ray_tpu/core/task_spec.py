@@ -69,6 +69,13 @@ class TaskOptions:
     memory: Optional[int] = None
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
     num_returns: Any = 1    # int, or "streaming" (generator tasks)
+    # Constrain scheduling to nodes advertising an accelerator type
+    # (ref: accelerator_type= -> an "accelerator_type:X" resource
+    # micro-demand; node daemons advertise theirs, accelerators.py).
+    accelerator_type: Optional[str] = None
+    # Retire the worker process after this many task executions (ref:
+    # max_calls — bounds leaks from native/user code; 0 = unlimited).
+    max_calls: int = 0
     max_retries: int = 3
     retry_exceptions: bool = False
     name: Optional[str] = None
@@ -96,6 +103,8 @@ class TaskOptions:
             demand["TPU"] = tpus
         if self.memory:
             demand["memory"] = float(self.memory)
+        if self.accelerator_type:
+            demand[f"accelerator_type:{self.accelerator_type}"] = 0.001
         return demand
 
 
