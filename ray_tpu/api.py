@@ -193,7 +193,10 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _global_worker().kill_actor(actor._actor_id, no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+def cancel(ref, *, force: bool = False, recursive: bool = True) -> None:
+    """Cancel the task producing `ref` — an ObjectRef or an
+    ObjectRefGenerator (cancelling a stream interrupts the running
+    generator; consumed item refs stay valid)."""
     _global_worker().cancel(ref, force, recursive)
 
 

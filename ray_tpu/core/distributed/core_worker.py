@@ -207,7 +207,7 @@ class _TaskLane:
                     # Cancelled while queued: never push (ref:
                     # CancelTask on unleased tasks). Consuming the
                     # tombstone bounds the set to in-flight cancels.
-                    self.core._cancelled_tasks.discard(spec["task_id"])
+                    self.core._cancelled_tasks.pop(spec["task_id"], None)
                     if not fut.done():
                         fut.set_result({
                             "results": [],
@@ -338,8 +338,14 @@ class DistributedCoreWorker:
         self._inline_cache: Dict[ObjectID, bytes] = {}
         # Task ids tombstoned by cancel(): queued entries are swept,
         # running tasks interrupted, retries suppressed. Entries are
-        # consumed wherever a cancellation completes.
-        self._cancelled_tasks: set = set()
+        # consumed wherever a cancellation completes; insertion-ordered
+        # and bounded (see _tombstone) so a cancel that never meets its
+        # task ages out instead of leaking.
+        self._cancelled_tasks: Dict[bytes, None] = {}
+        # task_id -> None for streaming tasks whose stream is still
+        # running (streams register no _pending_objects entries, so
+        # cancel() needs its own liveness map to route tombstones).
+        self._live_streams: Dict[bytes, None] = {}
         # task_id -> worker address while a lane batch holding it is in
         # flight (routes running-task cancels to the right worker).
         self._task_locations: Dict[bytes, str] = {}
@@ -1234,6 +1240,7 @@ class DistributedCoreWorker:
         state = StreamState()
         fut: Future = Future()   # pins args until the stream completes
         self._pin_task_deps(deps, fut)
+        self._live_streams[task_id.binary()] = None
         self.loop_thread.loop.call_soon_threadsafe(
             lambda: asyncio.ensure_future(
                 self._run_stream_to_completion(spec, demand, sched,
@@ -1253,7 +1260,7 @@ class DistributedCoreWorker:
         try:
             while True:
                 if spec["task_id"] in self._cancelled_tasks:
-                    self._cancelled_tasks.discard(spec["task_id"])
+                    self._cancelled_tasks.pop(spec["task_id"], None)
                     state.finish(None, rexc.TaskCancelledError(
                         opts.get("name", "task")))
                     return
@@ -1294,6 +1301,7 @@ class DistributedCoreWorker:
                 state.finish(len(results), None)
                 return
         finally:
+            self._live_streams.pop(spec["task_id"], None)
             if not fut.done():
                 fut.set_result(None)
 
@@ -1378,7 +1386,7 @@ class DistributedCoreWorker:
                                       results=reply["results"])
                     return
                 if isinstance(err, rexc.TaskCancelledError):
-                    self._cancelled_tasks.discard(spec["task_id"])
+                    self._cancelled_tasks.pop(spec["task_id"], None)
                     self._finish_task(return_ids, fut, error=err)
                     return
                 if (isinstance(err, rexc.TaskError)
@@ -1405,7 +1413,7 @@ class DistributedCoreWorker:
         last_err: Optional[BaseException] = None
         while attempt <= max_retries:
             if spec["task_id"] in self._cancelled_tasks:
-                self._cancelled_tasks.discard(spec["task_id"])
+                self._cancelled_tasks.pop(spec["task_id"], None)
                 self._finish_task(return_ids, fut,
                                   error=rexc.TaskCancelledError(
                                       opts.get("name", "task")))
@@ -1425,7 +1433,7 @@ class DistributedCoreWorker:
                     fut.cancel()
                 raise
             except rexc.TaskCancelledError as e:
-                self._cancelled_tasks.discard(spec["task_id"])
+                self._cancelled_tasks.pop(spec["task_id"], None)
                 self._finish_task(return_ids, fut, error=e)
                 return
             except BaseException as e:  # noqa: BLE001 system failure
@@ -1574,6 +1582,10 @@ class DistributedCoreWorker:
             state = StreamState()
             fut.stream_state = state
             fut.add_done_callback(self._finish_stream_on_cancel(state))
+            tid_bin = task_id.binary()
+            self._live_streams[tid_bin] = None
+            fut.add_done_callback(
+                lambda _f: self._live_streams.pop(tid_bin, None))
             gen = ObjectRefGenerator(self, task_id, state)
         # Batched cross-thread handoff: one loop wakeup per BURST, not
         # per call. A per-call call_soon_threadsafe costs a syscall plus
@@ -1616,7 +1628,7 @@ class DistributedCoreWorker:
         if spec["task_id"] in self._cancelled_tasks:
             # Cancelled before a seq was assigned: dropping here cannot
             # desync the actor's contiguous ordering.
-            self._cancelled_tasks.discard(spec["task_id"])
+            self._cancelled_tasks.pop(spec["task_id"], None)
             self._finish_task(return_ids, fut,
                               error=rexc.TaskCancelledError(
                                   spec["options"].get("name", "task")))
@@ -1778,7 +1790,7 @@ class DistributedCoreWorker:
                     batch, replies):
                 err = reply.get("error")
                 if isinstance(err, rexc.TaskCancelledError):
-                    self._cancelled_tasks.discard(spec["task_id"])
+                    self._cancelled_tasks.pop(spec["task_id"], None)
                 if err is None:
                     for r in reply["results"]:
                         if r.inline is not None:
@@ -1862,9 +1874,10 @@ class DistributedCoreWorker:
     def list_placement_groups(self) -> List[dict]:
         return self.gcs.call("PlacementGroups", "list_pgs", timeout=30)
 
-    def cancel(self, ref: ObjectRef, force: bool = False,
+    def cancel(self, ref, force: bool = False,
                recursive: bool = True) -> None:
-        """Cancel the task producing `ref` (ref: CoreWorker::CancelTask).
+        """Cancel the task producing `ref` — an ObjectRef or an
+        ObjectRefGenerator (ref: CoreWorker::CancelTask).
 
         Semantics: a task still QUEUED (lane queue, in-flight batch,
         or retry loop) is dropped and its getters raise
@@ -1877,13 +1890,25 @@ class DistributedCoreWorker:
         cancelled from the ordered queue (seq contiguity preserved), or
         interrupted while running a sync method; async actor methods
         are only cancellable while queued (injecting into the shared
-        event loop would break every other in-flight call)."""
-        oid = ref.id()
-        with self._lock:
-            if oid not in self._pending_objects:
-                return   # already finished (or unknown): no-op
-        tid = oid.task_id().binary()
-        self._cancelled_tasks.add(tid)
+        event loop would break every other in-flight call). STREAMING
+        tasks are cancellable through their `ObjectRefGenerator` or any
+        stream item ref: the running generator is interrupted and the
+        stream finishes with TaskCancelledError (ref: ray.cancel on
+        ObjectRefGenerator)."""
+        from ray_tpu.core.streaming import ObjectRefGenerator
+
+        if isinstance(ref, ObjectRefGenerator):
+            tid = ref._task_id.binary()
+            if tid not in self._live_streams:
+                return   # stream already finished: no-op
+        else:
+            oid = ref.id()
+            tid = oid.task_id().binary()
+            with self._lock:
+                if (oid not in self._pending_objects
+                        and tid not in self._live_streams):
+                    return   # already finished (or unknown): no-op
+        self._tombstone(tid)
 
         def on_loop():
             # Wake lanes so queued entries are swept promptly...
@@ -1906,6 +1931,14 @@ class DistributedCoreWorker:
             self.loop_thread.loop.call_soon_threadsafe(on_loop)
         except Exception:  # noqa: BLE001 loop shutting down
             pass
+
+    def _tombstone(self, tid: bytes) -> None:
+        # Bounded insertion-ordered, mirroring the worker-side
+        # _cancelled_here cap: a tombstone whose task already finished
+        # (or whose lane never re-pops it) ages out instead of leaking.
+        self._cancelled_tasks[tid] = None
+        while len(self._cancelled_tasks) > 4096:
+            self._cancelled_tasks.pop(next(iter(self._cancelled_tasks)))
 
     # ------------------------------------------------------------------
     # cluster introspection

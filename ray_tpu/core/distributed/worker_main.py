@@ -365,13 +365,41 @@ class WorkerService:
         results: List[protocol.TaskResult] = []
         error = None
         try:
-            for i, v in enumerate(result, start=1):
-                results.append(self._store_stream_item(task_id, i, v))
+            # Register for cancel-interrupt injection around the
+            # ITERATION (the generator body runs here, not at fn()-call
+            # time in _execute, whose registration window closed before
+            # the first yield executed).
+            with self._exec_lock:
+                self._executing[spec["task_id"]] = threading.get_ident()
+            try:
+                # A cancel that landed between _execute's registration
+                # window closing and this one opening left only the
+                # tombstone (no thread to interrupt) — honor it now or
+                # an endless generator runs forever.
+                if spec["task_id"] in self._cancelled_here:
+                    raise KeyboardInterrupt  # handler consumes tombstone
+                for i, v in enumerate(result, start=1):
+                    results.append(self._store_stream_item(task_id, i, v))
+            finally:
+                with self._exec_lock:
+                    self._executing.pop(spec["task_id"], None)
         except BaseException as e:  # noqa: BLE001
-            error = (e if isinstance(e, rexc.RayTpuError)
-                     else error_cls.from_exception(
-                         e, name, pid=os.getpid(),
-                         node_id=self.core.node_id))
+            # Same stray-interrupt discipline as _execute: deregister
+            # again (idempotent — injection can land mid-finally).
+            with self._exec_lock:
+                self._executing.pop(spec["task_id"], None)
+            if isinstance(e, KeyboardInterrupt):
+                if spec["task_id"] in self._cancelled_here:
+                    self._cancelled_here.pop(spec["task_id"], None)
+                    error = rexc.TaskCancelledError(name)
+                else:
+                    error = rexc.WorkerCrashedError(
+                        f"stream {name} interrupted by a stray cancel")
+            else:
+                error = (e if isinstance(e, rexc.RayTpuError)
+                         else error_cls.from_exception(
+                             e, name, pid=os.getpid(),
+                             node_id=self.core.node_id))
         return {"results": results, "error": error}
 
     def _store_stream_item(self, task_id, i: int,
@@ -491,10 +519,13 @@ class WorkerService:
             return {"requeue": True, "results": [], "error": None}
         mc = spec["options"].get("max_calls") or 0
         if mc:
-            n = self._exec_counts.get(spec["fn_key"], 0) + 1
-            self._exec_counts[spec["fn_key"]] = n
-            if n >= mc:
-                self._retire_after_reply = True
+            # Under _exec_lock: up to 4 pool threads race this RMW, and a
+            # lost increment would let the worker exceed its budget.
+            with self._exec_lock:
+                n = self._exec_counts.get(spec["fn_key"], 0) + 1
+                self._exec_counts[spec["fn_key"]] = n
+                if n >= mc:
+                    self._retire_after_reply = True
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
@@ -559,15 +590,35 @@ class WorkerService:
         path."""
         if not self._retire_after_reply:
             return
+        if getattr(self, "_retiring", False):
+            return
+        self._retiring = True
         logger.info("worker retiring (max_calls reached)")
 
         def die():
+            import time as _time
+
+            # Drain first: a task still executing in another pool slot
+            # must finish before exit, or its side effects run twice —
+            # the lane's connection-failure requeue does NOT charge
+            # max_retries (the reference drains the worker before exit).
+            # Pool shutdown (not an _executing poll) so a spec still
+            # fetching args counts too; specs that reach _execute after
+            # the retire flag get the `requeue` sentinel and finish
+            # instantly. Join is bounded: a never-ending task shouldn't
+            # pin the worker slot forever.
+            waiter = threading.Thread(
+                target=lambda: self._task_pool.shutdown(wait=True),
+                daemon=True)
+            waiter.start()
+            waiter.join(60.0)
+            # Then long enough for the (local-socket) reply bytes to
+            # flush; refused specs are requeued by the lane with a delay
+            # spanning this window, so they re-lease a fresh worker.
+            _time.sleep(0.2)
             os._exit(0)
 
-        # Long enough for the (local-socket) reply bytes to flush;
-        # refused specs are requeued by the lane with a delay spanning
-        # this window, so they re-lease a fresh worker.
-        threading.Timer(0.2, die).start()
+        threading.Thread(target=die, daemon=True).start()
 
     async def cancel_task(self, task_id: bytes) -> dict:
         """Interrupt a RUNNING task (ref: CancelTask): injects
@@ -596,8 +647,15 @@ class WorkerService:
 
     async def push_task(self, spec: dict) -> dict:
         loop = asyncio.get_running_loop()
-        reply = await loop.run_in_executor(self._task_pool, self._execute,
-                                           spec)
+        try:
+            reply = await loop.run_in_executor(self._task_pool,
+                                               self._execute, spec)
+        except RuntimeError:
+            # Pool shut down by the retirement drain while this push was
+            # in flight: the spec never ran — requeue, don't charge
+            # retries (without this, a max_retries=0 task arriving in
+            # the drain window would fail permanently unexecuted).
+            return {"requeue": True, "results": [], "error": None}
         self._maybe_retire()
         return reply
 
@@ -612,7 +670,12 @@ class WorkerService:
         def run_all():
             return [self._execute(s) for s in specs]
 
-        replies = await loop.run_in_executor(self._task_pool, run_all)
+        try:
+            replies = await loop.run_in_executor(self._task_pool, run_all)
+        except RuntimeError:
+            # Retirement drain closed the pool mid-push: see push_task.
+            return [{"requeue": True, "results": [], "error": None}
+                    for _ in specs]
         self._maybe_retire()
         return replies
 

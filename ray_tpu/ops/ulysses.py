@@ -45,11 +45,16 @@ def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,
     local head count must divide by the axis size."""
     n = lax.axis_size(axis)
     h = q.shape[2]
-    if h % n != 0:
+    h_kv = k.shape[2]
+    # Check k/v too: with GQA they carry n_kv_heads, and an indivisible
+    # kv count would otherwise surface as an opaque all_to_all shape
+    # error at trace time instead of this ValueError.
+    if h % n != 0 or h_kv % n != 0:
         raise ValueError(
-            f"Ulysses needs heads divisible by the sequence-parallel "
-            f"degree: {h} local heads over sp={n} (use ring attention "
-            f"when sp exceeds the head count)")
+            f"Ulysses needs q AND kv heads divisible by the sequence-"
+            f"parallel degree: {h} q heads / {h_kv} kv heads over "
+            f"sp={n} (use ring attention when sp exceeds the head "
+            f"count)")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
 
