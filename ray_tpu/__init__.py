@@ -25,7 +25,7 @@ from ray_tpu.api import (
 )
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.streaming import ObjectRefGenerator
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
@@ -70,6 +70,7 @@ __all__ = [
     "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
+    "method",
     "RemoteFunction",
     "get_runtime_context",
     "exceptions",

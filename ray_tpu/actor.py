@@ -17,6 +17,27 @@ from ray_tpu.core.task_spec import TaskOptions
 from ray_tpu.remote_function import _merge_options
 
 
+def method(*, concurrency_group: Optional[str] = None, **_ignored):
+    """Method-level actor options (ref: python/ray/actor.py `ray.method`).
+
+    Currently routes the method to a named concurrency group declared in
+    `@remote(concurrency_groups={...})`; the group's pool bounds how many
+    calls of its methods run at once, independently of other groups::
+
+        @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+        class A:
+            @ray_tpu.method(concurrency_group="io")
+            def fetch(self): ...
+    """
+
+    def wrap(fn):
+        if concurrency_group is not None:
+            fn.__ray_tpu_concurrency_group__ = concurrency_group
+        return fn
+
+    return wrap
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
                  num_returns: int = 1):

@@ -44,6 +44,7 @@ from ray_tpu.core.distributed.rpc import (
     AsyncRpcClient,
     EventLoopThread,
     RpcError,
+    RpcServer,
     SyncRpcClient,
 )
 
@@ -71,10 +72,17 @@ class _TaskLane:
     IDLE_HOLD_S = 0.02
     MAX_LEASES = 32
     # Batch size balances RPC amortization (16x fewer unaries) against
-    # failure blast radius (a dying worker fails one whole batch); on a
-    # single-core host (this VM) larger batches win outright — every RPC
-    # is pure overhead on the one shared CPU.
+    # failure blast radius (a dying worker fails one whole batch) AND
+    # placement spread: one pursuer grabbing a 64-deep queue of 200ms
+    # tasks serializes 13s of work on one worker while other nodes sit
+    # idle. The cap adapts to the lane's observed per-task duration
+    # (_batch_cap): micro-tasks batch at 64 (every RPC is pure overhead
+    # on a single-core host), long tasks go 1-2 per batch so surplus
+    # queue depth spawns more pursuers → more leases → spillback
+    # spreads them across nodes (the reference schedules per-task and
+    # gets spread for free; lease-reuse batching must buy it back).
     BATCH = 64
+    FIRST_BATCH = 8   # before any duration sample exists
     # Lease time-slice: return the lease after this many batches even if
     # work remains (re-request immediately). The daemon can't reclaim a
     # held lease, so a lane that drains its whole queue on one lease
@@ -95,6 +103,25 @@ class _TaskLane:
         self.wakeup = asyncio.Event()
         # Number of _pursue coroutines alive; each holds at most one lease.
         self.pursuers = 0
+        # EMA of seconds per task on this lane (None until first batch).
+        self._ema_task_s: Optional[float] = None
+
+    def _observe_batch(self, n: int, dt: float) -> None:
+        per = dt / max(1, n)
+        ema = self._ema_task_s
+        self._ema_task_s = per if ema is None else 0.7 * ema + 0.3 * per
+
+    def _batch_cap(self) -> int:
+        ema = self._ema_task_s
+        if ema is None:
+            return self.FIRST_BATCH
+        if ema < 0.005:
+            return self.BATCH
+        if ema < 0.05:
+            return 8
+        if ema < 0.5:
+            return 2
+        return 1
 
     async def submit(self, spec: dict) -> dict:
         fut = asyncio.get_running_loop().create_future()
@@ -201,7 +228,8 @@ class _TaskLane:
             if batches_run >= self.BATCHES_PER_LEASE and self.queue:
                 return  # time-slice over: re-lease so other lanes rotate
             batch = []
-            while self.queue and len(batch) < self.BATCH:
+            cap = self._batch_cap()
+            while self.queue and len(batch) < cap:
                 spec, fut = self.queue.popleft()
                 if spec["task_id"] in self.core._cancelled_tasks:
                     # Cancelled while queued: never push (ref:
@@ -228,6 +256,7 @@ class _TaskLane:
             for s, _ in batch:
                 self.core._task_locations[s["task_id"]] = \
                     grant["worker_address"]
+            push_t0 = time.monotonic()
             try:
                 replies = await worker.call(
                     "Worker", "push_tasks",
@@ -258,6 +287,12 @@ class _TaskLane:
             finally:
                 for s, _ in batch:
                     self.core._task_locations.pop(s["task_id"], None)
+            self._observe_batch(len(batch), time.monotonic() - push_t0)
+            if self.queue:
+                # Slow tasks shrink the cap AFTER the first batch; give
+                # the surplus queue fresh pursuers now (submit-time
+                # scaling already happened at the old, larger cap).
+                self._maybe_scale()
             batches_run += 1
             requeued = False
             for (spec, fut), reply in zip(batch, replies):
@@ -289,6 +324,33 @@ class _TaskLane:
                 return  # drop this lease
 
 
+class OwnerService:
+    """Serves this process's owned small objects to other processes.
+
+    The TPU-native analogue of the reference's owner-based in-process
+    memory store served over CoreWorkerService.GetObjectStatus (ref:
+    src/ray/core_worker/core_worker.cc HandleGetObjectStatus returning
+    in-band small values; memory_store.cc): small task returns live in
+    the OWNER's inline cache — never eagerly written to the node store —
+    and any process holding a ref (refs pickle with their owner address)
+    fetches them from the owner on a directory miss. Owner death loses
+    the object, exactly as in the reference."""
+
+    def __init__(self, core: "DistributedCoreWorker"):
+        self.core = core
+
+    def get_object(self, object_id: bytes) -> dict:
+        oid = ObjectID(object_id)
+        payload = self.core._inline_cache.get(oid)
+        if payload is None:
+            buf = self.core.store.get_buffer(oid)
+            if buf is not None:
+                payload = bytes(buf.view)
+        return {"payload": payload,
+                "pending": payload is None
+                and oid in self.core._pending_objects}
+
+
 class DistributedCoreWorker:
     def __init__(
         self,
@@ -309,12 +371,24 @@ class DistributedCoreWorker:
         self.daemon_address = daemon_address
         self.job_id = job_id
         self.is_driver = is_driver
-        self.address = worker_address or f"driver-{os.getpid()}"
 
         # grpc.aio binds its poller to one event loop per process — every
         # grpc object (server + clients) must live on this single loop.
         self.loop_thread = loop_thread or EventLoopThread(
             name="core-worker-rpc")
+        self._owner_server = None
+        if worker_address:
+            self.address = worker_address
+        else:
+            # Drivers serve their owned small objects too (workers
+            # register OwnerService on their existing server): every
+            # owner is addressable, so inline results need no eager
+            # node-store write. See OwnerService.
+            self._owner_server = RpcServer("127.0.0.1", 0)
+            self._owner_server.add_service("Owner", OwnerService(self))
+            self.loop_thread.run(self._owner_server.start())
+            self.address = self._owner_server.address
+        self._owner_clients: Dict[str, SyncRpcClient] = {}
         self.gcs = SyncRpcClient(gcs_address, self.loop_thread)
         from ray_tpu.core.distributed.pull_manager import PullManager
 
@@ -618,7 +692,23 @@ class DistributedCoreWorker:
     def _evict_inline_locked(self) -> None:
         while len(self._inline_cache_order) > self.INLINE_CACHE_CAP:
             old = self._inline_cache_order.popleft()
-            self._inline_cache.pop(old, None)
+            payload = self._inline_cache.pop(old, None)
+            # The inline cache is the PRIMARY copy of owned small
+            # results (no eager store write — see OwnerService): an
+            # owned entry with live refs spills to the node store on
+            # eviction instead of vanishing.
+            if (payload is not None and old in self._owned
+                    and self._refcounts.get(old, 0) > 0
+                    and not self.store.contains(old)):
+                try:
+                    self.store.put_raw(old, payload)
+                    self.queue_location(old, len(payload))
+                except Exception:  # noqa: BLE001 store full: keep the
+                    # entry (slightly over cap) — dropping it here would
+                    # lose the only copy of a live object.
+                    self._inline_cache[old] = payload
+                    self._inline_cache_order.append(old)
+                    break
 
     def _cache_inline(self, oid: ObjectID, payload: bytes) -> None:
         with self._lock:
@@ -660,6 +750,18 @@ class DistributedCoreWorker:
                                                           priority=priority)
             if pulled:
                 continue  # now in local store
+            # 4b) small objects live in their OWNER's inline cache (no
+            # eager store write — see OwnerService): on a directory
+            # miss, ask the owner directly.
+            owner = ref.owner_address
+            if owner and owner != self.address:
+                got, producing = self._try_fetch_from_owner(oid, owner)
+                if got:
+                    continue  # now in the inline cache
+                if producing:
+                    # The owner is still running the producing task:
+                    # not lost, keep polling.
+                    num_locations = max(num_locations, 1)
             # 5) object lost (no copies anywhere): lineage reconstruction
             if num_locations == 0 and self._maybe_reconstruct(oid, deadline):
                 continue
@@ -667,6 +769,37 @@ class DistributedCoreWorker:
                 raise rexc.GetTimeoutError(ref.hex())
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.05)
+
+    OWNER_CLIENT_CAP = 32
+
+    def _try_fetch_from_owner(self, oid: ObjectID,
+                              owner_addr: str) -> Tuple[bool, bool]:
+        """Fetch a small object from its owner's inline cache (ref:
+        in-band small-object replies via GetObjectStatus). Returns
+        (fetched, owner_still_producing)."""
+        client = self._owner_clients.get(owner_addr)
+        if client is None:
+            client = self._owner_clients[owner_addr] = SyncRpcClient(
+                owner_addr, self.loop_thread)
+            # Bounded: owners churn (max_calls retirement spawns fresh
+            # worker addresses), so cap and close the oldest instead of
+            # accreting dead-owner clients forever.
+            while len(self._owner_clients) > self.OWNER_CLIENT_CAP:
+                old = next(iter(self._owner_clients))
+                try:
+                    self._owner_clients.pop(old).close()
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            rep = client.call("Owner", "get_object",
+                              object_id=oid.binary(), timeout=10)
+        except Exception:  # noqa: BLE001 owner gone/unreachable: the
+            return False, False   # directory/lineage path decides
+        payload = rep.get("payload")
+        if payload is None:
+            return False, bool(rep.get("pending"))
+        self._cache_inline(oid, payload)
+        return True, False
 
     def _try_pull_remote(self, oid: ObjectID,
                          priority: Optional[int] = None
@@ -1531,6 +1664,8 @@ class DistributedCoreWorker:
                 "detached": options.lifetime == "detached",
                 "owner_job": self.job_id,
                 "max_concurrency": options.max_concurrency,
+                "concurrency_groups": dict(options.concurrency_groups
+                                           or {}),
                 "placement": sched["placement"],
                 "runtime_env": sched["runtime_env"],
             }, timeout=60)
@@ -1988,6 +2123,17 @@ class DistributedCoreWorker:
             self.store.disconnect()
         except Exception:  # noqa: BLE001
             pass
+        for client in self._owner_clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._owner_clients.clear()
+        if self._owner_server is not None:
+            try:
+                self.loop_thread.run(self._owner_server.stop(), timeout=3)
+            except Exception:  # noqa: BLE001
+                pass
         self.loop_thread.stop()
 
     def _stop_spawned_processes(self) -> None:

@@ -207,6 +207,10 @@ class ActorRecord:
     max_concurrency: int = 1
     placement: Optional[Tuple[str, int]] = None  # (pg_id, bundle_idx)
     runtime_env: Optional[dict] = None           # normalized spec
+    # name -> max concurrent executions (ref: concurrency groups,
+    # concurrency_group_manager.h)
+    concurrency_groups: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
 
 class ActorManager:
@@ -430,6 +434,7 @@ class ActorManager:
                 demand=rec.demand,
                 runtime_env=rec.runtime_env,
                 max_concurrency=rec.max_concurrency,
+                concurrency_groups=rec.concurrency_groups,
                 placement=rec.placement,
                 owner_job=rec.owner_job or "",
                 timeout=get_config().actor_creation_timeout_s)

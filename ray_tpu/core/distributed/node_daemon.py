@@ -971,6 +971,7 @@ class NodeDaemon:
                           args_blob: bytes, demand: Dict[str, float],
                           runtime_env: Optional[dict] = None,
                           max_concurrency: int = 1,
+                          concurrency_groups: Optional[Dict[str, int]] = None,
                           placement: Optional[Tuple[str, int]] = None,
                           owner_job: str = "") -> dict:
         if placement is not None:
@@ -1021,6 +1022,7 @@ class NodeDaemon:
                 "Worker", "create_actor", actor_id=actor_id,
                 cls_blob_key=cls_blob_key, args_blob=args_blob,
                 max_concurrency=max_concurrency,
+                concurrency_groups=concurrency_groups,
                 timeout=get_config().actor_creation_timeout_s)
         finally:
             await client.close()

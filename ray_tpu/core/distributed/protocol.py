@@ -19,12 +19,16 @@ from ray_tpu.core.object_ref import ObjectRef
 
 
 class RefMarker:
-    """Placeholder for a top-level ObjectRef argument."""
+    """Placeholder for a top-level ObjectRef argument. Carries the
+    ref's owner address so the executing worker can fetch small values
+    straight from the owner's inline cache (OwnerService) when the
+    directory has no store copy."""
 
-    __slots__ = ("oid_binary",)
+    __slots__ = ("oid_binary", "owner")
 
-    def __init__(self, oid_binary: bytes):
+    def __init__(self, oid_binary: bytes, owner: Optional[str] = None):
         self.oid_binary = oid_binary
+        self.owner = owner
 
 
 def function_key(func_or_cls) -> bytes:
@@ -60,7 +64,7 @@ def pack_args(args: List[Any], kwargs: Dict[str, Any],
         if isinstance(v, ObjectRef):
             promote(v)
             deps.append(v.id().binary())
-            return RefMarker(v.id().binary())
+            return RefMarker(v.id().binary(), v.owner_address)
         return v
 
     packed = ([conv(a) for a in args],
@@ -69,12 +73,14 @@ def pack_args(args: List[Any], kwargs: Dict[str, Any],
 
 
 def unpack_args(blob: bytes, fetch) -> Tuple[List[Any], Dict[str, Any]]:
-    """Deserialize an args blob, resolving RefMarkers via `fetch(oid)`."""
+    """Deserialize an args blob, resolving RefMarkers via
+    `fetch(oid, owner_address)`."""
     args, kwargs = serialization.deserialize(blob)
 
     def conv(v):
         if isinstance(v, RefMarker):
-            return fetch(ObjectID(v.oid_binary))
+            return fetch(ObjectID(v.oid_binary),
+                         getattr(v, "owner", None))
         return v
 
     return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}

@@ -44,7 +44,8 @@ class ActorRuntime:
     methods sync-only in a sync actor, or guard shared state, exactly as
     with the reference's async actors."""
 
-    def __init__(self, instance, max_concurrency: int):
+    def __init__(self, instance, max_concurrency: int,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.instance = instance
         self._is_async = any(
             inspect.iscoroutinefunction(m)
@@ -55,7 +56,36 @@ class ActorRuntime:
         if self._is_async and max_concurrency == 1:
             maxc = 1000
         self.max_concurrency = maxc
-        self._ordered = (maxc == 1 and not self._is_async)
+        # Named concurrency groups (ref: concurrency_group_manager.h):
+        # each group is its own pool, so a blocked "compute" call can
+        # never stall "io" calls. Methods pick their group with
+        # @ray_tpu.method(concurrency_group=...); undecorated methods
+        # run in the default pool. Groups apply to sync methods — async
+        # methods keep the shared actor event loop.
+        self._groups: Dict[str, ThreadPoolExecutor] = {}
+        self._method_groups: Dict[str, str] = {}
+        if concurrency_groups:
+            for gname, cap in concurrency_groups.items():
+                self._groups[gname] = ThreadPoolExecutor(
+                    max_workers=max(1, int(cap)),
+                    thread_name_prefix=f"cg-{gname}")
+        # Scan decorated methods even with NO groups declared: a
+        # @method(concurrency_group=...) pointing at an undeclared
+        # group must fail loudly, not silently lose its isolation.
+        for mname, m in inspect.getmembers(type(instance), callable):
+            g = getattr(m, "__ray_tpu_concurrency_group__", None)
+            if g is not None:
+                if g not in self._groups:
+                    raise ValueError(
+                        f"method {mname!r} declares concurrency group "
+                        f"{g!r} but the actor declares "
+                        f"{sorted(self._groups) or 'no groups'} "
+                        f"(@remote(concurrency_groups={{...}}))")
+                self._method_groups[mname] = g
+        # Per-caller ordered batch execution only when ONE serial pool
+        # exists: with groups, routing decides the pool per method.
+        self._ordered = (maxc == 1 and not self._is_async
+                         and not self._groups)
         self._pool = ThreadPoolExecutor(max_workers=maxc)
         self._expected: Dict[str, int] = defaultdict(int)
         self._buffered: Dict[str, Dict[int, Any]] = defaultdict(dict)
@@ -158,7 +188,9 @@ class ActorRuntime:
             main_loop.call_soon_threadsafe(
                 lambda: fut.done() or fut.set_result(reply))
 
-        self._pool.submit(run_sync)
+        group = self._method_groups.get(spec["method_name"])
+        pool = self._groups[group] if group is not None else self._pool
+        pool.submit(run_sync)
 
 
 class WorkerService:
@@ -195,40 +227,12 @@ class WorkerService:
         # escaping into the pool's worker loop (which would kill the
         # pool thread permanently).
         self._exec_lock = threading.Lock()
-        # Deferred store writes for inline-able results: the caller gets
-        # the value in the reply NOW; the store copy + location record
-        # (needed only by third-party readers of the ref, who poll the
-        # directory anyway) land a moment later off the latency path
-        # (ref: small returns skip plasma via the in-process memory
-        # store, core_worker store_provider/memory_store/).
-        self._store_queue: "queue.Queue" = queue.Queue()
-        self._store_thread = threading.Thread(
-            target=self._store_drain, name="store-defer", daemon=True)
-        self._store_thread.start()
         # Task-event sink (ref: gcs_task_manager.h — powers `ray-tpu list
         # tasks` and the chrome-trace timeline). Batched like locations.
         self._events: List[dict] = []
         self._events_lock = threading.Lock()
         if get_config().task_events_enabled:
             self._start_event_flusher()
-
-    def _store_drain(self) -> None:
-        from ray_tpu.core.object_store import ObjectExistsError as _Exists
-
-        while True:
-            oid, payload = self._store_queue.get()
-            try:
-                self.core.store.put_raw(oid, payload)
-            except _Exists:
-                pass
-            except Exception as e:  # noqa: BLE001 store full: reader
-                logger.debug("deferred store of %s failed: %s",
-                             oid.hex()[:12], e)
-                continue  # falls back to lineage if ever pulled
-            try:
-                self.core.queue_location(oid, len(payload))
-            except Exception:  # noqa: BLE001
-                pass
 
     def _start_event_flusher(self) -> None:
         period = get_config().task_events_flush_ms / 1000
@@ -279,10 +283,13 @@ class WorkerService:
                 del self._events[:cap // 2]
 
     # ---- helpers ------------------------------------------------------
-    def _fetch_arg(self, oid: ObjectID) -> Any:
+    def _fetch_arg(self, oid: ObjectID,
+                   owner: Optional[str] = None) -> Any:
         from ray_tpu.core.distributed.pull_manager import PRIORITY_TASK_ARG
 
-        return self.core.get([_mkref(oid)], timeout=300,
+        # The owner address (from the RefMarker) routes small values to
+        # the owner's inline cache when the store/directory has no copy.
+        return self.core.get([_mkref(oid, owner)], timeout=300,
                              _priority=PRIORITY_TASK_ARG)[0]
 
     def _store_results(self, spec: dict, value: Any,
@@ -310,12 +317,24 @@ class WorkerService:
             payload = serialization.dumps(v, is_error=is_error)
             inline = payload if len(payload) <= self._max_inline else None
             if inline is not None:
-                # The caller consumes the inline copy from the reply; the
-                # store write + directory record serve only third-party
-                # readers and happen off the reply path (they poll the
-                # directory with backoff, so eventual registration is
-                # enough).
-                self._store_queue.put((oid, payload))
+                # The caller consumes the inline copy from the reply and
+                # becomes the object's authoritative copy: third-party
+                # readers fetch from the OWNER (OwnerService), so the
+                # happy path makes no store write or directory record
+                # (ref: owner-based in-process memory store,
+                # core_worker.cc HandleGetObjectStatus). RETRIED tasks
+                # write through: if this attempt's reply is lost too,
+                # the next retry converges via _existing_results
+                # instead of re-running the body again.
+                if spec.get("attempt", 0) or spec.get("_lane_retries"):
+                    try:
+                        self.core.store.put_raw(oid, payload)
+                    except ObjectExistsError:
+                        pass
+                    except Exception:  # noqa: BLE001 store full
+                        pass
+                    else:
+                        self.core.queue_location(oid, len(payload))
             else:
                 # No inline copy: the store write must land before the
                 # reply or the caller's get() would race a missing object.
@@ -368,15 +387,22 @@ class WorkerService:
             # Register for cancel-interrupt injection around the
             # ITERATION (the generator body runs here, not at fn()-call
             # time in _execute, whose registration window closed before
-            # the first yield executed).
+            # the first yield executed). The tombstone check happens
+            # ATOMICALLY with registration: a cancel that landed in the
+            # unregistered gap left only the tombstone (no thread to
+            # interrupt) — honoring it without registering means
+            # cancel_task can never ALSO inject (it only injects at
+            # registered tasks, under this same lock), so no stray
+            # second interrupt escapes to a later task.
+            precancelled = False
             with self._exec_lock:
-                self._executing[spec["task_id"]] = threading.get_ident()
-            try:
-                # A cancel that landed between _execute's registration
-                # window closing and this one opening left only the
-                # tombstone (no thread to interrupt) — honor it now or
-                # an endless generator runs forever.
                 if spec["task_id"] in self._cancelled_here:
+                    precancelled = True
+                else:
+                    self._executing[spec["task_id"]] = \
+                        threading.get_ident()
+            try:
+                if precancelled:
                     raise KeyboardInterrupt  # handler consumes tombstone
                 for i, v in enumerate(result, start=1):
                     results.append(self._store_stream_item(task_id, i, v))
@@ -681,7 +707,9 @@ class WorkerService:
 
     async def create_actor(self, actor_id: str, cls_blob_key: bytes,
                            args_blob: bytes,
-                           max_concurrency: int = 1) -> dict:
+                           max_concurrency: int = 1,
+                           concurrency_groups: Optional[
+                               Dict[str, int]] = None) -> dict:
         loop = asyncio.get_running_loop()
 
         def construct():
@@ -704,7 +732,13 @@ class WorkerService:
             instance.__raytpu_apply__ = __raytpu_apply__
         except AttributeError:
             pass  # __slots__ class: compiled DAG loops unsupported on it
-        self.actor = ActorRuntime(instance, max_concurrency)
+        try:
+            self.actor = ActorRuntime(instance, max_concurrency,
+                                      concurrency_groups)
+        except Exception as e:  # noqa: BLE001 bad group declaration:
+            # surface as a creation failure, not a hung actor.
+            logger.exception("actor runtime setup failed")
+            return {"ok": False, "error": repr(e)}
         self.actor_id = actor_id
         return {"ok": True}
 
@@ -942,10 +976,10 @@ class WorkerService:
                 "actor_id": self.actor_id}
 
 
-def _mkref(oid: ObjectID):
+def _mkref(oid: ObjectID, owner: Optional[str] = None):
     from ray_tpu.core.object_ref import ObjectRef
 
-    return ObjectRef(oid, None, _skip_refcount=True)
+    return ObjectRef(oid, owner, _skip_refcount=True)
 
 
 def run_worker(args) -> None:
@@ -975,6 +1009,9 @@ def run_worker(args) -> None:
 
     service = WorkerService(core, args.worker_id)
     server.add_service("Worker", service)
+    from ray_tpu.core.distributed.core_worker import OwnerService
+
+    server.add_service("Owner", OwnerService(core))
 
     async def register():
         daemon = AsyncRpcClient(args.daemon_address)
