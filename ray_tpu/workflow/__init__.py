@@ -10,18 +10,21 @@ only the missing suffix.
 """
 from ray_tpu.workflow.api import (
     catch,
+    continuation,
     event,
     get_output,
     get_status,
     list_all,
     resume,
+    retry,
     run,
     run_async,
     send_event,
 )
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "event", "send_event", "catch"]
+           "list_all", "event", "send_event", "catch", "continuation",
+           "retry"]
 
 # Usage tagging (ref: usage_lib.record_library_usage; local-only,
 # see ray_tpu/util/usage_stats.py)

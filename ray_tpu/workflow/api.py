@@ -93,6 +93,89 @@ class _WorkflowRun:
             json.dump({"workflow_id": self.workflow_id, "status": status,
                        "error": error, "ts": time.time()}, f)
 
+    # -- steps -------------------------------------------------------------
+    def _run_step(self, node: FunctionNode, args, kwargs) -> Any:
+        """Submit one step with per-step retries + backoff (ref:
+        workflow step options max_retries; the reference retries the
+        WHOLE step — distinct from task-level max_retries, which only
+        covers worker death). `catch` composes after retries exhaust."""
+        import ray_tpu
+
+        retries = max(0, getattr(node, "_wf_max_retries", 0))
+        backoff = getattr(node, "_wf_backoff_s", 0.5)
+        attempt = 0
+        while True:
+            try:
+                return_val = ray_tpu.get(node._rf.remote(*args, **kwargs))
+                if isinstance(return_val, Continuation):
+                    # Continuations splice regardless of catch (the
+                    # sub-workflow's own steps can use catch).
+                    return return_val
+                if getattr(node, "_wf_catch", False):
+                    # catch_exceptions semantics: failures are data, not
+                    # workflow aborts. Exception only: KeyboardInterrupt/
+                    # SystemExit must still abort, not become a durable
+                    # step value.
+                    return (return_val, None)
+                return return_val
+            except Exception as e:  # noqa: BLE001
+                if attempt >= retries:
+                    if getattr(node, "_wf_catch", False):
+                        return (None, repr(e))
+                    raise
+                attempt += 1
+                time.sleep(backoff * attempt)
+
+    # -- continuations -----------------------------------------------------
+    def _cont_path(self, node: DAGNode) -> str:
+        return os.path.join(
+            self.steps_dir,
+            _step_key(node, self.order[id(node)]) + ".cont.pkl")
+
+    def _save_continuation(self, node: DAGNode, dag: DAGNode) -> None:
+        import cloudpickle
+
+        path = self._cont_path(node)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(dag, f)
+        os.rename(tmp, path)
+
+    def _load_continuation(self, node: DAGNode) -> Optional[DAGNode]:
+        path = self._cont_path(node)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def _finish_continuation(self, node: DAGNode, dag: DAGNode) -> Any:
+        """Run a step's continuation honoring the step's `catch` mark:
+        the catch contract ((value, None) | (None, error)) holds for
+        continuation-returning steps too — a sub-workflow failure
+        becomes data instead of aborting the workflow."""
+        if getattr(node, "_wf_catch", False):
+            try:
+                return (self._run_continuation(node, dag), None)
+            except Exception as e:  # noqa: BLE001
+                return (None, repr(e))
+        return self._run_continuation(node, dag)
+
+    def _run_continuation(self, node: DAGNode, dag: DAGNode) -> Any:
+        """Execute a step-returned sub-DAG in a namespaced sub-workflow:
+        its steps are durable under `sub/<step_key>/`, so nested resumes
+        skip completed sub-steps (arbitrary recursion depth — a sub-step
+        may itself return a continuation)."""
+        sub_dir = os.path.join(
+            self.dir, "sub", _step_key(node, self.order[id(node)]))
+        sub = _WorkflowRun(
+            dag, f"{self.workflow_id}#{os.path.basename(sub_dir)}",
+            sub_dir)
+        value = sub.execute()
+        if isinstance(value, Continuation):
+            raise TypeError("a continuation DAG's root resolved to "
+                            "another bare Continuation object")
+        return value
+
     # -- execution ---------------------------------------------------------
     def _wait_event(self, node: "EventNode") -> Any:
         path = os.path.join(self.dir, "events", f"{node.event_name}.pkl")
@@ -131,24 +214,31 @@ class _WorkflowRun:
                         self._save_step(node, {"value": value})
                         cache[key] = value
                         return value
+                    # A continuation checkpoint from a prior run: the
+                    # generating step already ran — resume its sub-DAG
+                    # without re-running the step body.
+                    cont_dag = self._load_continuation(node)
+                    if cont_dag is not None:
+                        value = self._finish_continuation(node, cont_dag)
+                        self._save_step(node, {"value": value})
+                        cache[key] = value
+                        return value
                     args = [run_node(a) if isinstance(a, DAGNode) else a
                             for a in node._bound_args]
                     kwargs = {k: (run_node(v) if isinstance(v, DAGNode)
                                   else v)
                               for k, v in node._bound_kwargs.items()}
                     if isinstance(node, FunctionNode):
-                        ref = node._rf.remote(*args, **kwargs)
-                        if getattr(node, "_wf_catch", False):
-                            # catch_exceptions semantics: failures are
-                            # data, not workflow aborts. Exception only:
-                            # a KeyboardInterrupt/SystemExit must still
-                            # abort, not become a durable step value.
-                            try:
-                                value = (ray_tpu.get(ref), None)
-                            except Exception as e:  # noqa: BLE001
-                                value = (None, repr(e))
-                        else:
-                            value = ray_tpu.get(ref)
+                        value = self._run_step(node, args, kwargs)
+                        if isinstance(value, Continuation):
+                            # Dynamic workflow (ref: workflow
+                            # continuation): checkpoint the returned
+                            # DAG so a resumed run re-enters the
+                            # sub-workflow WITHOUT re-running this
+                            # step, then splice it in.
+                            self._save_continuation(node, value.dag)
+                            value = self._finish_continuation(
+                                node, value.dag)
                     else:
                         raise TypeError(
                             f"workflows support function DAGs; got "
@@ -215,6 +305,39 @@ def send_event(workflow_id: str, name: str, payload: Any = None,
     with open(tmp, "wb") as f:
         pickle.dump(payload, f)
     os.replace(tmp, os.path.join(d, f"{name}.pkl"))
+
+
+class Continuation:
+    """Wrapper a STEP returns to splice a dynamically-built DAG into the
+    workflow (ref: python/ray/workflow/common.py `workflow.continuation`
+    + workflow_state_from_dag.py): the sub-DAG executes as a durable
+    sub-workflow and its result becomes this step's result. The DAG is
+    checkpointed when the generating step completes, so a resumed run
+    re-enters the sub-workflow without re-running the generator."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError(
+                f"continuation() takes a DAG node (fn.bind(...)), got "
+                f"{type(dag).__name__}")
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    """Return from inside a workflow step to continue with `dag`."""
+    return Continuation(dag)
+
+
+def retry(node: DAGNode, max_retries: int = 3,
+          backoff_s: float = 0.5) -> DAGNode:
+    """Per-step retry budget (ref: workflow step `max_retries`): the
+    whole step re-submits on ANY exception, with linear backoff —
+    distinct from task-level `max_retries`, which only re-runs on worker
+    death. Composes with `catch` (failure becomes data only after the
+    budget is spent)."""
+    node._wf_max_retries = int(max_retries)  # type: ignore[attr-defined]
+    node._wf_backoff_s = float(backoff_s)    # type: ignore[attr-defined]
+    return node
 
 
 def catch(node: DAGNode) -> DAGNode:

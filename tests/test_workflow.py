@@ -73,3 +73,216 @@ def test_workflow_with_input_and_async(wf_env):
         dag = scale.bind(inp, 5)
     fut = workflow.run_async(dag, 4, workflow_id="wf3", storage=wf_env)
     assert fut.result(timeout=120) == 20
+
+
+def test_continuation_dynamic_fanout(wf_env):
+    """A step returns workflow.continuation(dag): the dynamically built
+    sub-DAG executes as a durable sub-workflow and its result becomes
+    the step's result (ref: workflow.continuation +
+    workflow_state_from_dag.py)."""
+    @ray_tpu.remote
+    def leaf(i):
+        return i * i
+
+    @ray_tpu.remote
+    def merge(*xs):
+        return sum(xs)
+
+    @ray_tpu.remote
+    def plan(n):
+        from ray_tpu import workflow as wf
+
+        # fanout width decided at RUN time from data
+        return wf.continuation(merge.bind(*[leaf.bind(i)
+                                            for i in range(n)]))
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    dag = plus_one.bind(plan.bind(4))
+    out = workflow.run(dag, workflow_id="wf-cont", storage=wf_env)
+    assert out == (0 + 1 + 4 + 9) + 1
+
+
+def test_continuation_nested(wf_env):
+    """A continuation's own step may return another continuation
+    (arbitrary recursion)."""
+    @ray_tpu.remote
+    def base(x):
+        return x + 100
+
+    @ray_tpu.remote
+    def inner(x):
+        from ray_tpu import workflow as wf
+
+        return wf.continuation(base.bind(x))
+
+    @ray_tpu.remote
+    def outer():
+        from ray_tpu import workflow as wf
+
+        return wf.continuation(inner.bind(5))
+
+    assert workflow.run(outer.bind(), workflow_id="wf-nest",
+                        storage=wf_env) == 105
+
+
+def test_continuation_resume_skips_generator_and_done_substeps(
+        wf_env, tmp_path):
+    """Resume after a mid-sub-workflow failure: the generating step does
+    NOT re-run (its continuation DAG was checkpointed) and completed
+    sub-steps load from storage."""
+    gen_marker = tmp_path / "gen_runs"
+    a_marker = tmp_path / "a_runs"
+
+    @ray_tpu.remote
+    def step_a():
+        with open(str(a_marker), "a") as f:
+            f.write("x")
+        return 3
+
+    @ray_tpu.remote
+    def step_b(x, fail_flag):
+        import os
+
+        if os.path.exists(fail_flag):
+            raise RuntimeError("sub-step failing this run")
+        return x * 10
+
+    @ray_tpu.remote
+    def gen(fail_flag):
+        from ray_tpu import workflow as wf
+
+        with open(str(gen_marker), "a") as f:
+            f.write("x")
+        return wf.continuation(step_b.bind(step_a.bind(), fail_flag))
+
+    fail_flag = str(tmp_path / "fail")
+    open(fail_flag, "w").close()
+    dag = gen.bind(fail_flag)
+    with pytest.raises(Exception, match="sub-step failing"):
+        workflow.run(dag, workflow_id="wf-cres", storage=wf_env)
+    assert gen_marker.read_text() == "x"
+    assert a_marker.read_text() == "x"   # step_a completed + durable
+
+    import os
+
+    os.unlink(fail_flag)
+    out = workflow.resume("wf-cres", dag, storage=wf_env)
+    assert out == 30
+    # generator not re-run (DAG came from the checkpoint); step_a loaded
+    assert gen_marker.read_text() == "x"
+    assert a_marker.read_text() == "x"
+
+
+def test_per_step_retry_with_backoff(wf_env, tmp_path):
+    """workflow.retry(): the WHOLE step re-submits on app exceptions
+    (task-level max_retries only covers worker death)."""
+    counter = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky():
+        with open(str(counter), "a") as f:
+            f.write("x")
+        import os
+
+        if os.path.getsize(str(counter)) < 3:
+            raise ValueError("not yet")
+        return "ok"
+
+    dag = workflow.retry(flaky.bind(), max_retries=5, backoff_s=0.01)
+    assert workflow.run(dag, workflow_id="wf-retry",
+                        storage=wf_env) == "ok"
+    assert counter.read_text() == "xxx"   # 2 failures + 1 success
+
+
+def test_retry_exhaustion_then_catch(wf_env):
+    @ray_tpu.remote(max_retries=0)
+    def always_fails():
+        raise ValueError("permanent")
+
+    node = workflow.catch(
+        workflow.retry(always_fails.bind(), max_retries=2,
+                       backoff_s=0.01))
+    val, err = workflow.run(node, workflow_id="wf-rc", storage=wf_env)
+    assert val is None and "permanent" in err
+
+
+def test_resume_after_driver_death(wf_env, tmp_path):
+    """Kill the driver process mid-workflow; a fresh driver resumes and
+    only unfinished steps run (ref: workflow resume on crash)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time as _time
+
+    marker_a = tmp_path / "a"
+    marker_c = tmp_path / "c"
+    script = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))})
+        import ray_tpu
+        from ray_tpu import workflow
+
+        ray_tpu.init(local_mode=True)
+
+        @ray_tpu.remote
+        def a():
+            with open({str(marker_a)!r}, "a") as f:
+                f.write("x")
+            return 1
+
+        @ray_tpu.remote
+        def b(x):
+            time.sleep(600)   # the driver dies while this step runs
+            return x
+
+        @ray_tpu.remote
+        def c(x):
+            with open({str(marker_c)!r}, "a") as f:
+                f.write("x")
+            return x + 1
+
+        dag = c.bind(b.bind(a.bind()))
+        print("STARTING", flush=True)
+        workflow.run(dag, workflow_id="wf-crash", storage={wf_env!r})
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                            text=True)
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        if marker_a.exists():
+            break
+        _time.sleep(0.2)
+    assert marker_a.exists(), "step a never ran in the child driver"
+    _time.sleep(1.0)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    # Fresh "driver" (this test process): rebuild the same DAG, resume.
+    @ray_tpu.remote
+    def a():
+        with open(str(marker_a), "a") as f:
+            f.write("x")
+        return 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x   # no sleep this time; the step never completed before
+
+    @ray_tpu.remote
+    def c(x):
+        with open(str(marker_c), "a") as f:
+            f.write("x")
+        return x + 1
+
+    dag = c.bind(b.bind(a.bind()))
+    out = workflow.resume("wf-crash", dag, storage=wf_env)
+    assert out == 2
+    assert marker_a.read_text() == "x"   # a did NOT re-run
+    assert marker_c.read_text() == "x"   # c ran exactly once
