@@ -189,6 +189,158 @@ class DashboardHead:
             })
         return self._json(out)
 
+    # -- job submission over REST (ref: dashboard/modules/job/
+    # job_head.py submit/stop/logs; a non-Python client needs nothing
+    # but HTTP) ---------------------------------------------------------
+    async def _submit_job(self, request):
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.Response(status=400, text="invalid JSON body")
+        entrypoint = body.get("entrypoint")
+        if not entrypoint or not isinstance(entrypoint, str):
+            return web.Response(status=400,
+                                text="'entrypoint' (string) is required")
+        import uuid as _uuid
+
+        submission_id = (body.get("submission_id")
+                         or f"raytpu_job_{_uuid.uuid4().hex[:10]}")
+        existing = await self._call("KV", "get", namespace="job",
+                                    key=submission_id.encode())
+        if existing is not None:
+            return web.Response(
+                status=400, text=f"job {submission_id!r} already exists")
+        runtime_env = dict(body.get("runtime_env") or {})
+        env_vars = runtime_env.pop("env_vars", {}) or {}
+        metadata = body.get("metadata") or {}
+
+        # The supervisor is created straight through the GCS actor
+        # manager — the dashboard is not a driver, so it exports the
+        # class blob + builds the actor record itself (the same record
+        # core_worker.create_actor writes).
+        from ray_tpu.core.distributed import protocol
+        from ray_tpu.core.ids import ActorID
+        from ray_tpu.job_submission.supervisor import JobSupervisor
+
+        key, blob = protocol.function_key(JobSupervisor)
+        await self._call("KV", "put", namespace="fn", key=key,
+                         value=blob, overwrite=False)
+        args_blob, _ = protocol.pack_args(
+            [submission_id, entrypoint, metadata, self.gcs_address,
+             env_vars], {}, lambda r: None)
+        normalized = None
+        if runtime_env:
+            from ray_tpu.core.distributed.rpc import SyncRpcClient
+            from ray_tpu.runtime_env import normalize
+
+            def _normalize():
+                sc = SyncRpcClient(self.gcs_address)
+                try:
+                    def kv_put(namespace, key, value):
+                        if isinstance(namespace, bytes):
+                            namespace = namespace.decode()
+                        sc.call("KV", "put", namespace=namespace,
+                                key=key, value=value, overwrite=True,
+                                timeout=60)
+
+                    return normalize(runtime_env, kv_put)
+                finally:
+                    sc.close()
+
+            try:
+                loop = asyncio.get_running_loop()
+                normalized = await loop.run_in_executor(None, _normalize)
+            except ValueError as e:
+                return web.Response(status=400,
+                                    text=f"bad runtime_env: {e}")
+        record = {
+            "actor_id": ActorID.generate().hex(),
+            "cls_blob_key": key,
+            "cls_name": "JobSupervisor",
+            "args_blob": args_blob,
+            "demand": {"CPU": float(body.get("entrypoint_num_cpus", 0))},
+            "max_restarts": 0,
+            "name": f"_job_supervisor_{submission_id}",
+            "namespace": "_job",
+            "detached": True,
+            "owner_job": "",
+            "max_concurrency": 1,
+            "runtime_env": normalized,
+        }
+        # Initial PENDING record BEFORE the supervisor exists, so
+        # status polls right after submit see the job (the supervisor
+        # overwrites it when it starts); overwrite=False also closes
+        # the race of two concurrent submits with the same id.
+        import time as _time
+
+        info = {"submission_id": submission_id, "entrypoint": entrypoint,
+                "status": "PENDING", "message": "supervisor starting",
+                "metadata": metadata, "start_time": _time.time(),
+                "end_time": None}
+        fresh = await self._call(
+            "KV", "put", namespace="job", key=submission_id.encode(),
+            value=json.dumps(info).encode(), overwrite=False)
+        if not fresh:
+            return web.Response(
+                status=400, text=f"job {submission_id!r} already exists")
+        try:
+            await self._call("ActorManager", "create_actor",
+                             record=record)
+        except Exception as e:  # noqa: BLE001
+            await self._call("KV", "delete", namespace="job",
+                             key=submission_id.encode())
+            return web.Response(status=500,
+                                text=f"supervisor creation failed: {e}")
+        return self._json({"submission_id": submission_id})
+
+    async def _job_info(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["sid"]
+        raw = await self._call("KV", "get", namespace="job",
+                               key=sid.encode())
+        if raw is None:
+            return web.Response(status=404, text=f"no job {sid!r}")
+        return self._json(json.loads(raw.decode()))
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["sid"]
+        raw = await self._call("KV", "get", namespace="job",
+                               key=f"{sid}:logs".encode())
+        if raw is None:
+            info = await self._call("KV", "get", namespace="job",
+                                    key=sid.encode())
+            if info is None:
+                return web.Response(status=404, text=f"no job {sid!r}")
+            raw = b""
+        return web.Response(text=raw.decode(errors="replace"),
+                            content_type="text/plain")
+
+    async def _stop_job(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["sid"]
+        raw = await self._call("KV", "get", namespace="job",
+                               key=sid.encode())
+        if raw is None:
+            return web.Response(status=404, text=f"no job {sid!r}")
+        # Terminal jobs aren't stoppable — mirror the native client's
+        # False (and don't leave a stop flag that would kill a future
+        # job resubmitted under this id).
+        if json.loads(raw.decode()).get("status") in (
+                "SUCCEEDED", "FAILED", "STOPPED"):
+            return self._json({"stopped": False})
+        # Durable stop flag: the supervisor's poll loop consumes it
+        # within one tick (works even while the actor path is busy).
+        await self._call("KV", "put", namespace="job",
+                         key=f"{sid}:stop".encode(), value=b"1",
+                         overwrite=True)
+        return self._json({"stopped": True})
+
     async def _events(self, request):
         limit = int(request.query.get("limit", "500"))
         return self._json(await self._call("EventLog", "list_events",
@@ -260,6 +412,10 @@ class DashboardHead:
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_post("/api/jobs", self._submit_job)
+        app.router.add_get("/api/jobs/{sid}", self._job_info)
+        app.router.add_get("/api/jobs/{sid}/logs", self._job_logs)
+        app.router.add_post("/api/jobs/{sid}/stop", self._stop_job)
         app.router.add_get("/api/pgs", self._pgs)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/cluster_status", self._cluster_status)

@@ -3,8 +3,11 @@
 Analogue of the reference client (ref: dashboard/modules/job/sdk.py:39
 JobSubmissionClient — submit_job/get_job_status/get_job_logs/stop_job/
 list_jobs/delete_job). The reference round-trips through the dashboard
-REST API; ours joins the cluster directly (a driver connection) and
-drives the detached JobSupervisor actor + GCS KV records.
+REST API; ours supports BOTH transports: `address="http://host:port"`
+speaks the dashboard REST API (submit/status/logs/stop/list — a
+non-Python client needs nothing but HTTP, ref: job_head.py routes),
+while a GCS address (or None) joins the cluster directly as a driver
+and drives the detached JobSupervisor actor + GCS KV records.
 """
 from __future__ import annotations
 
@@ -60,6 +63,12 @@ class JobSubmissionClient:
     """
 
     def __init__(self, address: Optional[str] = None):
+        self._http: Optional[str] = None
+        if address is not None and address.startswith(("http://",
+                                                       "https://")):
+            self._http = address.rstrip("/")
+            self._worker = None
+            return
         import ray_tpu
 
         if address is not None and not ray_tpu.is_initialized():
@@ -75,6 +84,29 @@ class JobSubmissionClient:
                 f"{self._worker.gcs_address}; cannot submit to {address} "
                 f"(one cluster per process)")
 
+    # -- http transport -------------------------------------------------
+    def _http_req(self, method: str, path: str, body: Optional[dict] = None,
+                  raw: bool = False):
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self._http}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise RuntimeError(detail) from None
+            raise RuntimeError(
+                f"HTTP {e.code} from {path}: {detail}") from None
+        if raw:
+            return payload.decode(errors="replace")
+        return json.loads(payload)
+
     # -- submission -----------------------------------------------------
     def submit_job(
         self,
@@ -85,6 +117,15 @@ class JobSubmissionClient:
         metadata: Optional[Dict[str, str]] = None,
         entrypoint_num_cpus: float = 0,
     ) -> str:
+        if self._http is not None:
+            out = self._http_req("POST", "/api/jobs", {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env,
+                "metadata": metadata,
+                "entrypoint_num_cpus": entrypoint_num_cpus,
+            })
+            return out["submission_id"]
         import ray_tpu
 
         submission_id = submission_id or f"raytpu_job_{uuid.uuid4().hex[:10]}"
@@ -114,6 +155,14 @@ class JobSubmissionClient:
 
     # -- state ----------------------------------------------------------
     def _get_info(self, submission_id: str) -> Optional[JobInfo]:
+        if self._http is not None:
+            try:
+                d = self._http_req("GET", f"/api/jobs/{submission_id}")
+            except RuntimeError:
+                return None
+            return JobInfo(**{k: d.get(k) for k in (
+                "submission_id", "entrypoint", "status", "message",
+                "metadata", "start_time", "end_time")})
         raw = self._worker.kv_get(JOB_KV_NAMESPACE, submission_id.encode())
         if raw is None:
             return None
@@ -130,6 +179,9 @@ class JobSubmissionClient:
         return self.get_job_info(submission_id).status
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._http is not None:
+            return self._http_req(
+                "GET", f"/api/jobs/{submission_id}/logs", raw=True)
         import ray_tpu
 
         # Prefer the live supervisor (full log file); fall back to the KV
@@ -148,12 +200,25 @@ class JobSubmissionClient:
             return raw.decode(errors="replace")
 
     def list_jobs(self) -> List[JobInfo]:
+        if self._http is not None:
+            out = []
+            for row in self._http_req("GET", "/api/jobs"):
+                if row.get("kind") != "submission":
+                    continue
+                info = self._get_info(row["id"])
+                if info is not None:
+                    out.append(info)
+            return out
         items = {key: self._worker.kv_get(JOB_KV_NAMESPACE, key)
                  for key in self._worker.kv_keys(JOB_KV_NAMESPACE, b"")}
         return parse_job_records(items)
 
     # -- control --------------------------------------------------------
     def stop_job(self, submission_id: str) -> bool:
+        if self._http is not None:
+            out = self._http_req(
+                "POST", f"/api/jobs/{submission_id}/stop")
+            return bool(out.get("stopped"))
         import ray_tpu
 
         self.get_job_info(submission_id)
@@ -165,6 +230,10 @@ class JobSubmissionClient:
             return False
 
     def delete_job(self, submission_id: str) -> bool:
+        if self._http is not None:
+            raise NotImplementedError(
+                "delete_job needs a cluster connection (use the GCS "
+                "address form of JobSubmissionClient)")
         info = self.get_job_info(submission_id)
         if info.status not in JobStatus.TERMINAL:
             raise RuntimeError(
@@ -172,6 +241,8 @@ class JobSubmissionClient:
         self._worker.kv_del(JOB_KV_NAMESPACE, submission_id.encode())
         self._worker.kv_del(JOB_KV_NAMESPACE,
                             f"{submission_id}:logs".encode())
+        self._worker.kv_del(JOB_KV_NAMESPACE,
+                            f"{submission_id}:stop".encode())
         # Reap the (now idle) detached supervisor.
         import ray_tpu
 

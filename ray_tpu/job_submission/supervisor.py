@@ -90,6 +90,26 @@ class JobSupervisor:
             while self._proc.poll() is None:
                 time.sleep(0.5)
                 self._flush_logs()
+                # KV stop flag: lets HTTP-only clients (dashboard REST)
+                # stop the job without an actor-call path into this
+                # supervisor (ref: job_head.py stop → JobManager).
+                if not self._stopped:
+                    try:
+                        flag = self._kv().kv_get(
+                            JOB_KV_NAMESPACE,
+                            f"{self.submission_id}:stop".encode())
+                    except Exception:  # noqa: BLE001 GCS blip
+                        flag = None
+                    if flag:
+                        # Consume the flag: a leftover would instantly
+                        # kill a future job resubmitted under this id.
+                        try:
+                            self._kv().kv_del(
+                                JOB_KV_NAMESPACE,
+                                f"{self.submission_id}:stop".encode())
+                        except Exception:  # noqa: BLE001
+                            pass
+                        self.stop()
         self._flush_logs()
         rc = self._proc.returncode
         if self._stopped:

@@ -80,3 +80,64 @@ def test_job_stop(job_cluster):
     assert client.delete_job(sid)
     with pytest.raises(RuntimeError):
         client.get_job_info(sid)
+
+
+@pytest.fixture(scope="module")
+def http_job_cluster(job_cluster):
+    """Dashboard on the module's cluster: jobs driven over REST only
+    (ref: dashboard/modules/job/job_head.py submit/stop/logs routes)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    head, port = start_dashboard(job_cluster.address)
+    yield job_cluster, port
+
+
+def test_job_http_submit_logs_stop(http_job_cluster):
+    """Round-trip submit -> status -> logs -> stop via HTTP ONLY: the
+    client talks to the dashboard REST API, never to GCS/actors."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    _, port = http_job_cluster
+    client = JobSubmissionClient(f"http://127.0.0.1:{port}")
+
+    # 1) a short job runs to success, logs readable over HTTP
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('http job ran')\"")
+    info = client.wait_until_finished(sid, timeout=180)
+    assert info.status == JobStatus.SUCCEEDED
+    assert "http job ran" in client.get_job_logs(sid)
+    assert any(j.submission_id == sid for j in client.list_jobs())
+
+    # 2) a long job is stoppable over HTTP
+    sid2 = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(600)\"")
+    deadline = time.monotonic() + 120
+    while client.get_job_status(sid2) != JobStatus.RUNNING:
+        assert time.monotonic() < deadline, "job never started"
+        time.sleep(0.3)
+    assert client.stop_job(sid2)
+    info2 = client.wait_until_finished(sid2, timeout=120)
+    assert info2.status == JobStatus.STOPPED
+
+
+def test_job_http_env_vars_and_errors(http_job_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    _, port = http_job_cluster
+    client = JobSubmissionClient(f"http://127.0.0.1:{port}")
+
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c "
+                   f"\"import os; print('V=' + os.environ['MY_VAR'])\"",
+        runtime_env={"env_vars": {"MY_VAR": "http-env"}})
+    info = client.wait_until_finished(sid, timeout=180)
+    assert info.status == JobStatus.SUCCEEDED
+    assert "V=http-env" in client.get_job_logs(sid)
+
+    # duplicate id refused with a clear error
+    with pytest.raises(RuntimeError, match="already exists"):
+        client.submit_job(entrypoint="true", submission_id=sid)
+
+    # unknown job -> error
+    with pytest.raises(RuntimeError):
+        client.get_job_status("raytpu_job_nonexistent")
