@@ -34,3 +34,8 @@ from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     NodeProvider,
 )
 from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401
+from ray_tpu.autoscaler.v2 import (  # noqa: F401
+    AutoscalerV2,
+    InstanceManager,
+    InstanceRecord,
+)

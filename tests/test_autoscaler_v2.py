@@ -1,0 +1,204 @@
+"""Autoscaler v2: durable instance lifecycle + stuck-launch recovery
+(ref: python/ray/autoscaler/v2/instance_manager/instance_manager.py,
+v2/scheduler.py, v2/tests/test_instance_manager.py shapes)."""
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import Instance, NodeProvider
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATED,
+    InstanceManager,
+    QUEUED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+)
+
+
+class FakeProvider(NodeProvider):
+    """Instances appear instantly; the test decides which ones 'join'
+    ray (set .ray_node_id) and which hang forever (stuck)."""
+
+    def __init__(self):
+        self.instances = {}
+        self.terminated = []
+        self.seq = 0
+
+    def create_node(self, node_type, node_config):
+        iid = f"i-{self.seq}"
+        self.seq += 1
+        self.instances[iid] = Instance(iid, node_type)
+        return iid
+
+    def terminate_node(self, instance_id):
+        self.terminated.append(instance_id)
+        self.instances.pop(instance_id, None)
+
+    def non_terminated_nodes(self):
+        return dict(self.instances)
+
+
+TYPES = {"tpu-host": NodeTypeConfig(
+    resources={"CPU": 8.0, "TPU": 4.0}, min_workers=0, max_workers=4)}
+
+
+def _status(nodes=(), pending_pgs=(), pending_actors=()):
+    return {"nodes": list(nodes), "pending_pgs": list(pending_pgs),
+            "pending_actors": list(pending_actors),
+            "resource_requests": []}
+
+
+def _alive(node_id, idle_s=0.0, tpus=4.0):
+    return {"node_id": node_id, "alive": True, "idle_s": idle_s,
+            "total": {"CPU": 8.0, "TPU": tpus},
+            "available": {"CPU": 8.0, "TPU": tpus},
+            "queued_demand": []}
+
+
+def test_full_cycle_with_stuck_launch_recovery():
+    """0 -> 2 on a pending gang; the FIRST launch sticks (never joins),
+    times out, is terminated and REQUEUED; the replacement joins; both
+    reach RAY_RUNNING; idle brings both back down to 0."""
+    prov = FakeProvider()
+    saved = []
+    im = InstanceManager(prov, TYPES, launch_timeout_s=0.15,
+                         idle_timeout_s=0.1, drain_timeout_s=0.1,
+                         persist=saved.append)
+
+    # Pending 2-bundle gang => schedule 2 instances.
+    gang = {"bundles": [{"TPU": 4.0}, {"TPU": 4.0}], "strategy": "PACK"}
+    st = _status(pending_pgs=[gang])
+    im.schedule(st)
+    assert len(im.active(QUEUED)) == 2
+
+    # First reconcile: QUEUED -> REQUESTED -> ALLOCATED (instant provider)
+    im.reconcile(st)
+    assert len(im.active(ALLOCATED)) == 2
+    assert len(prov.instances) == 2
+
+    # One instance joins ray; the other is STUCK (never joins).
+    joined_iid = sorted(prov.instances)[0]
+    stuck_iid = sorted(prov.instances)[1]
+    prov.instances[joined_iid].ray_node_id = "node-A"
+    st = _status(nodes=[_alive("node-A")], pending_pgs=[gang])
+    im.reconcile(st)
+    assert len(im.active(RAY_RUNNING)) == 1
+
+    # Past the launch timeout the stuck one is terminated and replaced.
+    time.sleep(0.2)
+    im.reconcile(st)
+    assert stuck_iid in prov.terminated
+    replacements = im.active(QUEUED, REQUESTED, ALLOCATED)
+    assert len(replacements) == 1
+    assert replacements[0].attempt == 1
+
+    # Replacement allocates and joins.
+    im.reconcile(st)
+    (repl,) = im.active(ALLOCATED)
+    prov.instances[repl.cloud_id].ray_node_id = "node-B"
+    st = _status(nodes=[_alive("node-A"), _alive("node-B")],
+                 pending_pgs=[gang])
+    im.reconcile(st)
+    assert len(im.active(RAY_RUNNING)) == 2
+
+    # Gang placed; both nodes go idle -> drain -> terminate -> 0.
+    st = _status(nodes=[_alive("node-A", idle_s=5.0),
+                        _alive("node-B", idle_s=5.0)])
+    im.reconcile(st)   # RAY_RUNNING -> RAY_STOPPING
+    im.reconcile(st)   # -> TERMINATING -> TERMINATED
+    summary = im.reconcile(st)
+    assert summary.get(RAY_RUNNING) is None
+    assert not prov.instances
+    assert len(prov.terminated) == 3  # stuck + 2 drained
+    # no demand + empty cluster => nothing new scheduled
+    im.schedule(st)
+    assert not im.active(QUEUED)
+    assert saved, "persist callback never invoked"
+
+
+def test_restart_restores_durable_table():
+    """A new manager restored from the persisted table resumes the
+    lifecycle instead of double-launching (ref: instance storage)."""
+    prov = FakeProvider()
+    im = InstanceManager(prov, TYPES, launch_timeout_s=60)
+    gang = {"bundles": [{"TPU": 4.0}], "strategy": "PACK"}
+    st = _status(pending_pgs=[gang])
+    im.schedule(st)
+    im.reconcile(st)
+    assert len(im.active(ALLOCATED)) == 1
+    blob = im.dump()
+
+    # "Restarted" manager, same provider world.
+    im2 = InstanceManager(prov, TYPES, launch_timeout_s=60)
+    im2.restore(blob)
+    assert len(im2.active(ALLOCATED)) == 1
+    # Re-scheduling the SAME demand launches nothing new (the booting
+    # instance covers it).
+    im2.schedule(st)
+    im2.reconcile(st)
+    assert len(prov.instances) == 1
+
+    # The allocated instance joins; the restored manager advances it.
+    (rec,) = im2.active(ALLOCATED)
+    prov.instances[rec.cloud_id].ray_node_id = "node-A"
+    im2.reconcile(_status(nodes=[_alive("node-A")], pending_pgs=[gang]))
+    assert len(im2.active(RAY_RUNNING)) == 1
+
+
+def test_attempt_budget_exhaustion():
+    """A launch that keeps sticking burns its attempts and STOPS being
+    replaced (no infinite launch loop against a broken zone)."""
+    prov = FakeProvider()
+    im = InstanceManager(prov, TYPES, launch_timeout_s=0.05,
+                         max_attempts=2)
+    st = _status(pending_actors=[{"TPU": 4.0}])
+    im.schedule(st)
+    for _ in range(8):
+        im.reconcile(_status())   # demandless status: no re-schedule
+        time.sleep(0.06)
+    assert not im.active(QUEUED, REQUESTED, ALLOCATED)
+    terminated = [r for r in im.instances.values()
+                  if r.status == TERMINATED]
+    assert len(terminated) == 2          # original + 1 replacement
+    assert terminated[-1].attempt <= 2
+
+
+def test_gcp_sim_scale_up_down():
+    """Integration with the GCP TPU provider over a recording transport:
+    the gang demand turns into TPU-API node creates; idle turns into
+    deletes (ref: autoscaler/gcp.py; tests/test_gcp_provider.py)."""
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+    from tests.test_gcp_provider import RecordingTransport
+
+    transport = RecordingTransport()
+    prov = GcpTpuNodeProvider("c1", "proj", "us-central2-b",
+                              transport=transport)
+    types = {"v4-8-host": NodeTypeConfig(
+        resources={"TPU": 4.0},
+        node_config={"accelerator_type": "v4-8",
+                     "runtime_version": "tpu-ubuntu2204-base"},
+        max_workers=4)}
+    im = InstanceManager(prov, types, launch_timeout_s=60)
+    gang = {"bundles": [{"TPU": 4.0}, {"TPU": 4.0}], "strategy": "SPREAD"}
+    im.schedule(_status(pending_pgs=[gang]))
+    im.reconcile(_status(pending_pgs=[gang]))
+    creates = [c for c in transport.calls
+               if c["method"] == "POST"]
+    assert len(creates) == 2
+    assert len(im.active(REQUESTED, ALLOCATED)) == 2
+
+    # Both slices boot + join; then idle away.
+    view = prov.non_terminated_nodes()
+    for iid, inst in view.items():
+        inst.ray_node_id = f"node-{iid}"
+    im.reconcile(_status(
+        nodes=[_alive(f"node-{iid}") for iid in view]))
+    assert len(im.active(RAY_RUNNING)) == 2
+    idle_nodes = [_alive(f"node-{iid}", idle_s=999.0) for iid in view]
+    for _ in range(3):
+        im.reconcile(_status(nodes=idle_nodes))
+    deletes = [c for c in transport.calls
+               if c["method"] == "DELETE"]
+    assert len(deletes) == 2
