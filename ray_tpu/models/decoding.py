@@ -89,12 +89,14 @@ def _mlp(bp, x, cfg):
     cd = cfg.compute_dtype
     h = rms_norm(x, bp["mlp_norm"], eps=cfg.norm_eps)
     if cfg.n_experts > 0:
-        from ray_tpu.ops.moe import moe_mlp
+        # Dropless exact routing: decode must compute the same function
+        # regardless of batch size (capacity routing is train-only) —
+        # see moe_mlp_dropless.
+        from ray_tpu.ops.moe import moe_mlp_dropless
 
-        out, _ = moe_mlp(h, {"router": bp["router"], "w_gate": bp["w_gate"],
-                             "w_up": bp["w_up"], "w_down": bp["w_down"]},
-                         cfg.moe)
-        return out
+        return moe_mlp_dropless(
+            h, {"router": bp["router"], "w_gate": bp["w_gate"],
+                "w_up": bp["w_up"], "w_down": bp["w_down"]}, cfg.moe)
     gate = jnp.einsum("btd,df->btf", h, bp["w_gate"].astype(cd))
     up = jnp.einsum("btd,df->btf", h, bp["w_up"].astype(cd))
     return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up,

@@ -85,6 +85,45 @@ def top_k_routing(logits: jnp.ndarray, k: int, capacity: int):
     return dispatch, combine, probs
 
 
+def moe_mlp_dropless(x: jnp.ndarray, params: dict, cfg: MoEConfig, *,
+                     rules: LogicalRules = DEFAULT_RULES):
+    """Exact (dropless) top-k MoE for INFERENCE: every token reaches all
+    of its top-k experts, so the result is independent of how many other
+    tokens share the batch — a cached decode step computes the same
+    function as a full prefill (capacity-based `moe_mlp` drops over-
+    capacity tokens, which makes its output depend on the token count;
+    that's the standard train-time scheme, ref: Switch/GShard, but
+    serving engines route exactly, ref: Mixtral inference).
+
+    Implementation: dense-over-experts einsum with the top-k combine
+    weights zeroing non-selected experts — E/k extra FLOPs versus ideal
+    gather-dispatch, which is acceptable at decode batch sizes; the
+    expert axis still shards over `ep` for EP serving."""
+    b, t, d = x.shape
+    dtype = x.dtype
+    e = cfg.num_experts
+
+    logits = jnp.einsum("btd,de->bte", x, params["router"].astype(dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    # (B,T,E) combine weights: zero for unselected experts
+    w = jnp.sum(jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+                * gate_vals[..., None], axis=2)
+
+    gate = jnp.einsum("btd,edf->btef", x, params["w_gate"].astype(dtype))
+    up = jnp.einsum("btd,edf->btef", x, params["w_up"].astype(dtype))
+    hidden = jax.nn.silu(gate) * up
+    hidden = with_logical_constraint(
+        hidden, (None, None, "expert", "mlp"), rules)
+    out_e = jnp.einsum("btef,efd->bted", hidden,
+                       params["w_down"].astype(dtype))
+    out = jnp.einsum("bte,bted->btd", w.astype(jnp.float32),
+                     out_e.astype(jnp.float32))
+    return out.astype(dtype)
+
+
 def moe_mlp(x: jnp.ndarray, params: dict, cfg: MoEConfig, *,
             rules: LogicalRules = DEFAULT_RULES):
     """x (B, T, d) → (B, T, d), plus auxiliary losses dict."""
