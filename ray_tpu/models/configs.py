@@ -23,6 +23,15 @@ BENCH_350M = TransformerConfig(
     n_kv_heads=16, d_ff=4096, max_seq_len=2048,
 )
 
+# ~1.4B GPT-2-XL-class bench point: fits a 16GB-HBM chip with remat +
+# bf16 compute + a FACTORED optimizer (adafactor — fp32 Adam m/v alone
+# would be ~11GB; factored second moments are the standard big-model-on-
+# small-HBM choice, as in T5/PaLM training).
+BENCH_1B4 = TransformerConfig(
+    name="bench-1b4", vocab_size=32000, d_model=2048, n_layers=20,
+    n_heads=16, n_kv_heads=16, d_ff=8192, max_seq_len=2048,
+)
+
 LLAMA2_7B = TransformerConfig(
     name="llama2-7b", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
     n_kv_heads=32, d_ff=11008, max_seq_len=4096,
@@ -45,7 +54,8 @@ MIXTRAL_8X7B = TransformerConfig(
     rope_theta=1000000.0, n_experts=8, expert_top_k=2,
 )
 
-REGISTRY = {c.name: c for c in [TINY, GPT2_124M, BENCH_350M, LLAMA2_7B,
+REGISTRY = {c.name: c for c in [TINY, GPT2_124M, BENCH_350M, BENCH_1B4,
+                                LLAMA2_7B,
                                 LLAMA3_8B, TINY_MOE, MIXTRAL_8X7B]}
 
 

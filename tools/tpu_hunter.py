@@ -38,8 +38,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG_PATH = os.path.join(REPO, "tools", "tpu_hunter.log")
 HISTORY = os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl")
-ARTIFACTS = ("BENCH_TPU_LAST_GOOD.json", "BENCH_SERVE_TPU_LAST_GOOD.json",
+ARTIFACTS = ("BENCH_TPU_LAST_GOOD.json", "BENCH_TPU_1B4_LAST_GOOD.json",
+             "BENCH_SERVE_TPU_LAST_GOOD.json",
              "BENCH_SERVE_124M_TPU_LAST_GOOD.json",
+             "BENCH_SERVE_350M_TPU_LAST_GOOD.json",
              "BENCH_TPU_HISTORY.jsonl")
 
 
@@ -155,6 +157,20 @@ def main() -> None:
             if '"recorded": false' in out:
                 break   # tunnel dropped mid-window: stop the sweep
 
+        # The ~1.4B GPT-2-XL-class point (BENCH_TPU_1B4_LAST_GOOD.json):
+        # adafactor + remat, batch 4; fewer steps — each step is ~16x
+        # the 350M step's FLOPs.
+        out = run_recorded(
+            [sys.executable, "bench.py", "--record"], 2400,
+            {"RAY_TPU_BENCH_PROBE_TIMEOUT_S": "90",
+             "RAY_TPU_BENCH_PROBE_RETRIES": "1",
+             "RAY_TPU_BENCH_MODEL": "bench-1b4",
+             "RAY_TPU_BENCH_STEPS": "10"})
+        tail = (out.strip().splitlines()[-1][:300]
+                if out.strip() else "no output")
+        log(f"bench.py 1b4 --record: {tail}")
+        append_history("train_1b4", out)
+
         dout = run_recorded(
             [sys.executable, "tools/tpu_decompose_bench.py"], 1200, {})
         log(f"decompose: {dout.strip().splitlines()[-1][:200] if dout.strip() else 'no output'}")
@@ -174,6 +190,15 @@ def main() -> None:
              "--out", "BENCH_SERVE_124M_TPU_LAST_GOOD.json"], 1500, {})
         log(f"bench_serve 124m: {'ok' if 'serve_requests_per_second' in sout else sout[-200:]}")
         append_history("serve_124m", sout)
+        # 350M serve: the model size where the TPU clearly out-serves
+        # the CPU even through the ~10ms/step tunnel dispatch (the
+        # north-star artifact if 124M doesn't amortize it).
+        sout = run_recorded(
+            [sys.executable, "bench_serve.py", "--model", "bench-350m",
+             "--requests", "24", "--num-slots", "4", "--max-len", "192",
+             "--out", "BENCH_SERVE_350M_TPU_LAST_GOOD.json"], 2400, {})
+        log(f"bench_serve 350m: {'ok' if 'serve_requests_per_second' in sout else sout[-200:]}")
+        append_history("serve_350m", sout)
 
         commit_artifacts(
             "Record real-TPU bench evidence (tunnel-up window)")
