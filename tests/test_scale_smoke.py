@@ -1,0 +1,87 @@
+"""CI-sized scale smoke: the bench_scale.py probes at pytest scale
+(ref: release/benchmarks/distributed/test_many_tasks.py,
+test_many_actors.py scaled to a shared-CPU test box; full harness:
+bench_scale.py at the repo root)."""
+import time
+
+import pytest
+
+
+def test_task_flood_and_queue_drain(cluster_ray):
+    """A queued burst (all CPUs blocked) drains completely and in full
+    once released — the many_tasks/queued-flood shape."""
+    ray_tpu = cluster_ray
+
+    import os
+    import tempfile
+
+    @ray_tpu.remote(num_cpus=4)
+    def blocker(path):
+        import pathlib
+        import time as _t
+
+        while not pathlib.Path(path).exists():
+            _t.sleep(0.02)
+        return "released"
+
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    release = os.path.join(tempfile.mkdtemp(), "go")
+    b = blocker.remote(release)
+    time.sleep(0.3)
+    refs = [tick.remote(i) for i in range(2000)]
+    open(release, "w").close()
+    assert ray_tpu.get(b, timeout=60) == "released"
+    out = ray_tpu.get(refs, timeout=300)
+    assert out == list(range(2000))
+
+
+def test_actor_wave_create_ping_kill(cluster_ray):
+    """Sustained actor churn: waves of create+ping+kill leave no stuck
+    actors behind (the many_actors shape)."""
+    ray_tpu = cluster_ray
+
+    @ray_tpu.remote(num_cpus=0)
+    class Tiny:
+        def ping(self):
+            return 1
+
+    for _ in range(2):
+        batch = [Tiny.remote() for _ in range(6)]
+        assert ray_tpu.get([a.ping.remote() for a in batch],
+                           timeout=120) == [1] * 6
+        for a in batch:
+            ray_tpu.kill(a)
+    time.sleep(1.0)
+    alive = [a for a in ray_tpu.api._global_worker().gcs.call(
+        "ActorManager", "list_actors", timeout=30)
+        if a["state"] == "ALIVE" and a["cls_name"] == "Tiny"]
+    assert not alive, alive
+
+
+def test_many_args_many_returns_many_gets(cluster_ray):
+    """Single-node scalability shapes: wide arg lists, wide returns,
+    bulk get (ref: single_node/test_single_node.py)."""
+    ray_tpu = cluster_ray
+
+    arg_refs = [ray_tpu.put(i) for i in range(200)]
+
+    @ray_tpu.remote
+    def sink(*xs):
+        return sum(xs)
+
+    assert ray_tpu.get(sink.remote(*arg_refs),
+                       timeout=120) == sum(range(200))
+
+    n = 64
+
+    @ray_tpu.remote(num_returns=n)
+    def fan():
+        return list(range(n))
+
+    assert ray_tpu.get(list(fan.remote()), timeout=120) == list(range(n))
+
+    refs = [ray_tpu.put(i) for i in range(1500)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(1500))
