@@ -350,6 +350,12 @@ class OwnerService:
                 "pending": payload is None
                 and oid in self.core._pending_objects}
 
+    def borrow_update(self, events) -> dict:
+        """Batched borrow protocol deltas from a borrower: see
+        DistributedCoreWorker._ref_serialized."""
+        self.core.apply_borrow_update(events)
+        return {"ok": True}
+
 
 class DistributedCoreWorker:
     def __init__(
@@ -401,6 +407,7 @@ class DistributedCoreWorker:
             # use, or root spans would dangle (children reference a
             # parent the sink never saw).
             self.loop_thread.submit(self._span_flush_loop())
+        self.loop_thread.submit(self._borrow_sweep_loop())
         self.daemon = SyncRpcClient(daemon_address, self.loop_thread)
         self.store = ObjectStore(store_dir)
 
@@ -409,6 +416,15 @@ class DistributedCoreWorker:
         self._owned: set = set()                 # ObjectIDs owned here
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._free_batch: List[bytes] = []
+        # ---- borrow protocol state (see _ref_serialized) ----
+        # oid -> (count, expiry): transit = serialized-but-unregistered
+        # handoffs; borrow = registered remote borrowers.
+        self._transit_pins: Dict[ObjectID, Tuple[int, float]] = {}
+        self._borrow_pins: Dict[ObjectID, Tuple[int, float]] = {}
+        self._borrowed_owner: Dict[ObjectID, str] = {}
+        self._deferred_free: set = set()
+        self._borrow_outbox: Dict[str, list] = {}
+        self._borrow_flush_scheduled = False
         self._inline_cache: Dict[ObjectID, bytes] = {}
         # Task ids tombstoned by cancel(): queued entries are swept,
         # running tasks interrupted, retries suppressed. Entries are
@@ -474,7 +490,8 @@ class DistributedCoreWorker:
         self.job_runtime_env: Optional[dict] = None
 
         self._shutdown = False
-        install_refcounter(self._ref_added, self._ref_removed)
+        install_refcounter(self._ref_added, self._ref_removed,
+                           self._ref_serialized)
         if is_driver:
             if log_to_driver and os.environ.get(
                     "RAY_TPU_LOG_TO_DRIVER", "1") not in ("0", "false"):
@@ -543,9 +560,55 @@ class DistributedCoreWorker:
     # ------------------------------------------------------------------
     # reference counting / distributed GC
     # ------------------------------------------------------------------
-    def _ref_added(self, ref: ObjectRef) -> None:
+    # Borrow protocol (ref: reference_count.h borrower bookkeeping).
+    # Serializing an OWNED ref adds a TTL'd transit pin — the object
+    # cannot be freed while its ref rides a message to a borrower.
+    # Deserializing a borrowed ref queues a batched `borrow_add` to the
+    # owner (which converts one transit pin into a tracked borrow);
+    # dropping the last local ref queues `borrow_release`. An owned
+    # object whose local refcount hits zero while pinned defers its
+    # free until the pins clear. Backstops: transit pins expire
+    # TRANSIT_PIN_TTL_S after the LAST serialization; registered
+    # borrows expire BORROW_TTL_S after their last add/refresh, and
+    # live borrowers re-send refreshes every sweep — so a SIGKILLed
+    # borrower pins the owner's object for at most one TTL, not
+    # forever.
+    TRANSIT_PIN_TTL_S = 600.0
+    BORROW_TTL_S = 600.0
+
+    def _ref_serialized(self, ref: ObjectRef) -> None:
+        if self._shutdown:
+            return
+        oid = ref.id()
+        owner = ref.owner_address
         with self._lock:
-            self._refcounts[ref.id()] += 1
+            if oid in self._owned:
+                self._add_transit_pin_locked(oid)
+            elif owner and owner != self.address:
+                # Pass-through borrow: tell the owner a new transit is
+                # in flight (batched, best-effort; TTL at the owner).
+                self._queue_borrow_locked(owner, oid, "transit")
+
+    def _add_transit_pin_locked(self, oid: ObjectID) -> None:
+        # (count, expiry): ONE coarse expiry — TTL after the LAST
+        # serialization — instead of a per-serialization list, so a hot
+        # ref re-sent thousands of times costs O(1) state, at the cost
+        # of the whole count expiring together (a backstop, not the
+        # primary release path).
+        count, _ = self._transit_pins.get(oid, (0, 0.0))
+        self._transit_pins[oid] = (
+            count + 1, time.monotonic() + self.TRANSIT_PIN_TTL_S)
+
+    def _ref_added(self, ref: ObjectRef) -> None:
+        oid = ref.id()
+        owner = ref.owner_address
+        with self._lock:
+            n = self._refcounts[oid]
+            self._refcounts[oid] = n + 1
+            if (n == 0 and owner and owner != self.address
+                    and not self._shutdown):
+                self._borrowed_owner[oid] = owner
+                self._queue_borrow_locked(owner, oid, "add")
 
     def _ref_removed(self, ref: ObjectRef) -> None:
         if self._shutdown:
@@ -560,14 +623,148 @@ class DistributedCoreWorker:
         if n <= 1:
             del self._refcounts[oid]
             self._drop_lineage_locked(oid)
+            owner = self._borrowed_owner.pop(oid, None)
+            if owner is not None:
+                self._queue_borrow_locked(owner, oid, "release")
             if oid in self._owned:
-                self._owned.discard(oid)
-                self._inline_cache.pop(oid, None)
-                self._free_batch.append(oid.binary())
-                if len(self._free_batch) >= 100:
-                    self._flush_frees_locked()
+                if self._has_pins_locked(oid):
+                    # Borrowers (or in-flight handoffs) still reference
+                    # this object: free when the pins clear.
+                    self._deferred_free.add(oid)
+                    return
+                self._free_owned_locked(oid)
         else:
             self._refcounts[oid] = n - 1
+
+    def _free_owned_locked(self, oid: ObjectID) -> None:
+        self._owned.discard(oid)
+        self._deferred_free.discard(oid)
+        self._transit_pins.pop(oid, None)
+        self._borrow_pins.pop(oid, None)
+        self._inline_cache.pop(oid, None)
+        self._free_batch.append(oid.binary())
+        if len(self._free_batch) >= 100:
+            self._flush_frees_locked()
+
+    def _has_pins_locked(self, oid: ObjectID) -> bool:
+        now = time.monotonic()
+        borrow = self._borrow_pins.get(oid)
+        if borrow is not None:
+            count, expiry = borrow
+            if count > 0 and expiry > now:
+                return True
+            # Expired: the borrower stopped refreshing (crashed).
+            del self._borrow_pins[oid]
+        transit = self._transit_pins.get(oid)
+        if transit is not None:
+            count, expiry = transit
+            if count > 0 and expiry > now:
+                return True
+            del self._transit_pins[oid]
+        return False
+
+    def _queue_borrow_locked(self, owner: str, oid: ObjectID,
+                             kind: str) -> None:
+        self._borrow_outbox.setdefault(owner, []).append(
+            (kind, oid.binary()))
+        if not self._borrow_flush_scheduled:
+            self._borrow_flush_scheduled = True
+            try:
+                self.loop_thread.loop.call_soon_threadsafe(
+                    self._schedule_borrow_flush)
+            except Exception:  # noqa: BLE001 loop shutting down
+                self._borrow_flush_scheduled = False
+
+    def _schedule_borrow_flush(self) -> None:
+        # Small coalescing delay: a consume loop dropping hundreds of
+        # borrowed refs flushes one RPC per owner, not one per ref.
+        self.loop_thread.loop.call_later(
+            0.1, lambda: asyncio.ensure_future(self._flush_borrows()))
+
+    BORROW_FLUSH_RETRIES = 5
+
+    async def _flush_borrows(self) -> None:
+        with self._lock:
+            outbox, self._borrow_outbox = self._borrow_outbox, {}
+            self._borrow_flush_scheduled = False
+        for owner, events in outbox.items():
+            wire = [(kind, oid_b) for kind, oid_b, *_ in events]
+            try:
+                client = await self._aclient(owner)
+                await client.call(
+                    "Owner", "borrow_update", events=wire, timeout=10)
+            except Exception:  # noqa: BLE001
+                # Transient failure must NOT drop the events — a lost
+                # `add` would let a reachable owner free an object a
+                # live borrower holds. Re-queue with a retry budget;
+                # only a persistently unreachable (dead) owner drops
+                # them, and its objects die with it anyway.
+                keep = []
+                for kind, oid_b, *rest in events:
+                    attempts = (rest[0] if rest else 0) + 1
+                    if attempts < self.BORROW_FLUSH_RETRIES:
+                        keep.append((kind, oid_b, attempts))
+                if keep:
+                    with self._lock:
+                        self._borrow_outbox.setdefault(owner,
+                                                       []).extend(keep)
+                        if not self._borrow_flush_scheduled:
+                            self._borrow_flush_scheduled = True
+                            self.loop_thread.loop.call_later(
+                                1.0, lambda: asyncio.ensure_future(
+                                    self._flush_borrows()))
+
+    async def _borrow_sweep_loop(self) -> None:
+        """Periodic borrow maintenance: refresh this process's live
+        borrows at their owners (so their pins don't TTL out under us),
+        expire pins whose borrower never registered or crashed, and run
+        the deferred frees they were blocking."""
+        while not self._shutdown:
+            await asyncio.sleep(30.0)
+            with self._lock:
+                for oid, owner in self._borrowed_owner.items():
+                    self._queue_borrow_locked(owner, oid, "refresh")
+                for oid in list(self._deferred_free):
+                    if (not self._has_pins_locked(oid)
+                            and oid not in self._refcounts):
+                        self._free_owned_locked(oid)
+                self._flush_frees_locked()
+
+    def apply_borrow_update(self, events) -> None:
+        """Owner side of the protocol (called via OwnerService)."""
+        now = time.monotonic()
+        expiry = now + self.BORROW_TTL_S
+        with self._lock:
+            touched = set()
+            for kind, oid_b in events:
+                oid = ObjectID(oid_b)
+                touched.add(oid)
+                if kind == "add":
+                    count, _ = self._borrow_pins.get(oid, (0, 0.0))
+                    self._borrow_pins[oid] = (count + 1, expiry)
+                    # The handoff completed: retire one transit pin.
+                    tcount, texp = self._transit_pins.get(oid, (0, 0.0))
+                    if tcount > 1:
+                        self._transit_pins[oid] = (tcount - 1, texp)
+                    else:
+                        self._transit_pins.pop(oid, None)
+                elif kind == "refresh":
+                    pin = self._borrow_pins.get(oid)
+                    if pin is not None:
+                        self._borrow_pins[oid] = (pin[0], expiry)
+                elif kind == "release":
+                    count, _ = self._borrow_pins.get(oid, (0, 0.0))
+                    if count > 1:
+                        self._borrow_pins[oid] = (count - 1, expiry)
+                    else:
+                        self._borrow_pins.pop(oid, None)
+                elif kind == "transit":
+                    self._add_transit_pin_locked(oid)
+            for oid in touched:
+                if (oid in self._deferred_free
+                        and not self._has_pins_locked(oid)
+                        and oid not in self._refcounts):
+                    self._free_owned_locked(oid)
 
     def _pin_task_deps(self, deps, fut: Future) -> None:
         """Pin a submitted task's argument objects until it completes
@@ -724,6 +921,8 @@ class DistributedCoreWorker:
                  priority: Optional[int] = None) -> Any:
         oid = ref.id()
         backoff = 0.002
+        definite_misses = 0
+        first_miss_at: Optional[float] = None
         while True:
             # 1) inline cache
             payload = self._inline_cache.get(oid)
@@ -754,17 +953,39 @@ class DistributedCoreWorker:
             # eager store write — see OwnerService): on a directory
             # miss, ask the owner directly.
             owner = ref.owner_address
+            owner_definitely_missing = False
             if owner and owner != self.address:
-                got, producing = self._try_fetch_from_owner(oid, owner)
+                got, producing, absent = self._try_fetch_from_owner(
+                    oid, owner)
                 if got:
                     continue  # now in the inline cache
                 if producing:
                     # The owner is still running the producing task:
                     # not lost, keep polling.
                     num_locations = max(num_locations, 1)
+                owner_definitely_missing = absent
             # 5) object lost (no copies anywhere): lineage reconstruction
             if num_locations == 0 and self._maybe_reconstruct(oid, deadline):
                 continue
+            if num_locations == 0 and owner_definitely_missing \
+                    and not self._lineage.get(oid):
+                # Nobody has it, the owner isn't producing it, and we
+                # cannot reconstruct: surface the loss instead of
+                # polling forever (the borrow protocol makes this an
+                # exceptional state — owner death or pin-TTL expiry).
+                definite_misses += 1
+                now = time.monotonic()
+                if first_miss_at is None:
+                    first_miss_at = now
+                if definite_misses >= 10 and now - first_miss_at > 2.0:
+                    raise rexc.ObjectLostError(
+                        f"object {ref.hex()[:16]} exists nowhere: no "
+                        f"store copy, owner {owner} has no value and "
+                        f"is not producing it, and this process holds "
+                        f"no lineage to reconstruct it")
+            else:
+                definite_misses = 0
+                first_miss_at = None
             if deadline is not None and time.monotonic() >= deadline:
                 raise rexc.GetTimeoutError(ref.hex())
             time.sleep(backoff)
@@ -772,11 +993,14 @@ class DistributedCoreWorker:
 
     OWNER_CLIENT_CAP = 32
 
-    def _try_fetch_from_owner(self, oid: ObjectID,
-                              owner_addr: str) -> Tuple[bool, bool]:
+    def _try_fetch_from_owner(self, oid: ObjectID, owner_addr: str
+                              ) -> Tuple[bool, bool, bool]:
         """Fetch a small object from its owner's inline cache (ref:
         in-band small-object replies via GetObjectStatus). Returns
-        (fetched, owner_still_producing)."""
+        (fetched, owner_still_producing, definitely_absent) —
+        `definitely_absent` only when the owner ANSWERED and has
+        neither the value nor a producing task; an unreachable owner
+        is indeterminate (transient restarts must not read as loss)."""
         client = self._owner_clients.get(owner_addr)
         if client is None:
             client = self._owner_clients[owner_addr] = SyncRpcClient(
@@ -794,12 +1018,13 @@ class DistributedCoreWorker:
             rep = client.call("Owner", "get_object",
                               object_id=oid.binary(), timeout=10)
         except Exception:  # noqa: BLE001 owner gone/unreachable: the
-            return False, False   # directory/lineage path decides
+            return False, False, False   # directory/lineage path decides
         payload = rep.get("payload")
         if payload is None:
-            return False, bool(rep.get("pending"))
+            pending = bool(rep.get("pending"))
+            return False, pending, not pending
         self._cache_inline(oid, payload)
-        return True, False
+        return True, False, False
 
     def _try_pull_remote(self, oid: ObjectID,
                          priority: Optional[int] = None

@@ -38,7 +38,9 @@ def function_key(func_or_cls) -> bytes:
     return hashlib.sha1(blob).digest(), blob
 
 
-_EMPTY_ARGS_BLOB: Optional[bytes] = None
+# Eager (not lazy): deterministic across processes, so the unpack fast
+# path works in workers that never packed a no-arg call themselves.
+_EMPTY_ARGS_BLOB: bytes = serialization.dumps(([], {}))
 _EMPTY_DEPS: List[bytes] = []
 
 
@@ -53,9 +55,6 @@ def pack_args(args: List[Any], kwargs: Dict[str, Any],
     blob — zero serialization work per call.
     """
     if not args and not kwargs:
-        global _EMPTY_ARGS_BLOB
-        if _EMPTY_ARGS_BLOB is None:
-            _EMPTY_ARGS_BLOB = serialization.dumps(([], {}))
         return _EMPTY_ARGS_BLOB, _EMPTY_DEPS
 
     deps: List[bytes] = []
@@ -75,6 +74,10 @@ def pack_args(args: List[Any], kwargs: Dict[str, Any],
 def unpack_args(blob: bytes, fetch) -> Tuple[List[Any], Dict[str, Any]]:
     """Deserialize an args blob, resolving RefMarkers via
     `fetch(oid, owner_address)`."""
+    # No-arg fast path mirroring pack_args' cached blob: the dominant
+    # actor/task hot-path shape skips deserialization entirely.
+    if blob == _EMPTY_ARGS_BLOB:
+        return [], {}
     args, kwargs = serialization.deserialize(blob)
 
     def conv(v):

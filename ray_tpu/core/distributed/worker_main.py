@@ -314,7 +314,12 @@ class WorkerService:
         task_id = TaskID(task_id_b)
         for i, v in enumerate(values):
             oid = ObjectID.for_task_return(task_id, i + 1)
-            payload = serialization.dumps(v, is_error=is_error)
+            if v is None and not is_error:
+                # The most common return on control-flow hot paths
+                # (noop tasks, side-effect actors): one cached payload.
+                payload = _none_payload()
+            else:
+                payload = serialization.dumps(v, is_error=is_error)
             inline = payload if len(payload) <= self._max_inline else None
             if inline is not None:
                 # The caller consumes the inline copy from the reply and
@@ -974,6 +979,16 @@ class WorkerService:
     def ping(self) -> dict:
         return {"ok": True, "pid": os.getpid(),
                 "actor_id": self.actor_id}
+
+
+_NONE_PAYLOAD: Optional[bytes] = None
+
+
+def _none_payload() -> bytes:
+    global _NONE_PAYLOAD
+    if _NONE_PAYLOAD is None:
+        _NONE_PAYLOAD = serialization.dumps(None)
+    return _NONE_PAYLOAD
 
 
 def _mkref(oid: ObjectID, owner: Optional[str] = None):

@@ -46,8 +46,13 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        # Serializing a ref borrows it; the deserializing process registers
-        # the borrow with its own reference table.
+        # Serializing a ref hands it to another process: the engine's
+        # serialize hook PINS the object at its owner (a transit pin) so
+        # it cannot be freed before the receiver registers its borrow
+        # (ref: reference_count.h borrower bookkeeping — without the
+        # pin, an owner that drops its last local ref right after
+        # replying frees the object out from under the borrower).
+        _refcounter_serialize(self)
         return (_deserialize_ref, (self._id.binary(), self._owner))
 
     def __del__(self):
@@ -73,15 +78,18 @@ def _deserialize_ref(binary: bytes, owner: Optional[str]) -> ObjectRef:
 # Reference counting hooks — installed by the active engine. Default: no-op.
 _refcounter_add = lambda ref: None
 _refcounter_remove = lambda ref: None
+_refcounter_serialize = lambda ref: None
 
 
-def install_refcounter(add, remove) -> None:
-    global _refcounter_add, _refcounter_remove
+def install_refcounter(add, remove, serialize=None) -> None:
+    global _refcounter_add, _refcounter_remove, _refcounter_serialize
     _refcounter_add = add
     _refcounter_remove = remove
+    _refcounter_serialize = serialize or (lambda ref: None)
 
 
 def uninstall_refcounter() -> None:
-    global _refcounter_add, _refcounter_remove
+    global _refcounter_add, _refcounter_remove, _refcounter_serialize
     _refcounter_add = lambda ref: None
     _refcounter_remove = lambda ref: None
+    _refcounter_serialize = lambda ref: None

@@ -53,7 +53,8 @@ _PAGE = """<!doctype html>
  <section><h2>Logs
   <input id="logq" placeholder="actor/worker/job id (blank: all)"
          style="font-size:12px;margin-left:8px;padding:2px 6px">
-  <button id="logb" style="font-size:12px">tail</button></h2>
+  <button id="logb" style="font-size:12px">tail</button>
+  <button id="profb" style="font-size:12px">profile worker</button></h2>
   <pre id="logs" style="font-size:11.5px;max-height:260px;overflow:auto;
     background:#14161a;color:#d7dce2;padding:8px;border-radius:6px;
     margin:0"></pre></section>
@@ -120,6 +121,18 @@ async function tailLogs(){
  }catch(e){document.getElementById("logs").textContent="error: "+e}
 }
 document.getElementById("logb").onclick=tailLogs;
+document.getElementById("profb").onclick=async()=>{
+ const q=document.getElementById("logq").value.trim();
+ const el=document.getElementById("logs");
+ el.textContent="sampling 2s...";
+ try{
+  const r=await fetch(`/api/profile?duration=2&worker_id=${q}`);
+  if(!r.ok){el.textContent=await r.text();return}
+  const p=await r.json();
+  el.textContent=`worker ${p.worker_id.slice(0,12)} pid ${p.pid} — ${p.samples} samples\n`+
+   p.top.map(([f,n])=>`${(100*n/p.samples).toFixed(1).padStart(5)}%  ${f}`).join("\n");
+ }catch(e){el.textContent="error: "+e}
+};
 document.getElementById("addr").textContent=location.host;
 tick();setInterval(tick,2000);
 </script></body></html>"""
@@ -341,6 +354,58 @@ class DashboardHead:
                          overwrite=True)
         return self._json({"stopped": True})
 
+    async def _profile_worker(self, request):
+        """On-demand stack sampling of a live worker, from the UI/REST
+        (ref: dashboard/modules/reporter/profile_manager.py attaching
+        py-spy from the dashboard). `?worker_id=<prefix>` picks the
+        worker; `&duration=2` seconds; `&format=collapsed` returns
+        flamegraph-collapsed lines instead of the summary."""
+        from aiohttp import web
+
+        prefix = request.query.get("worker_id", "")
+        duration = min(30.0, float(request.query.get("duration", "2")))
+        fmt = request.query.get("format", "summary")
+        for n in await self._call("NodeInfo", "list_nodes"):
+            if not n["alive"]:
+                continue
+            daemon = AsyncRpcClient(n["address"])
+            try:
+                workers = await daemon.call("NodeDaemon", "list_workers",
+                                            timeout=10)
+            except Exception:  # noqa: BLE001
+                continue
+            finally:
+                await daemon.close()
+            for w in workers:
+                if not w.get("address") or not w.get("alive", True):
+                    continue
+                if prefix and not w["worker_id"].startswith(prefix):
+                    continue
+                client = AsyncRpcClient(w["address"])
+                try:
+                    report = await client.call(
+                        "Worker", "profile", duration_s=duration,
+                        timeout=duration + 30)
+                except Exception:  # noqa: BLE001 worker churned away
+                    continue       # between list and call: try the next
+                finally:
+                    await client.close()
+                if fmt == "collapsed":
+                    lines = [f"{stack} {cnt}" for stack, cnt in
+                             report["stacks"].items()]
+                    return web.Response(text="\n".join(lines),
+                                        content_type="text/plain")
+                return self._json({
+                    "worker_id": w["worker_id"], "pid": w.get("pid"),
+                    "node_id": n["node_id"],
+                    "samples": report["samples"],
+                    "duration_s": report["duration_s"],
+                    "top": report["top"],
+                })
+        return web.Response(status=404,
+                            text=f"no live worker matches "
+                                 f"{prefix!r}")
+
     async def _events(self, request):
         limit = int(request.query.get("limit", "500"))
         return self._json(await self._call("EventLog", "list_events",
@@ -422,6 +487,7 @@ class DashboardHead:
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/profile", self._profile_worker)
         app.router.add_get("/api/serve", self._serve)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()

@@ -550,6 +550,55 @@ class Dataset:
                 f, (tfrecord.encode_example(row)
                     for row in B.iter_rows(blk)))
 
+    def write_mongo(self, *, database: str, collection: str,
+                    uri: Optional[str] = None,
+                    client_factory=None) -> None:
+        """Insert every row into a MongoDB collection (ref: datasource/
+        mongo_datasource.py write path). `client_factory` is the same
+        injectable seam as `read_mongo`."""
+        if client_factory is None:
+            def client_factory():  # pragma: no cover - needs a mongod
+                import pymongo
+
+                return pymongo.MongoClient(uri)
+
+        client = client_factory()
+        try:
+            coll = client[database][collection]
+            for blk in self.iter_blocks():
+                rows = [dict(r) for r in B.iter_rows(blk)]
+                if rows:
+                    coll.insert_many(rows)
+        finally:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def write_bigquery(self, *, dataset: str,
+                       project_id: Optional[str] = None,
+                       client_factory=None) -> None:
+        """Load every block into a BigQuery table (ref: datasource/
+        bigquery_datasource.py write path); `dataset` is
+        "dataset.table"."""
+        if client_factory is None:
+            def client_factory():  # pragma: no cover - needs GCP creds
+                from google.cloud import bigquery
+
+                return bigquery.Client(project=project_id)
+
+        client = client_factory()
+        try:
+            for blk in self.iter_blocks():
+                job = client.load_table_from_dataframe(blk.to_pandas(),
+                                                       dataset)
+                job.result()
+        finally:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 fakes without close()
+                pass
+
     def _write(self, path: str, fmt: str) -> None:
         import os
 

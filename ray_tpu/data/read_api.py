@@ -298,14 +298,93 @@ def read_sql(sql: str, connection_factory, **_kw) -> Dataset:
     return Dataset([ReadTask(read_one, name="read_sql")])
 
 
-def read_mongo(*args, **kwargs):
-    raise ImportError(
-        "read_mongo needs the `pymongo` package, which is not available "
-        "in this environment; load via read_sql/read_parquet instead")
+def read_mongo(uri: Optional[str] = None, database: Optional[str] = None,
+               collection: Optional[str] = None, *,
+               pipeline: Optional[List[dict]] = None,
+               pipelines: Optional[List[List[dict]]] = None,
+               client_factory=None, **_kw) -> Dataset:
+    """Read a MongoDB collection (ref: datasource/mongo_datasource.py).
+
+    Positional shape matches the reference: (uri, database, collection).
+    `client_factory` is the injectable seam (same idiom as `read_sql`'s
+    connection_factory and the GCP provider transport): any callable
+    returning a pymongo.MongoClient-compatible object — tests inject a
+    fake, production omits it and pymongo connects to `uri`. Pass
+    `pipelines` (a list of aggregation pipelines) to shard the read
+    into one task per pipeline; `pipeline` alone reads in one task."""
+    if not database or not collection:
+        raise ValueError("read_mongo needs `database` and `collection`")
+    if client_factory is None:
+        def client_factory():  # pragma: no cover - needs a live mongod
+            try:
+                import pymongo
+            except ImportError as e:
+                raise ImportError(
+                    "read_mongo needs `pymongo` (or pass "
+                    "client_factory=)") from e
+            return pymongo.MongoClient(uri)
+
+    shards = pipelines if pipelines is not None else [pipeline or []]
+
+    def make_read(shard_pipeline):
+        def read_one(_unused=None):
+            client = client_factory()
+            try:
+                coll = client[database][collection]
+                rows = [dict(doc) for doc in
+                        (coll.aggregate(shard_pipeline)
+                         if shard_pipeline else coll.find())]
+            finally:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            for r in rows:
+                r.pop("_id", None)   # ObjectId is not arrow-encodable
+            if not rows:
+                return pa.table({})
+            return B.from_rows(rows)
+
+        return read_one
+
+    return Dataset([ReadTask(make_read(p), name="read_mongo")
+                    for p in shards])
 
 
-def read_bigquery(*args, **kwargs):
-    raise ImportError(
-        "read_bigquery needs `google-cloud-bigquery`, which is not "
-        "available in this environment; export to parquet/GCS and use "
-        "read_parquet instead")
+def read_bigquery(project_id: Optional[str] = None, *,
+                  dataset: Optional[str] = None,
+                  query: Optional[str] = None,
+                  client_factory=None, **_kw) -> Dataset:
+    """Read a BigQuery table or query result (ref: datasource/
+    bigquery_datasource.py — same (project_id, dataset=, query=) shape
+    as the reference's read_bigquery). `client_factory` returns a
+    google.cloud.bigquery.Client-compatible object (tests inject a
+    fake); `dataset` is "dataset.table" when `query` is None."""
+    if query is None:
+        if not dataset:
+            raise ValueError("read_bigquery needs `query` or `dataset`")
+        query = f"SELECT * FROM `{dataset}`"
+
+    if client_factory is None:
+        def client_factory():  # pragma: no cover - needs GCP creds
+            try:
+                from google.cloud import bigquery
+            except ImportError as e:
+                raise ImportError(
+                    "read_bigquery needs `google-cloud-bigquery` (or "
+                    "pass client_factory=)") from e
+            return bigquery.Client(project=project_id)
+
+    def read_one(_unused=None):
+        client = client_factory()
+        result = client.query(query).result()
+        to_arrow = getattr(result, "to_arrow", None)
+        if to_arrow is not None:
+            return to_arrow()
+        rows = [dict(r.items()) if hasattr(r, "items") else dict(r)
+                for r in result]
+        if not rows:
+            return pa.table({})
+        return B.from_rows(rows)
+
+    return Dataset([ReadTask(read_one, name="read_bigquery")])

@@ -101,3 +101,23 @@ def test_dashboard_serve_logs_events(dash_cluster):
     assert status == 200
     streams = json.loads(body)
     assert all("lines" in s for s in streams)
+
+
+def test_dashboard_profile_endpoint(dash_cluster):
+    """On-demand worker stack sampling over REST (ref: dashboard
+    profiling via reporter/profile_manager.py)."""
+    import ray_tpu
+
+    cluster, port = dash_cluster
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    assert ray_tpu.get(warm.remote(), timeout=60) == 1
+    status, body = _get(port, "/api/profile?duration=0.5")
+    assert status == 200
+    rep = json.loads(body)
+    assert rep["samples"] > 0 and rep["worker_id"]
+    status, body = _get(port, "/api/profile?duration=0.5&format=collapsed")
+    assert status == 200 and b";" in body

@@ -127,3 +127,52 @@ def test_streaming_generator_across_nodes():
         assert all(v.shape == (120_000,) for v in vals)
     finally:
         cluster.shutdown()
+
+
+def test_five_node_spread_and_broadcast():
+    """5 daemons: SPREAD placement reaches ≥4 nodes, and one object
+    broadcasts to consumers on every node (the interesting pull-manager
+    races live above 2 nodes — ref: many_nodes release test shape)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.task_spec import SpreadSchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    for _ in range(4):
+        cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes(5)
+    try:
+        @ray_tpu.remote(num_cpus=1,
+                        scheduling_strategy=SpreadSchedulingStrategy())
+        def whereami():
+            import time as _t
+
+            _t.sleep(0.3)   # dwell so placement, not lease reuse, decides
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        nodes = ray_tpu.get([whereami.remote() for _ in range(15)],
+                            timeout=300)
+        assert len(set(nodes)) >= 4, set(nodes)
+
+        # 4 MB object produced once, consumed on every node via the
+        # chunked pull path (dedup: concurrent pulls of the same oid).
+        payload = np.arange(500_000, dtype=np.float64)
+        ref = ray_tpu.put(payload)
+
+        @ray_tpu.remote(num_cpus=1,
+                        scheduling_strategy=SpreadSchedulingStrategy())
+        def consume(arr):
+            import time as _t
+
+            _t.sleep(0.2)
+            return float(arr.sum())
+
+        sums = ray_tpu.get([consume.remote(ref) for _ in range(10)],
+                           timeout=300)
+        assert all(s == payload.sum() for s in sums)
+    finally:
+        cluster.shutdown()
