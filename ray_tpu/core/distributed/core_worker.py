@@ -82,7 +82,10 @@ class _TaskLane:
     # spreads them across nodes (the reference schedules per-task and
     # gets spread for free; lease-reuse batching must buy it back).
     BATCH = 64
-    FIRST_BATCH = 8   # before any duration sample exists
+    # Before any duration sample exists: small, so a burst of unknown
+    # (possibly long) tasks doesn't serialize 8-deep on one worker
+    # while other nodes idle; one observed batch later the cap adapts.
+    FIRST_BATCH = 2
     # Lease time-slice: return the lease after this many batches even if
     # work remains (re-request immediately). The daemon can't reclaim a
     # held lease, so a lane that drains its whole queue on one lease
@@ -198,6 +201,7 @@ class _TaskLane:
         cfg = get_config()
         sched = self.sched
         daemon_addr = self.core.daemon_address
+        parked = False
         for _ in range(16):  # bounded spillback hops
             daemon = await self.core._aclient(daemon_addr)
             grant = await daemon.call(
@@ -205,10 +209,14 @@ class _TaskLane:
                 strategy=sched["strategy"], affinity=sched["affinity"],
                 soft=sched["soft"], placement=sched["placement"],
                 runtime_env=sched.get("runtime_env"),
-                job_id=self.core.job_id,
+                job_id=self.core.job_id, parked=parked,
                 timeout=cfg.worker_lease_timeout_ms / 1000)
             if grant.get("spill_to"):
                 daemon_addr = grant["spill_to"]
+                # A "park" spill is terminal: the target queues us until
+                # capacity frees instead of re-spilling on ITS stale
+                # view (stops spread-spill ping-pong across busy nodes).
+                parked = bool(grant.get("park"))
                 continue
             if not grant.get("granted"):
                 if grant.get("transient"):
@@ -256,24 +264,73 @@ class _TaskLane:
             for s, _ in batch:
                 self.core._task_locations[s["task_id"]] = \
                     grant["worker_address"]
+            # Per-task STREAMED replies: the batch executes sequentially
+            # on one lease, but each task's reply lands as soon as IT
+            # finishes — a quick task's waiter is never gated on a slow
+            # batchmate. (Pre-owner-serving this visibility came from
+            # the executing worker's eager store write + directory
+            # registration; with owner-served results the reply IS the
+            # visibility.)
             push_t0 = time.monotonic()
+            answered = [False] * len(batch)
+            requeued = False
             try:
-                replies = await worker.call(
-                    "Worker", "push_tasks",
-                    specs=[s for s, _ in batch], timeout=None)
+                async for i, reply in worker.stream(
+                        "Worker", "push_tasks_stream",
+                        specs=[s for s, _ in batch]):
+                    spec, fut = batch[i]
+                    answered[i] = True
+                    self.core._task_locations.pop(spec["task_id"], None)
+                    if reply.get("requeue"):
+                        # Worker retiring (max_calls): the spec never
+                        # ran — requeue WITHOUT charging its retry
+                        # budget, bounded like connection retries.
+                        n = spec.get("_lane_retries", 0) + 1
+                        spec["_lane_retries"] = n
+                        if n > self.MAX_BATCH_RETRIES:
+                            if not fut.done():
+                                fut.set_result({
+                                    "results": [],
+                                    "error": rexc.WorkerCrashedError(
+                                        "worker kept retiring under "
+                                        "max_calls pressure")})
+                        else:
+                            self.queue.append((spec, fut))
+                            requeued = True
+                        continue
+                    if not fut.done():
+                        fut.set_result(reply)
+                # A stream that ENDED OK must have answered every spec;
+                # requeue any gap defensively rather than stranding its
+                # future forever.
+                for (spec, fut), done in zip(batch, answered):
+                    if done or fut.done():
+                        continue
+                    n = spec.get("_lane_retries", 0) + 1
+                    spec["_lane_retries"] = n
+                    if n > self.MAX_BATCH_RETRIES:
+                        fut.set_exception(rexc.WorkerCrashedError(
+                            "batch stream ended without this task's "
+                            "reply"))
+                    else:
+                        self.queue.append((spec, fut))
+                        requeued = True
             except asyncio.CancelledError:
                 # Event-loop shutdown, not a worker death: cancel the
-                # batch instead of re-queueing it forever.
-                for _, fut in batch:
-                    if not fut.done():
+                # unanswered remainder instead of re-queueing forever.
+                for (spec, fut), done in zip(batch, answered):
+                    if not done and not fut.done():
                         fut.cancel()
                 raise
             except Exception as e:  # noqa: BLE001
-                # Worker likely died mid-batch: re-queue the batch (fresh
-                # leases redistribute it) instead of charging every task a
-                # full retry attempt for one worker's death.
+                # Worker likely died mid-batch: re-queue the UNANSWERED
+                # specs (fresh leases redistribute them) instead of
+                # charging each a full retry attempt; answered ones
+                # already completed.
                 err = e
-                for spec, fut in batch:
+                for (spec, fut), done in zip(batch, answered):
+                    if done:
+                        continue
                     n = spec.get("_lane_retries", 0) + 1
                     spec["_lane_retries"] = n
                     if n > self.MAX_BATCH_RETRIES:
@@ -294,27 +351,6 @@ class _TaskLane:
                 # scaling already happened at the old, larger cap).
                 self._maybe_scale()
             batches_run += 1
-            requeued = False
-            for (spec, fut), reply in zip(batch, replies):
-                if reply.get("requeue"):
-                    # Worker retiring (max_calls): the spec never ran —
-                    # requeue WITHOUT charging its retry budget, bounded
-                    # like connection-level retries.
-                    n = spec.get("_lane_retries", 0) + 1
-                    spec["_lane_retries"] = n
-                    if n > self.MAX_BATCH_RETRIES:
-                        if not fut.done():
-                            fut.set_result({
-                                "results": [],
-                                "error": rexc.WorkerCrashedError(
-                                    "worker kept retiring under "
-                                    "max_calls pressure")})
-                    else:
-                        self.queue.append((spec, fut))
-                        requeued = True
-                    continue
-                if not fut.done():
-                    fut.set_result(reply)
             if requeued:
                 self.wakeup.set()
                 self._maybe_scale()
@@ -417,9 +453,10 @@ class DistributedCoreWorker:
         self._refcounts: Dict[ObjectID, int] = defaultdict(int)
         self._free_batch: List[bytes] = []
         # ---- borrow protocol state (see _ref_serialized) ----
-        # oid -> (count, expiry): transit = serialized-but-unregistered
-        # handoffs; borrow = registered remote borrowers.
-        self._transit_pins: Dict[ObjectID, Tuple[int, float]] = {}
+        # transit: oid -> expiry (serialized-but-unregistered handoffs,
+        # one coarse window); borrow: oid -> (count, expiry) registered
+        # remote borrowers.
+        self._transit_pins: Dict[ObjectID, float] = {}
         self._borrow_pins: Dict[ObjectID, Tuple[int, float]] = {}
         self._borrowed_owner: Dict[ObjectID, str] = {}
         self._deferred_free: set = set()
@@ -589,15 +626,19 @@ class DistributedCoreWorker:
                 # in flight (batched, best-effort; TTL at the owner).
                 self._queue_borrow_locked(owner, oid, "transit")
 
+    # Once SOME borrower registered, remaining in-flight handoffs get
+    # this grace to register before the transit pin may lapse (borrow
+    # pins protect the object from then on). Counting pins per handoff
+    # and retiring one per `add` would mis-pair under broadcast (one
+    # serialization, N deserializers) and could steal an unrelated
+    # handoff's protection — a single coarse expiry cannot.
+    TRANSIT_GRACE_S = 60.0
+
     def _add_transit_pin_locked(self, oid: ObjectID) -> None:
-        # (count, expiry): ONE coarse expiry — TTL after the LAST
-        # serialization — instead of a per-serialization list, so a hot
-        # ref re-sent thousands of times costs O(1) state, at the cost
-        # of the whole count expiring together (a backstop, not the
-        # primary release path).
-        count, _ = self._transit_pins.get(oid, (0, 0.0))
-        self._transit_pins[oid] = (
-            count + 1, time.monotonic() + self.TRANSIT_PIN_TTL_S)
+        # ONE coarse expiry — TTL after the LAST serialization — so a
+        # hot ref re-sent thousands of times costs O(1) state.
+        self._transit_pins[oid] = \
+            time.monotonic() + self.TRANSIT_PIN_TTL_S
 
     def _ref_added(self, ref: ObjectRef) -> None:
         oid = ref.id()
@@ -655,10 +696,9 @@ class DistributedCoreWorker:
                 return True
             # Expired: the borrower stopped refreshing (crashed).
             del self._borrow_pins[oid]
-        transit = self._transit_pins.get(oid)
-        if transit is not None:
-            count, expiry = transit
-            if count > 0 and expiry > now:
+        expiry = self._transit_pins.get(oid)
+        if expiry is not None:
+            if expiry > now:
                 return True
             del self._transit_pins[oid]
         return False
@@ -706,8 +746,12 @@ class DistributedCoreWorker:
                         keep.append((kind, oid_b, attempts))
                 if keep:
                     with self._lock:
-                        self._borrow_outbox.setdefault(owner,
-                                                       []).extend(keep)
+                        # PREPEND: a release queued during the retry
+                        # window must not be applied before the failed
+                        # add it pairs with (events are order-sensitive
+                        # per oid).
+                        existing = self._borrow_outbox.get(owner, [])
+                        self._borrow_outbox[owner] = keep + existing
                         if not self._borrow_flush_scheduled:
                             self._borrow_flush_scheduled = True
                             self.loop_thread.loop.call_later(
@@ -742,12 +786,14 @@ class DistributedCoreWorker:
                 if kind == "add":
                     count, _ = self._borrow_pins.get(oid, (0, 0.0))
                     self._borrow_pins[oid] = (count + 1, expiry)
-                    # The handoff completed: retire one transit pin.
-                    tcount, texp = self._transit_pins.get(oid, (0, 0.0))
-                    if tcount > 1:
-                        self._transit_pins[oid] = (tcount - 1, texp)
-                    else:
-                        self._transit_pins.pop(oid, None)
+                    # A borrower registered: shorten (never extend) the
+                    # transit window — other still-in-flight handoffs
+                    # get TRANSIT_GRACE_S to register; after that the
+                    # borrow pins carry the object.
+                    texp = self._transit_pins.get(oid)
+                    if texp is not None:
+                        self._transit_pins[oid] = min(
+                            texp, now + self.TRANSIT_GRACE_S)
                 elif kind == "refresh":
                     pin = self._borrow_pins.get(oid)
                     if pin is not None:

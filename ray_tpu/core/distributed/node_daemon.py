@@ -642,9 +642,10 @@ class NodeDaemon:
                             soft: bool = False,
                             placement: Optional[Tuple[str, int]] = None,
                             runtime_env: Optional[dict] = None,
-                            job_id: str = "") -> dict:
+                            job_id: str = "",
+                            parked: bool = False) -> dict:
         reply = await self._request_lease(demand, strategy, affinity, soft,
-                                          placement, runtime_env)
+                                          placement, runtime_env, parked)
         if job_id and reply.get("granted"):
             # Log attribution: worker lines stream to the leasing job's
             # driver (ref: log records carry the worker's job).
@@ -658,8 +659,8 @@ class NodeDaemon:
                              affinity: Optional[str] = None,
                              soft: bool = False,
                              placement: Optional[Tuple[str, int]] = None,
-                             runtime_env: Optional[dict] = None
-                             ) -> dict:
+                             runtime_env: Optional[dict] = None,
+                             parked: bool = False) -> dict:
         cfg = get_config()
         # Placement-group leases draw from the reserved bundle.
         if placement is not None:
@@ -758,12 +759,35 @@ class NodeDaemon:
             self._ledger("sub:direct", demand)
             return await self._grant_safely(demand, None, runtime_env)
 
-        # Local node busy: consider spilling (hybrid policy).
-        node = pick_node(self._view, demand, strategy=strategy,
-                         local_node_id=self.node_id,
-                         spread_threshold=cfg.scheduler_spread_threshold)
+        # Local node busy: consider spilling (hybrid policy). A PARKED
+        # request (terminal spill target) queues here instead.
+        node = (None if parked else
+                pick_node(self._view, demand, strategy=strategy,
+                          local_node_id=self.node_id,
+                          spread_threshold=cfg.scheduler_spread_threshold))
         if node is not None and node.node_id != self.node_id:
             return {"spill_to": node.address}
+        if strategy == "spread" and not parked:
+            # SPREAD must not park behind local capacity: the 1 Hz view
+            # can lag the local grant that just consumed our CPUs, so
+            # pick_node tie-breaks to the (apparently idle) local node —
+            # and parked waiters only re-pump on LOCAL release, so a
+            # burst of spread tasks serializes on one node while the
+            # rest of the cluster idles. Any other fitting node beats
+            # waiting here. `park: True` makes the spill terminal: the
+            # target queues the request rather than re-spilling on ITS
+            # stale view (no ping-pong between busy nodes).
+            others = [n for n in self._view.alive_nodes()
+                      if n.node_id != self.node_id
+                      and rs.fits(n.available, demand)]
+            if others:
+                # UNIFORM choice, not least-utilized-first: a burst of
+                # waiters all consulting the same stale view would pile
+                # onto one "least utilized" target and serialize there.
+                import random as _random
+
+                return {"spill_to": _random.choice(others).address,
+                        "park": True}
         return await self._wait_for_lease(demand, None, runtime_env)
 
     async def _wait_for_lease(self, demand, placement,

@@ -690,25 +690,54 @@ class WorkerService:
         self._maybe_retire()
         return reply
 
-    async def push_tasks(self, specs: List[dict]) -> List[dict]:
-        """Batched task push from a lease-reuse lane. Executes the batch
-        SEQUENTIALLY in one pool slot: the whole batch rides a single
-        lease, so running specs in parallel would oversubscribe the
-        resources that lease reserved (parallelism comes from the lane
-        holding multiple leases, each its own batch)."""
+    async def push_tasks_stream(self, specs: List[dict]):
+        """Batched task push from a lease-reuse lane, with STREAMED
+        `(index, reply)` items. The batch executes SEQUENTIALLY in one
+        pool slot — the whole batch rides a single lease, so running
+        specs in parallel would oversubscribe the resources that lease
+        reserved (parallelism comes from the lane holding multiple
+        leases, each its own batch) — but each task's reply leaves the
+        worker as soon as IT finishes, so a fast task's caller — a
+        get()/wait() at the owner — is never gated on a slow
+        batchmate. With owner-served small results the reply IS result
+        visibility, which is why per-task delivery matters (ref: the
+        reference pushes tasks individually and gets this for free)."""
         loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
 
         def run_all():
-            return [self._execute(s) for s in specs]
+            # The end sentinel is UNCONDITIONAL: an exception escaping
+            # _execute (stray injected interrupt between tasks, store
+            # failure in a pre-try region) must not strand the stream —
+            # the lane would wait forever on a batch that never ends.
+            try:
+                for i, s in enumerate(specs):
+                    reply = self._execute(s)
+                    loop.call_soon_threadsafe(q.put_nowait, (i, reply))
+            except BaseException as e:  # noqa: BLE001
+                logger.exception("batch executor died mid-stream")
+                raise e
+            finally:
+                try:
+                    loop.call_soon_threadsafe(q.put_nowait, None)
+                except RuntimeError:
+                    pass   # loop closing; the connection dies with it
 
         try:
-            replies = await loop.run_in_executor(self._task_pool, run_all)
+            pool_fut = loop.run_in_executor(self._task_pool, run_all)
         except RuntimeError:
             # Retirement drain closed the pool mid-push: see push_task.
-            return [{"requeue": True, "results": [], "error": None}
-                    for _ in specs]
+            for i in range(len(specs)):
+                yield (i, {"requeue": True, "results": [],
+                           "error": None})
+            return
+        while True:
+            item = await q.get()
+            if item is None:
+                break
+            yield item
+        await pool_fut
         self._maybe_retire()
-        return replies
 
     async def create_actor(self, actor_id: str, cls_blob_key: bytes,
                            args_blob: bytes,

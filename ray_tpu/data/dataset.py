@@ -555,7 +555,10 @@ class Dataset:
                     client_factory=None) -> None:
         """Insert every row into a MongoDB collection (ref: datasource/
         mongo_datasource.py write path). `client_factory` is the same
-        injectable seam as `read_mongo`."""
+        injectable seam as `read_mongo`. Blocks stream through the
+        DRIVER sequentially — sink writes are correctness-first here;
+        distribute by mapping a write over shards yourself if the sink
+        is the bottleneck."""
         if client_factory is None:
             def client_factory():  # pragma: no cover - needs a mongod
                 import pymongo

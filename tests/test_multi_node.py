@@ -154,7 +154,9 @@ def test_five_node_spread_and_broadcast():
             _t.sleep(0.3)   # dwell so placement, not lease reuse, decides
             return ray_tpu.get_runtime_context().get_node_id()
 
-        nodes = ray_tpu.get([whereami.remote() for _ in range(15)],
+        # Enough work that every node's cold worker spawn (~1s each on
+        # a busy 1-CPU host) amortizes: the burst outlives the spawns.
+        nodes = ray_tpu.get([whereami.remote() for _ in range(40)],
                             timeout=300)
         assert len(set(nodes)) >= 4, set(nodes)
 
