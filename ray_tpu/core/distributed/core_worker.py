@@ -275,37 +275,41 @@ class _TaskLane:
             answered = [False] * len(batch)
             requeued = False
             try:
-                async for i, reply in worker.stream(
+                async for chunk in worker.stream(
                         "Worker", "push_tasks_stream",
                         specs=[s for s, _ in batch]):
-                    spec, fut = batch[i]
-                    answered[i] = True
-                    self.core._task_locations.pop(spec["task_id"], None)
-                    if reply.get("requeue"):
-                        # Worker retiring (max_calls): the spec never
-                        # ran — requeue WITHOUT charging its retry
-                        # budget, bounded like connection retries.
-                        n = spec.get("_lane_retries", 0) + 1
-                        spec["_lane_retries"] = n
-                        if n > self.MAX_BATCH_RETRIES:
-                            if not fut.done():
-                                fut.set_result({
-                                    "results": [],
-                                    "error": rexc.WorkerCrashedError(
-                                        "worker kept retiring under "
-                                        "max_calls pressure")})
-                        else:
-                            self.queue.append((spec, fut))
-                            requeued = True
-                        continue
-                    if not fut.done():
-                        fut.set_result(reply)
+                    for i, reply in chunk:
+                        spec, fut = batch[i]
+                        answered[i] = True
+                        self.core._task_locations.pop(spec["task_id"],
+                                                      None)
+                        if reply.get("requeue"):
+                            # Worker retiring (max_calls): the spec
+                            # never ran — requeue WITHOUT charging its
+                            # retry budget, bounded like connection
+                            # retries.
+                            n = spec.get("_lane_retries", 0) + 1
+                            spec["_lane_retries"] = n
+                            if n > self.MAX_BATCH_RETRIES:
+                                if not fut.done():
+                                    fut.set_result({
+                                        "results": [],
+                                        "error": rexc.WorkerCrashedError(
+                                            "worker kept retiring under "
+                                            "max_calls pressure")})
+                            else:
+                                self.queue.append((spec, fut))
+                                requeued = True
+                            continue
+                        if not fut.done():
+                            fut.set_result(reply)
                 # A stream that ENDED OK must have answered every spec;
                 # requeue any gap defensively rather than stranding its
                 # future forever.
                 for (spec, fut), done in zip(batch, answered):
                     if done or fut.done():
                         continue
+                    self.core._task_locations.pop(spec["task_id"], None)
                     n = spec.get("_lane_retries", 0) + 1
                     spec["_lane_retries"] = n
                     if n > self.MAX_BATCH_RETRIES:
@@ -319,18 +323,25 @@ class _TaskLane:
                 # Event-loop shutdown, not a worker death: cancel the
                 # unanswered remainder instead of re-queueing forever.
                 for (spec, fut), done in zip(batch, answered):
-                    if not done and not fut.done():
-                        fut.cancel()
+                    if not done:
+                        self.core._task_locations.pop(spec["task_id"],
+                                                      None)
+                        if not fut.done():
+                            fut.cancel()
                 raise
             except Exception as e:  # noqa: BLE001
                 # Worker likely died mid-batch: re-queue the UNANSWERED
                 # specs (fresh leases redistribute them) instead of
                 # charging each a full retry attempt; answered ones
-                # already completed.
+                # already completed. Locations pop per-spec BEFORE the
+                # requeue (a blanket pop afterwards would clobber the
+                # fresh location another pursuer may already have set
+                # for a re-pushed spec, breaking cancel routing).
                 err = e
                 for (spec, fut), done in zip(batch, answered):
                     if done:
                         continue
+                    self.core._task_locations.pop(spec["task_id"], None)
                     n = spec.get("_lane_retries", 0) + 1
                     spec["_lane_retries"] = n
                     if n > self.MAX_BATCH_RETRIES:
@@ -341,9 +352,6 @@ class _TaskLane:
                 self.wakeup.set()
                 self._maybe_scale()
                 return  # drop this lease; the worker may be gone
-            finally:
-                for s, _ in batch:
-                    self.core._task_locations.pop(s["task_id"], None)
             self._observe_batch(len(batch), time.monotonic() - push_t0)
             if self.queue:
                 # Slow tasks shrink the cap AFTER the first batch; give
@@ -462,6 +470,7 @@ class DistributedCoreWorker:
         self._deferred_free: set = set()
         self._borrow_outbox: Dict[str, list] = {}
         self._borrow_flush_scheduled = False
+        self._borrow_flush_lock: Optional[asyncio.Lock] = None
         self._inline_cache: Dict[ObjectID, bytes] = {}
         # Task ids tombstoned by cancel(): queued entries are swept,
         # running tasks interrupted, retries suppressed. Entries are
@@ -724,6 +733,16 @@ class DistributedCoreWorker:
     BORROW_FLUSH_RETRIES = 5
 
     async def _flush_borrows(self) -> None:
+        # Serialized: two concurrent flush bodies could deliver a
+        # 'release' (queued during the first flush's failing RPC) ahead
+        # of the 'add' it pairs with — the owner would then hold a
+        # count-1 borrow pin no borrower ever releases (until TTL).
+        if self._borrow_flush_lock is None:
+            self._borrow_flush_lock = asyncio.Lock()
+        async with self._borrow_flush_lock:
+            await self._flush_borrows_serialized()
+
+    async def _flush_borrows_serialized(self) -> None:
         with self._lock:
             outbox, self._borrow_outbox = self._borrow_outbox, {}
             self._borrow_flush_scheduled = False

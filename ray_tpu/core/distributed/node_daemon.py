@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import subprocess
 import sys
 import tempfile
@@ -427,9 +428,7 @@ class NodeDaemon:
 
     def kill_random_worker(self, include_actor_workers: bool = False,
                            seed: Optional[int] = None) -> dict:
-        import random as _random
-
-        rng = _random.Random(seed)
+        rng = random.Random(seed)
         candidates = [
             h for h in self._workers.values()
             if h.proc.poll() is None
@@ -784,9 +783,7 @@ class NodeDaemon:
                 # UNIFORM choice, not least-utilized-first: a burst of
                 # waiters all consulting the same stale view would pile
                 # onto one "least utilized" target and serialize there.
-                import random as _random
-
-                return {"spill_to": _random.choice(others).address,
+                return {"spill_to": random.choice(others).address,
                         "park": True}
         return await self._wait_for_lease(demand, None, runtime_env)
 

@@ -727,17 +727,44 @@ class WorkerService:
             pool_fut = loop.run_in_executor(self._task_pool, run_all)
         except RuntimeError:
             # Retirement drain closed the pool mid-push: see push_task.
-            for i in range(len(specs)):
-                yield (i, {"requeue": True, "results": [],
-                           "error": None})
+            yield [(i, {"requeue": True, "results": [], "error": None})
+                   for i in range(len(specs))]
             return
-        while True:
-            item = await q.get()
-            if item is None:
-                break
-            yield item
-        await pool_fut
-        self._maybe_retire()
+        try:
+            done = False
+            while not done:
+                item = await q.get()
+                if item is None:
+                    break
+                # Coalesce everything already completed into ONE frame:
+                # micro-tasks that outpace the socket amortize framing
+                # like the old batched reply did, while a slow task's
+                # reply still leaves the moment it finishes.
+                chunk = [item]
+                while True:
+                    try:
+                        nxt = q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if nxt is None:
+                        done = True
+                        break
+                    chunk.append(nxt)
+                yield chunk
+            await pool_fut
+        finally:
+            # A client disconnect/cancel closes this generator at a
+            # yield: still consume the executor future's exception (no
+            # 'never retrieved' noise) and run the retirement check the
+            # tail would otherwise have done.
+            def _consume(f):
+                try:
+                    f.exception()
+                except Exception:  # noqa: BLE001
+                    pass
+
+            pool_fut.add_done_callback(_consume)
+            self._maybe_retire()
 
     async def create_actor(self, actor_id: str, cls_blob_key: bytes,
                            args_blob: bytes,

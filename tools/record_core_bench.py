@@ -1,0 +1,50 @@
+"""Record bench_core.py output into BENCH_CORE_r{N}.json (round-end
+artifact; same shape as previous rounds'). Usage:
+    python tools/record_core_bench.py 5 [--quick]
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    rnd = int(sys.argv[1])
+    args = [a for a in sys.argv[2:]]
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_core.py"), *args],
+        capture_output=True, text=True, timeout=3000)
+    results = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                results.append(json.loads(line))
+            except ValueError:
+                pass
+    doc = {
+        "round": rnd,
+        "host": {
+            "nproc": len(os.sched_getaffinity(0)),
+            "note": "single-CPU VM (os.sched_getaffinity=1): every "
+                    "process — driver, GCS, daemon, workers, submitters "
+                    "— timeshares ONE core, so multi-process throughput "
+                    "equals 1/total-CPU-per-op; the reference baselines "
+                    "are from a 64-vCPU m5.16xlarge. Best compared via "
+                    "us_per_op.",
+        },
+        "recorded_at_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "results": results,
+    }
+    path = os.path.join(REPO, f"BENCH_CORE_r{rnd:02d}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path} ({len(results)} metrics)")
+
+
+if __name__ == "__main__":
+    main()
