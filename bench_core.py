@@ -180,6 +180,40 @@ def main() -> None:
 
     emit("get_calls_per_second", timeit(gets, n), "gets/s")
 
+    # -- task cold start: submit-to-result with NO pooled worker ---------
+    # Each sample flushes the daemon's idle pool first, so the lease has
+    # to start a worker (zygote fork by default, cold Popen with
+    # RAY_TPU_ZYGOTE_ENABLED=0) — the number the warm-worker subsystem
+    # exists to shrink.
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    w = _global_worker()
+    node = [x for x in ray_tpu.nodes() if x["Alive"]][0]
+    client = SyncRpcClient(node["Address"], w.loop_thread)
+    samples = []
+    for _ in range(max(5, int(20 * scale))):
+        # The previous sample's lease returns asynchronously after its
+        # get() — keep flushing until every TASK worker is gone (actor
+        # workers from earlier probes stay), so the next lease must
+        # start a worker from scratch.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            client.call("NodeDaemon", "flush_idle_workers", timeout=30)
+            ws = client.call("NodeDaemon", "list_workers", timeout=15)
+            if not [x for x in ws if x["actor_id"] is None and x["alive"]]:
+                break
+            time.sleep(0.05)
+        t0 = time.perf_counter()
+        ray_tpu.get(noop.remote(), timeout=120)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    emit("task_cold_start_p50_ms",
+         samples[len(samples) // 2] * 1e3, "ms")
+    emit("task_cold_start_p95_ms",
+         samples[int(len(samples) * 0.95) - 1] * 1e3, "ms")
+    client.close()
+
     del refs
     ray_tpu.shutdown()
 

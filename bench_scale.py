@@ -42,7 +42,10 @@ def emit(metric: str, value: float, unit: str, baseline: float = None,
 
 
 def worker_procs() -> int:
-    out = subprocess.run(["pgrep", "-fc", "worker_main"],
+    # Zygote-forked workers inherit the zygote's cmdline, so count both
+    # spellings (the zygote itself is one constant process per env key,
+    # present in the baseline sample too).
+    out = subprocess.run(["pgrep", "-fc", "worker_(main|zygote)"],
                          capture_output=True, text=True)
     try:
         return int(out.stdout.strip() or 0)
@@ -52,6 +55,9 @@ def worker_procs() -> int:
 
 def main() -> None:
     quick = "--quick" in sys.argv
+    out_path = "BENCH_SCALE_r05.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
     s = 0.1 if quick else 1.0
 
     import ray_tpu
@@ -90,12 +96,14 @@ def main() -> None:
         def ping(self):
             return 1
 
-    # Waves: every actor needs a fresh worker process, and racing
-    # hundreds of python startups on this host's core count would trip
-    # the per-call actor-ready timeout — sustained creation rate is the
-    # metric either way (the reference's 580/s is a multi-node number).
-    n = int(150 * s) or 15
-    wave = 15
+    # Waves: every actor needs a worker process, and racing hundreds of
+    # starts on this host's core count would trip the per-call
+    # actor-ready timeout — sustained creation rate is the metric either
+    # way (the reference's 580/s is a multi-node number). Workers come
+    # from the zygote fork path (worker_zygote.py), so waves of 50 are
+    # safe where cold python startups needed 15.
+    n = int(1000 * s) or 20
+    wave = 50
     actors = []
     t0 = time.perf_counter()
     for i in range(0, n, wave):
@@ -262,7 +270,7 @@ def main() -> None:
     out = {"kind": "scale", "mode": tag, "host_cpus":
            len(os.sched_getaffinity(0)), "results": RESULTS,
            "recorded_unix": time.time()}
-    with open("BENCH_SCALE_r05.json", "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"metric": "scale_suite", "value": len(RESULTS),
                       "unit": "probes", "vs_baseline": None}))

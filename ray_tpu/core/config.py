@@ -54,6 +54,32 @@ class Config:
     worker_lease_timeout_ms: int = 30000
     idle_worker_killing_time_threshold_ms: int = 1000
     worker_register_timeout_s: int = 30
+    # ---- worker zygote / prestart (ref: worker_pool.h:347
+    # PrestartWorkers + idle pool; worker_zygote.py here) ----
+    # Fork workers from a pre-imported zygote template instead of cold
+    # subprocess spawns (RAY_TPU_ZYGOTE_ENABLED=0 to disable; containers
+    # and foreign-python runtime envs always cold-spawn).
+    zygote_enabled: bool = True
+    # Distinct per-runtime-env-key zygotes kept alive (LRU beyond this).
+    zygote_max: int = 4
+    # Extra comma-separated modules the zygote pre-imports (must be
+    # fork-safe: no import-time threads/sockets).
+    zygote_preload: str = ""
+    # How long a fork request may wait for a just-launched zygote's
+    # socket before the spawn falls back to a cold Popen.
+    zygote_boot_wait_s: float = 5.0
+    # Backlog-driven prestart: when >= watermark default-env lease
+    # requests are queued, warm workers are started ahead of grants, up
+    # to the warm-pool cap (0 => num_workers_soft_limit).
+    worker_prestart_enabled: bool = True
+    zygote_prestart_watermark: int = 1
+    zygote_warm_pool_cap: int = 0
+    # GCS-side actor creations in flight at once (ref:
+    # gcs_actor_scheduler.h leases many actors concurrently): a serial
+    # loop caps creation at 1/start_actor-latency; the bound keeps a
+    # burst from flooding daemons with more concurrent fork+boot
+    # pipelines than hosts can absorb.
+    actor_schedule_concurrency: int = 8
     # Object transfer chunk size over DCN (ref: ray_config_def.h:352 — 5 MiB).
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
 

@@ -1306,11 +1306,17 @@ class DistributedCoreWorker:
         from ray_tpu.util import tracing
 
         period = get_config().task_events_flush_ms / 1000
+        delay = period
         while not self._shutdown:
-            await asyncio.sleep(period)
+            await asyncio.sleep(delay)
             batch = tracing.drain()
             if not batch:
+                # Idle backoff (tracing is usually off): parked workers
+                # must not tick at full cadence — see the event flusher
+                # in worker_main for the same discipline at pool scale.
+                delay = min(delay * 2, max(period, 16.0))
                 continue
+            delay = period
             try:
                 gcs = await self._aget_gcs()
                 await gcs.call("TaskEvents", "add_events", events=batch,
@@ -2242,12 +2248,17 @@ class DistributedCoreWorker:
                                    timeout: float = 60.0) -> dict:
         deadline = time.monotonic() + timeout
         gcs = await self._aget_gcs()
+        known = ""
         while True:
             info = self._actor_cache.get(actor_id_hex)
             if info and info["state"] == "ALIVE":
                 return info
-            info = await gcs.call("ActorManager", "get_actor",
-                                  actor_id=actor_id_hex, timeout=30)
+            # Long-poll: the GCS replies on the next state TRANSITION
+            # (or its own ~2s timeout), so a pending actor costs one
+            # parked RPC instead of a 50ms polling loop per caller.
+            info = await gcs.call("ActorManager", "wait_actor",
+                                  actor_id=actor_id_hex,
+                                  known_state=known, timeout=30)
             if info is None:
                 raise rexc.ActorDiedError(actor_id_hex, "actor not found")
             self._actor_cache[actor_id_hex] = info
@@ -2260,7 +2271,7 @@ class DistributedCoreWorker:
                 raise rexc.GetTimeoutError(
                     f"actor {actor_id_hex[:8]} not ready in {timeout}s "
                     f"(state={info['state']})")
-            await asyncio.sleep(0.05)
+            known = info["state"]
 
     def get_actor(self, name: str, namespace: Optional[str]) -> ActorID:
         info = self.gcs.call("ActorManager", "get_actor", name=name,

@@ -226,6 +226,9 @@ class ActorManager:
         self.actors: Dict[str, ActorRecord] = {}
         self.named: Dict[Tuple[str, str], str] = {}
         self._pending: asyncio.Queue = asyncio.Queue()
+        # wait_actor long-poll parkers, woken by _publish: actor_id ->
+        # futures of callers waiting for the NEXT state transition.
+        self._state_waiters: Dict[str, List[asyncio.Future]] = {}
         # Recovery (ref: GcsActorManager::Initialize reloading from
         # storage): reload records; queued/restarting actors reschedule,
         # ALIVE ones are revalidated once daemons re-register.
@@ -354,6 +357,36 @@ class ActorManager:
             "worker_address": rec.worker_address,
             "death_reason": rec.death_reason,
         })
+        for fut in self._state_waiters.pop(rec.actor_id, ()):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def wait_actor(self, actor_id: str, known_state: str = "",
+                         timeout: float = 2.0) -> Optional[dict]:
+        """Long-poll get_actor: return when the actor's state differs
+        from `known_state` (immediately if it already does), or after
+        `timeout`. Owners resolving a pending actor park HERE instead of
+        hammering get_actor on a fixed cadence — at a 1k-actor creation
+        storm the 20ms polling loops alone were a double-digit share of
+        the control plane's core (ref: the reference's pubsub-driven
+        actor state notifications, gcs_actor_manager.h:281)."""
+        rec = self.actors.get(actor_id)
+        if rec is None or rec.state != known_state:
+            return self.get_actor(actor_id=actor_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._state_waiters.setdefault(actor_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            waiters = self._state_waiters.get(actor_id)
+            if waiters is not None:
+                try:
+                    waiters.remove(fut)
+                except ValueError:
+                    pass
+                if not waiters:
+                    self._state_waiters.pop(actor_id, None)
+        return self.get_actor(actor_id=actor_id)
 
     def _handle_failure(self, rec: ActorRecord, reason: str) -> None:
         if rec.state == ACTOR_RESTARTING:
@@ -387,27 +420,46 @@ class ActorManager:
                 asyncio.ensure_future(self.kill_actor(rec.actor_id))
 
     async def scheduling_loop(self):
+        # Bounded-concurrency scheduling (ref: gcs_actor_scheduler.h —
+        # the reference leases workers for many actors in flight at
+        # once): a serial loop would cap cluster-wide actor creation at
+        # 1/start_actor-latency (~15/s on a small host), no matter how
+        # fast the node plane forks. The window is bounded so a burst of
+        # creations cannot flood daemons with more concurrent
+        # fork+register pipelines than the host can boot at once.
+        sem = asyncio.Semaphore(
+            max(1, get_config().actor_schedule_concurrency))
+
+        async def requeue(actor_id: str) -> None:
+            # Re-queue with a delay (resources may free up) WITHOUT
+            # holding a scheduling slot — parked retries must not
+            # starve schedulable actors of the window.
+            await asyncio.sleep(0.5)
+            await self._pending.put(actor_id)
+
+        async def gated(actor_id: str) -> None:
+            try:
+                rec = self.actors.get(actor_id)
+                # Only PENDING/RESTARTING actors may be scheduled; ALIVE
+                # means a duplicate queue entry (a second worker would
+                # leak), DEAD means the actor was killed while queued.
+                if rec is None or rec.state not in (ACTOR_PENDING,
+                                                    ACTOR_RESTARTING):
+                    return
+                try:
+                    ok = await self._try_schedule(rec)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("actor scheduling error: %s", e)
+                    ok = False
+                if not ok and rec.state != ACTOR_DEAD:
+                    asyncio.ensure_future(requeue(actor_id))
+            finally:
+                sem.release()
+
         while True:
             actor_id = await self._pending.get()
-            rec = self.actors.get(actor_id)
-            # Only PENDING/RESTARTING actors may be scheduled; ALIVE means a
-            # duplicate queue entry (a second worker would leak), DEAD means
-            # the actor was killed while queued.
-            if rec is None or rec.state not in (ACTOR_PENDING,
-                                                ACTOR_RESTARTING):
-                continue
-            try:
-                ok = await self._try_schedule(rec)
-            except Exception as e:  # noqa: BLE001
-                logger.exception("actor scheduling error: %s", e)
-                ok = False
-            if not ok and rec.state != ACTOR_DEAD:
-                # Re-queue with a delay; resources may free up.
-                async def requeue(aid=actor_id):
-                    await asyncio.sleep(0.5)
-                    await self._pending.put(aid)
-
-                asyncio.ensure_future(requeue())
+            await sem.acquire()
+            asyncio.ensure_future(gated(actor_id))
 
     async def _try_schedule(self, rec: ActorRecord) -> bool:
         view = self._gcs.nodes.view

@@ -33,6 +33,8 @@ from ray_tpu.core.distributed import resources as rs
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
 from ray_tpu.core.distributed.scheduler import (
     ClusterView, NodeView, pick_feasible_node, pick_node)
+from ray_tpu.core.distributed.worker_zygote import (
+    ZygoteError, ZygoteHandle, start_zygote)
 
 logger = logging.getLogger(__name__)
 
@@ -130,6 +132,11 @@ class NodeDaemon:
         self._soft_limit = int(get_config().num_workers_soft_limit
                                or self.total.get("CPU", 1))
         self._env_builder = None  # RuntimeEnvBuilder, lazy (needs gcs)
+        # Worker zygotes, one per runtime-env key (insertion order = LRU;
+        # ref: worker_pool.h:347 prestart + forkserver-style templates).
+        # NOT in self._workers: the OOM sweep and idle reaping never see
+        # them — killing the template would re-cold-start the node.
+        self._zygotes: Dict[str, ZygoteHandle] = {}
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -164,6 +171,10 @@ class NodeDaemon:
             asyncio.ensure_future(self._log_monitor.run(self.gcs)),
         ]
         self._start_metrics_http()
+        if get_config().zygote_enabled:
+            # Eager default-env zygote: its interpreter boot + preload
+            # overlaps daemon idle time, so the first lease already forks.
+            self._ensure_zygote("", None)
         logger.info("node daemon %s on %s (resources=%s store=%s)",
                     self.node_id[:8], self.server.address, self.total,
                     self.store_dir)
@@ -180,6 +191,9 @@ class NodeDaemon:
                 w.kill()
             except Exception:  # noqa: BLE001
                 pass
+        for zh in list(self._zygotes.values()):
+            zh.kill()
+        self._zygotes.clear()
         await self.server.stop()
         self.store.disconnect()
         ObjectStore.destroy(self.store_dir)
@@ -241,8 +255,116 @@ class NodeDaemon:
             self._env_builder = RuntimeEnvBuilder(self.gcs)
         return await self._env_builder.ensure_env(runtime_env)
 
+    # -- zygote fork path (ref: worker_pool.h:347 PrestartWorkers;
+    # worker_zygote.py docstring for the fork-safety contract) ---------
+    def _zygote_compatible(self, built_env) -> bool:
+        """Fork is only equivalent to a cold spawn when the child would
+        run THIS platform's python in this mount namespace."""
+        if not get_config().zygote_enabled:
+            return False
+        if not sys.platform.startswith("linux"):
+            return False  # fork+threads semantics unsafe elsewhere
+        if built_env is None:
+            return True
+        if built_env.container:
+            return False  # worker lives in another mount/pid namespace
+        if built_env.python != sys.executable:
+            return False  # conda/venv env: different interpreter binary
+        return True
+
+    def _zygote_socket_path(self, env_key: str) -> str:
+        return os.path.join(self.log_dir,
+                            f"zygote-{env_key or 'default'}.sock")
+
+    def _ensure_zygote(self, env_key: str,
+                       built_env) -> Optional[ZygoteHandle]:
+        """Running zygote for this runtime-env key, launching (or
+        relaunching after a crash) as needed. Non-blocking: the returned
+        handle's socket may still be booting."""
+        zh = self._zygotes.pop(env_key, None)
+        if zh is not None and zh.alive():
+            self._zygotes[env_key] = zh     # re-insert: LRU freshest
+            return zh
+        if zh is not None:
+            logger.warning("zygote for env %r died (code %s); relaunching",
+                           env_key or "default", zh.proc.returncode)
+            zh.kill()
+            self._m_zygote_restarts.inc()
+        while len(self._zygotes) >= max(1, get_config().zygote_max):
+            old_key, old = next(iter(self._zygotes.items()))
+            self._zygotes.pop(old_key)
+            old.kill()
+        env = {}
+        cwd = None
+        if built_env is not None:
+            env.update(built_env.env_vars)
+            if built_env.pythonpath:
+                from ray_tpu.core.distributed.driver import child_env
+
+                base = child_env().get("PYTHONPATH", "")
+                env["PYTHONPATH"] = ":".join(
+                    built_env.pythonpath
+                    + [p for p in base.split(":") if p])
+            cwd = built_env.cwd
+        try:
+            proc = start_zygote(
+                gcs_address=self.gcs_address,
+                daemon_address=self.server.address,
+                node_id=self.node_id,
+                store_dir=self.store_dir,
+                socket_path=self._zygote_socket_path(env_key),
+                log_path=os.path.join(
+                    self.log_dir, f"zygote-{env_key or 'default'}.log"),
+                env=env, cwd=cwd,
+                preload=get_config().zygote_preload)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("zygote launch failed: %s", e)
+            return None
+        zh = ZygoteHandle(proc, self._zygote_socket_path(env_key),
+                          env_key=env_key)
+        self._zygotes[env_key] = zh
+        return zh
+
+    def _try_fork_worker(self, actor_id: Optional[str], built_env,
+                         env_key: str) -> Optional[WorkerHandle]:
+        zh = self._ensure_zygote(env_key, built_env)
+        if zh is None:
+            return None
+        worker_id = uuid.uuid4().hex
+        out = os.path.join(self.log_dir, f"worker-{worker_id}.out")
+        err = os.path.join(self.log_dir, f"worker-{worker_id}.err")
+        t0 = time.monotonic()
+        try:
+            proc = zh.fork_worker(
+                worker_id, out, err,
+                boot_wait=get_config().zygote_boot_wait_s)
+        except ZygoteError as e:
+            # One strike: a wedged/crashed zygote is replaced on the
+            # next _ensure_zygote; THIS spawn cold-starts.
+            logger.warning("zygote fork failed (%s); cold-spawning", e)
+            self._zygotes.pop(env_key, None)
+            zh.kill()
+            self._m_zygote_restarts.inc()
+            return None
+        self._m_fork_latency.observe(time.monotonic() - t0)
+        self._m_forked.inc()
+        self._m_spawned.inc()
+        handle = WorkerHandle(proc, worker_id, env_key=env_key)
+        handle.actor_id = actor_id
+        self._workers[worker_id] = handle
+        return handle
+
     def _spawn_worker(self, actor_id: Optional[str] = None,
                       built_env=None, env_key: str = "") -> WorkerHandle:
+        if self._zygote_compatible(built_env):
+            handle = self._try_fork_worker(actor_id, built_env, env_key)
+            if handle is not None:
+                return handle
+        return self._cold_spawn_worker(actor_id, built_env, env_key)
+
+    def _cold_spawn_worker(self, actor_id: Optional[str] = None,
+                           built_env=None,
+                           env_key: str = "") -> WorkerHandle:
         from ray_tpu.core.distributed.driver import child_env
 
         worker_id = uuid.uuid4().hex
@@ -293,6 +415,7 @@ class NodeDaemon:
             out_f.close()
             err_f.close()
         self._m_spawned.inc()
+        self._m_cold_spawned.inc()
         handle = WorkerHandle(proc, worker_id, env_key=env_key)
         handle.actor_id = actor_id
         if container_name is not None:
@@ -337,6 +460,25 @@ class NodeDaemon:
         self._m_oom_kills = Counter(
             "raytpu_oom_worker_kills_total",
             "Workers killed by the memory monitor").set_default_tags(tags)
+        # Zygote / warm-pool subsystem (worker_zygote.py).
+        self._m_forked = Counter(
+            "raytpu_workers_forked_total",
+            "Workers started by zygote fork").set_default_tags(tags)
+        self._m_cold_spawned = Counter(
+            "raytpu_workers_cold_spawned_total",
+            "Workers started by cold process spawn").set_default_tags(tags)
+        self._m_fork_latency = Histogram(
+            "raytpu_zygote_fork_seconds",
+            "Zygote fork request latency",
+            boundaries=(0.001, 0.005, 0.02, 0.1, 0.5, 2)
+        ).set_default_tags(tags)
+        self._m_zygote_restarts = Counter(
+            "raytpu_zygote_restarts_total",
+            "Zygote relaunches after crash/wedge").set_default_tags(tags)
+        self._m_prestarted = Counter(
+            "raytpu_workers_prestarted_total",
+            "Warm workers prestarted against lease backlog"
+        ).set_default_tags(tags)
 
     def get_metrics(self) -> str:
         """Prometheus exposition text; also served over HTTP when
@@ -404,6 +546,8 @@ class NodeDaemon:
             "busy_workers": sum(1 for h in self._workers.values()
                                 if h.busy),
             "pg_bundles": len(self._pg_bundles),
+            "zygotes": sum(1 for z in self._zygotes.values()
+                           if z.alive()),
         }
 
     def list_workers(self) -> list:
@@ -453,28 +597,43 @@ class NodeDaemon:
         handle.registered.set()
         if handle.actor_id is None and not handle.busy:
             if handle not in self._idle:
+                # Idleness starts NOW, not at spawn: last_idle was
+                # stamped in the constructor, and a slow-registering
+                # worker appended with that stale stamp would sit behind
+                # younger idlers, breaking _reap_idle_workers' deque-is-
+                # idle-ordered assumption (it stops at the first
+                # too-young front entry).
+                handle.last_idle = time.monotonic()
                 self._idle.append(handle)
             self._pump_lease_queue()
         return {"ok": True}
+
+    def _take_idle_worker(self, env_key: str) -> Optional[WorkerHandle]:
+        """Pop a live, registered, env-matching idle worker — or None.
+        Non-matching idlers keep their front-to-back (longest-idle-
+        first) order, same discipline as _get_idle_worker."""
+        kept = []
+        found = None
+        while self._idle:
+            handle = self._idle.popleft()
+            if (handle.proc.poll() is None and handle.address
+                    and not handle.busy):
+                if handle.env_key == env_key:
+                    found = handle
+                    break
+                kept.append(handle)
+        self._idle.extendleft(reversed(kept))
+        return found
 
     async def _get_idle_worker(self, runtime_env: Optional[dict] = None
                                ) -> WorkerHandle:
         from ray_tpu.runtime_env import env_hash
 
         env_key = env_hash(runtime_env)
-        kept = []
-        found = None
-        while self._idle:
-            handle = self._idle.popleft()
-            if handle.proc.poll() is None and handle.address:
-                if handle.env_key == env_key:
-                    found = handle
-                    break
-                kept.append(handle)
         # Other-env idlers go back to the FRONT in their original order:
         # _reap_idle_workers assumes self._idle[0] is the longest-idle
         # worker, and these were popped from the front.
-        self._idle.extendleft(reversed(kept))
+        found = self._take_idle_worker(env_key)
         if found is not None:
             return found
         built = await self._built_env(runtime_env)
@@ -488,6 +647,15 @@ class NodeDaemon:
         while True:
             try:
                 await asyncio.wait_for(handle.registered.wait(), timeout=0.1)
+                # register_worker appended the new worker to _idle (it
+                # cannot know this grant is waiting for it) — claim it
+                # back out, or a busy leased worker sits in the idle
+                # deque where the reaper/OOM sweep would kill it as
+                # expendable.
+                try:
+                    self._idle.remove(handle)
+                except ValueError:
+                    pass
                 return handle
             except asyncio.TimeoutError:
                 if handle.proc.poll() is not None:
@@ -499,6 +667,114 @@ class NodeDaemon:
                     self._workers.pop(handle.worker_id, None)
                     raise RuntimeError(
                         "worker failed to register in time") from None
+
+    # ------------------------------------------------------------------
+    # backlog-driven prestart (ref: worker_pool.h:347 PrestartWorkers)
+    # ------------------------------------------------------------------
+    def _maybe_prestart_workers(self) -> None:
+        """When default-env lease requests queue up, start warm workers
+        ahead of the grants: the spawn (fork, ~ms; cold, ~150ms+)
+        overlaps the wait for resources instead of following it."""
+        cfg = get_config()
+        if not cfg.worker_prestart_enabled:
+            return
+        backlog = sum(1 for (_d, _p, fut, _t, renv) in self._lease_waiters
+                      if not renv and not fut.done())
+        if backlog < max(1, cfg.zygote_prestart_watermark):
+            return
+        # Attribute-only scans — no per-handle poll() syscalls: at warm-
+        # pool scale this runs against 1k+ handles on every lease, and a
+        # dead-but-uncollected handle only overcounts until the monitor
+        # loop prunes it (≤1 s), which just delays prestart one beat.
+        idle = len(self._idle)
+        starting = sum(1 for h in self._workers.values()
+                       if h.address is None and h.actor_id is None)
+        cap = int(cfg.zygote_warm_pool_cap or self._soft_limit)
+        want = min(backlog, cap) - idle - starting
+        if want <= 0:
+            return
+        for _ in range(want):
+            try:
+                self._spawn_worker()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("prestart spawn failed: %s", e)
+                return
+        self._m_prestarted.inc(want)
+
+    def _maybe_refill_warm_pool(self, env_key: str, built_env) -> None:
+        """Keep `actor_schedule_concurrency` warm workers ahead of actor
+        demand: called on every start_actor, so a creation storm settles
+        into pop-warm-worker + async refill — the fork+boot pipeline
+        overlaps the NEXT creations instead of serializing inside each
+        (ref: worker_pool.h:347 PrestartWorkers, which the reference
+        pops actor workers from)."""
+        cfg = get_config()
+        if not cfg.worker_prestart_enabled:
+            return
+        depth = min(max(1, cfg.actor_schedule_concurrency),
+                    int(cfg.zygote_warm_pool_cap or self._soft_limit))
+        # Attribute-only scans (see _maybe_prestart_workers): this runs
+        # on EVERY start_actor against every live handle — per-handle
+        # poll() syscalls here were a measurable slice of a 1k-actor
+        # creation storm on a small host.
+        idle = sum(1 for h in self._idle if h.env_key == env_key)
+        starting = sum(1 for h in self._workers.values()
+                       if h.address is None and h.actor_id is None
+                       and h.env_key == env_key)
+        want = depth - idle - starting
+        if want <= 0:
+            return
+        for _ in range(want):
+            try:
+                self._spawn_worker(built_env=built_env, env_key=env_key)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("warm refill spawn failed: %s", e)
+                return
+        self._m_prestarted.inc(want)
+
+    async def prestart_workers(self, count: int = 1,
+                               runtime_env: Optional[dict] = None) -> dict:
+        """Explicit warm-pool fill RPC (the reference exposes the same
+        hook as NodeManager PrestartWorkers): start up to `count`
+        workers of the given runtime env, bounded by the warm-pool cap."""
+        from ray_tpu.runtime_env import env_hash
+
+        built = await self._built_env(runtime_env)
+        env_key = env_hash(runtime_env)
+        cap = int(get_config().zygote_warm_pool_cap or self._soft_limit)
+        idle = len(self._idle)
+        started = 0
+        for _ in range(max(0, min(int(count), cap - idle))):
+            self._spawn_worker(built_env=built, env_key=env_key)
+            started += 1
+        if started:
+            self._m_prestarted.inc(started)
+        return {"ok": True, "started": started}
+
+    def flush_idle_workers(self) -> dict:
+        """Kill every idle pooled worker (bench/test hook: forces the
+        next lease onto the fork-or-cold start path). Zygotes are
+        untouched — they are templates, not pool members."""
+        killed = 0
+        while self._idle:
+            handle = self._idle.popleft()
+            if handle.busy:
+                continue  # mid-grant claim raced in; not idle
+            self._workers.pop(handle.worker_id, None)
+            self._retire_worker_logs(handle)
+            try:
+                handle.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            killed += 1
+        return {"ok": True, "killed": killed}
+
+    def zygote_state(self) -> dict:
+        """Zygote snapshot (tests/tools)."""
+        return {"zygotes": [
+            {"env_key": k, "pid": zh.proc.pid, "alive": zh.alive(),
+             "forks": zh.forks}
+            for k, zh in self._zygotes.items()]}
 
     # ------------------------------------------------------------------
     # memory monitor + OOM killing (ref: memory_monitor.h:52, LIFO-
@@ -541,6 +817,8 @@ class NodeDaemon:
         killed_idle = 0
         while self._idle:
             handle = self._idle.popleft()
+            if handle.busy:
+                continue  # mid-grant claim raced in; not expendable
             self._workers.pop(handle.worker_id, None)
             try:
                 handle.kill()
@@ -577,11 +855,19 @@ class NodeDaemon:
         (ref: worker_pool idle eviction, worker_pool.h:156 pool semantics)."""
         threshold = (get_config().idle_worker_killing_time_threshold_ms
                      / 1000.0)
+        if self._lease_waiters:
+            # Queued demand will consume these idlers the moment
+            # resources free — reaping them now would just churn spawns
+            # against the prestart policy.
+            return
         now = time.monotonic()
         n_task_workers = sum(1 for h in self._workers.values()
                              if h.actor_id is None)
         while n_task_workers > self._soft_limit and self._idle:
             handle = self._idle[0]
+            if handle.busy:
+                self._idle.popleft()  # mid-grant claim raced in
+                continue
             if now - handle.last_idle < threshold:
                 break  # deque is in idle order; newer ones won't qualify
             self._idle.popleft()
@@ -608,8 +894,38 @@ class NodeDaemon:
 
     async def _monitor_workers_loop(self):
         while True:
-            await asyncio.sleep(0.25)
+            # Adaptive cadence: each tick polls EVERY worker handle, so
+            # at warm-pool scale (1k+ live workers) the base 0.25 s
+            # period alone costs several % of a small host's core in
+            # kill(0) probes and dict scans. Death-detection latency
+            # degrades to at most 1 s when the pool is huge — the same
+            # trade the log monitor makes.
+            await asyncio.sleep(
+                min(1.0, max(0.25, len(self._workers) / 1000.0)))
             self._reap_idle_workers()
+            self._maybe_prestart_workers()
+            # Crashed zygotes: drop the handle (and relaunch the
+            # default-env one eagerly — it is the hot path for every
+            # pool/actor spawn; per-env zygotes relaunch on demand).
+            for key, zh in list(self._zygotes.items()):
+                if not zh.alive():
+                    self._zygotes.pop(key, None)
+                    zh.kill()
+                    self._m_zygote_restarts.inc()
+                    logger.warning(
+                        "zygote for env %r exited with code %s",
+                        key or "default", zh.proc.returncode)
+                    # Eager relaunch for the default-env (hot-path)
+                    # zygote, rate-limited so a zygote that dies at
+                    # boot (bad preload, unbindable socket) cannot
+                    # become a 4 Hz spawn storm — spawns meanwhile
+                    # ride the cold fallback.
+                    now = time.monotonic()
+                    if (key == "" and get_config().zygote_enabled
+                            and now - getattr(self, "_zygote_relaunch_ts",
+                                              0.0) > 2.0):
+                        self._zygote_relaunch_ts = now
+                        self._ensure_zygote("", None)
             for wid, handle in list(self._workers.items()):
                 if handle.proc.poll() is not None:
                     self._workers.pop(wid, None)
@@ -792,6 +1108,7 @@ class NodeDaemon:
         fut = asyncio.get_running_loop().create_future()
         self._lease_waiters.append((demand, placement, fut,
                                     time.monotonic(), runtime_env))
+        self._maybe_prestart_workers()
         return await fut
 
     async def _grant_safely(self, demand, placement,
@@ -1020,8 +1337,18 @@ class NodeDaemon:
                     "creation_error": True}
         from ray_tpu.runtime_env import env_hash
 
-        handle = self._spawn_worker(actor_id=actor_id, built_env=built,
-                                    env_key=env_hash(runtime_env))
+        env_key = env_hash(runtime_env)
+        # Warm-pool fast path (ref: the reference pops actor-creation
+        # workers from the same pool as task workers): an idle, already-
+        # registered worker of the right env skips spawn + registration
+        # entirely — actor readiness becomes one create_actor RPC.
+        handle = self._take_idle_worker(env_key)
+        if handle is not None:
+            handle.actor_id = actor_id
+        else:
+            handle = self._spawn_worker(actor_id=actor_id, built_env=built,
+                                        env_key=env_key)
+        self._maybe_refill_warm_pool(env_key, built)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + get_config().worker_register_timeout_s
         while not handle.registered.is_set():

@@ -240,8 +240,13 @@ class WorkerService:
         async def flush_loop():
             import asyncio as _a
 
+            # Idle backoff: an idle worker (e.g. one of hundreds of
+            # parked actors) must not wake at full cadence forever —
+            # with a warm pool of 1k workers the 2 wakeups/s/worker
+            # alone saturate a small host. Activity snaps it back.
+            delay = period
             while True:
-                await _a.sleep(period)
+                await _a.sleep(delay)
                 with self._events_lock:
                     batch, self._events = self._events, []
                 if get_config().tracing_enabled:
@@ -249,7 +254,9 @@ class WorkerService:
 
                     batch = batch + tracing.drain()
                 if not batch:
+                    delay = min(delay * 2, max(period, 16.0))
                     continue
+                delay = period
                 try:
                     gcs = await self.core._aget_gcs()
                     await gcs.call("TaskEvents", "add_events",
@@ -1095,17 +1102,19 @@ def run_worker(args) -> None:
     logger.info("worker %s serving on %s", args.worker_id[:8], address)
 
     # Fate-share with the daemon: if it stops answering pings, exit
-    # (ref: workers fate-share with their raylet).
+    # (ref: workers fate-share with their raylet). This is a BACKSTOP —
+    # the kernel PDEATHSIG chain (daemon → zygote → worker) already
+    # covers daemon death on Linux — so the cadence is lazy and the
+    # client connection persists: a warm pool of ~1k parked workers
+    # must not spend the host's CPU on connect/teardown churn.
     failures = 0
+    ping_client = AsyncRpcClient(args.daemon_address)
+    period = float(os.environ.get("RAY_TPU_WORKER_PING_PERIOD_S", "45"))
     while True:
-        threading.Event().wait(3.0)
+        threading.Event().wait(period)
         try:
             async def ping():
-                client = AsyncRpcClient(args.daemon_address)
-                try:
-                    await client.call("NodeDaemon", "ping", timeout=5)
-                finally:
-                    await client.close()
+                await ping_client.call("NodeDaemon", "ping", timeout=5)
 
             loop_thread.run(ping(), timeout=10)
             failures = 0
@@ -1116,16 +1125,13 @@ def run_worker(args) -> None:
                 os._exit(1)
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--gcs-address", required=True)
-    parser.add_argument("--daemon-address", required=True)
-    parser.add_argument("--node-id", required=True)
-    parser.add_argument("--store-dir", required=True)
-    parser.add_argument("--worker-id", required=True)
-    args = parser.parse_args()
+def boot_worker(args) -> None:
+    """Process body shared by the cold-spawn CLI path (`main`) and the
+    zygote fork path (worker_zygote._child_main): everything after the
+    per-worker identity (worker_id, env, stdio) is known. `force=True`
+    because a forked child inherits the zygote's logging handlers."""
     logging.basicConfig(
-        level=logging.INFO,
+        level=logging.INFO, force=True,
         format=f"[worker {args.worker_id[:6]}] %(levelname)s %(message)s")
     # tpu_profiling runtime env (the nsight analogue): trace the whole
     # worker process with the JAX profiler, like `nsys profile` wraps
@@ -1159,6 +1165,16 @@ def main():
         run_worker(args)
     except KeyboardInterrupt:
         pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--daemon-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--store-dir", required=True)
+    parser.add_argument("--worker-id", required=True)
+    boot_worker(parser.parse_args())
 
 
 if __name__ == "__main__":

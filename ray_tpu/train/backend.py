@@ -41,8 +41,21 @@ class JaxBackend(Backend):
     def on_start(self, rank, world_size, master_env) -> None:
         if world_size <= 1:
             return
+        import os
+
         import jax
 
+        # CPU processes need the gloo collectives client — the default
+        # CPU backend refuses multi-process computations. Decided from
+        # the env var (not jax.default_backend(): querying it would
+        # initialize backends BEFORE distributed.initialize, which
+        # pins single-process topology). TPU keeps ICI collectives.
+        if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 knob absent on this jax
+                pass
         jax.distributed.initialize(
             coordinator_address=master_env["RTPU_JAX_COORDINATOR"],
             num_processes=world_size,

@@ -1,0 +1,55 @@
+"""Multi-process `jax.distributed` through the train backend
+(ref: train/torch/config.py:153 on_start wiring for the torch analogue):
+two gang workers, each its own OS process, form one JAX coordination
+service on CPU (gloo collectives) and run an in-graph psum that spans
+both processes — the JaxBackend path `train/backend.py` exercised for
+real, not just world_size==1 no-ops."""
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (DataParallelTrainer, RunConfig, ScalingConfig)
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_two_process_psum_over_gloo(ray_cluster, tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("jaxdist"))
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+
+        ctx = train.get_context()
+        # The gang spans 2 worker PROCESSES; each contributes its local
+        # CPU devices to one global device set.
+        n_local = jax.local_device_count()
+        out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(
+            jnp.ones((n_local,)))
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "procs": jax.process_count(),
+            "global_devices": jax.device_count(),
+            "local_devices": n_local,
+            # psum of ones over the GLOBAL axis == total device count:
+            # proof the collective crossed the process boundary.
+            "psum": float(out[0]),
+        })
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="jaxdist", storage_path=tmp),
+        backend="jax")
+    result = trainer.fit()
+    assert result.error is None, result.error
+    m = result.metrics
+    assert m["procs"] == 2
+    assert m["global_devices"] == 2 * m["local_devices"]
+    assert m["psum"] == m["global_devices"]

@@ -1,0 +1,245 @@
+"""Worker zygote subsystem lifecycle (worker_zygote.py + the daemon's
+fork-first spawn path): fork-per-lease, fork-per-actor, cold-spawn
+fallback, crash relaunch, OOM-sweep exemption, and the idle-pool
+ordering discipline the prestart/warm-pool machinery leans on
+(ref: src/ray/raylet/worker_pool.h:347 PrestartWorkers + idle pool)."""
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _metric(text: str, name: str) -> float:
+    total = 0.0
+    found = False
+    for line in text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            total += float(line.rsplit(" ", 1)[1])
+            found = True
+    return total if found else 0.0
+
+
+@pytest.fixture(scope="module")
+def zcluster():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+def _daemon(cluster):
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    w = _global_worker()
+    node = [n for n in ray_tpu.nodes() if n["Alive"]][0]
+    return SyncRpcClient(node["Address"], w.loop_thread)
+
+
+def _zygote(client) -> dict:
+    zs = client.call("NodeDaemon", "zygote_state", timeout=15)["zygotes"]
+    assert zs, "no zygote running"
+    return zs[0]
+
+
+def test_fork_per_lease(zcluster):
+    client = _daemon(zcluster)
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(4)],
+                       timeout=120) == [1, 2, 3, 4]
+    text = client.call("NodeDaemon", "get_metrics", timeout=15)
+    assert _metric(text, "raytpu_workers_forked_total") >= 1
+    z = _zygote(client)
+    assert z["alive"] and z["forks"] >= 1
+
+
+def test_fork_per_actor(zcluster):
+    client = _daemon(zcluster)
+    client.call("NodeDaemon", "flush_idle_workers", timeout=15)
+    before = _metric(client.call("NodeDaemon", "get_metrics", timeout=15),
+                     "raytpu_workers_forked_total")
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    pid = ray_tpu.get(a.pid.remote(), timeout=120)
+    after = _metric(client.call("NodeDaemon", "get_metrics", timeout=15),
+                    "raytpu_workers_forked_total")
+    assert after >= before + 1
+    # The actor's host process is a fork child of the zygote, not a
+    # `python -m worker_main` cold spawn: its cmdline is the zygote's.
+    with open(f"/proc/{pid}/cmdline", "rb") as f:
+        cmdline = f.read().replace(b"\0", b" ")
+    assert b"worker_zygote" in cmdline
+    ray_tpu.kill(a)
+
+
+def test_prestart_rpc_fills_warm_pool(zcluster):
+    client = _daemon(zcluster)
+    client.call("NodeDaemon", "flush_idle_workers", timeout=15)
+    reply = client.call("NodeDaemon", "prestart_workers", count=2,
+                        timeout=30)
+    assert reply["started"] >= 1
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        state = client.call("NodeDaemon", "debug_state", timeout=15)
+        if state["idle_workers"] >= reply["started"]:
+            break
+        time.sleep(0.1)
+    assert state["idle_workers"] >= reply["started"]
+
+
+def test_runtime_env_gets_own_zygote_and_env_vars(zcluster):
+    client = _daemon(zcluster)
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZYG_MARKER": "yes"}})
+    def probe():
+        return os.environ.get("ZYG_MARKER")
+
+    assert ray_tpu.get(probe.remote(), timeout=120) == "yes"
+    zs = client.call("NodeDaemon", "zygote_state", timeout=15)["zygotes"]
+    # A second, per-env-key zygote appears next to the default one.
+    assert len(zs) >= 2, zs
+    assert sum(1 for z in zs if z["alive"]) >= 2
+
+
+def test_zygote_crash_detected_and_relaunched(zcluster):
+    client = _daemon(zcluster)
+    old = _zygote(client)
+    os.kill(old["pid"], signal.SIGKILL)
+    # The monitor loop (0.25 s cadence) notices and relaunches the
+    # default-env zygote.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        zs = client.call("NodeDaemon", "zygote_state",
+                         timeout=15)["zygotes"]
+        fresh = [z for z in zs if z["env_key"] == "" and z["alive"]
+                 and z["pid"] != old["pid"]]
+        if fresh:
+            break
+        time.sleep(0.1)
+    assert fresh, zs
+    # And spawning still works end to end (fork from the new zygote, or
+    # a cold fallback while it boots — either way the lease completes).
+    client.call("NodeDaemon", "flush_idle_workers", timeout=15)
+
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.remote(), timeout=120) == "ok"
+
+
+def test_oom_sweep_never_kills_zygote(zcluster):
+    client = _daemon(zcluster)
+    z = _zygote(client)
+    reply = client.call("NodeDaemon", "relieve_memory_pressure",
+                        usage=0.99, timeout=15)
+    assert "usage" in reply
+    z2 = _zygote(client)
+    assert z2["alive"] and z2["pid"] == z["pid"]
+
+
+def test_zygote_disabled_falls_back_to_cold_spawn(tmp_path):
+    """A daemon with RAY_TPU_ZYGOTE_ENABLED=0 (and a containerized/
+    foreign-python env in general) must spawn workers the old way.
+    Driven purely over RPC — no driver attach — so it can run next to
+    the module cluster."""
+    from ray_tpu.core.distributed.driver import (start_gcs_process,
+                                                 start_node_daemon_process)
+    from ray_tpu.core.distributed.rpc import EventLoopThread, SyncRpcClient
+
+    gcs_proc, gcs_address = start_gcs_process()
+    daemon_proc, info = start_node_daemon_process(
+        gcs_address, num_cpus=1,
+        extra_env={"RAY_TPU_ZYGOTE_ENABLED": "0"})
+    loop = EventLoopThread("zygote-off-test")
+    client = SyncRpcClient(info["address"], loop)
+    try:
+        assert client.call("NodeDaemon", "zygote_state",
+                           timeout=15)["zygotes"] == []
+        reply = client.call("NodeDaemon", "prestart_workers", count=1,
+                            timeout=60)
+        assert reply["started"] == 1
+        text = client.call("NodeDaemon", "get_metrics", timeout=15)
+        assert _metric(text, "raytpu_workers_cold_spawned_total") >= 1
+        assert _metric(text, "raytpu_workers_forked_total") == 0
+    finally:
+        client.close()
+        loop.stop()
+        daemon_proc.terminate()
+        gcs_proc.terminate()
+        daemon_proc.wait(timeout=10)
+        gcs_proc.wait(timeout=10)
+
+
+def test_idle_order_survives_mixed_env_churn(tmp_path):
+    """Regression for the _reap_idle_workers ordering assumption: the
+    idle deque must stay longest-idle-first through (a) other-env
+    scans putting non-matching idlers back and (b) slow-registering
+    workers joining the pool (register_worker must stamp last_idle at
+    REGISTRATION, not keep the spawn-time stamp)."""
+    import asyncio
+
+    from ray_tpu.core.distributed.node_daemon import NodeDaemon, WorkerHandle
+    from ray_tpu.core.object_store import ObjectStore
+
+    class FakeProc:
+        pid = 4242
+        returncode = None
+
+        def poll(self):
+            return None
+
+        def kill(self):
+            pass
+
+        def terminate(self):
+            pass
+
+    daemon = NodeDaemon(gcs_address="127.0.0.1:1", num_cpus=2,
+                        store_dir=str(tmp_path / "store"))
+    try:
+        now = time.monotonic()
+
+        def mk(name, env_key, idle_age):
+            h = WorkerHandle(FakeProc(), name, env_key=env_key)
+            h.address = f"addr-{name}"
+            h.last_idle = now - idle_age
+            daemon._workers[name] = h
+            return h
+
+        a = mk("a", "", 30.0)       # longest idle, default env
+        b = mk("b", "envX", 20.0)
+        c = mk("c", "", 10.0)
+        daemon._idle.extend([a, b, c])
+
+        # Take the mid-deque envX worker: a and c keep their order.
+        got = daemon._take_idle_worker("envX")
+        assert got is b
+        assert list(daemon._idle) == [a, c]
+
+        # A slow-registering worker (spawned 100 s ago) joins the pool:
+        # it became idle NOW, so it must sit at the back with a fresh
+        # stamp — not poison the front-is-oldest invariant.
+        d = mk("d", "", 100.0)
+        asyncio.run(daemon.register_worker("d", "addr-d", 4242))
+        assert list(daemon._idle) == [a, c, d]
+        stamps = [h.last_idle for h in daemon._idle]
+        assert stamps == sorted(stamps), (
+            "idle deque no longer longest-idle-first")
+        assert d.last_idle >= now
+    finally:
+        daemon.store.disconnect()
+        ObjectStore.destroy(daemon.store_dir)

@@ -1,0 +1,54 @@
+"""Record bench_scale.py output into BENCH_SCALE_r{N}.json (round-end
+artifact; same shape as record_core_bench.py's). Usage:
+    python tools/record_scale_bench.py 6 [--quick]
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    rnd = int(sys.argv[1])
+    args = [a for a in sys.argv[2:]]
+    path = os.path.join(REPO, f"BENCH_SCALE_r{rnd:02d}.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scale.py"),
+         "--out", path, *args],
+        capture_output=True, text=True, timeout=7200)
+    results = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                results.append(json.loads(line))
+            except ValueError:
+                pass
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout[-4000:])
+        sys.stderr.write(out.stderr[-4000:])
+        raise SystemExit(f"bench_scale exited {out.returncode} "
+                         f"({len(results)} metrics recorded before)")
+    doc = {
+        "round": rnd,
+        "host": {
+            "nproc": len(os.sched_getaffinity(0)),
+            "note": "single-CPU VM (os.sched_getaffinity=1): every "
+                    "process — driver, GCS, daemon, workers, submitters "
+                    "— timeshares ONE core; the reference baselines are "
+                    "multi-node cluster numbers (BASELINE.md).",
+        },
+        "recorded_at_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "results": results,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path} ({len(results)} metrics)")
+
+
+if __name__ == "__main__":
+    main()
