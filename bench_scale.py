@@ -4,9 +4,11 @@ release/benchmarks/distributed/test_many_tasks.py, test_many_actors.py;
 release/benchmarks/single_node/test_single_node.py).
 
 Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
+  many_nodes        1000 virtual daemons syncing deltas to one GCS
+                    (virtual_node.py harness; ref demonstrates 2k nodes)
   many_tasks        10k short tasks through 4 submitters   (ref 589/s)
   many_actors       1k actor create+ping+kill              (ref 580/s)
-  queued_flood      100k tasks queued behind a blocker     (ref 5163/s*)
+  queued_flood      1M tasks queued behind a blocker       (ref 5163/s*)
   multi_daemon      6-node-daemon cluster, spread tasks + cross-node gets
   chaos_soak        task flood with a worker killer running
   many_args         1,000 object args into one task        (ref 10k in 17.3s)
@@ -16,9 +18,11 @@ Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
 *ref numbers come from a 64-vCPU m5.16xlarge / multi-node clusters
 (BASELINE.md); this harness records the same quantities on this host so
 rounds can be compared like-for-like. Leak assertions: worker count and
-driver-visible cluster resources return to baseline after each probe.
+driver-visible cluster resources return to baseline after each probe;
+many_nodes asserts the sync path ships deltas, not full-state posts
+(suppressed+delta vs full-report ratio from the syncer metrics).
 
-Usage: python bench_scale.py [--quick]
+Usage: python bench_scale.py [--quick] [--only probe1,probe2]
 """
 from __future__ import annotations
 
@@ -53,12 +57,84 @@ def worker_procs() -> int:
         return 0
 
 
+def bench_many_nodes(quick: bool) -> None:
+    """Control-plane scale envelope: N virtual daemons (virtual_node.py —
+    real registration + real NodeSyncer protocol, no worker processes)
+    against one in-process GCS, with load churn. Asserts the sync path
+    processes versioned deltas, not full-state posts."""
+    import asyncio
+
+    from ray_tpu.core.distributed.gcs_server import GcsServer
+    from ray_tpu.core.distributed.virtual_node import VirtualCluster
+
+    n = 120 if quick else 1000
+    churn_rounds = 4 if quick else 10
+
+    async def run():
+        gcs = GcsServer()
+        port = await gcs.start()
+        vc = VirtualCluster(f"127.0.0.1:{port}", n_nodes=n,
+                            report_interval_s=0.5, keepalive_s=2.0,
+                            subscribers=4, seed=7)
+        t0 = time.perf_counter()
+        await vc.start()
+        t_register = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(churn_rounds):
+            vc.churn(0.25)
+            await asyncio.sleep(0.6)
+        await asyncio.sleep(2.0)        # drain the last coalescing window
+        t_churn = time.perf_counter() - t0
+        alive = sum(1 for nv in gcs.nodes.view.nodes.values() if nv.alive)
+        stats = gcs.syncer.stats()
+        agg = vc.aggregate_stats()
+        sub_view = len(vc.nodes[0].view.nodes)
+        await vc.stop()
+        await gcs.stop()
+        return t_register, t_churn, alive, stats, agg, sub_view
+
+    t_register, t_churn, alive, stats, agg, sub_view = asyncio.run(run())
+    assert alive >= n, f"only {alive}/{n} virtual daemons alive"
+    assert agg["errors"] == 0, agg
+    assert stats["applied_deltas"] > 0, stats
+    # The whole point of the syncer: full-state reports happen once per
+    # (re)connect; steady state is deltas + suppressed no-change ticks.
+    delta_like = stats["applied_deltas"] + agg["suppressed"]
+    ratio = delta_like / max(1, stats["applied_full"])
+    assert ratio >= 3.0, (stats, agg)
+    # Fan-out sanity: a subscriber's spillback view saw every node.
+    assert sub_view >= n, f"subscriber view has {sub_view}/{n} nodes"
+    emit("many_nodes_alive", alive, "nodes", total=n,
+         register_seconds=round(t_register, 2))
+    emit("many_nodes_sync_updates_per_second",
+         (stats["applied_deltas"] + stats["keepalives"]) / t_churn,
+         "updates/s", broadcasts=stats["broadcasts"])
+    emit("many_nodes_delta_vs_full_ratio", ratio, "x",
+         deltas=stats["applied_deltas"], suppressed=int(agg["suppressed"]),
+         fulls=stats["applied_full"],
+         delta_bytes=int(agg["bytes_sent"]))
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     out_path = "BENCH_SCALE_r05.json"
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
+    only = None
+    if "--only" in sys.argv:
+        only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
     s = 0.1 if quick else 1.0
+
+    def want(probe: str) -> bool:
+        return only is None or probe in only
+
+    # Standalone control-plane probe first: it hosts its own in-process
+    # GCS and must not share the driver's cluster.
+    if want("many_nodes"):
+        bench_many_nodes(quick)
+    if only is not None and not (only - {"many_nodes"}):
+        _write_results(out_path, quick)
+        return
 
     import ray_tpu
     from ray_tpu.core.task_spec import SpreadSchedulingStrategy
@@ -73,111 +149,119 @@ def main() -> None:
     base_workers = worker_procs()
 
     # ---- many_tasks: 10k short tasks via 4 in-cluster submitters ------
-    @ray_tpu.remote
-    class Submitter:
-        def run(self, fn, k):
-            import ray_tpu as rt
+    if want("many_tasks"):
+        @ray_tpu.remote
+        class Submitter:
+            def run(self, fn, k):
+                import ray_tpu as rt
 
-            rt.get([fn.remote() for _ in range(k)], timeout=1200)
-            return k
+                rt.get([fn.remote() for _ in range(k)], timeout=1200)
+                return k
 
-    subs = [Submitter.remote() for _ in range(4)]
-    ray_tpu.get([x.run.remote(noop, 5) for x in subs])
-    n = int(10_000 * s)
-    t0 = time.perf_counter()
-    ray_tpu.get([x.run.remote(noop, n // 4) for x in subs], timeout=1800)
-    dt = time.perf_counter() - t0
-    emit("many_tasks_per_second", n / dt, "tasks/s", baseline=589,
-         total=n)
+        subs = [Submitter.remote() for _ in range(4)]
+        ray_tpu.get([x.run.remote(noop, 5) for x in subs])
+        n = int(10_000 * s)
+        t0 = time.perf_counter()
+        ray_tpu.get([x.run.remote(noop, n // 4) for x in subs],
+                    timeout=1800)
+        dt = time.perf_counter() - t0
+        emit("many_tasks_per_second", n / dt, "tasks/s", baseline=589,
+             total=n)
 
     # ---- many_actors: create + ping + kill 1k lightweight actors ------
-    @ray_tpu.remote(num_cpus=0, max_restarts=0)
-    class Tiny:
-        def ping(self):
-            return 1
+    if want("many_actors"):
+        @ray_tpu.remote(num_cpus=0, max_restarts=0)
+        class Tiny:
+            def ping(self):
+                return 1
 
-    # Waves: every actor needs a worker process, and racing hundreds of
-    # starts on this host's core count would trip the per-call
-    # actor-ready timeout — sustained creation rate is the metric either
-    # way (the reference's 580/s is a multi-node number). Workers come
-    # from the zygote fork path (worker_zygote.py), so waves of 50 are
-    # safe where cold python startups needed 15.
-    n = int(1000 * s) or 20
-    wave = 50
-    actors = []
-    t0 = time.perf_counter()
-    for i in range(0, n, wave):
-        batch = [Tiny.remote() for _ in range(min(wave, n - i))]
-        ray_tpu.get([a.ping.remote() for a in batch], timeout=1800)
-        actors.extend(batch)
-    dt = time.perf_counter() - t0
-    emit("many_actors_per_second", n / dt, "actors/s", baseline=580,
-         total=n)
-    for a in actors:
-        ray_tpu.kill(a)
-    del actors
-    time.sleep(2.0)
+        # Waves: every actor needs a worker process, and racing hundreds
+        # of starts on this host's core count would trip the per-call
+        # actor-ready timeout — sustained creation rate is the metric
+        # either way (the reference's 580/s is a multi-node number).
+        # Workers come from the zygote fork path (worker_zygote.py), so
+        # waves of 50 are safe where cold python startups needed 15.
+        n = int(1000 * s) or 20
+        wave = 50
+        actors = []
+        t0 = time.perf_counter()
+        for i in range(0, n, wave):
+            batch = [Tiny.remote() for _ in range(min(wave, n - i))]
+            ray_tpu.get([a.ping.remote() for a in batch], timeout=1800)
+            actors.extend(batch)
+        dt = time.perf_counter() - t0
+        emit("many_actors_per_second", n / dt, "actors/s", baseline=580,
+             total=n)
+        for a in actors:
+            ray_tpu.kill(a)
+        del actors
+        time.sleep(2.0)
 
     # ---- queued_flood: tasks queued behind a full-CPU blocker ---------
-    # (ref single_node 1M queued in 193.7s => 5163/s; we queue 100k)
-    @ray_tpu.remote(num_cpus=8)
-    def blocker(path):
-        import pathlib
-        import time as _t
+    # (ref single_node 1M queued in 193.7s => 5163/s; we queue the same 1M)
+    if want("queued_flood"):
+        @ray_tpu.remote(num_cpus=8)
+        def blocker(path):
+            import pathlib
+            import time as _t
 
-        while not pathlib.Path(path).exists():
-            _t.sleep(0.05)
-        return None
+            while not pathlib.Path(path).exists():
+                _t.sleep(0.05)
+            return None
 
-    import tempfile
+        import tempfile
 
-    release = os.path.join(tempfile.mkdtemp(), "release")
-    b = blocker.remote(release)
-    time.sleep(0.5)
-    n = int(100_000 * s)
-    t0 = time.perf_counter()
-    refs = [noop.remote() for _ in range(n)]
-    t_submit = time.perf_counter() - t0
-    open(release, "w").close()
-    ray_tpu.get(b, timeout=120)
-    ray_tpu.get(refs, timeout=3600)
-    dt = time.perf_counter() - t0
-    emit("queued_flood_per_second", n / dt, "tasks/s", baseline=5163,
-         total=n, submit_seconds=round(t_submit, 2))
-    del refs
+        release = os.path.join(tempfile.mkdtemp(), "release")
+        b = blocker.remote(release)
+        time.sleep(0.5)
+        n = int(1_000_000 * s)
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(n)]
+        t_submit = time.perf_counter() - t0
+        open(release, "w").close()
+        ray_tpu.get(b, timeout=120)
+        ray_tpu.get(refs, timeout=3600)
+        dt = time.perf_counter() - t0
+        emit("queued_flood_per_second", n / dt, "tasks/s", baseline=5163,
+             total=n, submit_seconds=round(t_submit, 2))
+        del refs
 
     # ---- many_args / many_returns / many_gets -------------------------
-    n = int(1_000 * s)
-    arg_refs = [ray_tpu.put(i) for i in range(n)]
+    if want("many_args"):
+        n = int(1_000 * s)
+        arg_refs = [ray_tpu.put(i) for i in range(n)]
 
-    @ray_tpu.remote
-    def sink(*xs):
-        return len(xs)
+        @ray_tpu.remote
+        def sink(*xs):
+            return len(xs)
 
-    t0 = time.perf_counter()
-    assert ray_tpu.get(sink.remote(*arg_refs), timeout=600) == n
-    emit("many_args_seconds", time.perf_counter() - t0, "s", total=n)
-    del arg_refs
+        t0 = time.perf_counter()
+        assert ray_tpu.get(sink.remote(*arg_refs), timeout=600) == n
+        emit("many_args_seconds", time.perf_counter() - t0, "s", total=n)
+        del arg_refs
 
-    n = max(10, int(500 * s))
+    if want("many_returns"):
+        n = max(10, int(500 * s))
 
-    @ray_tpu.remote(num_returns=n)
-    def fan():
-        return list(range(n))
+        @ray_tpu.remote(num_returns=n)
+        def fan():
+            return list(range(n))
 
-    t0 = time.perf_counter()
-    outs = ray_tpu.get(list(fan.remote()), timeout=600)
-    emit("many_returns_seconds", time.perf_counter() - t0, "s", total=n)
-    assert outs == list(range(n))
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(list(fan.remote()), timeout=600)
+        emit("many_returns_seconds", time.perf_counter() - t0, "s",
+             total=n)
+        assert outs == list(range(n))
 
-    n = int(10_000 * s)
-    refs = [ray_tpu.put(i) for i in range(n)]
-    t0 = time.perf_counter()
-    vals = ray_tpu.get(refs, timeout=1200)
-    emit("many_gets_seconds", time.perf_counter() - t0, "s",
-         baseline=26.53, total=n)
-    assert vals == list(range(n))
-    del refs
+    if want("many_gets"):
+        n = int(10_000 * s)
+        refs = [ray_tpu.put(i) for i in range(n)]
+        t0 = time.perf_counter()
+        vals = ray_tpu.get(refs, timeout=1200)
+        emit("many_gets_seconds", time.perf_counter() - t0, "s",
+             baseline=26.53, total=n)
+        assert vals == list(range(n))
+        del refs
 
     # ---- leak check after the single-cluster probes -------------------
     # The daemon retains up to num_workers_soft_limit (= num_cpus here)
@@ -192,80 +276,90 @@ def main() -> None:
     ray_tpu.shutdown()
     time.sleep(2.0)
 
-    # ---- multi_daemon: 6 node daemons, spread + cross-node ------------
-    from ray_tpu.cluster_utils import Cluster
+    if want("multi_daemon") or want("chaos_soak"):
+        # ---- multi_daemon: 6 node daemons, spread + cross-node --------
+        from ray_tpu.cluster_utils import Cluster
 
-    ndaemons = 3 if quick else 6
-    cluster = Cluster(head_node_args={"num_cpus": 1})
-    for i in range(ndaemons - 1):
-        cluster.add_node(num_cpus=1, resources={f"n{i}": 1.0})
-    cluster.connect()
-    cluster.wait_for_nodes(ndaemons)
+        ndaemons = 3 if quick else 6
+        cluster = Cluster(head_node_args={"num_cpus": 1})
+        for i in range(ndaemons - 1):
+            cluster.add_node(num_cpus=1, resources={f"n{i}": 1.0})
+        cluster.connect()
+        cluster.wait_for_nodes(ndaemons)
 
-    @ray_tpu.remote(num_cpus=1, scheduling_strategy=SpreadSchedulingStrategy())
-    def where():
-        import time as _t
+        if want("multi_daemon"):
+            @ray_tpu.remote(num_cpus=1,
+                            scheduling_strategy=SpreadSchedulingStrategy())
+            def where():
+                import time as _t
 
-        import ray_tpu as rt
+                import ray_tpu as rt
 
-        # Dwell so the probe measures PLACEMENT across daemons, not one
-        # reused lease draining instant tasks (lease reuse keeps a fast
-        # serial stream on one worker by design — the reference's
-        # many-nodes probe sleeps for the same reason).
-        _t.sleep(0.2)
-        return rt.get_runtime_context().get_node_id()
+                # Dwell so the probe measures PLACEMENT across daemons,
+                # not one reused lease draining instant tasks (lease
+                # reuse keeps a fast serial stream on one worker by
+                # design — the reference's many-nodes probe sleeps for
+                # the same reason).
+                _t.sleep(0.2)
+                return rt.get_runtime_context().get_node_id()
 
-    n = 20 * ndaemons
-    t0 = time.perf_counter()
-    nodes_hit = set(ray_tpu.get([where.remote() for _ in range(n)],
-                                timeout=1800))
-    dt = time.perf_counter() - t0
-    emit("multi_daemon_tasks_per_second", n / dt, "tasks/s",
-         daemons=ndaemons, nodes_hit=len(nodes_hit))
-    assert len(nodes_hit) >= min(ndaemons, 3), nodes_hit
+            n = 20 * ndaemons
+            t0 = time.perf_counter()
+            nodes_hit = set(ray_tpu.get(
+                [where.remote() for _ in range(n)], timeout=1800))
+            dt = time.perf_counter() - t0
+            emit("multi_daemon_tasks_per_second", n / dt, "tasks/s",
+                 daemons=ndaemons, nodes_hit=len(nodes_hit))
+            assert len(nodes_hit) >= min(ndaemons, 3), nodes_hit
 
-    # cross-node object traffic: a chain that forces pulls between nodes
-    import numpy as np
+            # cross-node object traffic: a chain forcing inter-node pulls
+            import numpy as np
 
-    @ray_tpu.remote(num_cpus=1, scheduling_strategy=SpreadSchedulingStrategy())
-    def produce(i):
-        import time as _t
+            @ray_tpu.remote(num_cpus=1,
+                            scheduling_strategy=SpreadSchedulingStrategy())
+            def produce(i):
+                import time as _t
 
-        _t.sleep(0.2)   # dwell: spread across daemons (see `where`)
-        return np.full(200_000, i, dtype=np.float64)  # 1.6 MB
+                _t.sleep(0.2)   # dwell: spread across daemons (`where`)
+                return np.full(200_000, i, dtype=np.float64)  # 1.6 MB
 
-    @ray_tpu.remote(num_cpus=1, scheduling_strategy=SpreadSchedulingStrategy())
-    def reduce_sum(*arrs):
-        return float(sum(a.sum() for a in arrs))
+            @ray_tpu.remote(num_cpus=1,
+                            scheduling_strategy=SpreadSchedulingStrategy())
+            def reduce_sum(*arrs):
+                return float(sum(a.sum() for a in arrs))
 
-    k = 8 if quick else 24
-    t0 = time.perf_counter()
-    total = ray_tpu.get(
-        reduce_sum.remote(*[produce.remote(i) for i in range(k)]),
-        timeout=1800)
-    dt = time.perf_counter() - t0
-    assert total == sum(i * 200_000 for i in range(k))
-    emit("cross_node_reduce_seconds", dt, "s", chunks=k)
+            k = 8 if quick else 24
+            t0 = time.perf_counter()
+            total = ray_tpu.get(
+                reduce_sum.remote(*[produce.remote(i) for i in range(k)]),
+                timeout=1800)
+            dt = time.perf_counter() - t0
+            assert total == sum(i * 200_000 for i in range(k))
+            emit("cross_node_reduce_seconds", dt, "s", chunks=k)
 
-    # ---- chaos_soak: flood while a killer murders workers -------------
-    from ray_tpu.util.chaos import WorkerKiller
+        if want("chaos_soak"):
+            # ---- chaos_soak: flood while a killer murders workers -----
+            from ray_tpu.util.chaos import WorkerKiller
 
-    monkey = WorkerKiller(interval_s=1.0)
-    monkey.start()
-    try:
-        n = int(2_000 * s) or 200
-        t0 = time.perf_counter()
-        outs = ray_tpu.get(
-            [noop.remote() for _ in range(n)], timeout=3600)
-        dt = time.perf_counter() - t0
-        assert all(o is None for o in outs)
-        emit("chaos_soak_tasks_per_second", n / dt, "tasks/s",
-             total=n, kill_interval_s=1.0)
-    finally:
-        monkey.stop()
+            monkey = WorkerKiller(interval_s=1.0)
+            monkey.start()
+            try:
+                n = int(2_000 * s) or 200
+                t0 = time.perf_counter()
+                outs = ray_tpu.get(
+                    [noop.remote() for _ in range(n)], timeout=3600)
+                dt = time.perf_counter() - t0
+                assert all(o is None for o in outs)
+                emit("chaos_soak_tasks_per_second", n / dt, "tasks/s",
+                     total=n, kill_interval_s=1.0)
+            finally:
+                monkey.stop()
 
-    ray_tpu.shutdown()
+        ray_tpu.shutdown()
+    _write_results(out_path, quick)
 
+
+def _write_results(out_path: str, quick: bool) -> None:
     tag = "quick" if quick else "full"
     out = {"kind": "scale", "mode": tag, "host_cpus":
            len(os.sched_getaffinity(0)), "results": RESULTS,

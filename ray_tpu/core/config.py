@@ -41,6 +41,26 @@ class Config:
     health_check_failure_threshold: int = 5
     # How long raylets may take to reconnect to a restarted control plane.
     gcs_rpc_server_reconnect_timeout_s: int = 60
+    # ---- cluster-state syncer (syncer.py; ref: ray_syncer.proto:62 —
+    # versioned delta sync replaces full-state heartbeats) ----
+    # Delta sync on/off (off => legacy full-state heartbeats + 1 Hz
+    # list_nodes view polls).
+    syncer_enabled: bool = True
+    # Coalescing window between delta pushes: local changes batch into at
+    # most one wire message per interval.
+    syncer_report_interval_ms: int = 100
+    # Idle nodes piggyback liveness on the sync channel with a tiny
+    # keepalive at this cadence (must undercut health_check_period_ms *
+    # health_check_failure_threshold or idle nodes get marked dead).
+    syncer_keepalive_ms: int = 2000
+    # GCS fan-out coalescing: node changes batch into at most one
+    # cluster-view broadcast per interval.
+    syncer_broadcast_interval_ms: int = 200
+    # While the sync channel is healthy the legacy heartbeat degrades to
+    # a slow fallback: its period is multiplied by this factor.
+    syncer_heartbeat_fallback_factor: float = 5.0
+    # Cap for the heartbeat/syncer retry backoff when the GCS is down.
+    heartbeat_backoff_cap_s: float = 8.0
 
     # ---- node daemon / scheduling ----
     # Hybrid scheduling policy threshold: prefer the local node until its

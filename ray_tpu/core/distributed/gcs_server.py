@@ -108,15 +108,28 @@ class NodeInfo:
     def register_node(self, node_id: str, address: str,
                       resources: Dict[str, float], store_dir: str,
                       labels: Optional[Dict[str, str]] = None) -> dict:
+        prior = self.view.nodes.get(node_id)
         self.view.nodes[node_id] = NodeView(
             node_id=node_id, address=address, total=dict(resources),
             available=dict(resources), store_dir=store_dir,
             labels=labels or {})
-        logger.info("node %s registered at %s resources=%s", node_id[:8],
-                    address, resources)
-        self._gcs.event_log.emit("node", "INFO",
-                                 f"node {node_id[:8]} registered",
-                                 node_id=node_id, address=address)
+        if prior is not None and not prior.alive:
+            # An explicit resurrection, not silent flapping: the daemon
+            # was told "stale node" and chose to re-register as a fresh
+            # incarnation (its actors/objects were already failed over).
+            logger.warning("dead node %s re-registered at %s", node_id[:8],
+                           address)
+            self._gcs.event_log.emit(
+                "node", "WARNING",
+                f"node {node_id[:8]} re-registered after being marked "
+                f"dead", node_id=node_id, address=address)
+        else:
+            logger.info("node %s registered at %s resources=%s",
+                        node_id[:8], address, resources)
+            self._gcs.event_log.emit("node", "INFO",
+                                     f"node {node_id[:8]} registered",
+                                     node_id=node_id, address=address)
+        self._gcs.syncer.on_node_registered(node_id)
         self._gcs.pubsub.publish(
             "node", {"event": "added", "node_id": node_id,
                      "address": address, "resources": resources,
@@ -130,8 +143,14 @@ class NodeInfo:
         if n is None:
             return {"registered": False}  # ask the node to re-register
         if not n.alive:
-            return {"registered": False}
+            # Explicit stale-node verdict: updates from a node already
+            # marked dead must not flap its entry back to life — the
+            # daemon re-registers deliberately (a fresh incarnation) and
+            # full-resyncs its state through the syncer.
+            return {"registered": False, "stale": True,
+                    "reason": f"node {node_id[:8]} is marked dead"}
         self.view.update(node_id, available, queued=queued_demand)
+        self._gcs.syncer.on_node_heartbeat(node_id)
         return {"registered": True}
 
     def list_nodes(self) -> List[dict]:
@@ -161,6 +180,7 @@ class NodeInfo:
         self._gcs.event_log.emit("node", "WARNING",
                                  f"node {node_id[:8]} dead: {reason}",
                                  node_id=node_id, reason=reason)
+        self._gcs.syncer.on_node_dead(node_id)
         self._gcs.pubsub.publish(
             "node", {"event": "dead", "node_id": node_id, "reason": reason})
         self._gcs.actors.on_node_dead(node_id)
@@ -914,6 +934,14 @@ class AutoscalerStateManager:
                 "queued_demand": [dict(d) for d in n.queued],
                 "idle_s": max(0.0, now - n.last_busy) if n.alive else 0.0,
                 "labels": dict(n.labels),
+                # Synced through the delta channel (syncer.py): pool
+                # depth + store pressure, for scale-down safety checks.
+                "worker_pool": {"workers": n.workers,
+                                "idle": n.idle_workers,
+                                "busy": n.busy_workers},
+                "store": {"used": n.store_used,
+                          "objects": n.store_objects,
+                          "spilled": n.spilled_bytes},
             })
         pending_actors = [
             dict(rec.demand) for rec in self._gcs.actors.actors.values()
@@ -997,10 +1025,17 @@ class GcsServer:
         # with a storage dir, KV/actors/PGs/jobs survive a GCS restart —
         # daemons re-register via heartbeats and detached actors keep
         # their names (the Redis-backed fault-tolerance story).
+        from ray_tpu.core.distributed.syncer import ClusterSyncer
+
         self.store = open_store(storage_dir)
         self.pubsub = Pubsub()
         self.kv = KV(self.store)
         self.nodes = NodeInfo(self)
+        # Versioned delta sync (syncer.py): merges per-node state pushes
+        # into self.nodes.view and fans the coalesced cluster view back
+        # out to daemons. Constructed right after NodeInfo — every other
+        # manager reads the view it maintains.
+        self.syncer = ClusterSyncer(self)
         self.actors = ActorManager(self, self.store)
         self.objects = ObjectDirectory(self)
         self.placement_groups = PlacementGroupManager(self, self.store)
@@ -1033,6 +1068,7 @@ class GcsServer:
             ("AutoscalerState", self.autoscaler_state),
             ("Pubsub", self.pubsub),
             ("LogManager", self.logs),
+            ("Syncer", self.syncer),
         ]:
             self.server.add_service(name, svc)
         port = await self.server.start()
@@ -1040,6 +1076,7 @@ class GcsServer:
             asyncio.ensure_future(self.nodes.health_check_loop()),
             asyncio.ensure_future(self.actors.scheduling_loop()),
             asyncio.ensure_future(self.placement_groups.scheduling_loop()),
+            asyncio.ensure_future(self.syncer.broadcast_loop()),
         ]
         # Resume scheduling of state loaded from durable storage.
         self.actors.requeue_loaded()

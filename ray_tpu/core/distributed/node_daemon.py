@@ -33,6 +33,8 @@ from ray_tpu.core.distributed import resources as rs
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
 from ray_tpu.core.distributed.scheduler import (
     ClusterView, NodeView, pick_feasible_node, pick_node)
+from ray_tpu.core.distributed.syncer import (
+    NodeSyncer, collect_queued_demand)
 from ray_tpu.core.distributed.worker_zygote import (
     ZygoteError, ZygoteHandle, start_zygote)
 
@@ -128,6 +130,10 @@ class NodeDaemon:
         # object_id -> [bytearray, last_touch_monotonic]
         self._push_partial: Dict[bytes, list] = {}
         self._view = ClusterView()
+        # Versioned delta reporter + cluster-view receiver (syncer.py);
+        # None when RAY_TPU_SYNCER_ENABLED=0 (legacy full-state
+        # heartbeats + 1 Hz list_nodes polling).
+        self.syncer: Optional[NodeSyncer] = None
         self._tasks: List[asyncio.Task] = []
         self._soft_limit = int(get_config().num_workers_soft_limit
                                or self.total.get("CPU", 1))
@@ -163,6 +169,18 @@ class NodeDaemon:
 
         self._log_monitor = LogMonitor(self.log_dir, self.node_id,
                                        worker_info)
+        if get_config().syncer_enabled:
+            self.syncer = NodeSyncer(
+                gcs=self.gcs, node_id=self.node_id,
+                collect=self._syncer_state,
+                on_reregister=self._re_register,
+                metrics={
+                    "deltas": self._m_sync_deltas,
+                    "suppressed": self._m_sync_suppressed,
+                    "bytes": self._m_sync_bytes,
+                    "full_syncs": self._m_sync_full,
+                    "keepalives": self._m_sync_keepalives,
+                })
         self._tasks = [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._monitor_workers_loop()),
@@ -170,6 +188,12 @@ class NodeDaemon:
             asyncio.ensure_future(self._memory_monitor_loop()),
             asyncio.ensure_future(self._log_monitor.run(self.gcs)),
         ]
+        if self.syncer is not None:
+            self._tasks += [
+                asyncio.ensure_future(self.syncer.report_loop()),
+                asyncio.ensure_future(
+                    self.syncer.subscribe_loop(self._view)),
+            ]
         self._start_metrics_http()
         if get_config().zygote_enabled:
             # Eager default-env zygote: its interpreter boot + preload
@@ -198,43 +222,97 @@ class NodeDaemon:
         self.store.disconnect()
         ObjectStore.destroy(self.store_dir)
 
+    def _syncer_state(self) -> Dict[str, Any]:
+        """Local versioned view the syncer diffs + ships: resources,
+        queued load, object-store stats, worker-pool depth (ref: the
+        raylet's RESOURCE_VIEW sync message, ray_syncer.proto:62)."""
+        busy = sum(1 for h in self._workers.values() if h.busy)
+        return {
+            "available": dict(self.available),
+            "queued": collect_queued_demand(self._lease_waiters,
+                                            self._infeasible_waits),
+            "store_used": self.store.used,
+            "store_objects": self.store.num_objects,
+            "spilled_bytes": self.store.spilled_bytes,
+            "workers": len(self._workers),
+            "idle_workers": len(self._idle),
+            "busy_workers": busy,
+        }
+
+    async def _re_register(self) -> None:
+        """(Re-)register this node and force the syncer to full-resync —
+        the GCS forgot us (restart) or marked us dead (stale verdict)."""
+        await self.gcs.call(
+            "NodeInfo", "register_node", node_id=self.node_id,
+            address=self.server.address, resources=self.total,
+            store_dir=self.store_dir, labels=self.labels, timeout=10)
+        if self.syncer is not None:
+            self.syncer.force_full_resync()
+            self.syncer.mark_dirty()
+
     async def _heartbeat_loop(self):
-        period = get_config().health_check_period_ms / 1000 / 2
+        cfg = get_config()
+        base = cfg.health_check_period_ms / 1000 / 2
+        cap = max(base, cfg.heartbeat_backoff_cap_s)
+        backoff = base
         while True:
             try:
                 # Queued demand feeds the autoscaler (ref: the raylet's
                 # resource-load report through the syncer): leases waiting
                 # on busy local resources plus infeasible-here demands
                 # still waiting for a capable node to join the cluster.
-                queued = [dict(d) for (d, *_rest) in self._lease_waiters]
-                queued.extend(dict(d)
-                              for d in self._infeasible_waits.values())
+                queued = collect_queued_demand(self._lease_waiters,
+                                               self._infeasible_waits)
                 reply = await self.gcs.call(
                     "NodeInfo", "heartbeat", node_id=self.node_id,
                     available=dict(self.available),
                     queued_demand=queued, timeout=10)
                 if not reply.get("registered"):
-                    await self.gcs.call(
-                        "NodeInfo", "register_node", node_id=self.node_id,
-                        address=self.server.address, resources=self.total,
-                        store_dir=self.store_dir, labels=self.labels,
-                        timeout=10)
+                    if reply.get("stale"):
+                        logger.warning(
+                            "GCS verdict: stale node (%s); re-registering "
+                            "as a fresh incarnation",
+                            reply.get("reason", ""))
+                    await self._re_register()
+                backoff = base
             except Exception as e:  # noqa: BLE001
-                logger.debug("heartbeat failed: %s", e)
+                # Capped exponential backoff — a down GCS must not be
+                # hammered at full cadence, and the failure must be
+                # visible (counter + warning, not a swallowed debug).
+                self._m_heartbeat_failures.inc()
+                logger.warning("heartbeat failed: %s (retry in %.1fs)",
+                               e, backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, cap)
+                continue
+            period = base
+            if self.syncer is not None and self.syncer.healthy():
+                # Liveness rides the sync stream (pushes + keepalives);
+                # this loop degrades to a slow safety-net probe.
+                period = base * max(
+                    1.0, cfg.syncer_heartbeat_fallback_factor)
             await asyncio.sleep(period)
 
     async def _refresh_view_once(self) -> None:
         nodes = await self.gcs.call("NodeInfo", "list_nodes", timeout=10)
-        view = ClusterView()
+        fresh = {}
         for n in nodes:
-            view.nodes[n["node_id"]] = NodeView(
+            fresh[n["node_id"]] = NodeView(
                 node_id=n["node_id"], address=n["address"],
                 total=n["total"], available=n["available"],
                 alive=n["alive"], store_dir=n["store_dir"])
-        self._view = view
+        # Mutate in place: the syncer's subscribe loop folds broadcasts
+        # into this same ClusterView object.
+        self._view.nodes = fresh
 
     async def _refresh_view_loop(self):
         while True:
+            if self.syncer is not None and self.syncer.view_fresh():
+                # The spillback view is being fed by the GCS fan-out
+                # stream; polling the full node table would be O(nodes)
+                # redundant bytes per tick.
+                await asyncio.sleep(1.0)
+                continue
             try:
                 await self._refresh_view_once()
             except Exception:  # noqa: BLE001
@@ -479,6 +557,32 @@ class NodeDaemon:
             "raytpu_workers_prestarted_total",
             "Warm workers prestarted against lease backlog"
         ).set_default_tags(tags)
+        self._m_heartbeat_failures = Counter(
+            "raytpu_heartbeat_failures_total",
+            "Heartbeat RPCs to the GCS that failed").set_default_tags(tags)
+        # Cluster-state syncer (syncer.py): the delta/suppressed/bytes
+        # trio is what proves the control plane ships deltas, not
+        # full-state posts.
+        self._m_sync_deltas = Counter(
+            "raytpu_syncer_deltas_sent_total",
+            "Versioned state deltas pushed to the GCS"
+        ).set_default_tags(tags)
+        self._m_sync_suppressed = Counter(
+            "raytpu_syncer_deltas_suppressed_total",
+            "Report ticks suppressed because nothing changed"
+        ).set_default_tags(tags)
+        self._m_sync_bytes = Counter(
+            "raytpu_syncer_bytes_sent_total",
+            "Serialized bytes of state pushed to the GCS"
+        ).set_default_tags(tags)
+        self._m_sync_full = Counter(
+            "raytpu_syncer_full_syncs_sent_total",
+            "Full snapshot resyncs pushed (connect/reconnect/gap)"
+        ).set_default_tags(tags)
+        self._m_sync_keepalives = Counter(
+            "raytpu_syncer_keepalives_sent_total",
+            "Liveness keepalives piggybacked on the sync channel"
+        ).set_default_tags(tags)
 
     def get_metrics(self) -> str:
         """Prometheus exposition text; also served over HTTP when
@@ -548,6 +652,10 @@ class NodeDaemon:
             "pg_bundles": len(self._pg_bundles),
             "zygotes": sum(1 for z in self._zygotes.values()
                            if z.alive()),
+            "syncer": (dict(self.syncer.stats,
+                            version=self.syncer.version,
+                            view_version=self.syncer.view_version)
+                       if self.syncer is not None else None),
         }
 
     def list_workers(self) -> list:
@@ -1202,6 +1310,8 @@ class NodeDaemon:
         lease_id = uuid.uuid4().hex
         self._leases[lease_id] = Lease(lease_id, demand, worker, placement)
         self._m_leases.inc()
+        if self.syncer is not None:
+            self.syncer.mark_dirty()  # availability changed: sync promptly
         self._ledger(f"grant:{lease_id[:8]}:pid{worker.proc.pid}", demand)
         return {"granted": True, "worker_address": worker.address,
                 "lease_id": lease_id}
@@ -1240,6 +1350,8 @@ class NodeDaemon:
             worker.last_idle = time.monotonic()
             if worker not in self._idle:
                 self._idle.append(worker)
+        if self.syncer is not None:
+            self.syncer.mark_dirty()  # resources freed: sync promptly
         self._pump_lease_queue()
 
     def _find_pg_bundle(self, pg_id: str, demand) -> Optional[int]:

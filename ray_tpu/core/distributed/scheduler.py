@@ -33,6 +33,54 @@ class NodeView:
     # daemon and the last moment it was observed busy.
     queued: List[rs.ResourceSet] = dataclasses.field(default_factory=list)
     last_busy: float = dataclasses.field(default_factory=time.monotonic)
+    # Synced node stats (syncer.py STATE_KEYS): object-store pressure and
+    # worker-pool depth, shipped as deltas alongside resources.
+    store_used: int = 0
+    store_objects: int = 0
+    spilled_bytes: int = 0
+    workers: int = 0
+    idle_workers: int = 0
+    busy_workers: int = 0
+
+
+# Dynamic NodeView attributes the syncer may overwrite from a reported
+# state dict (the "available"/"queued" pair keeps heartbeat parity).
+_SYNCED_ATTRS = ("available", "queued", "store_used", "store_objects",
+                 "spilled_bytes", "workers", "idle_workers", "busy_workers")
+# Everything a daemon needs of a peer to make spillback decisions —
+# the cluster-view fan-out entry.
+_WIRE_ATTRS = ("node_id", "address", "total", "available", "alive",
+               "labels", "store_dir", "queued") + _SYNCED_ATTRS[2:]
+
+
+def node_wire(n: NodeView) -> dict:
+    """NodeView -> broadcast wire dict (plain primitives only)."""
+    return {a: getattr(n, a) for a in _WIRE_ATTRS}
+
+
+def apply_node_wire(view: "ClusterView", payload: dict) -> None:
+    """Fold a syncer broadcast payload (full or delta) into a view."""
+    if payload.get("full"):
+        view.nodes = {}
+    for nid, wire in (payload.get("nodes") or {}).items():
+        n = view.nodes.get(nid)
+        if n is None:
+            view.nodes[nid] = NodeView(
+                node_id=nid, address=wire.get("address", ""),
+                total=dict(wire.get("total") or {}),
+                available=dict(wire.get("available") or {}),
+                alive=wire.get("alive", True),
+                labels=dict(wire.get("labels") or {}),
+                store_dir=wire.get("store_dir", ""))
+            n = view.nodes[nid]
+        for attr in _WIRE_ATTRS:
+            if attr in wire:
+                setattr(n, attr, wire[attr])
+        n.last_heartbeat = time.monotonic()
+    for nid in payload.get("dead") or ():
+        n = view.nodes.get(nid)
+        if n is not None:
+            n.alive = False
 
 
 class ClusterView:
@@ -52,6 +100,20 @@ class ClusterView:
             n.last_heartbeat = time.monotonic()
             if n.queued or rs.utilization(n.total, n.available) > rs.EPS:
                 n.last_busy = n.last_heartbeat
+
+    def apply_state(self, node_id: str, state: Dict) -> bool:
+        """Apply a (partial) synced state dict — the syncer's delta-apply
+        seam. Refreshes liveness exactly like a heartbeat would."""
+        n = self.nodes.get(node_id)
+        if n is None:
+            return False
+        for attr in _SYNCED_ATTRS:
+            if attr in state:
+                setattr(n, attr, state[attr])
+        n.last_heartbeat = time.monotonic()
+        if n.queued or rs.utilization(n.total, n.available) > rs.EPS:
+            n.last_busy = n.last_heartbeat
+        return True
 
 
 def pick_node(
@@ -90,10 +152,16 @@ def pick_node(
     if not fitting:
         return None
 
+    def rank(n: NodeView):
+        # Primary: least utilized. Tie-breaks come from the synced view:
+        # shorter queued backlog, then a warm (idle) worker already
+        # booted — landing there turns the spawn into a pool pop.
+        return (rs.utilization(n.total, n.available, demand),
+                len(n.queued), -n.idle_workers)
+
     if strategy == "spread":
         # Least utilized first => round-robin-ish spread under churn.
-        fitting.sort(key=lambda n: rs.utilization(n.total, n.available,
-                                                  demand))
+        fitting.sort(key=rank)
         return fitting[0]
 
     # hybrid
@@ -104,7 +172,7 @@ def pick_node(
                 and rs.utilization(local.total, local.available,
                                    demand) < spread_threshold):
             return local
-    fitting.sort(key=lambda n: rs.utilization(n.total, n.available, demand))
+    fitting.sort(key=rank)
     k = max(1, int(len(fitting) * top_k_fraction))
     return rng.choice(fitting[:k])
 

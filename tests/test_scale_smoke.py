@@ -80,6 +80,67 @@ def test_many_actors_1000(cluster_ray):
     assert rate >= 5.0, f"actor churn regressed to {rate:.2f}/s"
 
 
+def _virtual_node_envelope(n_nodes: int, churn_rounds: int,
+                           report_interval_s: float) -> tuple:
+    """Stand up `n_nodes` virtual daemons (virtual_node.py) against an
+    in-process GCS, churn load, and return (alive, gcs_stats, agg)."""
+    import asyncio
+
+    from ray_tpu.core.distributed.gcs_server import GcsServer
+    from ray_tpu.core.distributed.virtual_node import VirtualCluster
+
+    async def run():
+        gcs = GcsServer()
+        port = await gcs.start()
+        vc = VirtualCluster(f"127.0.0.1:{port}", n_nodes=n_nodes,
+                            report_interval_s=report_interval_s,
+                            keepalive_s=2.0, subscribers=3, seed=11)
+        await vc.start()
+        for _ in range(churn_rounds):
+            vc.churn(0.25)
+            await asyncio.sleep(report_interval_s + 0.1)
+        await asyncio.sleep(1.5)
+        alive = sum(1 for nv in gcs.nodes.view.nodes.values() if nv.alive)
+        stats = gcs.syncer.stats()
+        agg = vc.aggregate_stats()
+        sub_view = len(vc.nodes[0].view.nodes)
+        await vc.stop()
+        await gcs.stop()
+        return alive, stats, agg, sub_view
+
+    return asyncio.run(run())
+
+
+def test_virtual_nodes_100_sync_deltas():
+    """CI-sized many_nodes shape: 100 virtual daemons register, sync
+    deltas (not full-state posts), and stay alive through churn."""
+    alive, stats, agg, sub_view = _virtual_node_envelope(
+        100, churn_rounds=3, report_interval_s=0.1)
+    assert alive == 100
+    assert agg["errors"] == 0
+    assert stats["applied_deltas"] >= 1
+    delta_like = stats["applied_deltas"] + agg["suppressed"]
+    assert delta_like >= 2 * stats["applied_full"], (stats, agg)
+    assert sub_view == 100
+
+
+@pytest.mark.slow
+def test_many_virtual_nodes_1000():
+    """Full-size scale envelope (bench_scale.py's many_nodes shape):
+    1000 virtual daemons sustained on one GCS, with the sync path
+    provably delta-dominant — a regression to full-state reporting
+    (or nodes flapping dead under load) fails this."""
+    alive, stats, agg, sub_view = _virtual_node_envelope(
+        1000, churn_rounds=8, report_interval_s=0.5)
+    assert alive >= 1000, f"only {alive}/1000 virtual daemons alive"
+    assert agg["errors"] == 0, agg
+    assert stats["applied_deltas"] >= 100
+    ratio = ((stats["applied_deltas"] + agg["suppressed"])
+             / max(1, stats["applied_full"]))
+    assert ratio >= 3.0, (stats, agg)
+    assert sub_view >= 1000
+
+
 def test_many_args_many_returns_many_gets(cluster_ray):
     """Single-node scalability shapes: wide arg lists, wide returns,
     bulk get (ref: single_node/test_single_node.py)."""

@@ -1,6 +1,10 @@
 """Record bench_scale.py output into BENCH_SCALE_r{N}.json (round-end
 artifact; same shape as record_core_bench.py's). Usage:
-    python tools/record_scale_bench.py 6 [--quick]
+    python tools/record_scale_bench.py 7 [--quick] [--only probe1,probe2]
+
+Extra args pass straight through to bench_scale.py — `--only
+many_nodes,queued_flood` re-records just the control-plane envelope
+probes (1000 virtual daemons / 1M queued tasks) without the full suite.
 """
 import datetime
 import json
