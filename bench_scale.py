@@ -6,6 +6,11 @@ release/benchmarks/single_node/test_single_node.py).
 Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
   many_nodes        1000 virtual daemons syncing deltas to one GCS
                     (virtual_node.py harness; ref demonstrates 2k nodes)
+  object_transfer   1->1 pull of a 512 MiB object over the zero-copy
+                    plane (raw frames + direct-to-shm striped pull) vs
+                    the legacy pickle/heap-assemble path
+  broadcast         1->8 in-proc daemons via the relay tree; asserts
+                    the owner uplink carries <= fanout x size bytes
   many_tasks        10k short tasks through 4 submitters   (ref 589/s)
   many_actors       1k actor create+ping+kill              (ref 580/s)
   queued_flood      1M tasks queued behind a blocker       (ref 5163/s*)
@@ -115,6 +120,145 @@ def bench_many_nodes(quick: bool) -> None:
          delta_bytes=int(agg["bytes_sent"]))
 
 
+def _fill_store_object(store, oid, size: int) -> None:
+    """Seed a store object of `size` bytes without a size-sized Python
+    heap allocation (create-then-fill keeps the bench's own RSS flat)."""
+    import os as _os
+
+    pb = store.create_for_receive(oid, size)
+    seed = _os.urandom(4 << 20)
+    for off in range(0, size, len(seed)):
+        pb.write_at(off, seed[:min(len(seed), size - off)])
+    pb.seal()
+
+
+def bench_object_transfer(quick: bool) -> None:
+    """1->1 pull throughput over the zero-copy transfer plane (raw-frame
+    chunks, direct-to-shm striped pull) vs the legacy path (pickled
+    bytes chunks assembled on the receiver heap, then put_raw) — the
+    r07-and-earlier pull pipeline, measured on the same host/object."""
+    import asyncio
+    import tempfile
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.core.distributed.transfer import (
+        ChunkSink, RawChunkFetcher, striped_pull)
+    from ray_tpu.core.distributed.virtual_node import InProcDaemonCluster
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import ObjectStore
+
+    size = (64 if quick else 512) << 20
+
+    async def run():
+        vc = InProcDaemonCluster(1, store_capacity=2 * size)
+        await vc.start()
+        sink_dir = tempfile.mkdtemp(prefix="bench_pull_", dir="/dev/shm")
+        local = ObjectStore(sink_dir, capacity=2 * size)
+        fetcher = RawChunkFetcher()
+        try:
+            d0 = vc.daemons[0]
+            oid = ObjectID(os.urandom(20))
+            _fill_store_object(d0.store, oid, size)
+            client = AsyncRpcClient(d0.server.address)
+            cfg = get_config()
+
+            def open_sink(oid_b, total):
+                return ChunkSink(
+                    local.create_for_receive(ObjectID(oid_b), total),
+                    total)
+
+            t0 = time.perf_counter()
+            total, _ = await striped_pull(
+                oid.binary(), [(d0.node_id, d0.server.address)],
+                fetcher.fetch, open_sink,
+                chunk_bytes=cfg.object_transfer_chunk_bytes,
+                window_bytes=cfg.transfer_window_bytes,
+                per_source=cfg.transfer_per_source_inflight)
+            dt_new = time.perf_counter() - t0
+            assert total == size, total
+            assert local.contains(oid)
+            local.delete(oid, force=True)
+
+            # Legacy r07 pull path: server bytes()-copies each chunk
+            # through pickle, receiver accumulates the whole object on
+            # the heap, joins, then copies into the store.
+            t0 = time.perf_counter()
+            chunks = []
+            async for item in client.stream(
+                    "NodeDaemon", "stream_pull_object",
+                    object_id=oid.binary(), timeout=600):
+                if item.get("missing"):
+                    raise RuntimeError("object vanished")
+                chunks.append(item["data"])
+            data = b"".join(chunks)
+            local.put_raw(oid, data)
+            dt_old = time.perf_counter() - t0
+            assert len(data) == size
+            await client.close()
+        finally:
+            fetcher.close()
+            local.disconnect()
+            ObjectStore.destroy(sink_dir)
+            await vc.stop()
+        return dt_new, dt_old
+
+    dt_new, dt_old = asyncio.run(run())
+    gbps_old = size / dt_old / 1e9
+    emit("object_transfer_gbps", size / dt_new / 1e9, "GB/s",
+         baseline=gbps_old, size_mib=size >> 20)
+    emit("object_transfer_legacy_gbps", gbps_old, "GB/s",
+         size_mib=size >> 20)
+
+
+def bench_broadcast(quick: bool) -> None:
+    """1->8 pre-staging through the chunked relay tree (fanout 2): the
+    owner serves only its children while grandchildren stream the same
+    chunks onward as they land. Asserts the owner's uplink moved
+    <= fanout x size bytes (the counters are the proof the tree, not
+    N unicasts, carried the object)."""
+    import asyncio
+
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.core.distributed.virtual_node import InProcDaemonCluster
+    from ray_tpu.core.ids import ObjectID
+
+    size = (16 if quick else 64) << 20
+    n = 8
+
+    async def run():
+        vc = InProcDaemonCluster(n + 1,
+                                 store_capacity=max(2 * size, 128 << 20))
+        await vc.start()
+        try:
+            owner, *rest = vc.daemons
+            oid = ObjectID(os.urandom(20))
+            _fill_store_object(owner.store, oid, size)
+            sent0 = sum(v for _, v in owner._m_xfer_out.samples())
+            client = AsyncRpcClient(owner.server.address)
+            t0 = time.perf_counter()
+            rep = await client.call(
+                "NodeDaemon", "broadcast_object", object_id=oid.binary(),
+                targets=[d.server.address for d in rest], timeout=600)
+            dt = time.perf_counter() - t0
+            await client.close()
+            assert rep["ok"] and rep["nodes"] == n, rep
+            for d in rest:
+                assert d.store.contains(oid)
+            owner_sent = sum(
+                v for _, v in owner._m_xfer_out.samples()) - sent0
+            assert owner_sent <= 2 * size * 1.05, (
+                f"owner uplink {owner_sent} bytes > fanout bound "
+                f"{2 * size}")
+        finally:
+            await vc.stop()
+        return dt, owner_sent
+
+    dt, owner_sent = asyncio.run(run())
+    emit("broadcast_gbps", size * n / dt / 1e9, "GB/s", nodes=n,
+         size_mib=size >> 20, owner_uplink_x=round(owner_sent / size, 2))
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     out_path = "BENCH_SCALE_r05.json"
@@ -128,11 +272,16 @@ def main() -> None:
     def want(probe: str) -> bool:
         return only is None or probe in only
 
-    # Standalone control-plane probe first: it hosts its own in-process
-    # GCS and must not share the driver's cluster.
+    # Standalone probes first: each hosts its own in-process GCS/daemons
+    # and must not share the driver's cluster.
+    standalone = {"many_nodes", "object_transfer", "broadcast"}
     if want("many_nodes"):
         bench_many_nodes(quick)
-    if only is not None and not (only - {"many_nodes"}):
+    if want("object_transfer"):
+        bench_object_transfer(quick)
+    if want("broadcast"):
+        bench_broadcast(quick)
+    if only is not None and not (only - standalone):
         _write_results(out_path, quick)
         return
 

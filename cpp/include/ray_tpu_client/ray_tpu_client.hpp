@@ -478,9 +478,12 @@ inline Value PickleLoads(const std::string& data) {
 // the frame-TYPE byte at this offset (REQ=1..CANCEL=6), so a version
 // equal to a frame type would let an old-generation peer pass the
 // check and be misparsed instead of cleanly rejected.
-constexpr uint8_t kProtocolVersion = 16;
+// v17: RAW codec (out-of-band binary attachment frames; Python-
+// side bulk data plane — C++ peers never send or receive it).
+constexpr uint8_t kProtocolVersion = 17;
 constexpr uint8_t kCodecPickle = 0;
 constexpr uint8_t kCodecTyped = 1;
+constexpr uint8_t kCodecRaw = 2;  // not spoken from C++
 constexpr uint32_t kMaxFrame = 512u * 1024 * 1024;
 // u32 length | u8 version | u8 type | u64 req_id; length counts
 // version+type+id+payload.

@@ -102,6 +102,29 @@ class Config:
     actor_schedule_concurrency: int = 8
     # Object transfer chunk size over DCN (ref: ray_config_def.h:352 — 5 MiB).
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    # ---- object transfer plane (transfer.py; RAY_TPU_TRANSFER_*) ----
+    # Per-pull in-flight chunk budget in BYTES (not chunks): the window
+    # striped across all replica sources. Also the receiver's heap
+    # high-water bound — chunks land direct-to-shm, only in-flight
+    # frames live on the Python heap.
+    transfer_window_bytes: int = 64 * 1024 * 1024
+    # Concurrent chunk fetches pipelined per source within the window.
+    transfer_per_source_inflight: int = 2
+    # Per-chunk RPC deadline; also how long a relay serve waits for a
+    # not-yet-landed range of an in-flight broadcast object.
+    transfer_chunk_timeout_s: float = 30.0
+    # Abandoned receive partials (pusher/parent died mid-transfer) are
+    # aborted after this long, freeing their store reservation.
+    transfer_partial_ttl_s: float = 300.0
+    # Relay-tree fan-out for 1->N broadcast pre-staging: each node
+    # serves at most this many children, so the owner's uplink carries
+    # fanout*size instead of N*size.
+    transfer_broadcast_fanout: int = 2
+    # Chunk RPCs a push/relay keeps in flight toward one peer.
+    transfer_push_pipeline: int = 4
+    # Kill switch: serve chunk payloads as raw frames (zero-copy);
+    # 0 falls back to the legacy bytes-through-pickle path.
+    transfer_raw_frames: bool = True
 
     # ---- object store ----
     # Per-node shared-memory store capacity. 0 => 30% of system RAM

@@ -441,9 +441,20 @@ class DistributedCoreWorker:
         self._owner_clients: Dict[str, SyncRpcClient] = {}
         self.gcs = SyncRpcClient(gcs_address, self.loop_thread)
         from ray_tpu.core.distributed.pull_manager import PullManager
+        from ray_tpu.core.distributed.transfer import (
+            RawChunkFetcher, make_transfer_metrics)
 
-        self._pull_manager = PullManager(self.loop_thread.loop,
-                                         self._fetch_object_chunks)
+        # Striped transfer backend: raw-frame chunks fetched from every
+        # replica at once land straight in the local store's mmap
+        # (recv_into, create-then-fill) — pull_manager.py / transfer.py.
+        self._xfer_metrics = make_transfer_metrics(
+            {"node_id": node_id[:12], "component": "worker"})
+        self._chunk_fetcher = RawChunkFetcher()
+        self._pull_manager = PullManager(
+            self.loop_thread.loop,
+            fetch_chunk=self._chunk_fetcher.fetch,
+            open_sink=self._open_pull_sink,
+            metrics=self._xfer_metrics)
         self._submit_buffer: deque = deque()
         self._submit_scheduled = False
         if get_config().tracing_enabled:
@@ -1120,7 +1131,7 @@ class DistributedCoreWorker:
         if not candidates:
             return False, len(info["nodes"]) - stale
         try:
-            data, stale_nodes = self._pull_manager.pull_sync(
+            total_size, stale_nodes = self._pull_manager.pull_sync(
                 oid.binary(), candidates, info.get("size") or 1,
                 priority=pm.PRIORITY_GET if priority is None else priority)
         except Exception as e:  # noqa: BLE001 transfer timeout/failure:
@@ -1131,18 +1142,15 @@ class DistributedCoreWorker:
         for nid in stale_nodes:
             stale += 1
             self._remove_stale_location(oid, nid)
-        if data is None:
+        if total_size is None:
             return False, len(info["nodes"]) - stale
-        try:
-            self.store.put_raw(oid, data)
-        except Exception:  # noqa: BLE001 already raced in
-            pass
-        # This node now genuinely holds a copy — register it so other
+        # The striped pull sealed the bytes straight into the local
+        # store (create-then-fill); register the new copy so other
         # processes (e.g. a worker fetching task args) can find it.
         try:
             self.gcs.call("ObjectDirectory", "add_location",
                           object_id=oid.binary(), node_id=self.node_id,
-                          size=len(data), timeout=10)
+                          size=total_size, timeout=10)
         except Exception:  # noqa: BLE001
             pass
         return True, len(info["nodes"])
@@ -1285,22 +1293,15 @@ class DistributedCoreWorker:
             if r.inline is not None:
                 self._cache_inline(ObjectID(r.oid), r.inline)
 
-    async def _fetch_object_chunks(self, address: str,
-                                   oid_b: bytes) -> Optional[bytes]:
-        """One chunked transfer from a holder's daemon (PullManager's
-        fetch fn)."""
-        client = AsyncRpcClient(address)
-        try:
-            chunks = []
-            async for item in client.stream(
-                    "NodeDaemon", "stream_pull_object",
-                    object_id=oid_b, timeout=120):
-                if item.get("missing"):
-                    return None
-                chunks.append(item["data"])
-            return b"".join(chunks)
-        finally:
-            await client.close()
+    def _open_pull_sink(self, oid_b: bytes, total_size: int):
+        """Create-then-fill sink in the local store (striped_pull's
+        open_sink fn): received chunks never touch the Python heap
+        beyond their in-flight frame."""
+        from ray_tpu.core.distributed.transfer import ChunkSink
+
+        return ChunkSink(
+            self.store.create_for_receive(ObjectID(oid_b), total_size),
+            total_size)
 
     async def _span_flush_loop(self) -> None:
         from ray_tpu.util import tracing
@@ -1403,6 +1404,45 @@ class DistributedCoreWorker:
                                 target_address=target["address"],
                                 timeout=timeout)
             return bool(reply.get("ok"))
+        finally:
+            client.close()
+
+    def broadcast_object(self, ref: ObjectRef, node_ids: List[str],
+                         timeout: float = 600.0) -> dict:
+        """Pre-stage one object onto MANY nodes through the daemon
+        relay tree (node_daemon.broadcast_object): the holder serves
+        only its fanout children and the tree pipelines chunk relays,
+        so weight-style 1->N distribution costs the owner fanout*size
+        of uplink instead of N*size. Returns the daemon's verdict
+        ({ok, nodes, bytes, errors})."""
+        oid = ref.id()
+        nodes = {n["node_id"]: n
+                 for n in self.gcs.call("NodeInfo", "list_nodes",
+                                        timeout=30)
+                 if n["alive"]}
+        info = self.gcs.call("ObjectDirectory", "get_locations",
+                             object_id=oid.binary(), timeout=30)
+        holders = [n["node_id"] for n in info["nodes"]]
+        if self.store.contains(oid) and self.node_id not in holders:
+            holders.append(self.node_id)  # registration still in flight
+        if self.node_id in holders:
+            holder_id = self.node_id
+        else:
+            holder_id = next((h for h in holders if h in nodes), None)
+        if holder_id is None or holder_id not in nodes:
+            return {"ok": False, "nodes": 0,
+                    "errors": ["no live node holds the object"]}
+        targets = [nodes[nid]["address"] for nid in node_ids
+                   if nid in nodes and nid != holder_id
+                   and nid not in holders]
+        if not targets:
+            return {"ok": True, "nodes": 0, "errors": []}
+        client = SyncRpcClient(nodes[holder_id]["address"],
+                               self.loop_thread)
+        try:
+            return client.call("NodeDaemon", "broadcast_object",
+                               object_id=oid.binary(), targets=targets,
+                               timeout=timeout)
         finally:
             client.close()
 
@@ -2420,6 +2460,10 @@ class DistributedCoreWorker:
             except Exception:  # noqa: BLE001
                 pass
             self._stop_spawned_processes()
+        try:
+            self._chunk_fetcher.close()
+        except Exception:  # noqa: BLE001
+            pass
         try:
             self.store.disconnect()
         except Exception:  # noqa: BLE001

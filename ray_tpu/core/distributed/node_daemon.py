@@ -28,9 +28,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.object_store import ObjectExistsError, ObjectStore
 from ray_tpu.core.distributed import resources as rs
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
+from ray_tpu.core.distributed.transfer import (
+    ChunkSink, chunk_ranges, make_transfer_metrics, plan_broadcast_tree)
+from ray_tpu.core.distributed.wire import Raw
 from ray_tpu.core.distributed.scheduler import (
     ClusterView, NodeView, pick_feasible_node, pick_node)
 from ray_tpu.core.distributed.syncer import (
@@ -124,11 +127,18 @@ class NodeDaemon:
         self._infeasible_waits: Dict[int, rs.ResourceSet] = {}
         self._infeasible_seq = 0
         # Push manager state (ref: push_manager.h:30 — dedup + bounded
-        # concurrent pushes; receiving side assembles chunks).
+        # concurrent pushes; receiving side fills the store directly).
         self._push_inflight: Dict[Tuple[str, bytes], asyncio.Future] = {}
         self._push_sem = asyncio.Semaphore(4)
-        # object_id -> [bytearray, last_touch_monotonic]
-        self._push_partial: Dict[bytes, list] = {}
+        # In-flight receives: object_id -> ChunkSink writing straight
+        # into the store's mmap (create-then-fill). Chunks may land in
+        # any order; the sink seals itself at full coverage, and
+        # get_object_chunk can RE-SERVE landed ranges before seal (the
+        # broadcast relay pipeline).
+        self._recv_partials: Dict[bytes, ChunkSink] = {}
+        # Pooled clients to peer daemons (push/relay/broadcast): one
+        # multiplexed connection per peer instead of a dial per chunk.
+        self._peer_clients: Dict[str, AsyncRpcClient] = {}
         self._view = ClusterView()
         # Versioned delta reporter + cluster-view receiver (syncer.py);
         # None when RAY_TPU_SYNCER_ENABLED=0 (legacy full-state
@@ -218,6 +228,18 @@ class NodeDaemon:
         for zh in list(self._zygotes.values()):
             zh.kill()
         self._zygotes.clear()
+        for sink in list(self._recv_partials.values()):
+            try:
+                sink.abort()
+            except Exception:  # noqa: BLE001
+                pass
+        self._recv_partials.clear()
+        for client in list(self._peer_clients.values()):
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._peer_clients.clear()
         await self.server.stop()
         self.store.disconnect()
         ObjectStore.destroy(self.store_dir)
@@ -583,6 +605,12 @@ class NodeDaemon:
             "raytpu_syncer_keepalives_sent_total",
             "Liveness keepalives piggybacked on the sync channel"
         ).set_default_tags(tags)
+        # Object transfer plane (transfer.py): in/out chunk bytes prove
+        # where data actually moved — the broadcast acceptance check
+        # (owner uplink <= fanout*size, not N*size) reads bytes_out.
+        self._m_xfer = make_transfer_metrics(tags)
+        self._m_xfer_in = self._m_xfer["bytes_in"]
+        self._m_xfer_out = self._m_xfer["bytes_out"]
 
     def get_metrics(self) -> str:
         """Prometheus exposition text; also served over HTTP when
@@ -1505,14 +1533,70 @@ class NodeDaemon:
         return {"ok": False}
 
     # ------------------------------------------------------------------
-    # object plane
+    # object plane (transfer.py: raw-frame chunks, create-then-fill
+    # receive, striped pulls, broadcast relay tree)
     # ------------------------------------------------------------------
+    PEER_CLIENT_CAP = 32
+
+    def _peer_client(self, address: str) -> AsyncRpcClient:
+        """Pooled multiplexed connection to a peer daemon (LRU-capped):
+        chunk RPCs must not pay a TCP dial per chunk."""
+        client = self._peer_clients.pop(address, None)
+        if client is None:
+            client = AsyncRpcClient(address)
+            while len(self._peer_clients) >= self.PEER_CLIENT_CAP:
+                _, old = self._peer_clients.popitem()
+                asyncio.ensure_future(old.close())
+        self._peer_clients[address] = client    # re-insert: LRU freshest
+        return client
+
+    def _expire_recv_partials(self) -> None:
+        """Abort receives whose sender died mid-transfer — an abandoned
+        partial pins its full store reservation, not just RAM."""
+        ttl = get_config().transfer_partial_ttl_s
+        now = time.monotonic()
+        for ob, sink in list(self._recv_partials.items()):
+            if now - sink.last_touch > ttl:
+                self._recv_partials.pop(ob, None)
+                try:
+                    sink.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _new_recv_sink(self, object_id: bytes,
+                       total_size: int) -> ChunkSink:
+        """Create-then-fill receive surface for one incoming object;
+        registers the location and drops the partial on completion."""
+        oid = ObjectID(object_id)
+
+        def on_complete() -> None:
+            self._recv_partials.pop(object_id, None)
+
+            async def register() -> None:
+                try:
+                    await self.gcs.call(
+                        "ObjectDirectory", "add_location",
+                        object_id=object_id, node_id=self.node_id,
+                        size=total_size, timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            asyncio.ensure_future(register())
+
+        partial = self.store.create_for_receive(oid, total_size)
+        sink = ChunkSink(partial, total_size, on_complete=on_complete)
+        if not sink.sealed:               # zero-size seals immediately
+            self._recv_partials[object_id] = sink
+        return sink
+
     async def push_object(self, object_id: bytes,
                           target_address: str) -> dict:
         """Proactively push a local object into another node's store
         (ref: src/ray/object_manager/push_manager.h:30 — deduplicated,
         bounded-concurrency chunked pushes). Used for pre-staging /
-        replication; the pull path stays the default."""
+        replication; the pull path stays the default. Chunks ride raw
+        frames (wire.Raw memoryviews of the shm mapping) with a small
+        pipeline of RPCs in flight toward the receiver."""
         oid = ObjectID(object_id)
         key = (target_address, object_id)
         existing = self._push_inflight.get(key)
@@ -1527,25 +1611,33 @@ class NodeDaemon:
         self._push_inflight[key] = fut
         try:
             async with self._push_sem:
-                chunk = get_config().object_transfer_chunk_bytes
+                cfg = get_config()
                 total = buf.size
-                client = AsyncRpcClient(target_address)
-                try:
-                    off = 0
-                    while True:
-                        end = min(off + chunk, total)
-                        last = end >= total
-                        await client.call(
-                            "NodeDaemon", "receive_object_chunk",
-                            object_id=object_id, offset=off,
-                            total_size=total,
-                            data=bytes(buf.view[off:end]), last=last,
-                            timeout=120)
-                        if last:
-                            break
-                        off = end
-                finally:
-                    await client.close()
+                raw = cfg.transfer_raw_frames
+                client = self._peer_client(target_address)
+                pending: set = set()
+                depth = max(1, cfg.transfer_push_pipeline)
+                ranges = (chunk_ranges(
+                    total, cfg.object_transfer_chunk_bytes) or [(0, 0)])
+                for off, ln in ranges:
+                    while len(pending) >= depth:
+                        done, pending = await asyncio.wait(
+                            pending,
+                            return_when=asyncio.FIRST_COMPLETED)
+                        for t in done:
+                            t.result()   # surface receiver failures
+                    view = buf.view[off:off + ln]
+                    pending.add(asyncio.ensure_future(client.call(
+                        "NodeDaemon", "receive_object_chunk",
+                        object_id=object_id, offset=off,
+                        total_size=total,
+                        data=Raw(view) if raw else bytes(view),
+                        last=off + ln >= total, timeout=120)))
+                    self._m_xfer_out.inc(ln)
+                if pending:
+                    done, _ = await asyncio.wait(pending)
+                    for t in done:
+                        t.result()
             reply = {"ok": True, "bytes": total}
         except Exception as e:  # noqa: BLE001
             reply = {"ok": False, "error": repr(e)}
@@ -1557,45 +1649,76 @@ class NodeDaemon:
         return reply
 
     async def receive_object_chunk(self, object_id: bytes, offset: int,
-                                   total_size: int, data: bytes,
-                                   last: bool) -> dict:
-        """Receiving side of push_object: assemble chunks, seal into the
-        local store, register the new location."""
+                                   total_size: int, data,
+                                   last: bool = False) -> dict:
+        """Receiving side of push/relay: chunks land at their offset
+        DIRECTLY in the store's mmap (create-then-fill) — the receiver
+        heap holds only the in-flight frame, never the object. Order-
+        independent: the sink seals on full coverage, not on `last`."""
         oid = ObjectID(object_id)
-        now = time.monotonic()
-        # Expire abandoned partials (pusher died mid-push): a stale
-        # full-object bytearray per failed push would pin RAM forever.
-        for ob, entry in list(self._push_partial.items()):
-            if now - entry[1] > 300:
-                del self._push_partial[ob]
-        if self.store.contains(oid):
-            self._push_partial.pop(object_id, None)
-            return {"ok": True, "already": True}
-        entry = self._push_partial.setdefault(
-            object_id, [bytearray(total_size), now])
-        buf = entry[0]
-        entry[1] = now
-        buf[offset:offset + len(data)] = data
-        if not last:
-            return {"ok": True}
-        del self._push_partial[object_id]
-        try:
-            self.store.put_raw(oid, bytes(buf))
-        except Exception:  # noqa: BLE001 raced in via pull
-            pass
-        try:
-            await self.gcs.call("ObjectDirectory", "add_location",
-                                object_id=object_id,
-                                node_id=self.node_id,
-                                size=total_size, timeout=10)
-        except Exception:  # noqa: BLE001
-            pass
-        return {"ok": True, "sealed": True}
+        self._expire_recv_partials()
+        sink = self._recv_partials.get(object_id)
+        if sink is None:
+            if self.store.contains(oid):
+                return {"ok": True, "already": True}
+            try:
+                sink = self._new_recv_sink(object_id, total_size)
+            except ObjectExistsError:
+                # Raced in via the pull path / a local put mid-create.
+                return {"ok": True, "already": True}
+        sink.write(offset, data)
+        self._m_xfer_in.inc(len(data))
+        return {"ok": True, "sealed": sink.sealed}
 
-    async def stream_pull_object(self, object_id: bytes):
-        """Chunked zero-copy-read transfer (ref: object_manager.proto Push,
-        5 MiB chunks ray_config_def.h:352)."""
+    async def get_object_chunk(self, object_id: bytes, offset: int,
+                               length: int, wait: bool = False,
+                               raw: bool = True) -> dict:
+        """Serve one chunk as a raw frame — a memoryview straight off
+        the shm mapping, zero copies on this side (the legacy bytes()
+        path survives only for raw=False / kill-switch callers). Serves
+        from an in-flight partial too when the range has landed
+        (`wait=True` long-polls for it): broadcast children stream an
+        object out of this daemon while it is still arriving."""
         oid = ObjectID(object_id)
+        use_raw = raw and get_config().transfer_raw_frames
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            sink = self._recv_partials.get(object_id)
+            if sink is not None:
+                end = min(offset + length, sink.size)
+                have = sink.has(offset, end)
+                if not have and wait:
+                    have = await sink.wait_range(
+                        offset, end,
+                        get_config().transfer_chunk_timeout_s)
+                if sink.sealed:
+                    buf = self.store.get_buffer(oid)   # serve sealed
+                elif have:
+                    view = sink.read(offset, end)
+                    self._m_xfer_out.inc(end - offset)
+                    return {"total_size": sink.size,
+                            "data": Raw(view) if use_raw
+                            else bytes(view)}
+            if buf is None:
+                return {"missing": True}
+        total = buf.size
+        end = min(offset + length, total)
+        view = buf.view[offset:end]
+        # The slice keeps the mmap alive through the transport write;
+        # release the store ref NOW so eviction/GC never waits on us.
+        buf.release()
+        self._m_xfer_out.inc(len(view))
+        return {"total_size": total,
+                "data": Raw(view) if use_raw else bytes(view)}
+
+    async def stream_pull_object(self, object_id: bytes,
+                                 raw: bool = False):
+        """Chunked whole-object stream (ref: object_manager.proto Push,
+        5 MiB chunks ray_config_def.h:352). Legacy single-source path —
+        striped pulls use get_object_chunk; raw=True upgrades the
+        payloads to raw frames."""
+        oid = ObjectID(object_id)
+        use_raw = raw and get_config().transfer_raw_frames
         buf = self.store.get_buffer(oid)
         if buf is None:
             yield {"missing": True}
@@ -1604,15 +1727,152 @@ class NodeDaemon:
             chunk = get_config().object_transfer_chunk_bytes
             total = buf.size
             for off in range(0, total, chunk):
+                view = buf.view[off:off + chunk]
+                self._m_xfer_out.inc(len(view))
                 yield {
                     "offset": off,
                     "total_size": total,
-                    "data": bytes(buf.view[off:off + chunk]),
+                    "data": Raw(view) if use_raw else bytes(view),
                 }
             if total == 0:
                 yield {"offset": 0, "total_size": 0, "data": b""}
         finally:
             buf.release()
+
+    async def broadcast_object(self, object_id: bytes,
+                               targets: List[str]) -> dict:
+        """1->N pre-staging over a log-N relay tree (the weight-
+        distribution primitive): this node serves only its <=fanout
+        children; each child relays to its subtree WHILE its own copy
+        is still arriving (partial re-serve in get_object_chunk). The
+        owner's uplink therefore carries fanout*size bytes, not
+        N*size. Returns when the whole subtree has sealed."""
+        oid = ObjectID(object_id)
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            return {"ok": False, "error": "object not local"}
+        total = buf.size
+        buf.release()
+        cfg = get_config()
+        plan = plan_broadcast_tree(
+            [t for t in targets if t != self.server.address],
+            cfg.transfer_broadcast_fanout)
+        timeout = max(120.0, total / (4 << 20))
+        replies = await asyncio.gather(
+            *(self._peer_client(child).call(
+                "NodeDaemon", "relay_object", object_id=object_id,
+                total_size=total, parent_address=self.server.address,
+                subtree=subtree, timeout=timeout)
+              for child, subtree in plan),
+            return_exceptions=True)
+        nodes = 0
+        errors: List[str] = []
+        for rep in replies:
+            if isinstance(rep, BaseException):
+                errors.append(repr(rep))
+            elif rep.get("ok"):
+                nodes += rep.get("nodes", 0)
+            else:
+                errors.append(str(rep.get("error")))
+                nodes += rep.get("nodes", 0)
+        return {"ok": not errors, "nodes": nodes, "bytes": total,
+                "errors": errors}
+
+    async def relay_object(self, object_id: bytes, total_size: int,
+                           parent_address: str,
+                           subtree: List[str]) -> dict:
+        """One node of the broadcast tree: pull chunks from the parent
+        (which may itself still be receiving — wait=True long-polls)
+        while this node's children pull the same ranges from US as they
+        land. The relay returns once this node AND its subtree sealed."""
+        oid = ObjectID(object_id)
+        cfg = get_config()
+        sink: Optional[ChunkSink] = None
+        if not self.store.contains(oid):
+            sink = self._recv_partials.get(object_id)
+            if sink is None:
+                try:
+                    sink = self._new_recv_sink(object_id, total_size)
+                except ObjectExistsError:
+                    sink = None      # raced in: serve from the store
+        # Children first: they start pulling from this daemon's partial
+        # immediately, pipelining the tree instead of serializing it.
+        plan = plan_broadcast_tree(
+            [t for t in subtree if t != self.server.address],
+            cfg.transfer_broadcast_fanout)
+        timeout = max(120.0, total_size / (4 << 20))
+        child_calls = [
+            asyncio.ensure_future(self._peer_client(child).call(
+                "NodeDaemon", "relay_object", object_id=object_id,
+                total_size=total_size,
+                parent_address=self.server.address,
+                subtree=st, timeout=timeout))
+            for child, st in plan]
+        error: Optional[str] = None
+        try:
+            if sink is not None and not sink.sealed:
+                client = self._peer_client(parent_address)
+                pending: Dict[asyncio.Task, Tuple[int, int]] = {}
+                depth = max(1, cfg.transfer_push_pipeline)
+                per_chunk_timeout = cfg.transfer_chunk_timeout_s + 5.0
+
+                def spawn(off: int, ln: int) -> None:
+                    task = asyncio.ensure_future(client.call(
+                        "NodeDaemon", "get_object_chunk",
+                        object_id=object_id, offset=off, length=ln,
+                        wait=True, timeout=per_chunk_timeout))
+                    pending[task] = (off, ln)
+
+                ranges = chunk_ranges(
+                    total_size, cfg.object_transfer_chunk_bytes)
+                ranges.reverse()
+                try:
+                    while (ranges or pending) and not sink.sealed:
+                        while ranges and len(pending) < depth:
+                            off, ln = ranges.pop()
+                            spawn(off, ln)
+                        if not pending:
+                            break
+                        done, _ = await asyncio.wait(
+                            pending,
+                            return_when=asyncio.FIRST_COMPLETED)
+                        for task in done:
+                            off, ln = pending.pop(task)
+                            rep = task.result()
+                            if rep.get("missing"):
+                                raise RuntimeError(
+                                    f"parent {parent_address} lost "
+                                    f"{oid.hex()[:12]} mid-broadcast")
+                            sink.write(off, rep["data"])
+                            self._m_xfer_in.inc(ln)
+                finally:
+                    # A racing push may have sealed the sink with our
+                    # fetches still out — never leave tasks un-awaited.
+                    for task in pending:
+                        task.cancel()
+                if not sink.sealed:
+                    raise RuntimeError("relay pull did not complete")
+        except Exception as e:  # noqa: BLE001
+            error = repr(e)
+            if sink is not None and not sink.sealed:
+                self._recv_partials.pop(object_id, None)
+                sink.abort()
+        child_replies = await asyncio.gather(*child_calls,
+                                             return_exceptions=True)
+        nodes = 0 if error else 1
+        errors = [error] if error else []
+        for rep in child_replies:
+            if isinstance(rep, BaseException):
+                errors.append(repr(rep))
+            elif rep.get("ok"):
+                nodes += rep.get("nodes", 0)
+            else:
+                errors.append(str(rep.get("error")))
+                nodes += rep.get("nodes", 0)
+        if errors:
+            return {"ok": False, "nodes": nodes,
+                    "error": "; ".join(e for e in errors if e)}
+        return {"ok": True, "nodes": nodes}
 
     def delete_objects(self, object_ids: List[bytes]) -> dict:
         for ob in object_ids:

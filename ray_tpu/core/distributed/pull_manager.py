@@ -10,6 +10,16 @@ same remote object must share ONE transfer; a storm of pulls must not
 hold unbounded chunk buffers in RAM; and a user blocking in `get()`
 must cut ahead of background prefetch. All transfer work runs on the
 process's RPC loop; sync callers block on a concurrent future.
+
+Two transfer backends:
+* striped (default in the core worker): `fetch_chunk` + `open_sink`
+  hand each transfer to transfer.striped_pull — chunks stream from ALL
+  replica locations at once under a bytes window, landing directly in
+  the local store's mmap (create-then-fill). The pull result carries
+  the object's size; the bytes are already sealed locally.
+* legacy: a whole-object `fetch(address, oid)` callable tried one
+  replica at a time, returning the bytes (kept for tests and simple
+  embedders).
 """
 from __future__ import annotations
 
@@ -17,7 +27,8 @@ import asyncio
 import itertools
 import logging
 from collections import deque
-from typing import Awaitable, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, \
+    Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -25,8 +36,10 @@ PRIORITY_GET = 0        # a caller is blocked in ray.get()
 PRIORITY_TASK_ARG = 1   # a leased worker needs args to start
 PRIORITY_PREFETCH = 2   # speculative (dataset prefetch etc.)
 
-# (data, stale_node_ids): data None => no location produced the object.
-PullResult = Tuple[Optional[bytes], List[str]]
+# (result, stale_node_ids): result None => no location produced the
+# object; bytes under the legacy backend; the object's total size (int)
+# under the striped backend (the data is already in the local store).
+PullResult = Tuple[Optional[Any], List[str]]
 FetchFn = Callable[[str, bytes], Awaitable[Optional[bytes]]]
 
 
@@ -77,12 +90,21 @@ class _ClassQueue:
 
 
 class PullManager:
-    def __init__(self, loop: asyncio.AbstractEventLoop, fetch: FetchFn,
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 fetch: Optional[FetchFn] = None,
                  max_concurrent: int = 4,
                  max_inflight_bytes: int = 256 << 20,
-                 min_service_every: int = 4):
+                 min_service_every: int = 4,
+                 fetch_chunk=None, open_sink=None, metrics=None):
+        if fetch is None and (fetch_chunk is None or open_sink is None):
+            raise ValueError(
+                "PullManager needs a legacy fetch fn or the striped "
+                "fetch_chunk + open_sink pair")
         self._loop = loop
         self._fetch = fetch
+        self._fetch_chunk = fetch_chunk
+        self._open_sink = open_sink
+        self._metrics = metrics
         self._max_concurrent = max_concurrent
         self._max_inflight_bytes = max_inflight_bytes
         self._min_service_every = min_service_every
@@ -163,6 +185,17 @@ class PullManager:
 
     async def _transfer(self, oid_b: bytes,
                         nodes: List[Tuple[str, str]]) -> PullResult:
+        if self._fetch_chunk is not None:
+            from ray_tpu.core.config import get_config
+            from ray_tpu.core.distributed.transfer import striped_pull
+
+            cfg = get_config()
+            return await striped_pull(
+                oid_b, list(nodes), self._fetch_chunk, self._open_sink,
+                chunk_bytes=cfg.object_transfer_chunk_bytes,
+                window_bytes=cfg.transfer_window_bytes,
+                per_source=cfg.transfer_per_source_inflight,
+                metrics=self._metrics)
         stale: List[str] = []
         for node_id, address in nodes:
             try:

@@ -48,8 +48,13 @@ import cloudpickle
 
 from ray_tpu.core.distributed.wire import (
     CODEC_PICKLE,
+    CODEC_RAW,
     CODEC_TYPED,
     PROTOCOL_VERSION,
+    Raw,
+    raw_dumps,
+    raw_loads,
+    scan_raw,
     typed_dumps,
     typed_loads,
     typed_safe,
@@ -69,14 +74,23 @@ STREAM_END = 5
 CANCEL = 6
 
 
-def _ser(obj: Any, codec: int = CODEC_PICKLE, safe: bool = False) -> bytes:
+def _ser(obj: Any, codec: int = CODEC_PICKLE, safe: bool = False):
     """Codec-tagged payload. Pickle (the Python<->Python default) tries
     plain pickle first (RPC messages are dicts of primitives/bytes),
     cloudpickle as the fallback — ~3-5x faster on the hot path. Under
     the typed codec, `safe=True` (server REPLIES) projects exceptions
     and foreign objects onto the cross-language model via
     wire.typed_safe; REQUESTS stay strict so an out-of-model argument
-    raises clearly instead of silently arriving as its repr string."""
+    raises clearly instead of silently arriving as its repr string.
+
+    A message carrying a wire.Raw marker (bulk chunk payloads) encodes
+    as a RAW frame regardless of the requested codec and returns a LIST
+    of buffers — typed header + the caller's body buffer untouched —
+    for the transport to writev. Everything else returns bytes."""
+    raw = scan_raw(obj)
+    if raw is not None:
+        header, body = raw_dumps(obj)
+        return [b"\x02" + header, body]
     if codec == CODEC_TYPED:
         return b"\x01" + typed_dumps(typed_safe(obj) if safe else obj)
     try:
@@ -99,6 +113,14 @@ def _de_codec(data: bytes) -> Tuple[Any, int]:
             # surface as RpcError so client read loops classify it as
             # a transport fault, not an unhandled crash.
             raise RpcError(f"corrupt typed payload: {e}") from e
+    if codec == CODEC_RAW:
+        try:
+            # The raw body arrives as a memoryview of `data`: the frame
+            # bytes stay alive for exactly as long as the handler keeps
+            # the view, and the chunk is never copied on the way in.
+            return raw_loads(view), CODEC_RAW
+        except Exception as e:  # noqa: BLE001
+            raise RpcError(f"corrupt raw frame: {e}") from e
     raise RpcError(f"unknown payload codec {codec}")
 
 
@@ -165,6 +187,15 @@ class ProtocolVersionError(RpcError):
 def _frame(ftype: int, req_id: int, payload: bytes) -> bytes:
     return _HEADER.pack(_POST_LEN + len(payload), PROTOCOL_VERSION,
                         ftype, req_id) + payload
+
+
+def _frame_parts(ftype: int, req_id: int, parts: list) -> list:
+    """Writev-style framing: header + payload buffers as separate
+    segments, so a bulk body (a shm memoryview) reaches the socket
+    without being concatenated into a fresh bytes object."""
+    total = sum(len(p) for p in parts)
+    return [_HEADER.pack(_POST_LEN + total, PROTOCOL_VERSION, ftype,
+                         req_id)] + parts
 
 
 async def _read_frame(reader: asyncio.StreamReader
@@ -248,7 +279,14 @@ class RpcServer:
             if d:
                 await asyncio.sleep(d)
             async with wlock:
-                writer.write(_frame(ftype, req_id, payload))
+                if isinstance(payload, list):
+                    # Raw frame: hand each segment to the transport
+                    # separately — the bulk body goes down as the
+                    # handler's memoryview, never re-joined in Python.
+                    for part in _frame_parts(ftype, req_id, payload):
+                        writer.write(part)
+                else:
+                    writer.write(_frame(ftype, req_id, payload))
                 await writer.drain()
 
         async def run_unary(req_id: int, fn, kwargs: dict,
@@ -436,9 +474,13 @@ class AsyncRpcClient:
         d = _sched_fuzz_delay()
         if d:
             await asyncio.sleep(d)
+        payload = _ser(obj, self.codec)
         async with self._wlock:
-            self._writer.write(
-                _frame(ftype, req_id, _ser(obj, self.codec)))
+            if isinstance(payload, list):
+                for part in _frame_parts(ftype, req_id, payload):
+                    self._writer.write(part)
+            else:
+                self._writer.write(_frame(ftype, req_id, payload))
             await self._writer.drain()
 
     async def call(self, service: str, method: str,
@@ -664,13 +706,18 @@ class _BlockingConn:
         except OSError:
             return True                 # RST or dead fd
 
-    def send_request(self, req_id: int, payload: bytes,
+    def send_request(self, req_id: int, payload,
                      timeout: Optional[float]) -> None:
         d = _sched_fuzz_delay()
         if d:
             _time.sleep(d)
         self.sock.settimeout(timeout)
-        self.sock.sendall(_frame(REQ, req_id, payload))
+        if isinstance(payload, list):
+            # Raw frame: sendall per segment (writev-style, no join).
+            for part in _frame_parts(REQ, req_id, payload):
+                self.sock.sendall(part)
+        else:
+            self.sock.sendall(_frame(REQ, req_id, payload))
 
     def recv_reply(self, req_id: int) -> Any:
         while True:

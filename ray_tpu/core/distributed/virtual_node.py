@@ -144,3 +144,70 @@ class VirtualCluster:
         await asyncio.gather(*(n.stop() for n in self.nodes))
         for c in self.clients:
             await c.close()
+
+
+class InProcDaemonCluster:
+    """N REAL NodeDaemons + one GcsServer on one event loop — the
+    object-plane sibling of VirtualCluster: real RPC servers, real shm
+    object stores, the real transfer plane (raw frames, create-then-
+    fill, striped pulls, broadcast relays), but no worker processes
+    (zygote/prestart forced off for the process). Used by the
+    object_transfer / broadcast probes in bench_scale.py and the
+    transfer tests.
+    """
+
+    def __init__(self, n_nodes: int, *, store_capacity: int = 512 << 20,
+                 num_cpus: float = 1.0):
+        self.n_nodes = n_nodes
+        self.store_capacity = store_capacity
+        self.num_cpus = num_cpus
+        self.gcs = None
+        self.daemons: List = []
+
+    async def start(self) -> None:
+        import uuid
+
+        from ray_tpu.core.config import get_config
+        from ray_tpu.core.distributed.gcs_server import GcsServer
+        from ray_tpu.core.distributed.node_daemon import NodeDaemon
+
+        cfg = get_config()
+        # Daemons in THIS process must not fork zygotes or prestart
+        # worker processes — the harness exercises the object plane.
+        # Saved + restored on stop(): the config singleton is process-
+        # wide and later tests may exercise the zygote path.
+        self._saved_cfg = (cfg.zygote_enabled, cfg.worker_prestart_enabled)
+        cfg.zygote_enabled = False
+        cfg.worker_prestart_enabled = False
+        self.gcs = GcsServer()
+        port = await self.gcs.start()
+        for i in range(self.n_nodes):
+            daemon = NodeDaemon(
+                gcs_address=f"127.0.0.1:{port}",
+                node_id=f"inproc{i:03d}" + uuid.uuid4().hex[:10],
+                num_cpus=self.num_cpus,
+                store_dir=f"/dev/shm/raytpu_inproc_{uuid.uuid4().hex[:12]}",
+                object_store_memory=self.store_capacity)
+            await daemon.start()
+            self.daemons.append(daemon)
+
+    @property
+    def addresses(self) -> List[str]:
+        return [d.server.address for d in self.daemons]
+
+    async def stop(self) -> None:
+        for d in self.daemons:
+            try:
+                await d.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        self.daemons = []
+        if self.gcs is not None:
+            await self.gcs.stop()
+            self.gcs = None
+        saved = getattr(self, "_saved_cfg", None)
+        if saved is not None:
+            from ray_tpu.core.config import get_config
+
+            cfg = get_config()
+            cfg.zygote_enabled, cfg.worker_prestart_enabled = saved

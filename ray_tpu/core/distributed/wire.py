@@ -27,20 +27,39 @@ Typed format, little-endian throughout (x86/arm64):
            | 0x06 u32 utf8             # str
            | 0x07 u32 value*           # list (tuples encode as list)
            | 0x08 u32 (value value)*   # dict
+           | 0x09                      # out-of-band raw body (RAW codec)
+
+RAW codec (2): the bulk-data frame format. A message whose structure
+contains exactly one `Raw(buffer)` marker is encoded as
+
+    payload := 0x02 | u32 hlen | typed(header) | body
+
+where the header is the typed encoding of the message with the marker
+replaced by tag 0x09, and the body bytes follow verbatim — no pickle,
+no length-prefix copies. The encoder returns (header, body) as SEPARATE
+buffers so the transport can writev them (header built once, the body
+handed to the socket as the caller's memoryview); the decoder splices a
+zero-copy memoryview of the body back into the 0x09 position. This is
+the seam object-chunk transfers ride: a 5 MiB chunk crosses the RPC
+layer without ever being copied into a pickle stream on either side
+(ref: the reference moves chunk payloads as raw grpc bytes fields,
+object_manager.proto Push).
 """
 from __future__ import annotations
 
 import struct
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 # Deliberately outside 1..6: the previous (unversioned) frame format
 # carried the frame-TYPE byte at this offset, so any version equal to a
 # frame type (REQ=1..CANCEL=6) would make an old-generation peer pass
 # the version check and be misparsed instead of cleanly rejected.
-PROTOCOL_VERSION = 16
+# v17: RAW codec (out-of-band binary attachment frames).
+PROTOCOL_VERSION = 17
 
 CODEC_PICKLE = 0
 CODEC_TYPED = 1
+CODEC_RAW = 2
 
 _I64 = struct.Struct("<q")
 _F64 = struct.Struct("<d")
@@ -55,13 +74,40 @@ _T_BYTES = 0x05
 _T_STR = 0x06
 _T_LIST = 0x07
 _T_DICT = 0x08
+_T_RAW = 0x09
 
 
 class WireError(ValueError):
     """A value outside the typed model, or a corrupt typed payload."""
 
 
-def _enc(obj: Any, out: bytearray) -> None:
+class Raw:
+    """Marks one buffer in an RPC message for out-of-band raw-frame
+    transport. The wrapped buffer never enters a codec stream: the send
+    path writes it to the socket directly (after the typed header) and
+    the receive path splices a zero-copy memoryview back in its place.
+
+    Deliberately unpicklable: a Raw that escapes the raw-frame scan
+    (nested deeper than the bounded scan looks) must fail loudly at
+    encode time, not arrive at the peer as an opaque object.
+    """
+
+    __slots__ = ("buffer",)
+
+    def __init__(self, buffer):
+        self.buffer = buffer
+
+    def __len__(self) -> int:
+        return len(self.buffer)
+
+    def __reduce__(self):
+        raise WireError(
+            "Raw buffer outside a raw-frame position (nest it at the "
+            "top levels of the RPC message, see wire.scan_raw)")
+
+
+def _enc(obj: Any, out: bytearray,
+         raw_cell: Optional[list] = None) -> None:
     if obj is None:
         out.append(_T_NONE)
     elif obj is True:
@@ -91,13 +137,20 @@ def _enc(obj: Any, out: bytearray) -> None:
         out.append(_T_LIST)
         out += _U32.pack(len(obj))
         for item in obj:
-            _enc(item, out)
+            _enc(item, out, raw_cell)
     elif isinstance(obj, dict):
         out.append(_T_DICT)
         out += _U32.pack(len(obj))
         for k, v in obj.items():
-            _enc(k, out)
-            _enc(v, out)
+            _enc(k, out, raw_cell)
+            _enc(v, out, raw_cell)
+    elif isinstance(obj, Raw):
+        if raw_cell is None:
+            raise WireError("Raw buffer is only valid under the RAW codec")
+        if raw_cell:
+            raise WireError("at most one Raw buffer per RPC message")
+        raw_cell.append(obj.buffer)
+        out.append(_T_RAW)
     else:
         raise WireError(
             f"{type(obj).__name__} is outside the typed wire model "
@@ -110,7 +163,43 @@ def typed_dumps(obj: Any) -> bytes:
     return bytes(out)
 
 
-def _dec(data: memoryview, pos: int) -> Tuple[Any, int]:
+def raw_dumps(obj: Any) -> Tuple[bytes, Any]:
+    """Encode a message containing exactly one Raw marker. Returns
+    (header_bytes, body_buffer): the header is `u32 hlen | typed` with
+    tag 0x09 at the marker position; the body is the caller's buffer,
+    untouched, to be writev'd after the header."""
+    out = bytearray()
+    cell: list = []
+    _enc(obj, out, cell)
+    if not cell:
+        raise WireError("raw_dumps: message contains no Raw buffer")
+    return _U32.pack(len(out)) + bytes(out), cell[0]
+
+
+def scan_raw(obj: Any, depth: int = 3) -> Optional[Raw]:
+    """Bounded search for a Raw marker at the top levels of an RPC
+    message (kwargs dicts, reply dicts, small lists). Bounded so the
+    control-plane hot path never pays a deep traversal; Raw markers
+    nested past the bound fail loudly via Raw.__reduce__."""
+    if isinstance(obj, Raw):
+        return obj
+    if depth <= 0:
+        return None
+    if isinstance(obj, dict):
+        for v in obj.values():
+            r = scan_raw(v, depth - 1)
+            if r is not None:
+                return r
+    elif isinstance(obj, (list, tuple)):
+        for v in obj[:32]:
+            r = scan_raw(v, depth - 1)
+            if r is not None:
+                return r
+    return None
+
+
+def _dec(data: memoryview, pos: int,
+         raw_body: Optional[memoryview] = None) -> Tuple[Any, int]:
     try:
         tag = data[pos]
     except IndexError:
@@ -140,7 +229,7 @@ def _dec(data: memoryview, pos: int) -> Tuple[Any, int]:
             pos += 4
             items = []
             for _ in range(n):
-                item, pos = _dec(data, pos)
+                item, pos = _dec(data, pos, raw_body)
                 items.append(item)
             return items, pos
         if tag == _T_DICT:
@@ -148,10 +237,14 @@ def _dec(data: memoryview, pos: int) -> Tuple[Any, int]:
             pos += 4
             d = {}
             for _ in range(n):
-                k, pos = _dec(data, pos)
-                v, pos = _dec(data, pos)
+                k, pos = _dec(data, pos, raw_body)
+                v, pos = _dec(data, pos, raw_body)
                 d[k] = v
             return d, pos
+        if tag == _T_RAW:
+            if raw_body is None:
+                raise WireError("0x09 raw tag outside a RAW-codec frame")
+            return raw_body, pos
     except struct.error:
         raise WireError("truncated typed payload") from None
     raise WireError(f"unknown typed tag 0x{tag:02x}")
@@ -164,6 +257,47 @@ def typed_loads(data) -> Any:
     if pos != len(view):
         raise WireError(
             f"{len(view) - pos} trailing bytes after typed value")
+    return obj
+
+
+# Placeholder the 0x09 tag decodes to when the body is NOT in hand —
+# raw_header_loads callers (recv_into receivers) read the header first,
+# then stream the body straight into its destination buffer.
+RAW_BODY = type("RawBodyPlaceholder", (), {
+    "__repr__": lambda self: "<raw body>"})()
+
+
+def raw_header_loads(header) -> Any:
+    """Decode just the typed header of a RAW frame (no hlen prefix, no
+    body): the 0x09 position decodes to the RAW_BODY sentinel. Used by
+    direct-to-shm receivers that want the metadata BEFORE reading the
+    body, so the body bytes can be received straight into the store
+    mmap instead of through an intermediate buffer."""
+    view = memoryview(header)
+    obj, pos = _dec(view, 0, raw_body=RAW_BODY)
+    if pos != len(view):
+        raise WireError(
+            f"{len(view) - pos} trailing bytes after raw header")
+    return obj
+
+
+def raw_loads(data) -> Any:
+    """Decode a RAW-codec payload (after the codec byte): `u32 hlen |
+    typed(header) | body`. The body is spliced into the 0x09 position
+    as a zero-copy memoryview of `data` — the caller's frame bytes stay
+    alive as long as the decoded message references them."""
+    view = memoryview(data)
+    if len(view) < 4:
+        raise WireError("truncated raw frame")
+    (hlen,) = _U32.unpack_from(view, 0)
+    if 4 + hlen > len(view):
+        raise WireError("truncated raw frame header")
+    header = view[4:4 + hlen]
+    body = view[4 + hlen:]
+    obj, pos = _dec(header, 0, raw_body=body)
+    if pos != hlen:
+        raise WireError(
+            f"{hlen - pos} trailing bytes after raw frame header")
     return obj
 
 

@@ -70,6 +70,11 @@ def _load_lib() -> ctypes.CDLL:
     lib.rts_list.restype = ctypes.c_uint64
     lib.rts_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                              ctypes.c_uint64]
+    lib.rts_stat.restype = ctypes.c_int
+    lib.rts_stat.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_uint32),
+                             ctypes.POINTER(ctypes.c_uint64),
+                             ctypes.POINTER(ctypes.c_uint32)]
     return lib
 
 
@@ -164,6 +169,95 @@ class FileBuffer:
             self._mm.close()
         except BufferError:
             pass  # numpy views still alive; mmap closes when they drop
+
+
+class PartialBuffer:
+    """A created-but-unsealed object being filled at offsets: the
+    create-then-fill seam of the transfer plane. `write_at` lands chunk
+    bytes straight in the store's mmap (or the spill file when shm is
+    full) — receivers never accumulate an object on the Python heap.
+    `seal()` publishes atomically; `abort()` (also the GC finalizer)
+    rolls back so a dropped transfer cannot leak a creating slot.
+    """
+
+    def __init__(self, state: _StoreState, oid: ObjectID, size: int,
+                 mm: Optional[mmap.mmap], spill_tmp: Optional[str] = None,
+                 spill_path: Optional[str] = None):
+        self._state = state
+        self._oid = oid
+        self.size = size
+        self._mm = mm
+        self.view = memoryview(mm) if mm is not None else memoryview(b"")
+        self._spill_tmp = spill_tmp
+        self._spill_path = spill_path
+        self._done = False
+        # Safety net only: the owner is expected to seal or abort
+        # explicitly. kCreating slots do self-expire (kStaleCreatingSecs)
+        # but that pins `size` bytes of shm for 5 minutes.
+        self._finalizer = weakref.finalize(
+            self, PartialBuffer._abort_static, state, oid.binary(), mm,
+            self.view, spill_tmp)
+
+    def write_at(self, offset: int, data) -> None:
+        if self._done:
+            raise RuntimeError("write into sealed/aborted PartialBuffer")
+        n = len(data)
+        if offset < 0 or offset + n > self.size:
+            raise ValueError(
+                f"chunk [{offset}, {offset + n}) outside object of "
+                f"{self.size} bytes")
+        self.view[offset:offset + n] = data
+
+    def _close_mapping(self) -> None:
+        try:
+            self.view.release()
+            if self._mm is not None:
+                self._mm.close()
+        except BufferError:
+            pass  # outstanding views; mmap closes when they drop
+
+    def seal(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._finalizer.detach()
+        self._close_mapping()
+        if self._spill_tmp is not None:
+            os.rename(self._spill_tmp, self._spill_path)
+            return
+        rc = get_lib().rts_seal(self._state.handle, self._oid.binary())
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_seal failed: {rc}")
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._finalizer.detach()
+        PartialBuffer._abort_static(self._state, self._oid.binary(),
+                                    self._mm, self.view, self._spill_tmp)
+
+    @staticmethod
+    def _abort_static(state: _StoreState, oid_binary: bytes,
+                      mm: Optional[mmap.mmap], view: memoryview,
+                      spill_tmp: Optional[str]) -> None:
+        try:
+            view.release()
+            if mm is not None:
+                mm.close()
+        except BufferError:
+            pass
+        if spill_tmp is not None:
+            try:
+                os.unlink(spill_tmp)
+            except OSError:
+                pass
+            return
+        try:
+            if state.handle:
+                get_lib().rts_abort(state.handle, oid_binary)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class ObjectStore:
@@ -309,6 +403,42 @@ class ObjectStore:
             raise RuntimeError(f"rts_seal failed: {rc}")
         return size
 
+    def create_for_receive(self, oid: ObjectID, size: int) -> PartialBuffer:
+        """Create an unsealed object and hand back a writable fill-at-
+        offset view (the receive side of chunked transfers). Chunks land
+        directly in the shm mmap in any order; the caller seals once all
+        bytes arrived. Falls back to a spill tmp file when shm is full
+        even after eviction (rename-on-seal keeps the atomicity)."""
+        lib = get_lib()
+        fd = ctypes.c_int(-1)
+        rc = lib.rts_create(self._handle, oid.binary(), size,
+                            ctypes.byref(fd))
+        if rc == RTS_ERR_EXISTS:
+            raise ObjectExistsError(oid.hex())
+        if rc == RTS_ERR_FULL:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = self._spill_path(oid)
+            if os.path.exists(path):
+                raise ObjectExistsError(oid.hex())
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w+b") as f:
+                mm = None
+                if size:
+                    f.truncate(size)
+                    mm = mmap.mmap(f.fileno(), size)
+            return PartialBuffer(self._state, oid, size, mm,
+                                 spill_tmp=tmp, spill_path=path)
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_create failed: {rc}")
+        try:
+            mm = mmap.mmap(fd.value, size) if size else None
+        except BaseException:
+            os.close(fd.value)
+            lib.rts_abort(self._handle, oid.binary())
+            raise
+        os.close(fd.value)
+        return PartialBuffer(self._state, oid, size, mm)
+
     # -- read path ------------------------------------------------------
     def get_buffer(self, oid: ObjectID) -> Optional[SharedBuffer]:
         lib = get_lib()
@@ -336,6 +466,28 @@ class ObjectStore:
         return value, buf
 
     # -- management -----------------------------------------------------
+    def stat(self, oid: ObjectID) -> Optional[dict]:
+        """Slot introspection without touching refcount/LRU: dict with
+        state ('creating'/'sealed'), size, refcount — or None when the
+        store has no live slot (spilled objects report via the file)."""
+        state = ctypes.c_uint32(0)
+        size = ctypes.c_uint64(0)
+        refcount = ctypes.c_uint32(0)
+        rc = get_lib().rts_stat(self._handle, oid.binary(),
+                                ctypes.byref(state), ctypes.byref(size),
+                                ctypes.byref(refcount))
+        if rc != RTS_OK:
+            try:
+                sz = os.stat(self._spill_path(oid)).st_size
+            except OSError:
+                return None
+            return {"state": "sealed", "size": sz, "refcount": 0,
+                    "spilled": True}
+        return {"state": {1: "creating", 2: "sealed"}.get(
+                    state.value, str(state.value)),
+                "size": size.value, "refcount": refcount.value,
+                "spilled": False}
+
     def contains(self, oid: ObjectID) -> bool:
         if get_lib().rts_contains(self._handle, oid.binary()):
             return True

@@ -461,6 +461,32 @@ int rts_release(void* handle, const uint8_t* id) {
   return RTS_OK;
 }
 
+// Introspect a slot without touching refcounts or the LRU clock:
+// state/size/refcount out-params. Backs the transfer-plane leak
+// assertions (a sealed object whose transfer finished must be back at
+// refcount 0) and lets the daemon observe create-then-fill progress.
+// Returns RTS_OK, or RTS_ERR_NOT_FOUND for empty/tombstoned slots.
+int rts_stat(void* handle, const uint8_t* id, uint32_t* state_out,
+             uint64_t* size_out, uint32_t* refcount_out) {
+  Store* s = static_cast<Store*>(handle);
+  if (LockIndex(s) != 0) return RTS_ERR_IO;
+  Slot* slot = FindSlot(s, id, false);
+  if (slot == nullptr) {
+    UnlockIndex(s);
+    return RTS_ERR_NOT_FOUND;
+  }
+  uint32_t st = slot->state.load(std::memory_order_acquire);
+  if (st == kEmpty || st == kTombstone) {
+    UnlockIndex(s);
+    return RTS_ERR_NOT_FOUND;
+  }
+  *state_out = st;
+  *size_out = slot->size.load();
+  *refcount_out = slot->refcount.load();
+  UnlockIndex(s);
+  return RTS_OK;
+}
+
 int rts_contains(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
   Slot* slot = FindSlot(s, id, false);

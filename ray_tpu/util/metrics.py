@@ -92,12 +92,36 @@ class Gauge(Metric):
     def dec(self, value: float = 1.0, tags=None) -> None:
         self.inc(-value, tags)
 
+    def track(self, value: float = 1.0, tags=None):
+        """Context manager: add `value` for the duration of a block —
+        the in-flight-bytes / in-flight-requests idiom (the transfer
+        plane's windowed pulls account their outstanding chunk bytes
+        this way, so the gauge can never leak on an exception path)."""
+        return _GaugeTrack(self, value, tags)
+
     def kind(self) -> str:
         return "gauge"
 
     def samples(self):
         with self._lock:
             return list(self._values.items())
+
+
+class _GaugeTrack:
+    __slots__ = ("_gauge", "_value", "_tags")
+
+    def __init__(self, gauge: "Gauge", value: float, tags):
+        self._gauge = gauge
+        self._value = value
+        self._tags = tags
+
+    def __enter__(self):
+        self._gauge.inc(self._value, self._tags)
+        return self
+
+    def __exit__(self, *exc):
+        self._gauge.dec(self._value, self._tags)
+        return False
 
 
 class Histogram(Metric):

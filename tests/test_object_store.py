@@ -139,3 +139,84 @@ def test_put_raw_roundtrip(store):
     value, buf = store.get(oid)
     np.testing.assert_array_equal(value["k"], np.ones(10))
     buf.release()
+
+
+# ---------------------------------------------------------------------------
+# create-then-fill seam (PartialBuffer): the transfer plane's receive
+# surface — chunks land at offsets in the store mmap, seal publishes.
+# ---------------------------------------------------------------------------
+
+def test_create_for_receive_out_of_order_fill(store):
+    oid = ObjectID.from_random()
+    data = os.urandom(1 << 20)
+    pb = store.create_for_receive(oid, len(data))
+    # invisible until sealed
+    assert not store.contains(oid)
+    assert store.stat(oid)["state"] == "creating"
+    pb.write_at(512 << 10, data[512 << 10:])
+    pb.write_at(0, data[:512 << 10])
+    pb.seal()
+    assert store.contains(oid)
+    buf = store.get_buffer(oid)
+    assert bytes(buf.view) == data
+    buf.release()
+    assert store.stat(oid) == {"state": "sealed", "size": len(data),
+                               "refcount": 0, "spilled": False}
+
+
+def test_create_for_receive_abort_rolls_back(store):
+    oid = ObjectID.from_random()
+    used0, n0 = store.used, store.num_objects
+    pb = store.create_for_receive(oid, 4096)
+    pb.write_at(0, b"x" * 100)
+    pb.abort()
+    assert not store.contains(oid)
+    assert store.stat(oid) is None
+    assert (store.used, store.num_objects) == (used0, n0)
+    with pytest.raises(RuntimeError):
+        pb.write_at(0, b"y")          # dead handle refuses writes
+
+
+def test_create_for_receive_dropped_handle_is_aborted(store):
+    """A receiver that dies holding a partial must not leak the
+    reservation: the GC finalizer aborts unsealed PartialBuffers."""
+    import gc
+
+    oid = ObjectID.from_random()
+    n0 = store.num_objects
+    pb = store.create_for_receive(oid, 1 << 16)
+    del pb
+    gc.collect()
+    assert store.num_objects == n0
+    assert store.stat(oid) is None
+
+
+def test_create_for_receive_exists_and_bounds(store):
+    oid = ObjectID.from_random()
+    store.put_raw(oid, b"sealed")
+    with pytest.raises(ObjectExistsError):
+        store.create_for_receive(oid, 10)
+    oid2 = ObjectID.from_random()
+    pb = store.create_for_receive(oid2, 100)
+    with pytest.raises(ValueError):
+        pb.write_at(90, b"x" * 20)    # past the end
+    pb.abort()
+
+
+def test_create_for_receive_zero_and_spill(store):
+    # zero-size object seals fine
+    oid = ObjectID.from_random()
+    pb = store.create_for_receive(oid, 0)
+    pb.seal()
+    assert store.contains(oid)
+    # shm full even after eviction (pinned) -> spill-file fallback
+    big = ObjectID.from_random()
+    pb2 = store.create_for_receive(big, 128 * 1024 * 1024)
+    pb2.write_at(0, b"spilled!")
+    pb2.seal()
+    assert store.contains(big)
+    st = store.stat(big)
+    assert st["spilled"] and st["size"] == 128 * 1024 * 1024
+    buf = store.get_buffer(big)
+    assert bytes(buf.view[:8]) == b"spilled!"
+    buf.release()
