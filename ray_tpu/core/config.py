@@ -149,11 +149,42 @@ class Config:
     # Prometheus text endpoint on each node daemon (0 = disabled);
     # RAY_TPU_METRICS_EXPORT_PORT=8090 enables :8090/metrics.
     metrics_export_port: int = 0
+    # Federated Prometheus endpoint on the GCS (0 = disabled): one
+    # exposition merging every node's syncer-shipped metric snapshot,
+    # node-labelled (RAY_TPU_METRICS_GCS_EXPORT_PORT).
+    metrics_gcs_export_port: int = 0
+    # Per-service/method RPC instrumentation (queue-wait + handler
+    # latency histograms, inflight gauges, bytes counters) on RpcServer
+    # and both clients. RAY_TPU_METRICS_RPC_ENABLED=0 is the bench
+    # kill switch the observability-overhead probe flips.
+    metrics_rpc_enabled: bool = True
+    # EventLoopThread lag probe cadence (0 disables): a sleep(interval)
+    # measures its own overshoot — the Python analogue of the
+    # reference's instrumented asio event loops.
+    metrics_loop_probe_ms: int = 250
+    # How often a node piggybacks a full metric snapshot on its syncer
+    # push (0 disables federation; the cadence is deliberately much
+    # slower than the delta interval — snapshots are the big payload).
+    metrics_sync_interval_ms: int = 5000
     # Task events flushed to the GCS sink for the state API/timeline.
+    # 1s coalescing window (matches the reference's flush interval):
+    # the window size bounds staleness, not volume — volume is bounded
+    # by the ring.
     task_events_enabled: bool = True
-    task_events_flush_ms: int = 500
-    # Worker-side unflushed-event backstop when the GCS is unreachable.
+    task_events_flush_ms: int = 1000
+    # Worker-side unflushed-event backstop when the GCS is unreachable:
+    # the TaskEventBuffer ring never grows past this many attempts
+    # (oldest dropped, per-kind drop counters — execution never blocks).
     task_events_max_buffer: int = 10000
+    # Opt-in profile events (object transfers, user profiling spans)
+    # riding the same bounded pipeline (RAY_TPU_TASK_EVENTS_PROFILE=1).
+    task_events_profile: bool = False
+    # GCS-side per-job storage cap: oldest attempts evicted first, with
+    # eviction counts surfaced through the state API.
+    task_events_max_per_job: int = 10000
+    # Finished jobs keep their task events this long before GC frees
+    # the storage (0 = GC at the first sweep after job completion).
+    task_events_finished_job_ttl_s: float = 300.0
     # Opt-in distributed tracing: span context rides TaskSpecs, spans
     # flush into the TaskEvents sink (ref: ray.init tracing hooks,
     # util/tracing/tracing_helper.py).

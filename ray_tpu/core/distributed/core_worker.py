@@ -141,7 +141,8 @@ class _TaskLane:
     def _fail_queued(self, e: BaseException) -> None:
         err = e if isinstance(e, Exception) else RuntimeError(repr(e))
         while self.queue:
-            _, fut = self.queue.popleft()
+            spec, fut = self.queue.popleft()
+            self.core._record_driver_failure(spec, err)
             if not fut.done():
                 fut.set_exception(err)
 
@@ -264,6 +265,10 @@ class _TaskLane:
             for s, _ in batch:
                 self.core._task_locations[s["task_id"]] = \
                     grant["worker_address"]
+                # LEASED stamp: this attempt is bound to a granted
+                # worker; the executor folds it into the attempt's
+                # history record (see _stamp_submit).
+                s["lease_ts"] = time.time()
             # Per-task STREAMED replies: the batch executes sequentially
             # on one lease, but each task's reply lands as soon as IT
             # finishes — a quick task's waiter is never gated on a slow
@@ -457,11 +462,24 @@ class DistributedCoreWorker:
             metrics=self._xfer_metrics)
         self._submit_buffer: deque = deque()
         self._submit_scheduled = False
+        # Bounded task-event pipeline (task_events.py): this process's
+        # status transitions (drivers: SUBMITTED/LEASED; executors:
+        # RUNNING/terminal), opt-in profile events, and tracing spans
+        # all coalesce here and flush to the GCS off the hot path.
+        from ray_tpu.core.distributed.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(
+            flush_fn=self._flush_task_events, node_id=node_id,
+            pid=os.getpid())
+        self._submit_identity = (node_id, os.getpid())
+        if get_config().task_events_enabled or get_config().tracing_enabled:
+            self.loop_thread.submit(self.task_events.flush_loop())
         if get_config().tracing_enabled:
-            # Driver-side spans flush to the same TaskEvents sink workers
-            # use, or root spans would dangle (children reference a
-            # parent the sink never saw).
-            self.loop_thread.submit(self._span_flush_loop())
+            # Spans get stamped with this process's node so the timeline
+            # places them under the emitting node/worker rows.
+            from ray_tpu.util import tracing
+
+            tracing.set_node_context(node_id)
         self.loop_thread.submit(self._borrow_sweep_loop())
         self.daemon = SyncRpcClient(daemon_address, self.loop_thread)
         self.store = ObjectStore(store_dir)
@@ -1130,6 +1148,7 @@ class DistributedCoreWorker:
             candidates.append((node["node_id"], node["address"]))
         if not candidates:
             return False, len(info["nodes"]) - stale
+        pull_t0 = time.time()
         try:
             total_size, stale_nodes = self._pull_manager.pull_sync(
                 oid.binary(), candidates, info.get("size") or 1,
@@ -1139,6 +1158,13 @@ class DistributedCoreWorker:
             # the per-node try/except of the pre-PullManager path did.
             logger.debug("pull of %s failed: %s", oid.hex()[:12], e)
             return False, len(info["nodes"]) - stale
+        if total_size is not None:
+            # Opt-in transfer profile event: pulls show up on the
+            # timeline's node rows next to the tasks that waited on them.
+            self.task_events.record_profile(
+                f"pull:{oid.hex()[:12]}", "transfer", pull_t0,
+                time.time(), object_id=oid.hex(), nbytes=total_size,
+                sources=len(candidates))
         for nid in stale_nodes:
             stale += 1
             self._remove_stale_location(oid, nid)
@@ -1303,27 +1329,56 @@ class DistributedCoreWorker:
             self.store.create_for_receive(ObjectID(oid_b), total_size),
             total_size)
 
-    async def _span_flush_loop(self) -> None:
-        from ray_tpu.util import tracing
+    async def _flush_task_events(self, **payload) -> None:
+        """Transport for the TaskEventBuffer: one add_task_events RPC
+        (the buffer owns retry/drop policy)."""
+        gcs = await self._aget_gcs()
+        await gcs.call("TaskEvents", "add_task_events", timeout=10,
+                       **payload)
 
-        period = get_config().task_events_flush_ms / 1000
-        delay = period
-        while not self._shutdown:
-            await asyncio.sleep(delay)
-            batch = tracing.drain()
-            if not batch:
-                # Idle backoff (tracing is usually off): parked workers
-                # must not tick at full cadence — see the event flusher
-                # in worker_main for the same discipline at pool scale.
-                delay = min(delay * 2, max(period, 16.0))
-                continue
-            delay = period
-            try:
-                gcs = await self._aget_gcs()
-                await gcs.call("TaskEvents", "add_events", events=batch,
-                               timeout=10)
-            except Exception:  # noqa: BLE001 retried next tick
-                pass
+    def _record_task_status(self, spec: dict, state: str,
+                            ts: Optional[float] = None,
+                            error: Optional[str] = None) -> None:
+        """Record one status transition for a task spec into the bounded
+        pipeline (no-op when task events are off; never blocks)."""
+        opts = spec.get("options") or {}
+        self.task_events.record_status(
+            spec["task_id"].hex(), spec.get("attempt", 0), state, ts=ts,
+            error=error, name=opts.get("name"),
+            job_id=spec.get("job_id"), actor_id=spec.get("actor_id"))
+
+    def _stamp_submit(self, spec: dict) -> None:
+        """Submission-side history rides the SPEC, not a separate event:
+        the executor folds submit/lease timestamps into its single
+        terminal record, so the happy path ships ONE wire record per
+        attempt instead of a driver record + an executor record merged
+        at the GCS (half the flush volume — on a 1-core host the
+        telemetry pipeline's CPU IS task throughput). The driver-side
+        buffer still reports tasks that FAIL before reaching a worker
+        (_record_driver_failure)."""
+        spec["submit_ts"] = time.time()
+        spec["submit_ctx"] = self._submit_identity
+
+    def _record_driver_failure(self, spec: dict, error) -> None:
+        """Terminal event for a task that died driver-side (lease
+        refused, retries exhausted, cancelled while queued): no executor
+        ever saw it, so no one else will report it. This is the rare
+        complement of the executor's single-record happy path."""
+        opts = spec.get("options") or {}
+        te = self.task_events
+        task_id = spec["task_id"].hex()
+        attempt = spec.get("attempt", 0)
+        sub = spec.get("submit_ts")
+        if sub is not None:
+            ctx = spec.get("submit_ctx") or (None, None)
+            te.record_status(task_id, attempt, "SUBMITTED", ts=sub,
+                             name=opts.get("name"),
+                             job_id=spec.get("job_id"),
+                             actor_id=spec.get("actor_id"),
+                             submit_node_id=ctx[0], submit_pid=ctx[1])
+        te.record_status(task_id, attempt, "FAILED", error=repr(error),
+                         name=opts.get("name"),
+                         job_id=spec.get("job_id"))
 
     def prefetch(self, refs: List[ObjectRef]) -> None:
         """Best-effort background pulls at the lowest priority (ref: the
@@ -1648,6 +1703,7 @@ class DistributedCoreWorker:
             from ray_tpu.util import tracing
 
             spec["trace_ctx"] = tracing.inject()
+        self._stamp_submit(spec)
         if options.max_retries > 0 and get_config().lineage_pinning_enabled:
             with self._lock:
                 entry = {"spec": spec, "demand": demand, "sched": sched,
@@ -1706,6 +1762,7 @@ class DistributedCoreWorker:
             from ray_tpu.util import tracing
 
             spec["trace_ctx"] = tracing.inject()
+        self._stamp_submit(spec)
         state = StreamState()
         fut: Future = Future()   # pins args until the stream completes
         self._pin_task_deps(deps, fut)
@@ -1914,6 +1971,7 @@ class DistributedCoreWorker:
             return
         err = rexc.WorkerCrashedError(
             f"task failed after {max_retries + 1} attempts: {last_err}")
+        self._record_driver_failure(spec, err)
         self._finish_task(return_ids, fut, error=err)
 
     async def _aclient(self, address: str) -> AsyncRpcClient:
@@ -2039,6 +2097,7 @@ class DistributedCoreWorker:
             from ray_tpu.util import tracing
 
             spec["trace_ctx"] = tracing.inject()
+        self._stamp_submit(spec)
         gen = None
         if streaming:
             # Same discovery design as streaming tasks

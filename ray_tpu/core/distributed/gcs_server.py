@@ -186,6 +186,7 @@ class NodeInfo:
         self._gcs.actors.on_node_dead(node_id)
         self._gcs.objects.on_node_dead(node_id)
         self._gcs.placement_groups.on_node_dead(node_id)
+        self._gcs.metrics.on_node_dead(node_id)
         return {"ok": True}
 
     async def health_check_loop(self):
@@ -830,6 +831,7 @@ class JobManager:
             self._store.put("job", job_id, job)
         self._gcs.actors.on_job_finished(job_id)
         self._gcs.placement_groups.on_job_finished(job_id)
+        self._gcs.task_events.on_job_finished(job_id)
         return {"ok": True}
 
     def list_jobs(self) -> List[dict]:
@@ -878,26 +880,49 @@ class EventLog:
         return out
 
 
-class TaskEvents:
-    """Task event sink (ref: gcs_task_manager.h — powers `ray list tasks`
-    and the timeline)."""
+class MetricsFederation:
+    """Cluster-wide metrics view (the analogue of Prometheus federation
+    over the reference's per-node metrics agents): nodes piggyback
+    registry snapshots on their syncer pushes; this manager merges them
+    — each sample gaining a `node` label — into one exposition served
+    over RPC (`Metrics.federated_text`) and, with
+    RAY_TPU_METRICS_GCS_EXPORT_PORT set, over HTTP on the GCS."""
 
-    def __init__(self, max_events: int = 100000):
-        self.events: deque = deque(maxlen=max_events)
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        # node_id -> {"ts": wall time received, "dump": registry_dump()}
+        self._node_dumps: Dict[str, dict] = {}
 
-    def add_events(self, events: List[dict]) -> dict:
-        self.events.extend(events)
-        return {"ok": True}
+    def ingest(self, node_id: str, dump: List[dict]) -> None:
+        self._node_dumps[node_id] = {"ts": time.time(), "dump": dump}
 
-    def list_events(self, job_id: Optional[str] = None,
-                    limit: int = 10000) -> List[dict]:
-        out = []
-        for e in reversed(self.events):
-            if job_id is None or e.get("job_id") == job_id:
-                out.append(e)
-                if len(out) >= limit:
-                    break
-        return out
+    def on_node_dead(self, node_id: str) -> None:
+        self._node_dumps.pop(node_id, None)
+
+    def federated_text(self) -> str:
+        from ray_tpu.util.metrics import merge_dumps, registry_dump
+
+        dumps = {nid[:12]: rec["dump"]
+                 for nid, rec in self._node_dumps.items()}
+        dumps["gcs"] = registry_dump()
+        return merge_dumps(dumps)
+
+    def stats(self) -> dict:
+        now = time.time()
+        return {
+            "nodes_reporting": len(self._node_dumps),
+            "staleness_s": {nid[:12]: round(now - rec["ts"], 3)
+                            for nid, rec in self._node_dumps.items()},
+        }
+
+    def cluster_summary(self) -> dict:
+        """One-RPC observability rollup for `ray-tpu status` /
+        state.cluster_status callers: federation freshness + task-event
+        completeness accounting."""
+        return {
+            "metrics": self.stats(),
+            "task_events": self._gcs.task_events.stats(),
+        }
 
 
 class AutoscalerStateManager:
@@ -1040,7 +1065,12 @@ class GcsServer:
         self.objects = ObjectDirectory(self)
         self.placement_groups = PlacementGroupManager(self, self.store)
         self.jobs = JobManager(self, self.store)
-        self.task_events = TaskEvents()
+        # Bounded per-job task-event store (task_events.py GcsTaskManager;
+        # replaces the old unbounded deque sink).
+        from ray_tpu.core.distributed.task_events import GcsTaskManager
+
+        self.task_events = GcsTaskManager()
+        self.metrics = MetricsFederation(self)
         self.event_log = EventLog()
         self.autoscaler_state = AutoscalerStateManager(self)
         self.logs = LogManager(self)
@@ -1069,9 +1099,11 @@ class GcsServer:
             ("Pubsub", self.pubsub),
             ("LogManager", self.logs),
             ("Syncer", self.syncer),
+            ("Metrics", self.metrics),
         ]:
             self.server.add_service(name, svc)
         port = await self.server.start()
+        self._start_metrics_http()
         self._tasks = [
             asyncio.ensure_future(self.nodes.health_check_loop()),
             asyncio.ensure_future(self.actors.scheduling_loop()),
@@ -1084,7 +1116,49 @@ class GcsServer:
         logger.info("GCS listening on %s", self.server.address)
         return port
 
+    def _start_metrics_http(self) -> None:
+        """Federated /metrics on the GCS (ref: the dashboard's
+        prometheus scrape target): one exposition covering every node's
+        last syncer-shipped snapshot, node-labelled."""
+        port = get_config().metrics_gcs_export_port
+        if not port:
+            return
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        gcs = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = gcs.metrics.federated_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        try:
+            srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        except OSError as e:
+            logger.warning("GCS metrics port %d unavailable: %s", port, e)
+            return
+        self._metrics_http = srv
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        logger.info("federated metrics on :%d/metrics",
+                    srv.server_address[1])
+
     async def stop(self):
+        srv = getattr(self, "_metrics_http", None)
+        if srv is not None:
+            srv.shutdown()
         for t in self._tasks:
             t.cancel()
         await self.server.stop()

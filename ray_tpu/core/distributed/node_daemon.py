@@ -184,6 +184,11 @@ class NodeDaemon:
                 gcs=self.gcs, node_id=self.node_id,
                 collect=self._syncer_state,
                 on_reregister=self._re_register,
+                # Metrics federation: this node's whole registry
+                # piggybacks on the sync channel at a slow cadence; the
+                # GCS merges all nodes' snapshots into one node-labelled
+                # /metrics exposition.
+                metrics_provider=self._metrics_dump,
                 metrics={
                     "deltas": self._m_sync_deltas,
                     "suppressed": self._m_sync_suppressed,
@@ -612,12 +617,7 @@ class NodeDaemon:
         self._m_xfer_in = self._m_xfer["bytes_in"]
         self._m_xfer_out = self._m_xfer["bytes_out"]
 
-    def get_metrics(self) -> str:
-        """Prometheus exposition text; also served over HTTP when
-        RAY_TPU_METRICS_EXPORT_PORT is set (ref: metrics agent scrape
-        endpoint, dashboard/modules/metrics)."""
-        from ray_tpu.util.metrics import get_registry
-
+    def _refresh_gauges(self) -> None:
         # Called from HTTP handler threads too: iterate over snapshots,
         # never live dicts the event loop mutates.
         workers = list(self._workers.values())
@@ -628,7 +628,23 @@ class NodeDaemon:
         self._m_store_used.set(self.store.used)
         self._m_store_objects.set(self.store.num_objects)
         self._m_spilled.set(self.store.spilled_bytes)
+
+    def get_metrics(self) -> str:
+        """Prometheus exposition text; also served over HTTP when
+        RAY_TPU_METRICS_EXPORT_PORT is set (ref: metrics agent scrape
+        endpoint, dashboard/modules/metrics)."""
+        from ray_tpu.util.metrics import get_registry
+
+        self._refresh_gauges()
         return get_registry().prometheus_text()
+
+    def _metrics_dump(self):
+        """Structured registry snapshot for the syncer's federation
+        piggyback (gauges refreshed first, like the text exposition)."""
+        from ray_tpu.util.metrics import registry_dump
+
+        self._refresh_gauges()
+        return registry_dump()
 
     def _start_metrics_http(self) -> None:
         port = get_config().metrics_export_port

@@ -74,6 +74,93 @@ STREAM_END = 5
 CANCEL = 6
 
 
+# ---------------------------------------------------------------------------
+# Transport instrumentation (ref: the reference's per-method gRPC stats +
+# instrumented asio event loops, src/ray/common/asio/instrumented_io_
+# context.h). Per-service/method histograms for queue-wait and handler
+# latency, inflight gauges, and bytes counters on the server and both
+# clients — the framing IS the scheduler latency floor, so this is where
+# control-plane regressions become visible. RAY_TPU_METRICS_RPC_ENABLED=0
+# is the kill switch (the bench overhead probe flips it).
+# ---------------------------------------------------------------------------
+
+_rpc_metrics_singleton: Optional[dict] = None
+
+
+def rpc_metrics() -> dict:
+    """Process-wide transport metrics, created lazily (registry adoption
+    makes repeat creation in in-proc harnesses safe)."""
+    global _rpc_metrics_singleton
+    if _rpc_metrics_singleton is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _rpc_metrics_singleton = {
+            "handler": Histogram(
+                "raytpu_rpc_handler_seconds",
+                "Server-side handler execution latency",
+                tag_keys=("service", "method")),
+            "queue_wait": Histogram(
+                "raytpu_rpc_queue_wait_seconds",
+                "Frame-decoded to handler-start queueing delay on the "
+                "server event loop", tag_keys=("service", "method")),
+            "client": Histogram(
+                "raytpu_rpc_client_seconds",
+                "Client-observed RPC round-trip latency",
+                tag_keys=("service", "method")),
+            "inflight": Gauge(
+                "raytpu_rpc_inflight",
+                "RPCs currently in flight", tag_keys=("side",)),
+            "bytes": Counter(
+                "raytpu_rpc_bytes_total",
+                "Frame bytes moved over the RPC transport",
+                tag_keys=("side", "direction")),
+            "loop_lag": Histogram(
+                "raytpu_event_loop_lag_seconds",
+                "Event-loop scheduling lag (sleep-overshoot probe)",
+                tag_keys=("loop",)),
+        }
+    return _rpc_metrics_singleton
+
+
+def _instrumentation_enabled() -> bool:
+    from ray_tpu.core.config import get_config
+
+    return get_config().metrics_rpc_enabled
+
+
+# Precomputed sample KEYS for the per-frame/per-call fast paths
+# (metrics.*_key): the transport observes ~10 samples per RPC round
+# trip, and building + sorting a tags dict per observation was a
+# measurable slice of many_tasks throughput on a single-core host.
+def _k(**tags) -> tuple:
+    return tuple(sorted(tags.items()))
+
+
+_K_SRV_IN = _k(side="server", direction="in")
+_K_SRV_OUT = _k(side="server", direction="out")
+_K_CLI_IN = _k(side="client", direction="in")
+_K_CLI_OUT = _k(side="client", direction="out")
+_K_SRV = _k(side="server")
+_K_CLI = _k(side="client")
+# (service, method) -> precomputed key, shared process-wide (the
+# handler/queue-wait/client histograms share one tag shape).
+_method_keys: Dict[Tuple[str, str], tuple] = {}
+
+
+def _key_for(service: str, method: str) -> tuple:
+    key = _method_keys.get((service, method))
+    if key is None:
+        key = _method_keys[(service, method)] = _k(service=service,
+                                                   method=method)
+    return key
+
+
+def _payload_nbytes(payload) -> int:
+    if isinstance(payload, list):
+        return sum(len(p) for p in payload) + _HEADER.size
+    return len(payload) + _HEADER.size
+
+
 def _ser(obj: Any, codec: int = CODEC_PICKLE, safe: bool = False):
     """Codec-tagged payload. Pickle (the Python<->Python default) tries
     plain pickle first (RPC messages are dicts of primitives/bytes),
@@ -223,6 +310,8 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
         self._writers: set = set()
+        self._metrics = rpc_metrics() if _instrumentation_enabled() \
+            else None
 
     def add_service(self, name: str, service: Any) -> None:
         self._services[name] = service
@@ -265,6 +354,7 @@ class RpcServer:
         self._writers.add(writer)
         wlock = asyncio.Lock()
         inflight: Dict[int, asyncio.Task] = {}
+        metrics = self._metrics
 
         async def send(ftype: int, req_id: int, obj: Any,
                        codec: int = CODEC_PICKLE) -> None:
@@ -278,6 +368,9 @@ class RpcServer:
             d = _sched_fuzz_delay()
             if d:
                 await asyncio.sleep(d)
+            if metrics is not None:
+                metrics["bytes"].inc_key(
+                    _K_SRV_OUT, _payload_nbytes(payload))
             async with wlock:
                 if isinstance(payload, list):
                     # Raw frame: hand each segment to the transport
@@ -289,8 +382,14 @@ class RpcServer:
                     writer.write(_frame(ftype, req_id, payload))
                 await writer.drain()
 
-        async def run_unary(req_id: int, fn, kwargs: dict,
-                            codec: int) -> None:
+        async def run_unary(req_id: int, fn, kwargs: dict, codec: int,
+                            mkey: Optional[tuple] = None,
+                            t_recv: float = 0.0) -> None:
+            if metrics is not None:
+                now = _time.perf_counter()
+                metrics["queue_wait"].observe_key(
+                    mkey, max(0.0, now - t_recv))
+                metrics["inflight"].inc_key(_K_SRV)
             try:
                 result = fn(**kwargs)
                 if inspect.isawaitable(result):
@@ -305,13 +404,23 @@ class RpcServer:
                          "traceback": traceback.format_exc()}
             finally:
                 inflight.pop(req_id, None)
+                if metrics is not None:
+                    metrics["inflight"].inc_key(_K_SRV, -1)
+                    metrics["handler"].observe_key(
+                        mkey, _time.perf_counter() - now)
             try:
                 await send(RES, req_id, reply, codec)
             except (ConnectionError, OSError):
                 pass  # client hung up mid-reply; nothing to tell it
 
-        async def run_stream(req_id: int, fn, kwargs: dict,
-                             codec: int) -> None:
+        async def run_stream(req_id: int, fn, kwargs: dict, codec: int,
+                             mkey: Optional[tuple] = None,
+                             t_recv: float = 0.0) -> None:
+            if metrics is not None:
+                now = _time.perf_counter()
+                metrics["queue_wait"].observe_key(
+                    mkey, max(0.0, now - t_recv))
+                metrics["inflight"].inc_key(_K_SRV)
             try:
                 async for item in fn(**kwargs):
                     await send(STREAM_ITEM, req_id, item, codec)
@@ -326,6 +435,10 @@ class RpcServer:
                 end = {"ok": False, "error": e}
             finally:
                 inflight.pop(req_id, None)
+                if metrics is not None:
+                    metrics["inflight"].inc_key(_K_SRV, -1)
+                    metrics["handler"].observe_key(
+                        mkey, _time.perf_counter() - now)
             try:
                 await send(STREAM_END, req_id, end, codec)
             except (ConnectionError, OSError):
@@ -355,6 +468,12 @@ class RpcServer:
                     if task is not None:
                         task.cancel()
                     continue
+                if metrics is not None:
+                    t_recv = _time.perf_counter()
+                    metrics["bytes"].inc_key(
+                        _K_SRV_IN, len(payload) + _HEADER.size)
+                else:
+                    t_recv = 0.0
                 try:
                     (service, method, kwargs), codec = _de_codec(payload)
                 except Exception:  # noqa: BLE001
@@ -368,9 +487,11 @@ class RpcServer:
                         "error": RpcError(
                             f"no such RPC {service}.{method}")}, codec)
                     continue
+                mkey = (_key_for(service, method)
+                        if metrics is not None else None)
                 runner = (run_stream if ftype == STREAM_REQ else run_unary)
                 task = asyncio.ensure_future(
-                    runner(req_id, fn, kwargs, codec))
+                    runner(req_id, fn, kwargs, codec, mkey, t_recv))
                 inflight[req_id] = task
                 self._conn_tasks.add(task)
                 task.add_done_callback(self._conn_tasks.discard)
@@ -395,6 +516,8 @@ class AsyncRpcClient:
     def __init__(self, address: str, codec: int = CODEC_PICKLE):
         self.address = address
         self.codec = codec
+        self._metrics = rpc_metrics() if _instrumentation_enabled() \
+            else None
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock: Optional[asyncio.Lock] = None
@@ -431,9 +554,13 @@ class AsyncRpcClient:
 
     async def _read_loop(self) -> None:
         reader = self._reader
+        metrics = self._metrics
         try:
             while True:
                 ftype, req_id, payload = await _read_frame(reader)
+                if metrics is not None:
+                    metrics["bytes"].inc_key(
+                        _K_CLI_IN, len(payload) + _HEADER.size)
                 if ftype == RES:
                     fut = self._pending.pop(req_id, None)
                     if fut is not None and not fut.done():
@@ -475,6 +602,9 @@ class AsyncRpcClient:
         if d:
             await asyncio.sleep(d)
         payload = _ser(obj, self.codec)
+        if self._metrics is not None:
+            self._metrics["bytes"].inc_key(
+                _K_CLI_OUT, _payload_nbytes(payload))
         async with self._wlock:
             if isinstance(payload, list):
                 for part in _frame_parts(ftype, req_id, payload):
@@ -485,6 +615,19 @@ class AsyncRpcClient:
 
     async def call(self, service: str, method: str,
                    timeout: Optional[float] = None, **kwargs) -> Any:
+        if self._metrics is None:
+            return await self._call(service, method, timeout, **kwargs)
+        t0 = _time.perf_counter()
+        self._metrics["inflight"].inc_key(_K_CLI)
+        try:
+            return await self._call(service, method, timeout, **kwargs)
+        finally:
+            self._metrics["inflight"].inc_key(_K_CLI, -1)
+            self._metrics["client"].observe_key(
+                _key_for(service, method), _time.perf_counter() - t0)
+
+    async def _call(self, service: str, method: str,
+                    timeout: Optional[float] = None, **kwargs) -> Any:
         await self._ensure_conn()
         self._req_id += 1
         req_id = self._req_id
@@ -625,6 +768,31 @@ class EventLoopThread:
         self._started = threading.Event()
         self._thread.start()
         self._started.wait()
+        self._maybe_start_lag_probe(name)
+
+    def _maybe_start_lag_probe(self, name: str) -> None:
+        """Event-loop lag probe (ref: instrumented_io_context.h): a
+        periodic sleep measures its own scheduling overshoot — the
+        direct signal that a handler is hogging the loop (the exact
+        failure mode the reference's asio stats catch). Off when RPC
+        instrumentation is off or RAY_TPU_METRICS_LOOP_PROBE_MS=0."""
+        from ray_tpu.core.config import get_config
+
+        probe_ms = get_config().metrics_loop_probe_ms
+        if not probe_ms or not _instrumentation_enabled():
+            return
+
+        async def probe() -> None:
+            hist = rpc_metrics()["loop_lag"]
+            tags = {"loop": name}
+            interval = probe_ms / 1000.0
+            while True:
+                t0 = self.loop.time()
+                await asyncio.sleep(interval)
+                hist.observe(max(0.0, self.loop.time() - t0 - interval),
+                             tags)
+
+        self.submit(probe())
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
@@ -686,6 +854,7 @@ class _BlockingConn:
         self.sock = socket.create_connection((host, int(port)), timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = bytearray()
+        self.last_recv_nbytes = 0
 
     def stale(self) -> bool:
         """Has the peer closed this pooled socket (restarted server)?
@@ -746,6 +915,7 @@ class _BlockingConn:
             self._buf += chunk
         payload = bytes(self._buf[_HEADER.size:total])
         del self._buf[:total]
+        self.last_recv_nbytes = total
         if version != PROTOCOL_VERSION:
             raise ProtocolVersionError(version, req_id)
         return ftype, req_id, payload
@@ -771,6 +941,8 @@ class SyncRpcClient:
         self.address = address
         self.codec = codec
         self._loop = loop_thread        # kept for API compatibility
+        self._metrics = rpc_metrics() if _instrumentation_enabled() \
+            else None
         self._pool: list = []
         self._lock = threading.Lock()
         self._req_id = 0
@@ -779,6 +951,22 @@ class SyncRpcClient:
     def call(self, service: str, method: str,
              timeout: Optional[float] = None, idempotent: bool = False,
              **kwargs) -> Any:
+        if self._metrics is None:
+            return self._call(service, method, timeout, idempotent,
+                              **kwargs)
+        t0 = _time.perf_counter()
+        self._metrics["inflight"].inc_key(_K_CLI)
+        try:
+            return self._call(service, method, timeout, idempotent,
+                              **kwargs)
+        finally:
+            self._metrics["inflight"].inc_key(_K_CLI, -1)
+            self._metrics["client"].observe_key(
+                _key_for(service, method), _time.perf_counter() - t0)
+
+    def _call(self, service: str, method: str,
+              timeout: Optional[float] = None, idempotent: bool = False,
+              **kwargs) -> Any:
         """One blocking RPC.
 
         Retry semantics (at-most-once by default): stale pooled sockets
@@ -861,6 +1049,11 @@ class SyncRpcClient:
                         conn.close()
                         conn = None
                         raise rpc_error(e2, "send") from e2
+            if self._metrics is not None:
+                self._metrics["bytes"].inc_key(
+                    _K_CLI_OUT, _payload_nbytes(payload))
+                self._metrics["bytes"].inc_key(
+                    _K_CLI_IN, conn.last_recv_nbytes)
             with self._lock:
                 if conn is not None and len(self._pool) < self.MAX_POOL:
                     self._pool.append(conn)

@@ -86,6 +86,7 @@ class NodeSyncer:
         report_interval_s: Optional[float] = None,
         keepalive_s: Optional[float] = None,
         metrics: Optional[dict] = None,
+        metrics_provider: Optional[Callable[[], Any]] = None,
     ):
         cfg = get_config()
         self.gcs = gcs
@@ -98,6 +99,13 @@ class NodeSyncer:
             else cfg.syncer_report_interval_ms / 1000.0)
         self.keepalive_s = (keepalive_s if keepalive_s is not None
                             else cfg.syncer_keepalive_ms / 1000.0)
+        # Metrics federation: a registry snapshot piggybacks on an
+        # ordinary push (delta, full, OR keepalive — idle nodes must
+        # stay fresh in the GCS's federated view) at a much slower
+        # cadence than the delta interval. None/0 disables.
+        self._metrics_provider = metrics_provider
+        self.metrics_interval_s = cfg.metrics_sync_interval_ms / 1000.0
+        self._last_metrics_t = 0.0
         # None => next push is a full snapshot (first contact / resync).
         self._last_sent: Optional[Dict[str, Any]] = None
         self.version = 0
@@ -158,13 +166,30 @@ class NodeSyncer:
             return "suppressed"
         return await self._push(state, delta=delta)
 
+    def _metrics_payload(self) -> Optional[Any]:
+        """Registry snapshot to piggyback, when due (rate-limited to
+        metrics_interval_s; never blocks or fails the push)."""
+        if (self._metrics_provider is None
+                or self.metrics_interval_s <= 0):
+            return None
+        now = time.monotonic()
+        if now - self._last_metrics_t < self.metrics_interval_s:
+            return None
+        self._last_metrics_t = now
+        try:
+            return self._metrics_provider()
+        except Exception:  # noqa: BLE001 telemetry must not break sync
+            return None
+
     async def _push(self, state: Optional[Dict[str, Any]],
                     delta: Optional[Dict[str, Any]] = None,
                     full: bool = False, keepalive: bool = False) -> str:
+        msnap = self._metrics_payload()
         if keepalive:
             reply = await self.gcs.call(
                 "Syncer", "push_update", node_id=self.node_id,
-                version=self.version, keepalive=True, timeout=10)
+                version=self.version, keepalive=True, metrics=msnap,
+                timeout=10)
             kind = "keepalive"
         else:
             payload = dict(state) if full else delta
@@ -173,7 +198,7 @@ class NodeSyncer:
             reply = await self.gcs.call(
                 "Syncer", "push_update", node_id=self.node_id,
                 version=version, base_version=base, state=payload,
-                full=full, timeout=10)
+                full=full, metrics=msnap, timeout=10)
             kind = "full" if full else "delta"
         if not reply.get("registered", True):
             # The GCS does not know us (restart) or marked us dead
@@ -324,11 +349,14 @@ class ClusterSyncer:
     def push_update(self, node_id: str, version: int,
                     base_version: int = 0,
                     state: Optional[Dict[str, Any]] = None,
-                    full: bool = False, keepalive: bool = False) -> dict:
+                    full: bool = False, keepalive: bool = False,
+                    metrics: Optional[Any] = None) -> dict:
         """Apply one node update. Sequence-numbered and idempotent:
         duplicates/out-of-order arrivals are ignored, gaps get a resync
         verdict, and every accepted message (keepalives included)
-        refreshes the node's liveness — the stream IS the heartbeat."""
+        refreshes the node's liveness — the stream IS the heartbeat.
+        A piggybacked registry snapshot (`metrics`) feeds the GCS's
+        federated exposition."""
         view = self._gcs.nodes.view
         n = view.nodes.get(node_id)
         if n is None:
@@ -340,6 +368,10 @@ class ClusterSyncer:
             self.stats_counters["stale_node_verdicts"] += 1
             return {"registered": False, "stale": True,
                     "reason": f"node {node_id[:8]} is marked dead"}
+        if metrics is not None:
+            fed = getattr(self._gcs, "metrics", None)
+            if fed is not None:
+                fed.ingest(node_id, metrics)
         cur = self.versions.get(node_id)
         if keepalive:
             n.last_heartbeat = time.monotonic()

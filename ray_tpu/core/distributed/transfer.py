@@ -495,9 +495,10 @@ def plan_broadcast_tree(targets: List[Any], fanout: int
 
 
 def make_transfer_metrics(tags: Dict[str, str]) -> Dict[str, Any]:
-    """Per-process transfer metric instances (each daemon/worker makes
-    its own so in-process multi-daemon harnesses keep separate counts;
-    the registry exports by name, instances count independently)."""
+    """Per-component transfer metric handles. Instances created under
+    the same name share sample storage (registry adoption); per-
+    daemon/worker accounting lives in the default tags — filter
+    samples by node_id to read one component's counts."""
     from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
     return {

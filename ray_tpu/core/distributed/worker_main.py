@@ -227,67 +227,34 @@ class WorkerService:
         # escaping into the pool's worker loop (which would kill the
         # pool thread permanently).
         self._exec_lock = threading.Lock()
-        # Task-event sink (ref: gcs_task_manager.h — powers `ray-tpu list
-        # tasks` and the chrome-trace timeline). Batched like locations.
-        self._events: List[dict] = []
-        self._events_lock = threading.Lock()
-        if get_config().task_events_enabled:
-            self._start_event_flusher()
-
-    def _start_event_flusher(self) -> None:
-        period = get_config().task_events_flush_ms / 1000
-
-        async def flush_loop():
-            import asyncio as _a
-
-            # Idle backoff: an idle worker (e.g. one of hundreds of
-            # parked actors) must not wake at full cadence forever —
-            # with a warm pool of 1k workers the 2 wakeups/s/worker
-            # alone saturate a small host. Activity snaps it back.
-            delay = period
-            while True:
-                await _a.sleep(delay)
-                with self._events_lock:
-                    batch, self._events = self._events, []
-                if get_config().tracing_enabled:
-                    from ray_tpu.util import tracing
-
-                    batch = batch + tracing.drain()
-                if not batch:
-                    delay = min(delay * 2, max(period, 16.0))
-                    continue
-                delay = period
-                try:
-                    gcs = await self.core._aget_gcs()
-                    await gcs.call("TaskEvents", "add_events",
-                                   events=batch, timeout=10)
-                except Exception as e:  # noqa: BLE001
-                    logger.debug("task event flush failed: %s", e)
-
-        self.core.loop_thread.submit(flush_loop())
+        # Task-event pipeline (task_events.py TaskEventBuffer on the
+        # core, ref: gcs_task_manager.h — powers `ray-tpu list tasks`
+        # and the chrome-trace timeline): bounded ring + coalescing
+        # flusher, drops counted instead of silent.
+        self.core.task_events.worker_id = worker_id
 
     def _record_event(self, spec: dict, state: str, start_ts: float,
                       end_ts: float, error: Optional[str] = None) -> None:
-        if not get_config().task_events_enabled:
-            return
-        with self._events_lock:
-            self._events.append({
-                "task_id": spec["task_id"].hex(),
-                "name": spec["options"].get("name", "task"),
-                "job_id": spec.get("job_id"),
-                "actor_id": spec.get("actor_id"),
-                "attempt": spec.get("attempt", 0),
-                "node_id": self.core.node_id,
-                "worker_id": self.worker_id,
-                "pid": os.getpid(),
-                "state": state,
-                "start_ts": start_ts,
-                "end_ts": end_ts,
-                "error": error,
-            })
-            cap = get_config().task_events_max_buffer
-            if len(self._events) > cap:  # backstop vs a dead GCS
-                del self._events[:cap // 2]
+        """Record an attempt's FULL history in one coalesced record: the
+        submission half (SUBMITTED/LEASED timestamps + caller identity)
+        rides the spec itself, so the happy path ships a single wire
+        record per attempt instead of two GCS-merged halves."""
+        transitions = []
+        sub_ts = spec.get("submit_ts")
+        ctx = spec.get("submit_ctx") or (None, None)
+        if sub_ts is not None:
+            transitions.append(("SUBMITTED", sub_ts))
+        lease_ts = spec.get("lease_ts")
+        if lease_ts is not None:
+            transitions.append(("LEASED", lease_ts))
+        transitions.append(("RUNNING", start_ts))
+        transitions.append((state, end_ts))
+        self.core.task_events.record_attempt(
+            spec["task_id"].hex(), spec.get("attempt", 0), transitions,
+            error=error, name=spec["options"].get("name", "task"),
+            job_id=spec.get("job_id"), actor_id=spec.get("actor_id"),
+            worker_id=self.worker_id, pid=os.getpid(),
+            submit_node_id=ctx[0], submit_pid=ctx[1])
 
     # ---- helpers ------------------------------------------------------
     def _fetch_arg(self, oid: ObjectID,
@@ -564,6 +531,13 @@ class WorkerService:
                 self._exec_counts[spec["fn_key"]] = n
                 if n >= mc:
                     self._retire_after_reply = True
+        # RUNNING is visible mid-execution (long tasks show up in
+        # list_tasks before they finish), not only in the terminal
+        # record's back-dated history. Lean on purpose: the buffer
+        # stamps executor identity, the terminal record fills the rest.
+        self.core.task_events.record_status(
+            spec["task_id"].hex(), spec.get("attempt", 0), "RUNNING",
+            ts=start_ts, name=name, job_id=spec.get("job_id"))
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],

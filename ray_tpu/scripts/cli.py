@@ -107,6 +107,22 @@ def cmd_status(gcs: _Gcs, args) -> None:
     print(f"  placement groups: {len(pgs)}")
     running = [j for j in jobs if not j.get("finished")]
     print(f"  jobs: {len(running)} running / {len(jobs)} total")
+    # Observability rollup: task-event completeness + federation health.
+    try:
+        obs = gcs.call("Metrics", "cluster_summary")
+    except Exception:  # noqa: BLE001 — pre-federation GCS
+        return
+    te = obs.get("task_events", {})
+    dropped = (te.get("worker_dropped_status", 0)
+               + te.get("worker_dropped_profile", 0))
+    print(f"  task events: {te.get('stored', 0)} stored "
+          f"({te.get('evicted', 0)} evicted, {dropped} dropped, "
+          f"{te.get('gc_events', 0)} gc'd)")
+    m = obs.get("metrics", {})
+    staleness = m.get("staleness_s", {})
+    worst = max(staleness.values(), default=0.0)
+    print(f"  metrics federation: {m.get('nodes_reporting', 0)} nodes "
+          f"reporting (worst staleness {worst:.1f}s)")
 
 
 def cmd_list(gcs: _Gcs, args) -> None:
@@ -208,6 +224,12 @@ def cmd_grafana_out(args) -> None:
 
 
 def cmd_metrics(gcs: _Gcs, args) -> None:
+    if getattr(args, "federated", False):
+        # One exposition for the whole cluster, node-labelled, straight
+        # from the GCS's syncer-fed federation cache — no per-daemon
+        # scrape fan-out.
+        print(gcs.call("Metrics", "federated_text"))
+        return
     for n in gcs.call("NodeInfo", "list_nodes"):
         if not n["alive"]:
             continue
@@ -438,6 +460,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="write generated Grafana dashboards + "
                          "provisioning config to this dir and exit")
     mp.add_argument("--node", help="node id prefix filter")
+    mp.add_argument("--federated", action="store_true",
+                    help="print the GCS's merged, node-labelled "
+                         "cluster exposition instead of per-daemon "
+                         "scrapes")
     sp = sub.add_parser("start")
     sp.add_argument("--head", action="store_true")
     sp.add_argument("--num-cpus", type=float, default=None)

@@ -31,6 +31,12 @@ class Metric:
         self._lock = threading.Lock()
         get_registry().register(self)
 
+    def _share_state(self, other: "Metric") -> None:
+        """Alias this instance's sample storage onto `other`'s (registry
+        name-collision adoption): both instances observe into ONE sample
+        set while keeping their own default tags."""
+        raise NotImplementedError
+
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
         return self
@@ -41,6 +47,13 @@ class Metric:
             merged.update(tags or {})
             return _tagkey(merged)
         return _tagkey(tags)
+
+    def key(self, tags: Optional[Dict[str, str]] = None) -> _TagKey:
+        """Precompute a sample key for the *_key fast paths: hot sites
+        (the RPC transport observes ~10 samples per round trip) resolve
+        tags once per (service, method) instead of building + sorting a
+        dict per observation."""
+        return self._merged(tags)
 
     # exposition
     def kind(self) -> str:
@@ -63,6 +76,14 @@ class Counter(Metric):
             raise ValueError("Counter.inc() takes a non-negative value")
         with self._lock:
             self._values[self._merged(tags)] += value
+
+    def inc_key(self, key: _TagKey, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[key] += value
+
+    def _share_state(self, other: "Counter") -> None:
+        self._values = other._values
+        self._lock = other._lock
 
     def kind(self) -> str:
         return "counter"
@@ -92,12 +113,20 @@ class Gauge(Metric):
     def dec(self, value: float = 1.0, tags=None) -> None:
         self.inc(-value, tags)
 
+    def inc_key(self, key: _TagKey, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
     def track(self, value: float = 1.0, tags=None):
         """Context manager: add `value` for the duration of a block —
         the in-flight-bytes / in-flight-requests idiom (the transfer
         plane's windowed pulls account their outstanding chunk bytes
         this way, so the gauge can never leak on an exception path)."""
         return _GaugeTrack(self, value, tags)
+
+    def _share_state(self, other: "Gauge") -> None:
+        self._values = other._values
+        self._lock = other._lock
 
     def kind(self) -> str:
         return "gauge"
@@ -127,10 +156,18 @@ class _GaugeTrack:
 class Histogram(Metric):
     """Bucketed observations (ref: util/metrics.py Histogram)."""
 
+    # Sub-millisecond floor: the default consumer is RPC/event-loop
+    # latency (a loopback unary round-trips in ~50µs), where the old
+    # 1ms-floor default collapsed the entire control-plane fast path
+    # into one bucket.
+    DEFAULT_BOUNDARIES = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                          0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+                          10, 60)
+
     def __init__(self, name, description="", boundaries: Sequence[float] = (),
                  tag_keys=()):
         if not boundaries:
-            boundaries = (0.001, 0.01, 0.1, 1, 10, 100, 1000)
+            boundaries = self.DEFAULT_BOUNDARIES
         self.boundaries = tuple(sorted(boundaries))
         self._counts: Dict[_TagKey, List[int]] = {}
         self._sums: Dict[_TagKey, float] = defaultdict(float)
@@ -139,7 +176,9 @@ class Histogram(Metric):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        key = self._merged(tags)
+        self.observe_key(self._merged(tags), value)
+
+    def observe_key(self, key: _TagKey, value: float) -> None:
         with self._lock:
             counts = self._counts.get(key)
             if counts is None:
@@ -152,6 +191,19 @@ class Histogram(Metric):
                 counts[-1] += 1
             self._sums[key] += value
             self._totals[key] += 1
+
+    def time(self, tags: Optional[Dict[str, str]] = None):
+        """Context manager observing the block's wall duration in
+        seconds — the idiom for every RPC/handler latency site:
+        `with hist.time({"method": m}): ...` can't leak an observation
+        on an exception path."""
+        return _HistogramTimer(self, tags)
+
+    def _share_state(self, other: "Histogram") -> None:
+        self._counts = other._counts
+        self._sums = other._sums
+        self._totals = other._totals
+        self._lock = other._lock
 
     def kind(self) -> str:
         return "histogram"
@@ -167,14 +219,54 @@ class Histogram(Metric):
                     dict(self._sums), dict(self._totals))
 
 
+class _HistogramTimer:
+    __slots__ = ("_hist", "_tags", "_t0")
+
+    def __init__(self, hist: "Histogram", tags):
+        self._hist = hist
+        self._tags = tags
+
+    def __enter__(self):
+        import time as _time
+
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time as _time
+
+        self._hist.observe(_time.perf_counter() - self._t0, self._tags)
+        return False
+
+
 class MetricsRegistry:
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
         self._lock = threading.Lock()
 
     def register(self, metric: Metric) -> None:
+        """Register, adopting on name collision: a second instance with
+        the same name/kind/tag_keys (and boundaries, for histograms)
+        shares the existing instance's sample storage instead of
+        silently orphaning it — in-process daemon restarts (virtual_node
+        tests, InProcDaemonCluster) re-create every metric, and the old
+        replace-on-register dropped all prior samples from exposition.
+        A shape mismatch is a bug and raises."""
         with self._lock:
-            self._metrics[metric.name] = metric
+            existing = self._metrics.get(metric.name)
+            if existing is None or existing is metric:
+                self._metrics[metric.name] = metric
+                return
+            if (existing.kind() != metric.kind()
+                    or existing.tag_keys != metric.tag_keys
+                    or getattr(existing, "boundaries", None)
+                    != getattr(metric, "boundaries", None)):
+                raise ValueError(
+                    f"metric {metric.name!r} re-registered with a "
+                    f"different shape: {existing.kind()}"
+                    f"{existing.tag_keys} vs {metric.kind()}"
+                    f"{metric.tag_keys}")
+            metric._share_state(existing)
 
     def get(self, name: str) -> Optional[Metric]:
         return self._metrics.get(name)
@@ -231,6 +323,86 @@ def _fmt_tags(key: _TagKey, le=None) -> str:
 
 def registry_snapshot() -> List[dict]:
     return get_registry().snapshot_meta()
+
+
+# ---------------------------------------------------------------------------
+# Federation: structured per-process dumps the syncer ships to the GCS,
+# merged there into one cluster-wide exposition (the analogue of
+# Prometheus federation's instance-labelled scrape union).
+# ---------------------------------------------------------------------------
+
+def registry_dump() -> List[dict]:
+    """Serializable snapshot of every metric WITH its samples (metadata
+    + values; contrast snapshot_meta, which is metadata only). The shape
+    survives the pickle RPC codec: plain dicts/lists/tuples."""
+    reg = get_registry()
+    with reg._lock:
+        metrics = list(reg._metrics.values())
+    out: List[dict] = []
+    for m in metrics:
+        rec = {"name": m.name, "description": m.description,
+               "kind": m.kind()}
+        if isinstance(m, Histogram):
+            counts, sums, totals = m.snapshot()
+            rec["boundaries"] = list(m.boundaries)
+            rec["hist"] = [
+                [list(key), list(buckets), sums[key], totals[key]]
+                for key, buckets in counts.items()]
+        else:
+            rec["samples"] = [[list(key), value]
+                              for key, value in m.samples()]
+        out.append(rec)
+    return out
+
+
+def merge_dumps(dumps: Dict[str, List[dict]]) -> str:
+    """Render {origin -> registry_dump()} as ONE Prometheus exposition.
+    Every sample gains a `node="<origin>"` label (federation's
+    instance label), so identical tag sets from different processes —
+    e.g. raytpu_rpc_handler_seconds{service=...,method=...} on every
+    daemon — stay distinguishable instead of colliding."""
+    meta: Dict[str, tuple] = {}          # name -> (kind, description)
+    lines_by_name: Dict[str, List[str]] = {}
+    for origin, dump in sorted(dumps.items()):
+        for rec in dump:
+            name = rec["name"]
+            meta.setdefault(name, (rec["kind"], rec["description"]))
+            out = lines_by_name.setdefault(name, [])
+            if rec["kind"] == "histogram":
+                bounds = rec.get("boundaries", [])
+                for key, buckets, hsum, total in rec.get("hist", []):
+                    key = _with_node(key, origin)
+                    base = _fmt_tags(key)
+                    cum = 0
+                    for b, c in zip(bounds, buckets):
+                        cum += c
+                        out.append(
+                            f"{name}_bucket{_fmt_tags(key, le=b)} {cum}")
+                    if buckets:
+                        cum += buckets[-1]
+                    out.append(
+                        f"{name}_bucket{_fmt_tags(key, le='+Inf')} {cum}")
+                    out.append(f"{name}_sum{base} {hsum}")
+                    out.append(f"{name}_count{base} {total}")
+            else:
+                for key, value in rec.get("samples", []):
+                    out.append(
+                        f"{name}{_fmt_tags(_with_node(key, origin))} "
+                        f"{value}")
+    text: List[str] = []
+    for name in sorted(meta):
+        kind, desc = meta[name]
+        desc = desc.replace("\\", "\\\\").replace("\n", "\\n")
+        text.append(f"# HELP {name} {desc}")
+        text.append(f"# TYPE {name} {kind}")
+        text.extend(lines_by_name[name])
+    return "\n".join(text) + "\n"
+
+
+def _with_node(key, origin: str) -> _TagKey:
+    items = [(str(k), str(v)) for k, v in key if k != "node"]
+    items.append(("node", origin))
+    return tuple(sorted(items))
 
 
 _registry: Optional[MetricsRegistry] = None

@@ -56,10 +56,27 @@ def list_actors(filters: Optional[List[Filter]] = None,
 
 def list_tasks(filters: Optional[List[Filter]] = None,
                limit: int = 10000) -> List[dict]:
+    """Task attempts with their full status-transition history: each row
+    carries `state_ts` ({state: wall time} for SUBMITTED/LEASED/RUNNING/
+    FINISHED|FAILED) merged across the driver's and executor's reports.
+    Use task_events_stats() for how complete this window is."""
     rows = _gcs().call("TaskEvents", "list_events", limit=limit,
                        timeout=30)
-    rows = [r for r in rows if r.get("kind") != "span"]
+    rows = [r for r in rows if r.get("kind") not in ("span", "profile")]
     return _apply_filters(rows, filters)[:limit]
+
+
+def get_task(task_id: str) -> List[dict]:
+    """All stored attempts of one task (ref: `ray get tasks <id>`)."""
+    return _gcs().call("TaskEvents", "get_task", task_id=task_id,
+                       timeout=30)
+
+
+def task_events_stats() -> dict:
+    """Completeness accounting for the task-event window: stored counts
+    plus everything dropped worker-side (bounded ring under a dead GCS)
+    or evicted GCS-side (per-job cap, finished-job GC)."""
+    return _gcs().call("TaskEvents", "stats", timeout=30)
 
 
 def list_placement_groups(filters: Optional[List[Filter]] = None,
@@ -98,14 +115,16 @@ def list_workers(filters: Optional[List[Filter]] = None,
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Per-task-name state counts (ref: `ray summary tasks`)."""
-    summary: Dict[str, Dict[str, int]] = {}
-    for t in list_tasks():
-        name = t.get("name", "task")
-        state = t.get("state", "UNKNOWN")
-        summary.setdefault(name, {})
-        summary[name][state] = summary[name].get(state, 0) + 1
-    return summary
+    """Per-task-name state counts (ref: `ray summary tasks`), computed
+    GCS-side over the full stored window (not a list_tasks page)."""
+    return _gcs().call("TaskEvents", "summarize", timeout=30)["tasks"]
+
+
+def task_summary() -> dict:
+    """summarize_tasks plus the completeness meta: {"tasks": per-name
+    state counts, "completeness": stored/evicted/dropped accounting} —
+    the honest version (a capped window must say it is a window)."""
+    return _gcs().call("TaskEvents", "summarize", timeout=30)
 
 
 def get_actor(actor_id: str) -> Optional[dict]:
@@ -114,5 +133,20 @@ def get_actor(actor_id: str) -> Optional[dict]:
 
 
 def cluster_status() -> dict:
-    """The autoscaler's view: demand, idle times, resource requests."""
-    return _gcs().call("AutoscalerState", "get_cluster_status", timeout=30)
+    """The autoscaler's view: demand, idle times, resource requests —
+    enriched with the observability rollup (metrics federation freshness
+    + task-event completeness) under "observability"."""
+    status = _gcs().call("AutoscalerState", "get_cluster_status",
+                         timeout=30)
+    try:
+        status["observability"] = _gcs().call("Metrics", "cluster_summary",
+                                              timeout=30)
+    except Exception:  # noqa: BLE001 — pre-federation GCS
+        pass
+    return status
+
+
+def cluster_metrics() -> str:
+    """The GCS's federated Prometheus exposition: every node's last
+    syncer-shipped snapshot merged, node-labelled."""
+    return _gcs().call("Metrics", "federated_text", timeout=30)

@@ -3,6 +3,12 @@
 ref: `ray timeline` (python/ray/_private/state.py:917 chrome_tracing_dump
 over profile events, _private/profiling.py). Open the output in
 chrome://tracing or https://ui.perfetto.dev.
+
+One merged trace: task status transitions (submit slice on the caller's
+row, run slice on the worker's row, joined by a flow arrow), tracing
+spans (on the emitting node/worker rows), and opt-in profile events
+(object transfers etc.) — all in the same process/thread grid so a
+task's whole life reads left-to-right across rows.
 """
 from __future__ import annotations
 
@@ -17,35 +23,99 @@ def fetch_task_events(limit: int = 10000) -> List[dict]:
                                      limit=limit, timeout=30)
 
 
+def _node_row(node_id) -> str:
+    return f"node:{(node_id or '?')[:8]}"
+
+
 def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
-    """Convert task events to chrome-trace 'X' (complete) events."""
+    """Convert task events to chrome-trace events: 'X' (complete) slices
+    plus 's'/'f' flow arrows from each attempt's submit slice to its run
+    slice."""
     if events is None:
         events = fetch_task_events()
-    trace = []
+    trace: List[dict] = []
+    flow_seq = 0
     for e in events:
-        if e.get("kind") == "span":
+        kind = e.get("kind")
+        if kind == "span":
             from ray_tpu.util.tracing import spans_to_chrome_trace
 
             trace.extend(spans_to_chrome_trace([e]))
             continue
-        start, end = e.get("start_ts"), e.get("end_ts")
-        if start is None or end is None:
+        if kind == "profile":
+            start, end = e.get("start_ts"), e.get("end_ts")
+            if start is None or end is None:
+                continue
+            trace.append({
+                "name": e.get("name", "profile"),
+                "cat": e.get("category", "profile"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, end - start) * 1e6,
+                "pid": _node_row(e.get("node_id")),
+                "tid": f"worker:{e.get('pid', '?')}",
+                "args": {k: v for k, v in e.items()
+                         if k not in ("kind", "name", "category",
+                                      "start_ts", "end_ts")},
+            })
             continue
-        trace.append({
-            "name": e.get("name", "task"),
-            "cat": "actor_task" if e.get("actor_id") else "task",
-            "ph": "X",
-            "ts": start * 1e6,
-            "dur": max(0.0, (end - start)) * 1e6,
-            "pid": f"node:{(e.get('node_id') or '?')[:8]}",
-            "tid": f"worker:{e.get('pid', '?')}",
-            "args": {
-                "task_id": e.get("task_id"),
-                "state": e.get("state"),
-                "attempt": e.get("attempt"),
-                "error": e.get("error"),
-            },
-        })
+        name = e.get("name", "task")
+        st = e.get("state_ts") or {}
+        run_start = e.get("start_ts") or st.get("RUNNING")
+        end = e.get("end_ts")
+        args = {
+            "task_id": e.get("task_id"),
+            "state": e.get("state"),
+            "attempt": e.get("attempt"),
+            "error": e.get("error"),
+            "state_ts": st,
+        }
+        run_row = None
+        if run_start is not None and end is not None:
+            run_row = (_node_row(e.get("node_id")),
+                       f"worker:{e.get('pid', '?')}")
+            trace.append({
+                "name": name,
+                "cat": "actor_task" if e.get("actor_id") else "task",
+                "ph": "X",
+                "ts": run_start * 1e6,
+                "dur": max(0.0, end - run_start) * 1e6,
+                "pid": run_row[0],
+                "tid": run_row[1],
+                "args": args,
+            })
+        submit_ts = st.get("SUBMITTED")
+        if submit_ts is not None:
+            # Submit slice on the CALLER's row, spanning submission to
+            # lease/run handoff (floored so perfetto renders it).
+            handoff = st.get("LEASED") or run_start
+            sub_row = (_node_row(e.get("submit_node_id")),
+                       f"driver:{e.get('submit_pid', '?')}")
+            trace.append({
+                "name": f"submit:{name}",
+                "cat": "submit",
+                "ph": "X",
+                "ts": submit_ts * 1e6,
+                "dur": max(1.0, ((handoff or submit_ts) - submit_ts)
+                           * 1e6),
+                "pid": sub_row[0],
+                "tid": sub_row[1],
+                "args": args,
+            })
+            if run_row is not None and run_start >= submit_ts:
+                # Flow arrow: submit -> run. Same id binds the pair; the
+                # 's' sits inside the submit slice, the 'f' at the run
+                # slice's start (bp=e attaches to the enclosing slice).
+                flow_seq += 1
+                fid = (f"{e.get('task_id', flow_seq)}:"
+                       f"{e.get('attempt', 0)}")
+                flow = {"name": "submit_to_run", "cat": "task_flow",
+                        "id": fid}
+                trace.append({**flow, "ph": "s", "ts": submit_ts * 1e6,
+                              "pid": sub_row[0], "tid": sub_row[1]})
+                trace.append({**flow, "ph": "f", "bp": "e",
+                              "ts": run_start * 1e6,
+                              "pid": run_row[0], "tid": run_row[1]})
     return trace
 
 

@@ -30,6 +30,15 @@ _buffer: List[dict] = []
 _buffer_lock = threading.Lock()
 _exporter: Optional[Callable[[List[dict]], None]] = None
 MAX_BUFFER = 10000
+# Which node this process runs on (set by the core worker at init):
+# stamped onto finished spans so the timeline can place them under the
+# emitting node/worker rows instead of a synthetic trace_id process.
+_node_id: Optional[str] = None
+
+
+def set_node_context(node_id: str) -> None:
+    global _node_id
+    _node_id = node_id
 
 
 def enabled() -> bool:
@@ -51,6 +60,8 @@ class Span:
         self.end: Optional[float] = None
 
     def finish(self) -> dict:
+        import os
+
         self.end = time.time()
         record = {
             "kind": "span",
@@ -60,6 +71,8 @@ class Span:
             "parent_id": self.parent_id,
             "start_ts": self.start,
             "end_ts": self.end,
+            "node_id": _node_id,
+            "pid": os.getpid(),
             "attrs": self.attrs,
         }
         with _buffer_lock:
@@ -139,17 +152,26 @@ def set_exporter(fn: Optional[Callable[[List[dict]], None]]) -> None:
 
 
 def spans_to_chrome_trace(spans: List[dict]) -> List[dict]:
-    """Chrome-tracing events for `ray-tpu timeline` merging."""
+    """Chrome-tracing events for `ray-tpu timeline` merging. Spans land
+    under the emitting node/worker rows (pid=node, tid=worker pid), the
+    same rows their task slices render on — NOT under a synthetic
+    pid=trace_id process, which scattered every trace into its own
+    process group and never lined up with the task rows in perfetto.
+    The trace/span lineage stays available in args."""
     out = []
     for s in spans:
+        node = s.get("node_id")
         out.append({
             "name": s["name"],
             "cat": "span",
             "ph": "X",
             "ts": s["start_ts"] * 1e6,
             "dur": (s["end_ts"] - s["start_ts"]) * 1e6,
-            "pid": s["trace_id"][:8],
-            "tid": s.get("parent_id") or s["span_id"],
-            "args": s.get("attrs", {}),
+            "pid": f"node:{node[:8]}" if node else "node:?",
+            "tid": f"worker:{s.get('pid', '?')}",
+            "args": {**s.get("attrs", {}),
+                     "trace_id": s.get("trace_id"),
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id")},
         })
     return out

@@ -169,3 +169,61 @@ def test_syncer_snapshot_resync_after_gcs_restart(durable_cluster):
     assert converged, status
     final = w.gcs.call("Syncer", "stats", timeout=10)
     assert final["applied_deltas"] >= 1, final
+
+
+def test_task_event_flusher_recovers_after_gcs_restart(durable_cluster):
+    """GCS down: the task-event flusher fails without blocking anything
+    (bounded ring, failure counters); after the restart the buffered
+    events — recorded entirely while the GCS was dead — flush through
+    and become visible in list_tasks."""
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+
+    cluster = durable_cluster
+    w = _global_worker()
+
+    @ray_tpu.remote
+    def warm(x):
+        return x
+
+    assert ray_tpu.get(warm.remote(1), timeout=60) == 1
+
+    cluster.kill_gcs()
+    time.sleep(0.5)
+
+    # Recorded while the GCS is unreachable: buffered, never blocking.
+    base_failures = w.task_events.stats()["flush_failures"]
+    for i in range(5):
+        w.task_events.record_status(
+            f"ftevent{i:02d}", 0, "RUNNING", name="ft_buffered",
+            job_id=w.job_id)
+        w.task_events.record_status(
+            f"ftevent{i:02d}", 0, "FINISHED", name="ft_buffered",
+            job_id=w.job_id)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if w.task_events.stats()["flush_failures"] > base_failures:
+            break
+        time.sleep(0.2)
+    stats = w.task_events.stats()
+    assert stats["flush_failures"] > base_failures, stats
+    assert stats["pending"] >= 5, stats
+
+    cluster.restart_gcs()
+
+    # Recovery: the SAME buffered records land in the state API.
+    deadline = time.monotonic() + 90
+    names = set()
+    while time.monotonic() < deadline:
+        try:
+            events = w.gcs.call("TaskEvents", "list_events", timeout=10)
+            names = {e.get("task_id") for e in events
+                     if e.get("name") == "ft_buffered"
+                     and e.get("state") == "FINISHED"}
+            if len(names) >= 5:
+                break
+        except Exception:  # noqa: BLE001 reconnecting
+            pass
+        time.sleep(0.5)
+    assert len(names) >= 5, names
+    assert w.task_events.stats()["pending"] == 0

@@ -556,8 +556,16 @@ def test_broadcast_tree_reaches_all_and_bounds_owner_uplink():
             obj = os.urandom(16 * 1024 * 1024)
             oid = ObjectID(os.urandom(20))
             owner.store.put_raw(oid, obj)
-            out_before = sum(
-                v for _, v in owner._m_xfer_out.samples())
+
+            # Registry adoption shares sample storage across the
+            # in-proc daemons' metric instances; per-node accounting
+            # lives in the node_id tag.
+            def node_bytes(metric, d):
+                nid = ("node_id", d.node_id[:12])
+                return sum(v for key, v in metric.samples()
+                           if nid in key)
+
+            out_before = node_bytes(owner._m_xfer_out, owner)
             client = AsyncRpcClient(owner.server.address)
             try:
                 rep = await client.call(
@@ -575,15 +583,13 @@ def test_broadcast_tree_reaches_all_and_bounds_owner_uplink():
                 buf.release()
                 assert not d._recv_partials
                 assert_store_quiescent(d.store, 1)
-            owner_sent = sum(
-                v for _, v in owner._m_xfer_out.samples()) - out_before
+            owner_sent = node_bytes(owner._m_xfer_out, owner) - out_before
             fanout_bound = 2 * len(obj) * 1.05   # fanout=2 + header slack
             assert owner_sent <= fanout_bound, (
                 f"owner uplink {owner_sent / 1e6:.1f} MB exceeds "
                 f"fanout bound {fanout_bound / 1e6:.1f} MB")
             # Conservation: everyone received exactly one copy.
-            total_in = sum(sum(v for _, v in d._m_xfer_in.samples())
-                           for d in rest)
+            total_in = sum(node_bytes(d._m_xfer_in, d) for d in rest)
             assert total_in == 8 * len(obj), total_in
         finally:
             await vc.stop()

@@ -45,6 +45,160 @@ def test_counter_rejects_negative():
         c.inc(-1)
 
 
+def test_registry_collision_reuses_matching_metric():
+    """Re-registering the same name/kind/tag_keys ADOPTS the existing
+    sample storage (in-process daemon restarts re-create every metric;
+    the old replace-on-register orphaned all prior samples); a shape
+    mismatch raises."""
+    a = Counter("test_collide_total", "first", tag_keys=("node",))
+    b = Counter("test_collide_total", "second", tag_keys=("node",))
+    a.inc(2, tags={"node": "a"})
+    b.inc(3, tags={"node": "b"})
+    text = get_registry().prometheus_text()
+    assert 'test_collide_total{node="a"} 2.0' in text
+    assert 'test_collide_total{node="b"} 3.0' in text
+    # Both instances share one sample set.
+    assert dict(a.samples()) == dict(b.samples())
+    with pytest.raises(ValueError):
+        Gauge("test_collide_total")                   # kind mismatch
+    with pytest.raises(ValueError):
+        Counter("test_collide_total", tag_keys=("other",))  # tags mismatch
+    h1 = Histogram("test_collide_seconds", boundaries=(0.1, 1))
+    with pytest.raises(ValueError):                   # boundaries mismatch
+        Histogram("test_collide_seconds", boundaries=(0.5, 5))
+    h2 = Histogram("test_collide_seconds", boundaries=(0.1, 1))
+    h1.observe(0.05)
+    h2.observe(0.5)
+    assert h1.snapshot() == h2.snapshot()
+
+
+def test_histogram_time_context_manager():
+    h = Histogram("test_timer_seconds", "t", tag_keys=("m",))
+    with h.time({"m": "x"}):
+        time.sleep(0.002)
+    counts, sums, totals = h.snapshot()
+    key = (("m", "x"),)
+    assert totals[key] == 1
+    assert 0.0005 < sums[key] < 1.0
+    # Default boundaries resolve sub-millisecond RPC latencies.
+    assert Histogram("test_default_bounds").boundaries[0] < 0.001
+
+
+# ---------------------------------------------------------------------------
+# task-event pipeline: bounded buffer, drop accounting, GCS-side caps
+# ---------------------------------------------------------------------------
+
+def _drive(coro):
+    import asyncio
+
+    return asyncio.run(coro)
+
+
+def test_task_event_buffer_bounded_with_drop_counters(monkeypatch):
+    """GCS down: the ring stays bounded, execution never blocks, and
+    every dropped record is counted per kind."""
+    import asyncio
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.task_events import TaskEventBuffer
+
+    cfg = get_config()
+    monkeypatch.setattr(cfg, "task_events_enabled", True)
+    monkeypatch.setattr(cfg, "task_events_max_buffer", 16)
+    monkeypatch.setattr(cfg, "task_events_profile", True)
+
+    async def dead_gcs(**payload):
+        raise ConnectionError("gcs down")
+
+    buf = TaskEventBuffer(flush_fn=dead_gcs, node_id="n1", pid=1)
+    for i in range(50):
+        buf.record_status(f"task{i:04d}", 0, "RUNNING", ts=float(i))
+    assert buf.stats()["pending"] == 16
+    assert buf.stats()["dropped"]["status"] == 34
+    for i in range(20):
+        buf.record_profile(f"p{i}", "transfer", float(i), float(i) + 1)
+    assert buf.stats()["pending_profile"] == 16
+    assert buf.stats()["dropped"]["profile"] == 4
+
+    # A failed flush re-buffers (no loss beyond the cap) and counts.
+    assert _drive(buf.flush_once()) is False
+    assert buf.stats()["flush_failures"] == 1
+    assert buf.stats()["pending"] == 16
+
+    # Coalescing: transitions for one attempt merge into ONE record.
+    shipped = []
+
+    async def live_gcs(**payload):
+        shipped.append(payload)
+
+    buf2 = TaskEventBuffer(flush_fn=live_gcs, node_id="n1", pid=1)
+    buf2.record_status("t1", 0, "SUBMITTED", ts=1.0, name="t")
+    buf2.record_status("t1", 0, "RUNNING", ts=2.0)
+    buf2.record_status("t1", 0, "FINISHED", ts=3.0)
+    assert _drive(buf2.flush_once()) is True
+    (payload,) = shipped
+    (rec,) = payload["events"]
+    assert rec["state"] == "FINISHED"
+    assert rec["state_ts"] == {"SUBMITTED": 1.0, "RUNNING": 2.0,
+                               "FINISHED": 3.0}
+    # Unreported drop counts ride the next successful flush.
+    assert _drive(buf.flush_once()) in (True, False)
+
+
+def test_gcs_task_manager_eviction_and_gc(monkeypatch):
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.task_events import GcsTaskManager
+
+    cfg = get_config()
+    monkeypatch.setattr(cfg, "task_events_max_per_job", 5)
+    monkeypatch.setattr(cfg, "task_events_finished_job_ttl_s", 0.0)
+    mgr = GcsTaskManager()
+    for i in range(12):
+        mgr.add_task_events(events=[{
+            "task_id": f"t{i:03d}", "attempt": 0, "state": "FINISHED",
+            "state_ts": {"FINISHED": float(i)}, "job_id": "j1",
+            "name": "w", "end_ts": float(i)}])
+    s = mgr.stats()
+    assert s["stored"] == 5 and s["evicted"] == 7
+    assert s["evicted_by_job"]["j1"] == 7
+    # Oldest attempts went first.
+    kept = {r["task_id"] for r in mgr.list_events()}
+    assert kept == {f"t{i:03d}" for i in range(7, 12)}
+    # Worker-side drop counts accumulate into completeness accounting.
+    mgr.add_task_events(events=[], dropped={"status": 9, "profile": 2})
+    summ = mgr.summarize()
+    assert summ["completeness"]["worker_dropped_status"] == 9
+    assert summ["tasks"]["w"]["FINISHED"] == 5
+    # Job-completion GC frees the job's storage and counts it.
+    mgr.on_job_finished("j1")
+    assert mgr.gc_finished_jobs() == 5
+    assert mgr.stats()["stored"] == 0
+    assert mgr.stats()["gc_events"] == 5
+
+
+def test_gcs_task_manager_merges_driver_and_worker_halves():
+    from ray_tpu.core.distributed.task_events import GcsTaskManager
+
+    mgr = GcsTaskManager()
+    # Driver's half arrives first...
+    mgr.add_task_events(events=[{
+        "task_id": "tt", "attempt": 0, "state": "LEASED",
+        "state_ts": {"SUBMITTED": 1.0, "LEASED": 1.5}, "job_id": "j",
+        "name": "f", "submit_node_id": "head", "submit_pid": 10}])
+    # ...then the executor's, out of order.
+    mgr.add_task_events(events=[{
+        "task_id": "tt", "attempt": 0, "state": "FINISHED",
+        "state_ts": {"RUNNING": 2.0, "FINISHED": 3.0}, "job_id": "j",
+        "name": "f", "node_id": "worker_node", "pid": 20,
+        "start_ts": 2.0, "end_ts": 3.0}])
+    (rec,) = mgr.get_task("tt")
+    assert rec["state"] == "FINISHED"
+    assert list(sorted(rec["state_ts"])) == ["FINISHED", "LEASED",
+                                             "RUNNING", "SUBMITTED"]
+    assert rec["submit_node_id"] == "head" and rec["submit_pid"] == 10
+    assert rec["node_id"] == "worker_node" and rec["pid"] == 20
+
+
 # ---------------------------------------------------------------------------
 # cluster: task events, daemon metrics, timeline, CLI
 # ---------------------------------------------------------------------------
@@ -72,7 +226,9 @@ def test_task_events_and_timeline(obs_cluster, tmp_path):
     with pytest.raises(Exception):
         ray_tpu.get(boom.remote(), timeout=120)
 
-    # Events are flushed on a short period; poll the sink.
+    # Events are flushed on a short period; poll the sink until the
+    # driver-side (SUBMITTED/LEASED) and executor-side (terminal)
+    # halves have both landed and merged.
     from ray_tpu.api import _global_worker
 
     w = _global_worker()
@@ -80,21 +236,131 @@ def test_task_events_and_timeline(obs_cluster, tmp_path):
     events = []
     while time.monotonic() < deadline:
         events = w.gcs.call("TaskEvents", "list_events", timeout=15)
-        names = " ".join(e["name"] for e in events)
-        if "traced" in names and "boom" in names:
+        if (any("traced" in (e.get("name") or "")
+                and e.get("state") == "FINISHED" for e in events)
+                and any("boom" in (e.get("name") or "")
+                        and e.get("state") == "FAILED" for e in events)):
             break
         time.sleep(0.3)
     assert any("traced" in e["name"] and e["state"] == "FINISHED"
                for e in events)
-    failed = [e for e in events if "boom" in e["name"]]
+    failed = [e for e in events if "boom" in (e.get("name") or "")]
     assert failed and failed[0]["state"] == "FAILED"
     assert "intentional" in failed[0]["error"]
+    # Full status-transition history on a completed attempt: every stage
+    # of SUBMITTED -> LEASED -> RUNNING -> FINISHED, monotonically
+    # ordered, merged across the driver's and executor's reports.
+    done = [e for e in events if "traced" in (e.get("name") or "")
+            and e.get("state") == "FINISHED"]
+    hist = done[0]["state_ts"]
+    assert ["SUBMITTED", "LEASED", "RUNNING", "FINISHED"] == [
+        s for s in ("SUBMITTED", "LEASED", "RUNNING", "FINISHED")
+        if s in hist]
+    ts = [hist[s] for s in ("SUBMITTED", "LEASED", "RUNNING", "FINISHED")]
+    assert ts == sorted(ts)
+    # Submission identity (driver) is kept apart from execution identity
+    # (worker) — the timeline's flow arrows need both ends.
+    assert done[0]["submit_pid"] and done[0]["pid"]
 
     from ray_tpu.util.timeline import timeline
 
     out = timeline(str(tmp_path / "trace.json"))
     trace = json.load(open(out))
     assert any("traced" in ev["name"] and ev["ph"] == "X" for ev in trace)
+    # Merged trace: a submit slice on the caller's row plus s->f flow
+    # arrows binding submit to run.
+    assert any(ev["name"].startswith("submit:") for ev in trace)
+    starts = [ev for ev in trace if ev.get("ph") == "s"]
+    ends = {ev["id"] for ev in trace if ev.get("ph") == "f"}
+    assert starts and any(ev["id"] in ends for ev in starts)
+
+
+def test_rpc_instrumentation_and_loop_lag_in_exposition(obs_cluster):
+    """The transport self-instruments: per-service/method histograms,
+    bytes counters, and the event-loop lag probe all land in the
+    process registry after ordinary cluster traffic."""
+    text = get_registry().prometheus_text()
+    assert "# TYPE raytpu_rpc_client_seconds histogram" in text
+    assert 'service="NodeInfo"' in text or 'service="TaskEvents"' in text
+    assert "raytpu_rpc_bytes_total" in text
+    assert "raytpu_event_loop_lag_seconds" in text
+
+
+def test_metrics_federation_from_two_nodes():
+    """InProcDaemonCluster x2: each daemon piggybacks registry snapshots
+    on its syncer pushes; the GCS serves ONE federated exposition with
+    per-method RPC latency histograms labelled by >=2 distinct nodes."""
+    import asyncio
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.core.distributed.virtual_node import InProcDaemonCluster
+
+    cfg = get_config()
+    saved = cfg.metrics_sync_interval_ms
+    cfg.metrics_sync_interval_ms = 200
+
+    async def run():
+        cluster = InProcDaemonCluster(2, store_capacity=64 << 20)
+        await cluster.start()
+        client = AsyncRpcClient(cluster.gcs.server.address)
+        node_ids = [d.node_id[:12] for d in cluster.daemons]
+        try:
+            text = ""
+            deadline = asyncio.get_running_loop().time() + 20
+            while asyncio.get_running_loop().time() < deadline:
+                text = await client.call("Metrics", "federated_text",
+                                         timeout=10)
+                if all(f'node="{nid}"' in text for nid in node_ids):
+                    break
+                await asyncio.sleep(0.2)
+            # Per-method RPC latency histograms, from >= 2 nodes.
+            assert "# TYPE raytpu_rpc_client_seconds histogram" in text
+            for nid in node_ids:
+                assert f'node="{nid}"' in text
+            assert 'method="push_update"' in text
+            # The GCS's own registry federates too.
+            assert 'node="gcs"' in text
+            stats = await client.call("Metrics", "stats", timeout=10)
+            assert stats["nodes_reporting"] >= 2
+            summary = await client.call("Metrics", "cluster_summary",
+                                        timeout=10)
+            assert "task_events" in summary and "metrics" in summary
+
+            # Task events through the same cluster's RPC surface: a
+            # full-history attempt round-trips into list_events and a
+            # flow-arrowed merged timeline.
+            nid = cluster.daemons[0].node_id
+            await client.call("TaskEvents", "add_task_events", events=[{
+                "task_id": "fedtask00", "attempt": 0,
+                "state": "FINISHED", "name": "fed_task",
+                "job_id": "fedjob",
+                "state_ts": {"SUBMITTED": 10.0, "LEASED": 10.1,
+                             "RUNNING": 10.2, "FINISHED": 10.5},
+                "start_ts": 10.2, "end_ts": 10.5,
+                "submit_node_id": "drivernode", "submit_pid": 1,
+                "node_id": nid, "pid": 2}], timeout=10)
+            rows = await client.call("TaskEvents", "list_events",
+                                     timeout=10)
+            (row,) = [r for r in rows if r.get("task_id") == "fedtask00"]
+            assert row["state"] == "FINISHED"
+            assert list(row["state_ts"]) == ["SUBMITTED", "LEASED",
+                                             "RUNNING", "FINISHED"]
+            from ray_tpu.util.timeline import chrome_trace
+
+            trace = chrome_trace(rows)
+            assert any(ev.get("ph") == "s" for ev in trace)
+            assert any(ev.get("ph") == "f"
+                       and ev["pid"] == f"node:{nid[:8]}"
+                       for ev in trace)
+        finally:
+            await client.close()
+            await cluster.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        cfg.metrics_sync_interval_ms = saved
 
 
 def test_daemon_metrics_endpoint(obs_cluster):
