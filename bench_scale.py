@@ -15,6 +15,12 @@ Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
                     events + RPC instrumentation) on vs off in fresh
                     subprocesses; asserts <10% throughput regression
                     (--only opt-in: spawns two nested cluster boots)
+  attribution_overhead
+                    many_tasks with per-task resource attribution
+                    (thread CPU + RSS probes per attempt,
+                    RAY_TPU_TASK_EVENTS_RESOURCES) on vs off in paired
+                    subprocess runs; asserts the best-pair slowdown is
+                    <5% (--only opt-in, same reason as obs_overhead)
   many_tasks        10k short tasks through 4 submitters   (ref 589/s)
   many_actors       1k actor create+ping+kill              (ref 580/s)
   queued_flood      1M tasks queued behind a blocker       (ref 5163/s*)
@@ -323,6 +329,62 @@ def bench_obs_overhead(quick: bool) -> None:
         f"{pairs}")
 
 
+def _paired_many_tasks(quick: bool, label: str,
+                       off_env: dict, rounds: int = 3) -> list:
+    """Paired on/off many_tasks subprocess runs (see bench_obs_overhead
+    for why pairing: host load on a timeshared box drifts on minute
+    timescales, so only back-to-back pairs compare like with like)."""
+    import tempfile
+
+    def one_run(tag: str, extra: dict) -> float:
+        path = os.path.join(tempfile.mkdtemp(prefix=f"{label}_probe_"),
+                            f"many_tasks_{tag}.json")
+        cmd = [sys.executable, os.path.abspath(__file__), "--only",
+               "many_tasks", "--out", path]
+        if quick:
+            cmd.append("--quick")
+        env = dict(os.environ, **extra)
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{label} sub-bench ({tag}) failed:\n"
+                f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        with open(path) as f:
+            doc = json.load(f)
+        (rate,) = [r["value"] for r in doc["results"]
+                   if r["metric"] == "many_tasks_per_second"]
+        return rate
+
+    pairs = []
+    for _ in range(2 if quick else rounds):
+        off = one_run("off", off_env)
+        on = one_run("on", {})
+        pairs.append((off, on))
+    return pairs
+
+
+def bench_attribution_overhead(quick: bool) -> None:
+    """Per-task resource-attribution overhead: many_tasks with the
+    executor-side TaskUsageProbe (thread CPU-time + RSS delta/peak per
+    attempt) on vs off. The probe is two thread_time() reads, two
+    cached-fd statm preads, and two getrusage calls per attempt — the
+    best-pair slowdown must stay under 5%."""
+    pairs = _paired_many_tasks(
+        quick, "attribution",
+        {"RAY_TPU_TASK_EVENTS_RESOURCES": "0"})
+    # Slowdown factor off/on per pair; best pair filters host-load
+    # drift that landed INSIDE a pair.
+    best = min(pairs, key=lambda p: p[0] / p[1])
+    ratio = best[0] / best[1]
+    emit("attribution_overhead_ratio", ratio, "x", baseline=None,
+         tasks_per_second_on=best[1], tasks_per_second_off=best[0],
+         all_pairs=[[round(o, 1), round(n, 1)] for o, n in pairs])
+    assert ratio < 1.05, (
+        f"per-task attribution costs >5% many_tasks throughput: "
+        f"{pairs}")
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     out_path = "BENCH_SCALE_r05.json"
@@ -339,7 +401,7 @@ def main() -> None:
     # Standalone probes first: each hosts its own in-process GCS/daemons
     # and must not share the driver's cluster.
     standalone = {"many_nodes", "object_transfer", "broadcast",
-                  "obs_overhead"}
+                  "obs_overhead", "attribution_overhead"}
     if want("many_nodes"):
         bench_many_nodes(quick)
     if want("object_transfer"):
@@ -350,6 +412,9 @@ def main() -> None:
         # Subprocess-spawning probe: explicit opt-in (--only) so the
         # default full suite doesn't nest two extra cluster boots.
         bench_obs_overhead(quick)
+    if want("attribution_overhead") and only is not None:
+        # Subprocess-spawning probe, same opt-in rule as obs_overhead.
+        bench_attribution_overhead(quick)
     if only is not None and not (only - standalone):
         _write_results(out_path, quick)
         return

@@ -185,6 +185,34 @@ class Config:
     # Finished jobs keep their task events this long before GC frees
     # the storage (0 = GC at the first sweep after job completion).
     task_events_finished_job_ttl_s: float = 300.0
+    # Per-task resource attribution: the executor wraps each attempt
+    # with thread CPU-time + RSS delta/peak probes and ships them on the
+    # attempt's task-event record (RAY_TPU_TASK_EVENTS_RESOURCES=0 is
+    # the bench kill switch the attribution_overhead probe flips).
+    task_events_resources: bool = True
+    # Opt-in JAX device-memory attribution per attempt (reads
+    # device.memory_stats() around the task body — a device runtime
+    # call, so strictly opt-in: RAY_TPU_TASK_EVENTS_DEVICE_MEM=1).
+    task_events_device_mem: bool = False
+    # ---- diagnosis plane (signal-safe stack dumps + hung-task
+    # watchdog; profiling.py + the Diagnosis GCS service) ----
+    # Workers register faulthandler on SIGUSR1 at boot so the daemon can
+    # extract all-thread tracebacks even when the GIL is held by a
+    # thread stuck in native code (RAY_TPU_STACK_DUMP_ENABLED=0 off).
+    stack_dump_enabled: bool = True
+    # RUNNING attempts older than this with no progress are flagged
+    # hung: one rate-limited stack dump is auto-captured and attached
+    # to the attempt's task-event record (0 disables the watchdog).
+    hang_threshold_s: float = 300.0
+    # Watchdog poll cadence (each tick asks busy workers for their
+    # running attempts with a short deadline).
+    hang_poll_interval_s: float = 2.0
+    # Auto-captured dumps are truncated to this many bytes before they
+    # ride the task-event pipeline (bounded record size).
+    hang_dump_max_bytes: int = 32768
+    # Global floor between auto-captures on one daemon: a mass hang must
+    # not turn the watchdog into a signal storm.
+    hang_dump_min_interval_s: float = 30.0
     # Opt-in distributed tracing: span context rides TaskSpecs, spans
     # flush into the TaskEvents sink (ref: ray.init tracing hooks,
     # util/tracing/tracing_helper.py).

@@ -917,12 +917,59 @@ class MetricsFederation:
 
     def cluster_summary(self) -> dict:
         """One-RPC observability rollup for `ray-tpu status` /
-        state.cluster_status callers: federation freshness + task-event
-        completeness accounting."""
+        state.cluster_status callers: federation freshness, task-event
+        completeness accounting, and the watchdog's live hung-task
+        list."""
         return {
             "metrics": self.stats(),
             "task_events": self._gcs.task_events.stats(),
+            "hung_tasks": self._gcs.task_events.hung_tasks(),
         }
+
+
+class DiagnosisManager:
+    """Cluster-wide diagnosis fan-out (ISSUE 5 tentpole part 1; ref: the
+    dashboard's per-node `ray stack`/CpuProfilingManager surfaces): one
+    RPC here signals every matching daemon's workers for signal-safe
+    all-thread stack dumps and returns the merged, parsed results —
+    the `ray-tpu stack` backend."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+
+    async def dump_stacks(self, node_id: Optional[str] = None,
+                          worker_id: Optional[str] = None,
+                          pids: Optional[List[int]] = None) -> List[dict]:
+        """Fan `NodeDaemon.dump_worker_stacks` out over every alive
+        (matching) daemon; a node that fails mid-dump reports its error
+        instead of poisoning the rest."""
+        nodes = [n for n in self._gcs.nodes.view.nodes.values()
+                 if n.alive
+                 and (not node_id or n.node_id.startswith(node_id))]
+
+        async def one(n) -> dict:
+            client = self._gcs.daemon_client(n.node_id)
+            if client is None:
+                return {"node_id": n.node_id, "workers": [],
+                        "error": "daemon unreachable"}
+            try:
+                return await client.call(
+                    "NodeDaemon", "dump_worker_stacks",
+                    worker_id=worker_id, pids=pids, timeout=30)
+            except Exception as e:  # noqa: BLE001
+                return {"node_id": n.node_id, "workers": [],
+                        "error": repr(e)}
+
+        return list(await asyncio.gather(*(one(n) for n in nodes)))
+
+    async def summarize_stacks(self, node_id: Optional[str] = None
+                               ) -> dict:
+        """dump_stacks + cross-worker grouping of identical stacks —
+        the "412/512 workers blocked in all_reduce" answer in one RPC."""
+        from ray_tpu.util.profiling import summarize_stacks
+
+        results = await self.dump_stacks(node_id=node_id)
+        return {"groups": summarize_stacks(results), "nodes": results}
 
 
 class AutoscalerStateManager:
@@ -1071,6 +1118,7 @@ class GcsServer:
 
         self.task_events = GcsTaskManager()
         self.metrics = MetricsFederation(self)
+        self.diagnosis = DiagnosisManager(self)
         self.event_log = EventLog()
         self.autoscaler_state = AutoscalerStateManager(self)
         self.logs = LogManager(self)
@@ -1100,6 +1148,7 @@ class GcsServer:
             ("LogManager", self.logs),
             ("Syncer", self.syncer),
             ("Metrics", self.metrics),
+            ("Diagnosis", self.diagnosis),
         ]:
             self.server.add_service(name, svc)
         port = await self.server.start()

@@ -87,6 +87,77 @@ class Lease:
         self.granted_at = time.monotonic()
 
 
+class HangWatchdog:
+    """Flags RUNNING attempts that exceeded the hang threshold with no
+    progress, auto-capturing ONE rate-limited stack dump per attempt
+    (ISSUE 5 tentpole part 3; ref: the reference's `ray stack`-driven
+    hang triage, done by hand — here the daemon does the first capture
+    automatically). Pure policy: the daemon supplies `dump` (async,
+    info -> raw text or None) and `record` (info, text -> None), so
+    tests can drive `scan` with synthetic observations."""
+
+    MAX_TRACKED = 4096
+
+    def __init__(self, *, dump, record,
+                 threshold_s: Optional[float] = None,
+                 min_dump_interval_s: Optional[float] = None):
+        self._dump = dump
+        self._record = record
+        self._threshold_s = threshold_s
+        self._min_interval_s = min_dump_interval_s
+        # (task_id, attempt) -> dump wall time; one capture per attempt,
+        # surviving the attempt's disappearance (a retried attempt gets
+        # a NEW attempt number and its own budget).
+        self._dumped: Dict[Tuple[str, int], float] = {}
+        self._last_dump = 0.0
+        self.fired_total = 0
+
+    def _cfg(self) -> Tuple[float, float]:
+        cfg = get_config()
+        return (self._threshold_s if self._threshold_s is not None
+                else cfg.hang_threshold_s,
+                self._min_interval_s if self._min_interval_s is not None
+                else cfg.hang_dump_min_interval_s)
+
+    async def scan(self, running: List[dict],
+                   now: Optional[float] = None) -> int:
+        """One pass over the currently running attempts; returns how
+        many hung attempts were dumped this pass. An attempt that
+        completes under the threshold is simply never seen old enough —
+        it can never be flagged."""
+        threshold, min_interval = self._cfg()
+        if threshold <= 0:
+            return 0
+        now = time.time() if now is None else now
+        fired = 0
+        for info in running:
+            key = (info.get("task_id"), int(info.get("attempt", 0)))
+            st = info.get("start_ts")
+            age = 0.0 if st is None else now - float(st)
+            if age < threshold or key in self._dumped:
+                continue
+            if now - self._last_dump < min_interval:
+                # Global rate limit: a mass hang must not become a
+                # signal storm; the attempt stays eligible next scan.
+                continue
+            self._last_dump = now
+            self._dumped[key] = now
+            while len(self._dumped) > self.MAX_TRACKED:
+                del self._dumped[next(iter(self._dumped))]
+            try:
+                raw = await self._dump(info)
+            except Exception as e:  # noqa: BLE001 dump is best-effort
+                logger.debug("watchdog dump failed: %s", e)
+                raw = None
+            try:
+                self._record(dict(info), raw)
+            except Exception:  # noqa: BLE001
+                logger.exception("watchdog record failed")
+            fired += 1
+            self.fired_total += 1
+        return fired
+
+
 class NodeDaemon:
     def __init__(
         self,
@@ -114,8 +185,12 @@ class NodeDaemon:
                                  capacity=object_store_memory or 0)
         # Worker stdout/stderr files live OUTSIDE shm (logs are disk data,
         # ref: session_latest/logs layout, node.py get_logs_dir_path).
-        self.log_dir = os.environ.get("RAY_TPU_LOG_DIR") or os.path.join(
-            tempfile.gettempdir(), "ray_tpu_logs", self.node_id[:12])
+        # node_log_dir is the shared helper: workers derive the SAME path
+        # from their node_id, so the per-pid stack-dump files rendezvous
+        # here without extra spawn plumbing.
+        from ray_tpu.util.profiling import node_log_dir
+
+        self.log_dir = node_log_dir(self.node_id)
         os.makedirs(self.log_dir, exist_ok=True)
         self.gcs: Optional[AsyncRpcClient] = None
 
@@ -196,12 +271,24 @@ class NodeDaemon:
                     "full_syncs": self._m_sync_full,
                     "keepalives": self._m_sync_keepalives,
                 })
+        # Daemon-side task-event buffer: the hung-task watchdog's
+        # auto-captured dumps ride the SAME bounded ring/drop accounting
+        # as executor records (task_events.py).
+        from ray_tpu.core.distributed.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(
+            flush_fn=self._flush_task_events, node_id=self.node_id,
+            pid=os.getpid())
+        self._watchdog = HangWatchdog(
+            dump=self._watchdog_dump, record=self._watchdog_record)
         self._tasks = [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._monitor_workers_loop()),
             asyncio.ensure_future(self._refresh_view_loop()),
             asyncio.ensure_future(self._memory_monitor_loop()),
             asyncio.ensure_future(self._log_monitor.run(self.gcs)),
+            asyncio.ensure_future(self.task_events.flush_loop()),
+            asyncio.ensure_future(self._hang_watchdog_loop()),
         ]
         if self.syncer is not None:
             self._tasks += [
@@ -587,6 +674,15 @@ class NodeDaemon:
         self._m_heartbeat_failures = Counter(
             "raytpu_heartbeat_failures_total",
             "Heartbeat RPCs to the GCS that failed").set_default_tags(tags)
+        # Diagnosis plane: signal-safe dumps + hung-task watchdog.
+        self._m_stack_dumps = Counter(
+            "raytpu_stack_dumps_total",
+            "Signal-safe worker stack dumps captured").set_default_tags(
+            tags)
+        self._m_hung = Counter(
+            "raytpu_hung_tasks_total",
+            "Task attempts flagged hung by the watchdog"
+        ).set_default_tags(tags)
         # Cluster-state syncer (syncer.py): the delta/suppressed/bytes
         # trio is what proves the control plane ships deltas, not
         # full-state posts.
@@ -1034,6 +1130,12 @@ class NodeDaemon:
     def _retire_worker_logs(self, handle: WorkerHandle) -> None:
         """Tombstone attribution for the final tail sweep, then let the
         log monitor drain + unlink the dead worker's files."""
+        from ray_tpu.util.profiling import stack_dump_path
+
+        try:  # the dead worker's stack-dump file has no further reader
+            os.unlink(stack_dump_path(self.log_dir, handle.proc.pid))
+        except OSError:
+            pass
         mon = getattr(self, "_log_monitor", None)
         if mon is None:
             return
@@ -1547,6 +1649,224 @@ class NodeDaemon:
                 handle.kill()
                 return {"ok": True}
         return {"ok": False}
+
+    # ------------------------------------------------------------------
+    # diagnosis plane: signal-safe stack dumps + hung-task watchdog
+    # (profiling.py helpers; the GCS `Diagnosis` service fans
+    # dump_worker_stacks out over every daemon)
+    # ------------------------------------------------------------------
+    async def _flush_task_events(self, **payload) -> None:
+        await self.gcs.call("TaskEvents", "add_task_events", timeout=10,
+                            **payload)
+
+    def _dump_lock(self, pid: int) -> asyncio.Lock:
+        """Per-pid dump serialization: concurrent dumps of ONE worker
+        would race each other's size-offset bookkeeping."""
+        locks = getattr(self, "_dump_locks", None)
+        if locks is None:
+            locks = self._dump_locks = {}
+        if len(locks) > 1024:
+            locks.clear()
+        return locks.setdefault(pid, asyncio.Lock())
+
+    async def _signal_dump(self, pid: int,
+                           timeout_s: float = 3.0) -> dict:
+        """Signal-safe stack extraction: SIGUSR1 the worker (its
+        faulthandler handler appends an all-thread traceback to the
+        per-pid dump file WITHOUT needing the GIL), tail the file, and
+        return the new bytes. This is the path that still answers when
+        the worker is wedged in a GIL-holding native call — the case
+        the in-process sampling `profile` RPC can never see."""
+        import signal as _signal
+
+        from ray_tpu.util.profiling import stack_dump_path
+
+        path = stack_dump_path(self.log_dir, pid)
+        async with self._dump_lock(pid):
+            try:
+                pre = os.path.getsize(path)
+            except OSError:
+                pre = 0
+            if pre > (1 << 20):
+                # The handler writes with O_APPEND, so truncating the
+                # quiescent file is safe — appends land at the new EOF.
+                try:
+                    os.truncate(path, 0)
+                    pre = 0
+                except OSError:
+                    pass
+            try:
+                os.kill(pid, _signal.SIGUSR1)
+            except ProcessLookupError:
+                return {"ok": False, "error": "process gone"}
+            except PermissionError as e:
+                return {"ok": False, "error": f"signal failed: {e}"}
+            self._m_stack_dumps.inc()
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout_s
+            last = pre
+            while loop.time() < deadline:
+                await asyncio.sleep(0.05)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = pre
+                if size > pre and size == last:
+                    break       # grew, then stable for one poll: done
+                last = size
+            if last <= pre:
+                return {"ok": False,
+                        "error": "no dump produced (worker without a "
+                                 "SIGUSR1 faulthandler, or gone)"}
+            with open(path, "rb") as f:
+                f.seek(pre)
+                raw = f.read(min(last - pre, 256 * 1024)).decode(
+                    "utf-8", "replace")
+        return {"ok": True, "raw": raw}
+
+    async def dump_worker_stacks(self, worker_id: Optional[str] = None,
+                                 pids: Optional[List[int]] = None
+                                 ) -> dict:
+        """All-thread tracebacks of this node's live workers (filtered
+        by worker-id prefix and/or pid list), via the signal-safe path.
+        Powers `ray-tpu stack` through the GCS Diagnosis fan-out."""
+        from ray_tpu.util.profiling import parse_faulthandler_dump
+
+        targets = []
+        for h in list(self._workers.values()):
+            if h.proc.poll() is not None:
+                continue
+            if worker_id and not h.worker_id.startswith(worker_id):
+                continue
+            if pids and h.proc.pid not in pids:
+                continue
+            targets.append(h)
+
+        async def one(h) -> dict:
+            rep = await self._signal_dump(h.proc.pid)
+            rep.update(worker_id=h.worker_id, pid=h.proc.pid,
+                       actor_id=h.actor_id)
+            if rep.get("ok"):
+                rep["threads"] = parse_faulthandler_dump(rep["raw"])
+            return rep
+
+        workers = list(await asyncio.gather(*(one(h) for h in targets)))
+        return {"node_id": self.node_id, "workers": workers}
+
+    async def _hang_watchdog_loop(self):
+        cfg = get_config()
+        if cfg.hang_threshold_s <= 0:
+            return
+        # worker_id -> last successful running_tasks snapshot: when a
+        # worker stops answering (GIL wedged), the watchdog falls back
+        # to the attempts it LAST saw running there.
+        self._last_running: Dict[str, List[dict]] = {}
+        self._unresponsive: Dict[str, int] = {}
+        self._next_poll: Dict[str, float] = {}
+        period = max(0.2, cfg.hang_poll_interval_s)
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self._watchdog_tick(period)
+            except Exception:  # noqa: BLE001 watchdog must not die
+                logger.exception("hang watchdog tick failed")
+
+    async def _watchdog_tick(self, period: float) -> None:
+        cfg = get_config()
+        # Lazy per-worker cadence: an attempt can't exceed the hang
+        # threshold sooner than `threshold` after it starts, so polling
+        # each busy worker ~4x per threshold catches every hang within
+        # 1.25x threshold while keeping the watchdog O(busy/threshold)
+        # RPCs per second — a 1k-actor warm fleet must not cost 1k
+        # connects every tick. Cached snapshots keep aging in between.
+        repoll = max(period, cfg.hang_threshold_s / 4.0)
+        now_m = time.monotonic()
+        busy: List[WorkerHandle] = []
+        due: List[WorkerHandle] = []
+        for h in list(self._workers.values()):
+            if (not h.busy or h.address is None
+                    or h.proc.poll() is not None):
+                self._last_running.pop(h.worker_id, None)
+                self._unresponsive.pop(h.worker_id, None)
+                self._next_poll.pop(h.worker_id, None)
+                continue
+            busy.append(h)
+            if now_m >= self._next_poll.get(h.worker_id, 0.0):
+                self._next_poll[h.worker_id] = now_m + repoll
+                due.append(h)
+
+        sem = asyncio.Semaphore(16)
+
+        async def poll(h: WorkerHandle) -> None:
+            async with sem:
+                client = AsyncRpcClient(h.address)
+                try:
+                    rep = await client.call("Worker", "running_tasks",
+                                            timeout=min(2.0, repoll))
+                    self._last_running[h.worker_id] = rep.get("tasks") \
+                        or []
+                    self._unresponsive.pop(h.worker_id, None)
+                except Exception:  # noqa: BLE001 — wedged or mid-
+                    # restart: the LAST snapshot still names the
+                    # attempt to blame, and the signal-dump path works
+                    # regardless of the RPC loop's health.
+                    self._unresponsive[h.worker_id] = \
+                        self._unresponsive.get(h.worker_id, 0) + 1
+                finally:
+                    await client.close()
+
+        if due:
+            await asyncio.gather(*(poll(h) for h in due))
+        running: List[dict] = []
+        for h in busy:
+            for info in self._last_running.get(h.worker_id) or ():
+                info = dict(info)
+                info["worker_id"] = h.worker_id
+                info["wpid"] = h.proc.pid
+                running.append(info)
+        await self._watchdog.scan(running)
+
+    async def _watchdog_dump(self, info: dict) -> Optional[str]:
+        rep = await self._signal_dump(int(info.get("wpid") or 0))
+        return rep.get("raw") if rep.get("ok") else None
+
+    def _watchdog_record(self, info: dict, raw: Optional[str]) -> None:
+        """Attach the auto-captured dump to the attempt's task-event
+        record (bounded size; rides the daemon buffer's ring/drop
+        accounting) and surface the hang in the cluster event log."""
+        text = (raw or "")[:get_config().hang_dump_max_bytes] or None
+        now = time.time()
+        self.task_events.record_status(
+            info["task_id"], info.get("attempt", 0), "RUNNING",
+            ts=info.get("start_ts"), name=info.get("name"),
+            job_id=info.get("job_id"), actor_id=info.get("actor_id"),
+            node_id=self.node_id, worker_id=info.get("worker_id"),
+            pid=info.get("wpid"), hung=True, hung_stack=text,
+            hung_ts=now)
+        self._m_hung.inc()
+        logger.warning(
+            "hung task %s (%s) on worker %s pid=%s: running %.0fs; "
+            "stack dump %s", (info.get("task_id") or "")[:12],
+            info.get("name"), (info.get("worker_id") or "")[:8],
+            info.get("wpid"), now - (info.get("start_ts") or now),
+            "captured" if text else "unavailable")
+
+        async def log_event():
+            try:
+                await self.gcs.call(
+                    "EventLog", "add_event", source="task",
+                    severity="WARNING",
+                    message=f"hung task {info.get('name')} "
+                            f"({(info.get('task_id') or '')[:12]}) on "
+                            f"node {self.node_id[:8]}: no progress for "
+                            f"{now - (info.get('start_ts') or now):.0f}s",
+                    fields={"task_id": info.get("task_id"),
+                            "node_id": self.node_id,
+                            "pid": info.get("wpid")}, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+        asyncio.ensure_future(log_event())
 
     # ------------------------------------------------------------------
     # object plane (transfer.py: raw-frame chunks, create-then-fill

@@ -56,6 +56,14 @@ TERMINAL_STATES = ("FINISHED", "FAILED")
 _IDENTITY_FIELDS = ("name", "job_id", "actor_id", "node_id", "worker_id",
                     "pid", "submit_node_id", "submit_pid")
 
+# Per-attempt resource attribution (executor-side TaskUsageProbe) and
+# hung-task watchdog annotations (daemon-side auto-captured stack
+# dumps) ride the same coalesced record: a newer report overwrites.
+_RESOURCE_FIELDS = ("cpu_time_s", "rss_delta_bytes", "rss_peak_bytes",
+                    "device_mem_bytes", "device_mem_delta_bytes",
+                    "hung", "hung_stack", "hung_ts")
+_EXTRA_FIELDS = _IDENTITY_FIELDS + _RESOURCE_FIELDS
+
 
 def _buffer_metrics() -> dict:
     """Process-wide pipeline counters, created once (many buffers can
@@ -203,7 +211,7 @@ class TaskEventBuffer:
             if error is not None:
                 rec["error"] = error
             if fields:
-                for k in _IDENTITY_FIELDS:
+                for k in _EXTRA_FIELDS:
                     v = fields.get(k)
                     if v is not None:
                         rec[k] = v
@@ -245,7 +253,7 @@ class TaskEventBuffer:
         if error is not None:
             rec["error"] = error
         if fields:
-            for k in _IDENTITY_FIELDS:
+            for k in _EXTRA_FIELDS:
                 v = fields.get(k)
                 if v is not None:
                     rec[k] = v
@@ -431,6 +439,12 @@ def merge_attempt(dst: dict, src: dict) -> None:
     for k in _IDENTITY_FIELDS:
         if dst.get(k) is None and src.get(k) is not None:
             dst[k] = src[k]
+    # Resource/hung annotations: the newer report wins (a retry's fresh
+    # usage supersedes; the watchdog's hung flag survives the executor's
+    # later terminal record because that record simply omits it).
+    for k in _RESOURCE_FIELDS:
+        if src.get(k) is not None:
+            dst[k] = src[k]
 
 
 class GcsTaskManager:
@@ -583,16 +597,46 @@ class GcsTaskManager:
         }
 
     def summarize(self) -> dict:
-        """Per-name state counts plus completeness meta (the honest
-        version of `ray summary tasks`)."""
+        """Per-name state counts + per-name resource rollups (p50/p99
+        cpu/rss over the stored window) plus completeness meta (the
+        honest version of `ray summary tasks`)."""
+        from ray_tpu.util.metrics import percentile
+
         names: Dict[str, Dict[str, int]] = {}
+        res: Dict[str, Dict[str, list]] = {}
         for table in self._jobs.values():
             for rec in table.values():
-                per = names.setdefault(rec.get("name") or "task", {})
+                name = rec.get("name") or "task"
+                per = names.setdefault(name, {})
                 state = rec.get("state", "UNKNOWN")
                 per[state] = per.get(state, 0) + 1
+                cpu = rec.get("cpu_time_s")
+                rss = rec.get("rss_delta_bytes")
+                if cpu is None and rss is None:
+                    continue
+                u = res.setdefault(name, {"cpu": [], "rss": []})
+                if cpu is not None:
+                    u["cpu"].append(cpu)
+                if rss is not None:
+                    u["rss"].append(rss)
+        usage = {}
+        for name, u in res.items():
+            usage[name] = {
+                "n": max(len(u["cpu"]), len(u["rss"])),
+                "cpu_time_s": {
+                    "p50": percentile(u["cpu"], 50),
+                    "p99": percentile(u["cpu"], 99),
+                    "max": max(u["cpu"], default=0.0),
+                },
+                "rss_delta_bytes": {
+                    "p50": percentile(u["rss"], 50),
+                    "p99": percentile(u["rss"], 99),
+                    "max": max(u["rss"], default=0),
+                },
+            }
         s = self.stats()
         return {"tasks": names,
+                "usage": usage,
                 "completeness": {
                     "stored": s["stored"],
                     "evicted": s["evicted"],
@@ -602,6 +646,22 @@ class GcsTaskManager:
                         s.get("worker_dropped_profile", 0),
                     "gc_events": s["gc_events"],
                 }}
+
+    def hung_tasks(self, limit: int = 100) -> List[dict]:
+        """Attempts the watchdog flagged as hung that are STILL running
+        (a flagged attempt that later finished drops out — the flag
+        stays on the record for post-mortems, but the live view answers
+        "what is stuck right now"). Newest-flagged first."""
+        out: List[dict] = []
+        for table in self._jobs.values():
+            for rec in table.values():
+                if not rec.get("hung") or rec.get("state") != "RUNNING":
+                    continue
+                out.append({k: rec.get(k) for k in (
+                    "task_id", "attempt", "name", "job_id", "node_id",
+                    "worker_id", "pid", "hung_ts", "start_ts")})
+        out.sort(key=lambda r: r.get("hung_ts") or 0.0, reverse=True)
+        return out[:limit]
 
     # -- lifecycle -------------------------------------------------------
     def on_job_finished(self, job_id: str) -> None:

@@ -28,6 +28,7 @@ from ray_tpu.core.object_store import ObjectExistsError
 from ray_tpu.core.distributed import protocol
 from ray_tpu.core.distributed.core_worker import DistributedCoreWorker
 from ray_tpu.core.distributed.rpc import AsyncRpcClient, RpcServer
+from ray_tpu.util.profiling import TaskUsageProbe
 
 logger = logging.getLogger(__name__)
 
@@ -232,13 +233,24 @@ class WorkerService:
         # and the chrome-trace timeline): bounded ring + coalescing
         # flusher, drops counted instead of silent.
         self.core.task_events.worker_id = worker_id
+        # Per-task resource attribution (profiling.TaskUsageProbe):
+        # thread CPU-time + RSS delta/peak per attempt, riding the
+        # attempt's task-event record. Resolved once — workers get the
+        # knob through their spawn env.
+        self._attrib = get_config().task_events_resources
+        # task_id -> live attempt info for the daemon's hung-task
+        # watchdog (`running_tasks` RPC). Plain dict, GIL-atomic
+        # set/pop of whole entries; readers snapshot with list().
+        self._running_info: Dict[bytes, dict] = {}
 
     def _record_event(self, spec: dict, state: str, start_ts: float,
-                      end_ts: float, error: Optional[str] = None) -> None:
+                      end_ts: float, error: Optional[str] = None,
+                      usage: Optional[dict] = None) -> None:
         """Record an attempt's FULL history in one coalesced record: the
         submission half (SUBMITTED/LEASED timestamps + caller identity)
         rides the spec itself, so the happy path ships a single wire
-        record per attempt instead of two GCS-merged halves."""
+        record per attempt instead of two GCS-merged halves. `usage` is
+        the attempt's resource attribution (TaskUsageProbe.finish())."""
         transitions = []
         sub_ts = spec.get("submit_ts")
         ctx = spec.get("submit_ctx") or (None, None)
@@ -254,7 +266,7 @@ class WorkerService:
             error=error, name=spec["options"].get("name", "task"),
             job_id=spec.get("job_id"), actor_id=spec.get("actor_id"),
             worker_id=self.worker_id, pid=os.getpid(),
-            submit_node_id=ctx[0], submit_pid=ctx[1])
+            submit_node_id=ctx[0], submit_pid=ctx[1], **(usage or {}))
 
     # ---- helpers ------------------------------------------------------
     def _fetch_arg(self, oid: ObjectID,
@@ -331,7 +343,7 @@ class WorkerService:
         return out
 
     def _stream_reply(self, spec: dict, result: Any, start_ts: float,
-                      error_cls=None) -> dict:
+                      error_cls=None, probe=None) -> dict:
         """Run the streaming body + record the task event (shared by
         the task and actor execution paths)."""
         import time as _time
@@ -340,7 +352,8 @@ class WorkerService:
         self._record_event(
             spec, "FAILED" if reply["error"] else "FINISHED",
             start_ts, _time.time(),
-            error=repr(reply["error"]) if reply["error"] else None)
+            error=repr(reply["error"]) if reply["error"] else None,
+            usage=probe.finish() if probe is not None else None)
         return reply
 
     def _execute_stream(self, spec: dict, result: Any,
@@ -490,7 +503,33 @@ class WorkerService:
                 is_error=is_err))
         return out
 
+    def _running_entry(self, spec: dict, name: str) -> dict:
+        import time as _time
+
+        actor_id = spec.get("actor_id")
+        return {
+            "task_id": spec["task_id"].hex(),
+            "attempt": spec.get("attempt", 0),
+            "name": name,
+            "job_id": spec.get("job_id"),
+            "actor_id": (actor_id.hex() if isinstance(actor_id, bytes)
+                         else actor_id),
+            "start_ts": _time.time(),
+        }
+
     def _execute(self, spec: dict) -> dict:
+        """Tracked execution: the attempt is visible to the daemon's
+        hung-task watchdog (`running_tasks`) for exactly as long as it
+        occupies an executor thread."""
+        key = spec["task_id"]
+        self._running_info[key] = self._running_entry(
+            spec, spec["options"].get("name", "task"))
+        try:
+            return self._execute_task(spec)
+        finally:
+            self._running_info.pop(key, None)
+
+    def _execute_task(self, spec: dict) -> dict:
         name = spec["options"].get("name", "task")
         if (spec.get("attempt", 0) or spec.get("_lane_retries")) \
                 and not spec["options"].get("streaming"):
@@ -538,6 +577,7 @@ class WorkerService:
         self.core.task_events.record_status(
             spec["task_id"].hex(), spec.get("attempt", 0), "RUNNING",
             ts=start_ts, name=name, job_id=spec.get("job_id"))
+        probe = TaskUsageProbe() if self._attrib else None
         try:
             fn = self.core.fetch_function(spec["fn_key"])
             args, kwargs = protocol.unpack_args(spec["args_blob"],
@@ -558,10 +598,12 @@ class WorkerService:
                     with self._exec_lock:
                         self._executing.pop(spec["task_id"], None)
                 if spec["options"].get("streaming"):
-                    return self._stream_reply(spec, result, start_ts)
+                    return self._stream_reply(spec, result, start_ts,
+                                              probe=probe)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
-            self._record_event(spec, "FINISHED", start_ts, _time.time())
+            self._record_event(spec, "FINISHED", start_ts, _time.time(),
+                               usage=probe.finish() if probe else None)
             return reply
         except BaseException as e:  # noqa: BLE001
             # An injected interrupt can land BEFORE the inner try or
@@ -591,7 +633,8 @@ class WorkerService:
             except Exception:  # noqa: BLE001
                 pass
             self._record_event(spec, "FAILED", start_ts, _time.time(),
-                               error=repr(e))
+                               error=repr(e),
+                               usage=probe.finish() if probe else None)
             return {"results": [], "error": err}
 
     # ---- RPC surface --------------------------------------------------
@@ -805,6 +848,35 @@ class WorkerService:
 
     def _execute_actor(self, spec: dict, resolve_only: bool = False,
                        coro_args=None):
+        """Tracked actor execution (see _execute): arg-resolution passes
+        are not tracked — only phases that can actually hang user-visibly
+        on this method's body."""
+        if resolve_only:
+            return self._execute_actor_impl(spec, resolve_only, coro_args)
+        key = spec["task_id"]
+        name = (f"{type(self.actor.instance).__name__}."
+                f"{spec['method_name']}" if self.actor is not None
+                else spec["method_name"])
+        entry = self._running_entry(spec, name)
+        if coro_args is not None:
+            inner = self._execute_actor_impl(spec, resolve_only, coro_args)
+
+            async def tracked():
+                self._running_info[key] = entry
+                try:
+                    return await inner
+                finally:
+                    self._running_info.pop(key, None)
+
+            return tracked()
+        self._running_info[key] = entry
+        try:
+            return self._execute_actor_impl(spec, resolve_only, coro_args)
+        finally:
+            self._running_info.pop(key, None)
+
+    def _execute_actor_impl(self, spec: dict, resolve_only: bool = False,
+                            coro_args=None):
         name = f"{type(self.actor.instance).__name__}.{spec['method_name']}"
         import time as _time
 
@@ -884,6 +956,7 @@ class WorkerService:
             self._record_event(spec, "FAILED", start_ts, _time.time(),
                                error=repr(err))
             return {"results": [], "error": err}
+        probe = TaskUsageProbe() if self._attrib else None
         try:
             method = getattr(self.actor.instance, spec["method_name"])
             from ray_tpu.util import tracing
@@ -903,10 +976,12 @@ class WorkerService:
                         self._executing.pop(spec["task_id"], None)
                 if spec["options"].get("streaming"):
                     return self._stream_reply(spec, result, start_ts,
-                                              error_cls=rexc.ActorError)
+                                              error_cls=rexc.ActorError,
+                                              probe=probe)
             reply = {"results": self._store_results(spec, result),
                      "error": None}
-            self._record_event(spec, "FINISHED", start_ts, _time.time())
+            self._record_event(spec, "FINISHED", start_ts, _time.time(),
+                               usage=probe.finish() if probe else None)
             return reply
         except BaseException as e:  # noqa: BLE001
             with self._exec_lock:
@@ -927,7 +1002,8 @@ class WorkerService:
             except Exception:  # noqa: BLE001
                 pass
             self._record_event(spec, "FAILED", start_ts, _time.time(),
-                               error=repr(e))
+                               error=repr(e),
+                               usage=probe.finish() if probe else None)
             return {"results": [], "error": err}
 
     async def execute_simple(self, spec: dict) -> dict:
@@ -1013,6 +1089,17 @@ class WorkerService:
         return await loop.run_in_executor(
             None, lambda: profile_here(duration_s, interval_s))
 
+    def running_tasks(self) -> dict:
+        """Snapshot of attempts currently occupying executor threads —
+        the daemon's hung-task watchdog polls this (and falls back to
+        the signal-safe dump path when even this RPC can't be served
+        because a task is wedged holding the GIL)."""
+        import time as _time
+
+        return {"now": _time.time(), "pid": os.getpid(),
+                "tasks": [dict(v)
+                          for v in list(self._running_info.values())]}
+
     def ping(self) -> dict:
         return {"ok": True, "pid": os.getpid(),
                 "actor_id": self.actor_id}
@@ -1035,6 +1122,22 @@ def _mkref(oid: ObjectID, owner: Optional[str] = None):
 
 
 def run_worker(args) -> None:
+    # Signal-safe stack dumps FIRST — before the daemon can learn this
+    # pid: faulthandler on SIGUSR1 writes all-thread tracebacks to a
+    # per-pid file in the node's log dir, readable by the daemon even
+    # when a task wedges the GIL in native code (the default SIGUSR1
+    # disposition would TERMINATE the process, so registration must
+    # precede any chance of being signalled).
+    if get_config().stack_dump_enabled:
+        try:
+            from ray_tpu.util.profiling import (
+                node_log_dir, register_stack_dump_handler,
+                stack_dump_path)
+
+            register_stack_dump_handler(stack_dump_path(
+                node_log_dir(args.node_id), os.getpid()))
+        except Exception as e:  # noqa: BLE001 diagnosis is best-effort
+            logger.warning("stack-dump handler unavailable: %s", e)
     # One event loop for ALL grpc.aio objects in this process (server and
     # clients) — grpc-python's aio poller misbehaves across multiple loops.
     from ray_tpu.core.distributed.rpc import EventLoopThread

@@ -11,6 +11,11 @@ Usage:
     python -m ray_tpu.scripts.cli list nodes|actors|tasks|jobs|pgs|workers
     python -m ray_tpu.scripts.cli timeline --out trace.json
     python -m ray_tpu.scripts.cli metrics [--node <id-prefix>]
+    python -m ray_tpu.scripts.cli stack [--node ID] [--worker PID] \
+        [--task ID]        # signal-safe all-thread dumps (GIL-proof)
+    python -m ray_tpu.scripts.cli top [--per-node]   # cpu/rss per task
+    python -m ray_tpu.scripts.cli profile -d 5 [--task N|--actor A]
+    python -m ray_tpu.scripts.cli logs [--dead [WORKER]]
     python -m ray_tpu.scripts.cli start --head [--num-cpus N ...]
     python -m ray_tpu.scripts.cli start --address <gcs> [--num-cpus N]
 """
@@ -52,8 +57,8 @@ class _Gcs:
         self.client = SyncRpcClient(address, self._loop)
         self.address = address
 
-    def call(self, service, method, **kw):
-        return self.client.call(service, method, timeout=15, **kw)
+    def call(self, service, method, timeout=15, **kw):
+        return self.client.call(service, method, timeout=timeout, **kw)
 
     def daemon(self, address: str):
         from ray_tpu.core.distributed.rpc import SyncRpcClient
@@ -123,6 +128,14 @@ def cmd_status(gcs: _Gcs, args) -> None:
     worst = max(staleness.values(), default=0.0)
     print(f"  metrics federation: {m.get('nodes_reporting', 0)} nodes "
           f"reporting (worst staleness {worst:.1f}s)")
+    hung = obs.get("hung_tasks") or []
+    if hung:
+        names = ", ".join(
+            f"{h.get('name') or 'task'}@{(h.get('node_id') or '?')[:8]}"
+            for h in hung[:5])
+        more = f" (+{len(hung) - 5} more)" if len(hung) > 5 else ""
+        print(f"  HUNG tasks: {len(hung)} — {names}{more}  "
+              f"(`ray-tpu stack --task <id>` for stacks)")
 
 
 def cmd_list(gcs: _Gcs, args) -> None:
@@ -285,13 +298,161 @@ def cmd_job(args) -> None:
 
 
 def cmd_stack(gcs: _Gcs, args) -> None:
-    """Sample a live worker's stacks (ref: `ray stack` / dashboard
-    py-spy profiling). Target by worker-id prefix, or omit to sample
-    every worker on every node."""
-    from ray_tpu.util.profiling import render_report
+    """Signal-safe all-thread stack dumps from every (matching) live
+    worker (ref: `ray stack`): the GCS Diagnosis service fans SIGUSR1/
+    faulthandler captures out over all daemons — this works even when a
+    worker is wedged in a GIL-holding native call, the case in-process
+    sampling (`ray-tpu profile`) can never see. `--task` matches
+    RUNNING attempts by task-id/name substring and dumps only their
+    workers; identical stacks are grouped across workers at the end."""
+    from ray_tpu.util.profiling import summarize_stacks
 
+    worker_id = None
+    pids = None
+    if args.worker:
+        if args.worker.isdigit():
+            pids = [int(args.worker)]
+        else:
+            worker_id = args.worker
+    if args.task:
+        rows = gcs.call("TaskEvents", "list_events", limit=10000)
+        pids = sorted({
+            r["pid"] for r in rows
+            if r.get("pid") and r.get("state") == "RUNNING"
+            and r.get("kind") not in ("span", "profile")
+            and (args.task in (r.get("task_id") or "")
+                 or args.task in (r.get("name") or ""))})
+        if not pids:
+            print(f"no RUNNING task matches {args.task!r} "
+                  f"(try `ray-tpu list tasks`)")
+            return
+    results = gcs.call("Diagnosis", "dump_stacks", node_id=args.node,
+                       worker_id=worker_id, pids=pids, timeout=90)
+    n_ok = 0
+    for nres in results:
+        if nres.get("error"):
+            print(f"== node {nres['node_id'][:12]}: <{nres['error']}>")
+            continue
+        for w in nres.get("workers", []):
+            head = (f"== worker {w['worker_id'][:12]} pid={w['pid']} "
+                    f"node={nres['node_id'][:12]}")
+            if w.get("actor_id"):
+                head += f" actor={w['actor_id'][:12]}"
+            print(head)
+            if not w.get("ok"):
+                print(f"  <dump failed: {w.get('error')}>")
+                continue
+            n_ok += 1
+            if args.raw:
+                print(w.get("raw", ""))
+                continue
+            for t in w.get("threads", []):
+                kind = "current thread" if t.get("current") else "thread"
+                print(f"  {kind} {t['thread']} (most recent first):")
+                for fr in t["frames"]:
+                    print(f"    {fr}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"raw dumps -> {args.out}")
+    groups = summarize_stacks(results)
+    if groups and n_ok > 1:
+        print("-- identical stacks across workers --")
+        for g in groups[:10]:
+            print(f"  {g['workers']}/{g['total']} workers at {g['leaf']}")
+
+
+def cmd_top(gcs: _Gcs, args) -> None:
+    """Per-task-name resource usage view (`ray-tpu top`): attempts,
+    running/hung counts, summed + max thread CPU-time, RSS deltas and
+    peaks — from the per-attempt attribution the executor ships on
+    every task-event record; p50/p99 rollups come from the GCS-side
+    task summary."""
+    rows = gcs.call("TaskEvents", "list_events", limit=args.limit)
+    agg: dict = {}
+    for r in rows:
+        if r.get("kind") in ("span", "profile"):
+            continue
+        if args.node and not (r.get("node_id") or "").startswith(
+                args.node):
+            continue
+        key = (r.get("name") or "task",
+               (r.get("node_id") or "")[:12] if args.per_node else "*")
+        a = agg.setdefault(key, {"n": 0, "running": 0, "hung": 0,
+                                 "cpu": 0.0, "cpu_max": 0.0,
+                                 "rss": 0, "rss_peak": 0})
+        a["n"] += 1
+        if r.get("state") == "RUNNING":
+            a["running"] += 1
+        if r.get("hung"):
+            a["hung"] += 1
+        c = r.get("cpu_time_s") or 0.0
+        a["cpu"] += c
+        a["cpu_max"] = max(a["cpu_max"], c)
+        a["rss"] += r.get("rss_delta_bytes") or 0
+        a["rss_peak"] = max(a["rss_peak"], r.get("rss_peak_bytes") or 0)
+    if not agg:
+        print("no task attempts with attribution in the stored window")
+        return
+    table = []
+    for (name, node), a in sorted(agg.items(),
+                                  key=lambda kv: -kv[1]["cpu"]):
+        table.append([
+            name, node, a["n"], a["running"], a["hung"],
+            f"{a['cpu']:.3f}", f"{a['cpu_max']:.3f}",
+            f"{a['rss'] / 1e6:.1f}", f"{a['rss_peak'] / 1e6:.1f}"])
+    print(_fmt_table(table, ["NAME", "NODE", "ATTEMPTS", "RUN", "HUNG",
+                             "CPU_S", "CPU_MAX_S", "RSS_D_MB",
+                             "RSS_PEAK_MB"]))
+    try:
+        summ = gcs.call("TaskEvents", "summarize")
+    except Exception:  # noqa: BLE001 — pre-diagnosis GCS
+        return
+    usage = summ.get("usage") or {}
+    if usage:
+        print("-- per-name rollups (GCS window) --")
+        rows2 = [[name, u["n"], f"{u['cpu_time_s']['p50']:.4f}",
+                  f"{u['cpu_time_s']['p99']:.4f}",
+                  f"{u['rss_delta_bytes']['p50'] / 1e6:.1f}",
+                  f"{u['rss_delta_bytes']['p99'] / 1e6:.1f}"]
+                 for name, u in sorted(usage.items())]
+        print(_fmt_table(rows2, ["NAME", "N", "CPU_P50_S", "CPU_P99_S",
+                                 "RSS_P50_MB", "RSS_P99_MB"]))
+
+
+def cmd_profile(gcs: _Gcs, args) -> None:
+    """Cluster flamegraph (`ray-tpu profile`): fan the sampling
+    `profile` RPC out to the matching workers CONCURRENTLY (the capture
+    windows overlap, so one wall-clock duration samples the whole
+    target set), merge the collapsed stacks into one flamegraph file,
+    and annotate the perfetto timeline with the capture window."""
+    import asyncio
+
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.util.profiling import (
+        merge_reports, render_report, write_flamegraph_collapsed)
+
+    targets = []
+    running_pids = None
+    if args.task:
+        rows = gcs.call("TaskEvents", "list_events", limit=10000)
+        running_pids = {
+            (r.get("node_id"), r.get("pid")) for r in rows
+            if r.get("pid") and r.get("state") == "RUNNING"
+            and r.get("kind") not in ("span", "profile")
+            and (args.task in (r.get("task_id") or "")
+                 or args.task in (r.get("name") or ""))}
+    actor_addrs = None
+    if args.actor:
+        actor_addrs = {
+            a.get("worker_address")
+            for a in gcs.call("ActorManager", "list_actors")
+            if a and a["actor_id"].startswith(args.actor)
+            and a.get("worker_address")}
     for n in gcs.call("NodeInfo", "list_nodes"):
         if not n["alive"]:
+            continue
+        if args.node and not n["node_id"].startswith(args.node):
             continue
         try:
             workers = gcs.daemon(n["address"]).call(
@@ -299,27 +460,58 @@ def cmd_stack(gcs: _Gcs, args) -> None:
         except Exception:  # noqa: BLE001
             continue
         for w in workers:
-            if args.worker and not w["worker_id"].startswith(args.worker):
+            if not w.get("address") or not w.get("alive", True):
                 continue
-            if not w.get("address"):
+            if args.worker and not (
+                    w["worker_id"].startswith(args.worker)
+                    or str(w["pid"]) == args.worker):
                 continue
-            print(f"== worker {w['worker_id'][:12]} pid={w['pid']} "
-                  f"on node {n['node_id'][:12]}")
-            try:
-                report = gcs.daemon(w["address"]).call(
-                    "Worker", "profile", duration_s=args.duration,
-                    timeout=args.duration + 30)
-                print(render_report(report))
-                if args.out:
-                    from ray_tpu.util.profiling import (
-                        write_flamegraph_collapsed,
-                    )
+            if (running_pids is not None
+                    and (n["node_id"], w["pid"]) not in running_pids):
+                continue
+            if (actor_addrs is not None
+                    and w["address"] not in actor_addrs):
+                continue
+            targets.append({"node_id": n["node_id"], **w})
+    if not targets:
+        print("no matching live workers to profile")
+        return
+    print(f"sampling {len(targets)} workers for {args.duration:.1f}s...")
 
-                    path = f"{args.out}.{w['worker_id'][:12]}.collapsed"
-                    write_flamegraph_collapsed(report, path)
-                    print(f"collapsed stacks -> {path}")
-            except Exception as e:  # noqa: BLE001
-                print(f"  <unreachable: {e}>")
+    async def sample():
+        clients = [AsyncRpcClient(t["address"]) for t in targets]
+        try:
+            return await asyncio.gather(
+                *(c.call("Worker", "profile", duration_s=args.duration,
+                         interval_s=args.interval,
+                         timeout=args.duration + 30) for c in clients),
+                return_exceptions=True)
+        finally:
+            for c in clients:
+                await c.close()
+
+    t_start = time.time()
+    reps = gcs._loop.run(sample(), timeout=args.duration + 60)
+    t_end = time.time()
+    ok = [(t, r) for t, r in zip(targets, reps) if isinstance(r, dict)]
+    for t, r in zip(targets, reps):
+        if not isinstance(r, dict):
+            print(f"  worker {t['worker_id'][:12]}: <{r!r}>")
+    merged = merge_reports([r for _, r in ok])
+    print(render_report(merged))
+    write_flamegraph_collapsed(merged, args.out)
+    print(f"cluster flamegraph (collapsed stacks) -> {args.out}")
+    try:
+        # Counter-track annotations: the capture windows land on the
+        # perfetto timeline next to the tasks they sampled.
+        gcs.call("TaskEvents", "add_task_events", profile=[
+            {"kind": "profile", "category": "cpu_profile",
+             "name": f"cpu_profile:{t['worker_id'][:8]}",
+             "start_ts": t_start, "end_ts": t_end,
+             "node_id": t["node_id"], "pid": t["pid"],
+             "samples": r.get("samples", 0)} for t, r in ok])
+    except Exception:  # noqa: BLE001 annotation is best-effort
+        pass
 
 
 def cmd_logs(gcs: _Gcs, args) -> None:
@@ -359,9 +551,30 @@ def cmd_logs(gcs: _Gcs, args) -> None:
         except KeyboardInterrupt:
             pass
         return
+    worker = args.worker
+    if args.dead is not None and args.dead:
+        worker = args.dead
     records = gcs.call("LogManager", "tail_logs", node_id=args.node,
-                       worker_id=args.worker, actor_id=args.actor,
+                       worker_id=worker, actor_id=args.actor,
                        job_id=args.job, num_lines=args.lines)
+    if args.dead is not None:
+        # Post-mortem view: only workers NO LONGER alive anywhere (the
+        # GCS ring buffers retain their last lines precisely for this).
+        alive = set()
+        for n in gcs.call("NodeInfo", "list_nodes"):
+            if not n["alive"]:
+                continue
+            try:
+                for w in gcs.daemon(n["address"]).call(
+                        "NodeDaemon", "list_workers", timeout=10):
+                    if w.get("alive", True):
+                        alive.add(w["worker_id"])
+            except Exception:  # noqa: BLE001 node mid-restart
+                continue
+        records = [r for r in records if r["worker_id"] not in alive]
+        if not records:
+            print("no retained logs for dead workers match")
+            return
     for rec in sorted(records, key=lambda r: (r["node_id"],
                                               r["worker_id"])):
         who = (f"actor={rec['actor_id'][:12]}" if rec.get("actor_id")
@@ -482,10 +695,41 @@ def main(argv: Optional[List[str]] = None) -> None:
     dp = sub.add_parser("dashboard")
     dp.add_argument("--host", default="127.0.0.1")
     dp.add_argument("--port", type=int, default=8265)
-    kp = sub.add_parser("stack")
-    kp.add_argument("--worker", help="worker id prefix filter")
-    kp.add_argument("--duration", type=float, default=2.0)
-    kp.add_argument("--out", help="write collapsed flamegraph stacks")
+    kp = sub.add_parser(
+        "stack",
+        help="signal-safe all-thread stack dumps from live workers "
+             "(works on GIL-wedged workers; ref: `ray stack`)")
+    kp.add_argument("--node", help="node id prefix filter")
+    kp.add_argument("--worker", help="worker id prefix or exact pid")
+    kp.add_argument("--task",
+                    help="task id/name substring: dump only workers "
+                         "running matching RUNNING attempts")
+    kp.add_argument("--raw", action="store_true",
+                    help="print raw faulthandler text instead of "
+                         "parsed frames")
+    kp.add_argument("--out", help="write the full dump JSON here")
+    tp2 = sub.add_parser(
+        "top", help="per-task resource usage (cpu/rss attribution "
+                    "from task events)")
+    tp2.add_argument("--node", help="node id prefix filter")
+    tp2.add_argument("--per-node", action="store_true",
+                     help="break rows out per node instead of "
+                          "cluster-wide per name")
+    tp2.add_argument("--limit", type=int, default=10000)
+    pp = sub.add_parser(
+        "profile",
+        help="sampling cluster flamegraph: fan the profile RPC out to "
+             "matching workers, merge collapsed stacks")
+    pp.add_argument("--node", help="node id prefix filter")
+    pp.add_argument("--worker", help="worker id prefix or exact pid")
+    pp.add_argument("--task",
+                    help="task id/name substring: profile only workers "
+                         "running matching RUNNING attempts")
+    pp.add_argument("--actor", help="actor id prefix filter")
+    pp.add_argument("-d", "--duration", type=float, default=5.0)
+    pp.add_argument("--interval", type=float, default=0.01)
+    pp.add_argument("--out", default="cluster_flame.collapsed",
+                    help="merged collapsed-stack output file")
     up = sub.add_parser("up")
     up.add_argument("config", help="cluster YAML path")
     up.add_argument("--no-block", action="store_true",
@@ -502,6 +746,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     gp.add_argument("--lines", type=int, default=100)
     gp.add_argument("--follow", action="store_true",
                     help="stream live lines instead of dumping buffers")
+    gp.add_argument("--dead", nargs="?", const="", default=None,
+                    metavar="WORKER",
+                    help="post-mortem: only workers no longer alive "
+                         "(optionally a worker id prefix) — their last "
+                         "lines are retained GCS-side")
     args = p.parse_args(argv)
 
     if args.cmd == "up":
@@ -527,8 +776,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
-     "metrics": cmd_metrics, "stack": cmd_stack,
-     "logs": cmd_logs}[args.cmd](gcs, args)
+     "metrics": cmd_metrics, "stack": cmd_stack, "top": cmd_top,
+     "profile": cmd_profile, "logs": cmd_logs}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

@@ -321,6 +321,17 @@ def _fmt_tags(key: _TagKey, le=None) -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an unsorted sequence (q in [0, 100]);
+    0.0 for an empty input. Used by the task-summary resource rollups —
+    small windows (<=10k per job) make exact sorting fine."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
+    return float(s[idx])
+
+
 def registry_snapshot() -> List[dict]:
     return get_registry().snapshot_meta()
 

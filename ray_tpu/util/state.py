@@ -5,7 +5,8 @@ list_tasks/list_actors/list_nodes/list_placement_groups/list_jobs,
 backed by the GCS task-event and registry tables; CLI in state_cli.py —
 ours is `ray-tpu list ...`). Each call is one GCS RPC through the
 ambient driver connection; `filters` are (key, predicate, value) tuples
-with predicate "=" or "!=", matching the reference surface.
+with predicate "=", "!=", "contains" or "prefix" (the reference surface
+plus the substring forms `ray-tpu stack --task` name-matching needs).
 """
 from __future__ import annotations
 
@@ -33,8 +34,15 @@ def _apply_filters(rows: List[dict],
                 ok = have == value
             elif pred == "!=":
                 ok = have != value
+            elif pred == "contains":
+                ok = have is not None and str(value) in str(have)
+            elif pred == "prefix":
+                ok = (have is not None
+                      and str(have).startswith(str(value)))
             else:
-                raise ValueError(f"unsupported predicate {pred!r}")
+                raise ValueError(
+                    f"unsupported predicate {pred!r} "
+                    f"(valid: '=', '!=', 'contains', 'prefix')")
             if not ok:
                 break
         if ok:
@@ -132,10 +140,38 @@ def get_actor(actor_id: str) -> Optional[dict]:
                        timeout=30)
 
 
+def dump_stacks(node_id: Optional[str] = None,
+                worker_id: Optional[str] = None,
+                pids: Optional[List[int]] = None) -> List[dict]:
+    """Signal-safe all-thread stack dumps from every (matching) live
+    worker in the cluster, fanned out by the GCS Diagnosis service —
+    works even for workers wedged in GIL-holding native code (the
+    faulthandler/SIGUSR1 path, not in-process sampling)."""
+    return _gcs().call("Diagnosis", "dump_stacks", node_id=node_id,
+                       worker_id=worker_id, pids=pids, timeout=60)
+
+
+def summarize_stacks(node_id: Optional[str] = None) -> dict:
+    """Cluster stack dump grouped by identical thread stacks: the
+    one-line hang answer ("412/512 workers blocked in all_reduce at
+    collective.py:...") under "groups", raw per-node dumps under
+    "nodes"."""
+    return _gcs().call("Diagnosis", "summarize_stacks", node_id=node_id,
+                       timeout=60)
+
+
+def hung_tasks() -> List[dict]:
+    """Attempts the hung-task watchdog flagged that are still RUNNING
+    (also surfaced under cluster_status()["observability"])."""
+    return _gcs().call("Metrics", "cluster_summary",
+                       timeout=30).get("hung_tasks", [])
+
+
 def cluster_status() -> dict:
     """The autoscaler's view: demand, idle times, resource requests —
-    enriched with the observability rollup (metrics federation freshness
-    + task-event completeness) under "observability"."""
+    enriched with the observability rollup (metrics federation
+    freshness, task-event completeness, watchdog-flagged hung tasks)
+    under "observability"."""
     status = _gcs().call("AutoscalerState", "get_cluster_status",
                          timeout=30)
     try:

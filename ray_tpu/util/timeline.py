@@ -46,18 +46,30 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
             start, end = e.get("start_ts"), e.get("end_ts")
             if start is None or end is None:
                 continue
+            row = _node_row(e.get("node_id"))
             trace.append({
                 "name": e.get("name", "profile"),
                 "cat": e.get("category", "profile"),
                 "ph": "X",
                 "ts": start * 1e6,
                 "dur": max(0.0, end - start) * 1e6,
-                "pid": _node_row(e.get("node_id")),
+                "pid": row,
                 "tid": f"worker:{e.get('pid', '?')}",
                 "args": {k: v for k, v in e.items()
                          if k not in ("kind", "name", "category",
                                       "start_ts", "end_ts")},
             })
+            if e.get("samples") is not None:
+                # Counter track: `ray-tpu profile` captures annotate
+                # the node row with their sample weight, so a capture
+                # window reads as a labelled spike next to the tasks
+                # it sampled (perfetto renders 'C' events as tracks).
+                counter = {"name": "cpu_profile_samples",
+                           "cat": "cpu_profile", "ph": "C", "pid": row}
+                trace.append({**counter, "ts": start * 1e6,
+                              "args": {"samples": e["samples"]}})
+                trace.append({**counter, "ts": end * 1e6,
+                              "args": {"samples": 0}})
             continue
         name = e.get("name", "task")
         st = e.get("state_ts") or {}

@@ -125,6 +125,66 @@ def test_dead_worker_last_lines_survive_in_gcs(cluster):
     raise AssertionError("dead worker's lines never reached the GCS ring")
 
 
+def test_cli_logs_dead_worker_post_mortem(cluster, capsys):
+    """`ray-tpu logs --dead`: the GCS-retained last lines of a worker
+    that no longer exists are reachable from the CLI, and live workers
+    are filtered out of the post-mortem view."""
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed2:
+        def last_words(self):
+            print("POST_MORTEM_LINE", flush=True)
+            return "ok"
+
+        def die(self):
+            import os as _os
+
+            _os._exit(1)
+
+    @ray_tpu.remote
+    class Chatty:
+        def say(self):
+            print("STILL_ALIVE_LINE", flush=True)
+            return 1
+
+    a = Doomed2.remote()
+    b = Chatty.remote()
+    assert ray_tpu.get(a.last_words.remote(), timeout=60) == "ok"
+    assert ray_tpu.get(b.say.remote(), timeout=60) == 1
+    time.sleep(1.0)  # let the tailer ship the lines before the kill
+    try:
+        ray_tpu.get(a.die.remote(), timeout=30)
+    except Exception:  # noqa: BLE001 — death surfaces as an error
+        pass
+    from ray_tpu.scripts.cli import main as cli_main
+
+    def cli_ring_lines(s):
+        # Only the CLI's own dump (== headers + indented ring lines):
+        # the driver's live log STREAM also prints to stdout and must
+        # not satisfy the assertions.
+        return [ln for ln in s.splitlines()
+                if ln.startswith("== ") or ln.startswith("  ")]
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        cli_main(["--address", cluster.gcs_address, "logs", "--dead"])
+        lines = cli_ring_lines(capsys.readouterr().out)
+        if any("POST_MORTEM_LINE" in ln for ln in lines):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("dead worker's lines never reached "
+                             "`logs --dead`")
+    # The post-mortem view excludes workers that are still alive: the
+    # live Chatty actor's line is in the plain dump but not in --dead.
+    assert not any("STILL_ALIVE_LINE" in ln for ln in lines), lines
+    cli_main(["--address", cluster.gcs_address, "logs"])
+    full = cli_ring_lines(capsys.readouterr().out)
+    assert any("STILL_ALIVE_LINE" in ln for ln in full), full
+    assert any("POST_MORTEM_LINE" in ln for ln in full), full
+    ray_tpu.kill(b)
+
+
 def test_cli_logs_dumps_ring(cluster, capsys):
     @ray_tpu.remote
     def noisy():

@@ -200,6 +200,189 @@ def test_gcs_task_manager_merges_driver_and_worker_halves():
 
 
 # ---------------------------------------------------------------------------
+# hung-task watchdog policy (node_daemon.HangWatchdog; the e2e path
+# with real workers lives in test_diagnosis.py)
+# ---------------------------------------------------------------------------
+
+def _watchdog(dumps, records, **kw):
+    from ray_tpu.core.distributed.node_daemon import HangWatchdog
+
+    async def dump(info):
+        dumps.append(info)
+        return "Thread 0x1 (most recent call first):\n" \
+               '  File "x.py", line 1 in hang\n'
+
+    def record(info, raw):
+        records.append((info, raw))
+
+    return HangWatchdog(dump=dump, record=record, **kw)
+
+
+def test_watchdog_fires_once_per_attempt():
+    dumps, records = [], []
+    wd = _watchdog(dumps, records, threshold_s=5.0,
+                   min_dump_interval_s=0.0)
+    task = {"task_id": "t1", "attempt": 0, "start_ts": 100.0}
+
+    async def run():
+        # Under threshold: never flagged.
+        assert await wd.scan([task], now=104.0) == 0
+        # Over threshold: exactly one dump...
+        assert await wd.scan([task], now=106.0) == 1
+        # ...and NEVER again for the same attempt, however long it
+        # stays hung.
+        assert await wd.scan([task], now=200.0) == 0
+        assert await wd.scan([task], now=10000.0) == 0
+        # A retry is a NEW attempt with its own budget.
+        retry = dict(task, attempt=1, start_ts=300.0)
+        assert await wd.scan([retry], now=310.0) == 1
+
+    _drive(run())
+    assert len(records) == 2 and wd.fired_total == 2
+    assert records[0][1].endswith("in hang\n")
+
+
+def test_watchdog_respects_rate_limit_and_under_threshold():
+    dumps, records = [], []
+    wd = _watchdog(dumps, records, threshold_s=5.0,
+                   min_dump_interval_s=60.0)
+    a = {"task_id": "a", "attempt": 0, "start_ts": 0.0}
+    b = {"task_id": "b", "attempt": 0, "start_ts": 0.0}
+    quick = {"task_id": "q", "attempt": 0, "start_ts": 97.0}
+
+    async def run():
+        # Two hung tasks, one capture budget: only one dumps now, the
+        # other stays eligible and fires after the interval.
+        assert await wd.scan([a, b], now=100.0) == 1
+        assert await wd.scan([a, b], now=101.0) == 0
+        assert await wd.scan([a, b], now=161.0) == 1
+        # A task that completed just under the threshold (gone from
+        # the running set by the next scan) is never flagged.
+        assert await wd.scan([quick], now=101.5) == 0
+        assert await wd.scan([], now=300.0) == 0
+
+    _drive(run())
+    assert {r[0]["task_id"] for r in records} == {"a", "b"}
+
+
+def test_watchdog_record_rides_bounded_ring_without_evicting(monkeypatch):
+    """The auto-dump ships through the same bounded task-event ring:
+    on a full ring (GCS down) the hung record lands, the OLDEST attempt
+    is the one evicted (counted), and every record newer than it
+    survives — the dump can never displace fresher telemetry."""
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.task_events import TaskEventBuffer
+
+    cfg = get_config()
+    monkeypatch.setattr(cfg, "task_events_enabled", True)
+    monkeypatch.setattr(cfg, "task_events_max_buffer", 16)
+
+    async def dead_gcs(**payload):
+        raise ConnectionError("gcs down")
+
+    buf = TaskEventBuffer(flush_fn=dead_gcs, node_id="n1", pid=1)
+    for i in range(16):
+        buf.record_status(f"new{i:03d}", 0, "RUNNING", ts=float(i))
+    before = buf.stats()           # ring at capacity
+    assert before["pending"] == 16
+    buf.record_status("hungtask", 0, "RUNNING", ts=0.0, hung=True,
+                      hung_stack="File x.py line 1", hung_ts=1.0)
+    after = buf.stats()
+    assert after["pending"] == 16  # still bounded
+    assert after["dropped"]["status"] == before["dropped"]["status"] + 1
+    payload = buf.drain()
+    ids = {r["task_id"] for r in payload["events"]}
+    # The hung record made it in WITH its dump; the single eviction
+    # took the oldest attempt, never a newer one.
+    hung = [r for r in payload["events"] if r["task_id"] == "hungtask"]
+    assert hung and hung[0]["hung"] and hung[0]["hung_stack"]
+    assert "new000" not in ids
+    assert all(f"new{i:03d}" in ids for i in range(1, 16))
+
+
+def test_hung_fields_merge_and_survive_terminal_record():
+    """The watchdog's RUNNING+hung record merges into the attempt; the
+    executor's later FINISHED record keeps the flag for post-mortems
+    but removes the attempt from the LIVE hung_tasks view."""
+    from ray_tpu.core.distributed.task_events import GcsTaskManager
+
+    mgr = GcsTaskManager()
+    mgr.add_task_events(events=[{
+        "task_id": "h1", "attempt": 0, "state": "RUNNING",
+        "state_ts": {"RUNNING": 1.0}, "job_id": "j", "name": "stuck",
+        "node_id": "n1", "pid": 7}])
+    mgr.add_task_events(events=[{
+        "task_id": "h1", "attempt": 0, "state": "RUNNING",
+        "state_ts": {"RUNNING": 1.0}, "job_id": "j", "name": "stuck",
+        "hung": True, "hung_stack": "File x", "hung_ts": 400.0}])
+    (hung,) = mgr.hung_tasks()
+    assert hung["task_id"] == "h1" and hung["hung_ts"] == 400.0
+    (rec,) = mgr.get_task("h1")
+    assert rec["hung"] and rec["hung_stack"] == "File x"
+    mgr.add_task_events(events=[{
+        "task_id": "h1", "attempt": 0, "state": "FINISHED",
+        "state_ts": {"FINISHED": 500.0}, "job_id": "j", "name": "stuck",
+        "end_ts": 500.0, "cpu_time_s": 1.5, "rss_delta_bytes": 1024}])
+    assert mgr.hung_tasks() == []
+    (rec,) = mgr.get_task("h1")
+    assert rec["hung"] and rec["state"] == "FINISHED"
+    # Resource attribution merged onto the same record and rolls up.
+    assert rec["cpu_time_s"] == 1.5
+    summ = mgr.summarize()
+    assert summ["usage"]["stuck"]["cpu_time_s"]["p50"] == 1.5
+    assert summ["usage"]["stuck"]["rss_delta_bytes"]["max"] == 1024
+
+
+# ---------------------------------------------------------------------------
+# state API filter predicates + profiling guards (ISSUE 5 satellites)
+# ---------------------------------------------------------------------------
+
+def test_state_filter_predicates():
+    from ray_tpu.util.state import _apply_filters
+
+    rows = [{"name": "all_reduce_step", "state": "RUNNING"},
+            {"name": "decode", "state": "FINISHED"},
+            {"name": None, "state": "RUNNING"}]
+    assert _apply_filters(rows, [("name", "contains", "reduce")]) == \
+        [rows[0]]
+    assert _apply_filters(rows, [("name", "prefix", "dec")]) == [rows[1]]
+    assert _apply_filters(rows, [("state", "=", "RUNNING"),
+                                 ("name", "contains", "_")]) == [rows[0]]
+    with pytest.raises(ValueError) as ei:
+        _apply_filters(rows, [("name", "~=", "x")])
+    # The error names the valid predicate set.
+    for p in ("=", "!=", "contains", "prefix"):
+        assert p in str(ei.value)
+
+
+def test_profile_zero_samples_and_sampler_exclusion():
+    from ray_tpu.util.profiling import (
+        merge_reports, profile_here, render_report, sample_stacks)
+
+    # duration < interval on a loaded box => zero samples, an honest
+    # empty report, and a render that does not divide by zero.
+    report = profile_here(duration_s=0.0, interval_s=0.01)
+    assert report["samples"] == 0 and report["top"] == []
+    assert "0 samples" in render_report(report)
+    assert "0 samples" in render_report(merge_reports([report, report]))
+
+    # A concurrent sampler thread (the RPC executor driving a worker's
+    # `profile` call) never shows up in another capture's samples.
+    import threading
+
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: sample_stacks(duration_s=1.0, interval_s=0.005),
+        name="rival-sampler", daemon=True)
+    t.start()
+    time.sleep(0.05)
+    stacks = sample_stacks(duration_s=0.2, interval_s=0.01)
+    stop.set()
+    t.join()
+    assert not any("sample_stacks" in s for s in stacks), stacks
+
+
+# ---------------------------------------------------------------------------
 # cluster: task events, daemon metrics, timeline, CLI
 # ---------------------------------------------------------------------------
 
@@ -273,6 +456,53 @@ def test_task_events_and_timeline(obs_cluster, tmp_path):
     starts = [ev for ev in trace if ev.get("ph") == "s"]
     ends = {ev["id"] for ev in trace if ev.get("ph") == "f"}
     assert starts and any(ev["id"] in ends for ev in starts)
+
+
+def test_per_task_resource_attribution(obs_cluster, capsys):
+    """Executor-side attribution: a CPU-burning, allocating task shows
+    thread CPU-time + RSS fields on its list_tasks row, per-name
+    p50/p99 rollups in task_summary, and a `ray-tpu top` row."""
+
+    @ray_tpu.remote
+    def burner():
+        acc = 0
+        for i in range(600_000):
+            acc += i * i
+        blob = bytearray(8 << 20)     # ~8 MB transient RSS
+        return acc + len(blob)
+
+    ray_tpu.get([burner.remote() for _ in range(3)], timeout=120)
+
+    from ray_tpu.api import _global_worker
+
+    w = _global_worker()
+    row = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        events = w.gcs.call("TaskEvents", "list_events", timeout=15)
+        done = [e for e in events if "burner" in (e.get("name") or "")
+                and e.get("state") == "FINISHED"
+                and e.get("cpu_time_s") is not None]
+        if done:
+            row = done[0]
+            break
+        time.sleep(0.3)
+    assert row, "no attributed burner attempt reached the GCS"
+    assert row["cpu_time_s"] > 0.001, row
+    assert row.get("rss_peak_bytes", 0) > 0, row
+    assert "rss_delta_bytes" in row, row
+
+    summ = w.gcs.call("TaskEvents", "summarize", timeout=15)
+    usage = {k: v for k, v in summ["usage"].items() if "burner" in k}
+    assert usage, summ["usage"]
+    (u,) = usage.values()
+    assert u["cpu_time_s"]["p99"] >= u["cpu_time_s"]["p50"] > 0
+
+    from ray_tpu.scripts import cli
+
+    cli.main(["--address", w.gcs_address, "top"])
+    out = capsys.readouterr().out
+    assert "burner" in out and "CPU_P99_S" in out, out
 
 
 def test_rpc_instrumentation_and_loop_lag_in_exposition(obs_cluster):

@@ -55,7 +55,7 @@ def test_profile_running_worker(prof_cluster):
     assert ray_tpu.get(ref, timeout=60) > 0
 
 
-def test_cli_stack_command(prof_cluster, capsys):
+def test_cli_stack_and_profile_commands(prof_cluster, capsys, tmp_path):
     import ray_tpu
     from ray_tpu.api import _global_worker
     from ray_tpu.scripts.cli import main as cli_main
@@ -65,16 +65,26 @@ def test_cli_stack_command(prof_cluster, capsys):
         import time
 
         t = time.time()
-        while time.time() - t < 3:
+        while time.time() - t < 4:
             pass
         return 1
 
     ref = busy.remote()
     time.sleep(0.5)
-    cli_main(["--address", _global_worker().gcs_address, "stack",
-              "--duration", "0.5"])
+    addr = _global_worker().gcs_address
+    # Signal-safe dumps: every live worker answers with parsed frames;
+    # the spinning task's frame is visible.
+    cli_main(["--address", addr, "stack"])
     out = capsys.readouterr().out
-    assert "samples over" in out
+    assert "== worker" in out, out
+    assert ":busy:" in out, out
+    # Sampling cluster flamegraph (the old `stack --duration` role).
+    flame = str(tmp_path / "flame.collapsed")
+    cli_main(["--address", addr, "profile", "-d", "0.5", "--out", flame])
+    out = capsys.readouterr().out
+    assert "samples over" in out, out
+    assert "busy" in out, out
+    assert open(flame).read().strip()
     assert ray_tpu.get(ref, timeout=60) == 1
 
 
