@@ -4,10 +4,26 @@ with BASELINE.md. Prints one JSON line per metric:
 {"metric", "value", "unit", "vs_baseline"} — vs_baseline is
 value / reference_value from release_logs/2.9.3 (m5.16xlarge, 64 vCPU).
 
-Usage: python bench_core.py [--quick]
+Probes (select with --only, comma-separated):
+  tasks_per_second          multi-client task throughput
+  actor_calls_sync          1:1 sync actor calls
+  actor_calls_async         1:1 async actor calls
+  n_n_actor_calls           n:n async actor calls via client tasks
+  put_calls                 small-object put throughput
+  put_gigabytes             large numpy put bandwidth
+  get_calls                 gets on stored objects
+  lane_tasks_per_second     warm pre-leased lane dispatch vs the same
+                            ray.get loop with lanes disabled
+  compiled_dag_iteration_us per-iteration latency of a compiled DAG vs
+                            the paired submit+get loop on the same actor
+  task_cold_start           submit-to-result with no pooled worker
+
+Usage: python bench_core.py [--quick] [--only p1,p2] [--out FILE]
+                            [--round N]
 """
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import sys
@@ -26,8 +42,10 @@ BASELINE = {
     "get_calls_per_second": 1152,         # client__get_calls (nearest)
 }
 
+RESULTS = []
 
-def emit(metric: str, value: float, unit: str) -> None:
+
+def emit(metric: str, value: float, unit: str, **extra) -> None:
     """ops/s headline + µs/op: per-op CPU cost is the host-size-neutral
     number (the recorded baseline ran on 64 vCPUs; this box has
     len(sched_getaffinity) — ratios of ops/s conflate the two)."""
@@ -43,6 +61,8 @@ def emit(metric: str, value: float, unit: str) -> None:
         if base:
             rec["baseline_us_per_op"] = round(1e6 / base, 1)
     rec["host_cpus"] = len(os.sched_getaffinity(0))
+    rec.update(extra)
+    RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
 
 
@@ -56,10 +76,24 @@ def timeit(fn, number: int) -> float:
 def main() -> None:
     quick = "--quick" in sys.argv
     scale = 0.2 if quick else 1.0
+    out_path = None
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    round_no = None
+    if "--round" in sys.argv:
+        round_no = int(sys.argv[sys.argv.index("--round") + 1])
+    only = None
+    if "--only" in sys.argv:
+        only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
+
+    def want(probe: str) -> bool:
+        return only is None or probe in only
 
     import ray_tpu
+    from ray_tpu.core.config import get_config
 
-    ray_tpu.init(num_cpus=4)
+    core = ray_tpu.init(num_cpus=4)
+    cfg = get_config()
 
     @ray_tpu.remote
     def noop():
@@ -94,128 +128,249 @@ def main() -> None:
                 rt.put(payload)
             return k
 
-    n = int(4000 * scale)
-    submitters = [Submitter.remote() for _ in range(4)]
-    ray_tpu.get([s.run_tasks.remote(noop, 5) for s in submitters])
+    submitters = None
+    if want("tasks_per_second") or want("put_calls"):
+        submitters = [Submitter.remote() for _ in range(4)]
+        ray_tpu.get([s.run_tasks.remote(noop, 5) for s in submitters])
 
-    def multi_tasks(k):
-        per = k // len(submitters)
-        ray_tpu.get([s.run_tasks.remote(noop, per) for s in submitters],
-                    timeout=600)
+    if want("tasks_per_second"):
+        n = int(4000 * scale)
 
-    emit("tasks_per_second", timeit(multi_tasks, n), "tasks/s")
+        def multi_tasks(k):
+            per = k // len(submitters)
+            ray_tpu.get([s.run_tasks.remote(noop, per)
+                         for s in submitters], timeout=600)
+
+        emit("tasks_per_second", timeit(multi_tasks, n), "tasks/s")
 
     # -- 1:1 sync actor calls (ref 1_1_actor_calls_sync) ------------------
-    n = int(1000 * scale)
+    if want("actor_calls_sync"):
+        n = int(1000 * scale)
 
-    def sync_calls(k):
-        for _ in range(k):
-            ray_tpu.get(actor.ping.remote(), timeout=60)
+        def sync_calls(k):
+            for _ in range(k):
+                ray_tpu.get(actor.ping.remote(), timeout=60)
 
-    emit("actor_calls_sync_per_second", timeit(sync_calls, n), "calls/s")
+        emit("actor_calls_sync_per_second", timeit(sync_calls, n),
+             "calls/s")
 
     # -- 1:1 async actor calls (ref 1_1_actor_calls_async) ----------------
-    n = int(2000 * scale)
-    ops = timeit(lambda k: ray_tpu.get(
-        [actor.ping.remote() for _ in range(k)], timeout=600), n)
-    emit("actor_calls_async_per_second", ops, "calls/s")
+    if want("actor_calls_async"):
+        n = int(2000 * scale)
+        ops = timeit(lambda k: ray_tpu.get(
+            [actor.ping.remote() for _ in range(k)], timeout=600), n)
+        emit("actor_calls_async_per_second", ops, "calls/s")
 
     # -- n:n async actor calls (ref n_n_actor_calls_async: m=4 parallel
     # CLIENT TASKS each driving n_cpu actors — ray_perf.py:276-288 `work
     # .remote(a)` — NOT one driver thread; submission parallelism is part
     # of the measured quantity) ------------------------------------------
-    actors = [Sink.remote() for _ in range(4)]
-    ray_tpu.get([a.ping.remote() for a in actors])
-    m = 4
-    n = int(4000 * scale)
+    if want("n_n_actor_calls"):
+        actors = [Sink.remote() for _ in range(4)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        m = 4
+        n = int(4000 * scale)
 
-    @ray_tpu.remote
-    def nn_client(actor_list, k):
-        import ray_tpu as rt
+        @ray_tpu.remote
+        def nn_client(actor_list, k):
+            import ray_tpu as rt
 
-        rt.get([actor_list[i % len(actor_list)].ping.remote()
-                for i in range(k)], timeout=600)
-        return k
+            rt.get([actor_list[i % len(actor_list)].ping.remote()
+                    for i in range(k)], timeout=600)
+            return k
 
-    ray_tpu.get([nn_client.remote(actors, 10) for _ in range(m)])  # warm
+        ray_tpu.get([nn_client.remote(actors, 10) for _ in range(m)])
 
-    def n_n(k):
-        per = k // m
-        ray_tpu.get([nn_client.remote(actors, per) for _ in range(m)],
-                    timeout=600)
+        def n_n(k):
+            per = k // m
+            ray_tpu.get([nn_client.remote(actors, per) for _ in range(m)],
+                        timeout=600)
 
-    emit("n_n_actor_calls_async_per_second", timeit(n_n, m * n), "calls/s")
+        emit("n_n_actor_calls_async_per_second", timeit(n_n, m * n),
+             "calls/s")
 
     # -- put calls/s (small objects, ref multi_client_put_calls — same
     # multi-client shape as above) ----------------------------------------
-    n = int(4000 * scale)
-    payload = b"x" * 100
+    if want("put_calls"):
+        n = int(4000 * scale)
+        payload = b"x" * 100
 
-    def multi_puts(k):
-        per = k // len(submitters)
-        ray_tpu.get([s.run_puts.remote(per, payload)
-                     for s in submitters], timeout=600)
+        def multi_puts(k):
+            per = k // len(submitters)
+            ray_tpu.get([s.run_puts.remote(per, payload)
+                         for s in submitters], timeout=600)
 
-    emit("put_calls_per_second", timeit(multi_puts, n), "puts/s")
+        emit("put_calls_per_second", timeit(multi_puts, n), "puts/s")
 
     # -- put GB/s (large numpy, ref multi_client_put_gigabytes) -----------
     # Working set stays under ~512 MiB: this VM throttles tmpfs page
     # allocation hard (~0.2 GB/s) past ~900 MiB of fresh pages, regardless
     # of writer (verified with raw mmap and write() syscalls) — the
     # framework path itself runs at memcpy speed below the cliff.
-    big = np.zeros(32 * 1024 * 1024, dtype=np.uint8)
-    n = max(2, int(10 * scale))
-    start = time.perf_counter()
-    refs = [ray_tpu.put(big) for _ in range(n)]
-    dt = time.perf_counter() - start
-    emit("put_gigabytes_per_second", n * big.nbytes / dt / 1e9, "GB/s")
+    refs = []
+    if want("put_gigabytes"):
+        big = np.zeros(32 * 1024 * 1024, dtype=np.uint8)
+        n = max(2, int(10 * scale))
+        start = time.perf_counter()
+        refs = [ray_tpu.put(big) for _ in range(n)]
+        dt = time.perf_counter() - start
+        emit("put_gigabytes_per_second", n * big.nbytes / dt / 1e9,
+             "GB/s")
 
     # -- get calls/s on stored objects ------------------------------------
-    n = int(2000 * scale)
-    small_refs = [ray_tpu.put(i) for i in range(100)]
+    if want("get_calls"):
+        n = int(2000 * scale)
+        small_refs = [ray_tpu.put(i) for i in range(100)]
 
-    def gets(k):
-        for i in range(k):
-            ray_tpu.get(small_refs[i % 100], timeout=60)
+        def gets(k):
+            for i in range(k):
+                ray_tpu.get(small_refs[i % 100], timeout=60)
 
-    emit("get_calls_per_second", timeit(gets, n), "gets/s")
+        emit("get_calls_per_second", timeit(gets, n), "gets/s")
+
+    # -- pre-leased task lanes: after task_lane_min_calls repeats of one
+    # signature the driver pins the lease and drives calls as delta
+    # frames into the pinned worker — no TaskSpec pickle, no scheduler
+    # visit. Paired baseline: the IDENTICAL submit+get loop with lanes
+    # disabled (every call pays the full pooled-lease path). --------------
+    if want("lane_tasks_per_second"):
+        @ray_tpu.remote
+        def lane_noop():
+            return None
+
+        def seq_calls(k):
+            for _ in range(k):
+                ray_tpu.get(lane_noop.remote(), timeout=60)
+
+        # Warm until the lane is open and hitting.
+        base_hits = core.lane_stats["hits"]
+        ray_tpu.get([lane_noop.remote() for _ in range(20)], timeout=120)
+        assert core.lane_stats["hits"] > base_hits, core.lane_stats
+        n = int(2000 * scale)
+        lane_ops = timeit(seq_calls, n)
+
+        saved = cfg.task_lane_enabled
+        cfg.task_lane_enabled = False
+        core.loop_thread.run(core._close_pinned_lanes(), timeout=30)
+        try:
+            seq_calls(10)  # re-warm the ordinary pooled-lease path
+            slow_ops = timeit(seq_calls, max(50, int(300 * scale)))
+        finally:
+            cfg.task_lane_enabled = saved
+        emit("lane_tasks_per_second", lane_ops, "tasks/s",
+             baseline_us_per_op_lanes_off=round(1e6 / slow_ops, 1),
+             overhead_reduction=round(lane_ops / slow_ops, 1))
+        emit("lane_baseline_tasks_per_second", slow_ops, "tasks/s")
+
+    # -- compiled DAG: per-iteration latency of a 3-stage actor chain
+    # driven by execute()+get() through shm rings, vs the SAME chain
+    # driven the way a user writes it without experimental_compile —
+    # one ray.get per iteration over chained ObjectRefs (every hop pays
+    # TaskSpec pickle + scheduler dispatch + object-store transfer). A
+    # per-stage-get variant is recorded alongside for reference. ----------
+    if want("compiled_dag_iteration_us"):
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class Relay:
+            def fwd(self, x):
+                return x
+
+        st = [Relay.remote() for _ in range(3)]
+        ray_tpu.get([s.fwd.remote(0) for s in st], timeout=120)
+
+        n_b = max(50, int(300 * scale))
+        t0 = time.perf_counter()
+        for i in range(n_b):
+            ray_tpu.get(
+                st[2].fwd.remote(st[1].fwd.remote(st[0].fwd.remote(i))),
+                timeout=60)
+        base_us = (time.perf_counter() - t0) / n_b * 1e6
+
+        n_s = max(50, int(200 * scale))
+        t0 = time.perf_counter()
+        for i in range(n_s):
+            v = i
+            for s in st:
+                v = ray_tpu.get(s.fwd.remote(v), timeout=60)
+        stage_us = (time.perf_counter() - t0) / n_s * 1e6
+
+        with InputNode() as inp:
+            dag = st[2].fwd.bind(st[1].fwd.bind(st[0].fwd.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(10):  # warm the rings
+                compiled.execute(i).get(timeout=60)
+            n = int(2000 * scale)
+            t0 = time.perf_counter()
+            for i in range(n):
+                compiled.execute(i).get(timeout=60)
+            dag_us = (time.perf_counter() - t0) / n * 1e6
+        finally:
+            compiled.teardown()
+        emit("compiled_dag_iteration_us", dag_us, "us",
+             baseline_ray_get_us=round(base_us, 1),
+             baseline_stage_get_us=round(stage_us, 1),
+             overhead_reduction=round(base_us / dag_us, 1))
 
     # -- task cold start: submit-to-result with NO pooled worker ---------
     # Each sample flushes the daemon's idle pool first, so the lease has
     # to start a worker (zygote fork by default, cold Popen with
     # RAY_TPU_ZYGOTE_ENABLED=0) — the number the warm-worker subsystem
-    # exists to shrink.
-    from ray_tpu.api import _global_worker
-    from ray_tpu.core.distributed.rpc import SyncRpcClient
+    # exists to shrink. Task lanes are disabled for the probe: a pinned
+    # lane holds its worker out of the idle pool until the reaper fires,
+    # which is exactly the machinery this probe must not measure.
+    if want("task_cold_start"):
+        from ray_tpu.core.distributed.rpc import SyncRpcClient
 
-    w = _global_worker()
-    node = [x for x in ray_tpu.nodes() if x["Alive"]][0]
-    client = SyncRpcClient(node["Address"], w.loop_thread)
-    samples = []
-    for _ in range(max(5, int(20 * scale))):
-        # The previous sample's lease returns asynchronously after its
-        # get() — keep flushing until every TASK worker is gone (actor
-        # workers from earlier probes stay), so the next lease must
-        # start a worker from scratch.
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            client.call("NodeDaemon", "flush_idle_workers", timeout=30)
-            ws = client.call("NodeDaemon", "list_workers", timeout=15)
-            if not [x for x in ws if x["actor_id"] is None and x["alive"]]:
-                break
-            time.sleep(0.05)
-        t0 = time.perf_counter()
-        ray_tpu.get(noop.remote(), timeout=120)
-        samples.append(time.perf_counter() - t0)
-    samples.sort()
-    emit("task_cold_start_p50_ms",
-         samples[len(samples) // 2] * 1e3, "ms")
-    emit("task_cold_start_p95_ms",
-         samples[int(len(samples) * 0.95) - 1] * 1e3, "ms")
-    client.close()
+        saved_lanes = cfg.task_lane_enabled
+        cfg.task_lane_enabled = False
+        core.loop_thread.run(core._close_pinned_lanes(), timeout=30)
+        node = [x for x in ray_tpu.nodes() if x["Alive"]][0]
+        client = SyncRpcClient(node["Address"], core.loop_thread)
+        samples = []
+        try:
+            for _ in range(max(5, int(20 * scale))):
+                # The previous sample's lease returns asynchronously
+                # after its get() — keep flushing until every TASK
+                # worker is gone (actor workers from earlier probes
+                # stay), so the next lease must start a worker from
+                # scratch.
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    client.call("NodeDaemon", "flush_idle_workers",
+                                timeout=30)
+                    ws = client.call("NodeDaemon", "list_workers",
+                                     timeout=15)
+                    if not [x for x in ws
+                            if x["actor_id"] is None and x["alive"]]:
+                        break
+                    time.sleep(0.05)
+                t0 = time.perf_counter()
+                ray_tpu.get(noop.remote(), timeout=120)
+                samples.append(time.perf_counter() - t0)
+        finally:
+            cfg.task_lane_enabled = saved_lanes
+            client.close()
+        samples.sort()
+        emit("task_cold_start_p50_ms",
+             samples[len(samples) // 2] * 1e3, "ms")
+        emit("task_cold_start_p95_ms",
+             samples[int(len(samples) * 0.95) - 1] * 1e3, "ms")
 
     del refs
     ray_tpu.shutdown()
+
+    if out_path:
+        out = {
+            "round": round_no,
+            "host": {"nproc": len(os.sched_getaffinity(0))},
+            "recorded_at_utc":
+                datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "results": RESULTS,
+        }
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -126,6 +126,32 @@ class Config:
     # 0 falls back to the legacy bytes-through-pickle path.
     transfer_raw_frames: bool = True
 
+    # ---- compiled execution plane (task lanes + cross-host channels) ----
+    # Pre-leased task lanes: after `task_lane_min_calls` submissions of
+    # the same (function, resources, runtime-env) signature the lease is
+    # kept warm and pinned, and subsequent calls ride compact raw-frame
+    # deltas straight into the pinned worker's executor queue
+    # (RAY_TPU_TASK_LANE_ENABLED=0 restores per-call leasing).
+    task_lane_enabled: bool = True
+    task_lane_min_calls: int = 3
+    # Calls in flight on one pinned lane before new submissions spill
+    # back to the normal lease/scheduler path (backpressure bound).
+    # Kept small on purpose: a lane pipelines the low-concurrency
+    # submit+wait pattern, while a large burst should fan out across
+    # the worker pool instead of serializing behind one pinned worker.
+    task_lane_max_inflight: int = 8
+    # Idle pinned lanes release their worker after this long so the
+    # pool can reap it (mirrors idle_worker_killing_time_threshold_ms).
+    task_lane_idle_s: float = 2.0
+    # Channel spin-wait poll backoff bounds, in MICROSECONDS. Once the
+    # backoff saturates at the max the waiter also sched_yield()s so a
+    # busy peer on the same core can make progress.
+    channel_backoff_us_min: float = 1.0
+    channel_backoff_us_max: float = 200.0
+    # CompiledDag.teardown() wait on stage loops before raising with
+    # the straggler list.
+    dag_teardown_timeout_s: float = 10.0
+
     # ---- object store ----
     # Per-node shared-memory store capacity. 0 => 30% of system RAM
     # (matches the reference's default plasma sizing).

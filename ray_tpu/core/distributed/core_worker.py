@@ -20,6 +20,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from collections import defaultdict, deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -47,6 +48,7 @@ from ray_tpu.core.distributed.rpc import (
     RpcServer,
     SyncRpcClient,
 )
+from ray_tpu.core.distributed.wire import Raw
 
 logger = logging.getLogger(__name__)
 
@@ -373,6 +375,190 @@ class _TaskLane:
                 return  # drop this lease
 
 
+class _PinnedLane:
+    """A warm, pinned lease for one repeated task signature.
+
+    After `task_lane_min_calls` submissions of the same (function,
+    resources, runtime-env) signature, the driver leases a worker once,
+    PINS the lease (the daemon releases its resources back to the pool —
+    actor semantics — but keeps the worker bound and un-reapable) and
+    opens a lane on the worker: the fn_key/name/job_id template travels
+    once, and every subsequent call is a compact delta frame (task id +
+    raw arg blob + counters, wire codec 2) straight into the pinned
+    worker's executor queue. No per-call TaskSpec pickle, no
+    GCS/scheduler/daemon visit, no lease round-trip.
+
+    Spillback is transparent: a full in-flight window, a lost lease, a
+    retiring or dying worker all route the call back to the ordinary
+    `_TaskLane` lease/scheduler path (the memoized-results check on the
+    worker keeps a retried call from re-running a body whose results
+    already landed). Idle lanes release their worker after
+    `task_lane_idle_s` so the pool can reap it.
+    """
+
+    def __init__(self, core: "DistributedCoreWorker", key, demand, sched,
+                 fn_key: bytes, name: str, exclusive: bool = False):
+        self.core = core
+        self.key = key
+        self.demand = demand
+        self.sched = sched
+        self.fn_key = fn_key
+        self.name = name
+        self.exclusive = exclusive   # compiled-DAG stage lane: not shared
+        self.lane_id = uuid.uuid4().hex
+        self.state = "opening"        # opening -> ready -> closed
+        self.inflight = 0
+        self.last_used = time.monotonic()
+        self.worker_address: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.daemon_address: Optional[str] = None
+        self.lease_id: Optional[str] = None
+        self._client: Optional[AsyncRpcClient] = None
+        core._lane_stat("opened")
+        self._open_task: Optional[asyncio.Future] = \
+            asyncio.ensure_future(self._open())
+
+    async def _open(self) -> None:
+        """Lease + pin + lane_open. Runs once; callers await it."""
+        helper = _TaskLane(self.core, self.demand, self.sched)
+        try:
+            daemon, grant = await helper._lease_with_spillback()
+            self.lease_id = grant["lease_id"]
+            self.daemon_address = grant.get("daemon_address")
+            self.node_id = grant.get("node_id")
+            self.worker_address = grant["worker_address"]
+            pin = await daemon.call("NodeDaemon", "pin_lease",
+                                    lease_id=self.lease_id, timeout=10)
+            if not pin.get("ok"):
+                raise RpcError(f"pin_lease: {pin.get('error')}")
+            # Dedicated connection: the lane's frames never queue behind
+            # the shared client's control traffic, and teardown closes it.
+            self._client = AsyncRpcClient(self.worker_address)
+            opened = await self._client.call(
+                "Worker", "lane_open", lane_id=self.lane_id,
+                fn_key=self.fn_key, name=self.name,
+                job_id=self.core.job_id,
+                submit_ctx=getattr(self.core, "_submit_identity", None),
+                timeout=60)
+            if not opened.get("ok"):
+                raise RpcError(f"lane_open: {opened.get('error')}")
+            self.state = "ready"
+        except BaseException:
+            self.close()
+            raise
+
+    def try_submit(self, spec: dict, rfut: asyncio.Future) -> bool:
+        """Fast-path admission; False => caller spills to the slow path."""
+        if self.state == "closed" \
+                or self.inflight >= get_config().task_lane_max_inflight:
+            return False
+        self.inflight += 1
+        self.last_used = time.monotonic()
+        asyncio.ensure_future(self._call(spec, rfut))
+        return True
+
+    async def _call(self, spec: dict, rfut: asyncio.Future) -> None:
+        try:
+            reply = await self._execute(spec)
+        except asyncio.CancelledError:
+            if not rfut.done():
+                rfut.cancel()
+            raise
+        except BaseException as e:  # noqa: BLE001 — spill via on_done
+            if not rfut.done():
+                rfut.set_exception(e)
+        else:
+            if not rfut.done():
+                rfut.set_result(reply)
+        finally:
+            self.inflight -= 1
+            self.last_used = time.monotonic()
+
+    async def _execute(self, spec: dict) -> dict:
+        if self._open_task is not None:
+            await asyncio.shield(self._open_task)
+            self._open_task = None
+        if self.state != "ready":
+            raise RpcError("lane closed")
+        if spec["task_id"] in self.core._cancelled_tasks:
+            self.core._cancelled_tasks.pop(spec["task_id"], None)
+            return {"results": [], "error": rexc.TaskCancelledError(
+                spec["options"].get("name", "task"))}
+        self.core._task_locations[spec["task_id"]] = self.worker_address
+        spec["lease_ts"] = time.time()
+        try:
+            reply = await self._client.call(
+                "Worker", "lane_execute", lane_id=self.lane_id,
+                task_id=spec["task_id"],
+                num_returns=spec["num_returns"],
+                attempt=spec.get("attempt", 0),
+                lane_retries=spec.get("_lane_retries", 0),
+                submit_ts=spec.get("submit_ts"),
+                lease_ts=spec["lease_ts"],
+                args_blob=Raw(spec["args_blob"]), timeout=None)
+        except asyncio.CancelledError:
+            self.core._task_locations.pop(spec["task_id"], None)
+            raise
+        except Exception as e:  # noqa: BLE001 — worker likely died
+            self.core._task_locations.pop(spec["task_id"], None)
+            spec["_lane_retries"] = spec.get("_lane_retries", 0) + 1
+            self.close()
+            raise RpcError(f"lane transport failure: {e!r}")
+        self.core._task_locations.pop(spec["task_id"], None)
+        if reply.get("requeue"):
+            # Worker retiring / lane evaporated: the call never ran.
+            spec["_lane_retries"] = spec.get("_lane_retries", 0) + 1
+            self.close()
+            raise RpcError("lane worker retiring")
+        return reply
+
+    async def apply_async(self, blob: bytes, name: str = "dag_stage"):
+        """Long-running lane body (compiled-DAG stage loop): returns the
+        in-flight call's coroutine result dict when the loop exits."""
+        if self._open_task is not None:
+            await asyncio.shield(self._open_task)
+            self._open_task = None
+        if self.state != "ready":
+            raise RpcError("lane closed")
+        return await self._client.call("Worker", "lane_apply",
+                                       blob=Raw(blob), name=name,
+                                       timeout=None)
+
+    def close(self, reason: str = "") -> None:
+        """Idempotent teardown: unregister, close the worker lane,
+        return (unpin) the lease, drop the dedicated connection."""
+        if self.state == "closed":
+            return
+        self.state = "closed"
+        if not self.exclusive \
+                and self.core._pinned_lanes.get(self.key) is self:
+            del self.core._pinned_lanes[self.key]
+        self.core._lane_stat("closed")
+        asyncio.ensure_future(self._close_async())
+
+    async def _close_async(self) -> None:
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                await client.call("Worker", "lane_close",
+                                  lane_id=self.lane_id, timeout=5)
+            except Exception:  # noqa: BLE001 — worker may be gone
+                pass
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.daemon_address and self.lease_id:
+            # Unpin: a dead worker's lease was already auto-returned by
+            # the daemon's monitor; the double return is a no-op.
+            try:
+                daemon = await self.core._aclient(self.daemon_address)
+                await daemon.call("NodeDaemon", "return_lease",
+                                  lease_id=self.lease_id, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 class OwnerService:
     """Serves this process's owned small objects to other processes.
 
@@ -559,6 +745,20 @@ class DistributedCoreWorker:
         self._actor_pending: Dict[str, "deque"] = {}
         # Lease reuse lanes keyed by (demand, sched, runtime_env).
         self._lanes: Dict[tuple, "_TaskLane"] = {}
+        # Pre-leased (pinned) task lanes keyed by (fn_key, demand,
+        # sched, runtime_env) + per-signature call counts that decide
+        # when a signature is hot enough to pin (task_lane_min_calls).
+        self._pinned_lanes: Dict[tuple, "_PinnedLane"] = {}
+        self._lane_calls: Dict[tuple, int] = {}
+        self._lane_reaper: Optional[asyncio.Future] = None
+        self.lane_stats = {"hits": 0, "misses": 0, "spills": 0,
+                           "opened": 0, "closed": 0}
+        from ray_tpu.util.metrics import Counter
+
+        self._m_lane = Counter(
+            "raytpu_task_lane_calls_total",
+            "Pre-leased task lane dispatch outcomes",
+            tag_keys=("outcome",))
         # Raw runtime_env json -> normalized (pkg:// uploaded) spec.
         self._norm_env_cache: Dict[str, Optional[dict]] = {}
         # Job-level default runtime env (init(runtime_env=...)).
@@ -1877,6 +2077,8 @@ class DistributedCoreWorker:
                 for dfut in blockers:
                     dfut.add_done_callback(on_dep_done)
                 return
+        if self._maybe_lane_submit(spec, demand, sched, return_ids, fut):
+            return
         from ray_tpu.runtime_env import env_hash
 
         key = (tuple(sorted(demand.items())), sched["strategy"],
@@ -1890,6 +2092,13 @@ class DistributedCoreWorker:
         lane.queue.append((spec, rfut))
         lane.wakeup.set()
         lane._maybe_scale()
+        rfut.add_done_callback(
+            self._task_reply_cb(spec, demand, sched, return_ids, fut))
+
+    def _task_reply_cb(self, spec, demand, sched, return_ids, fut):
+        """Shared completion callback for both dispatch paths (pinned
+        lane and lease-reuse lane): finish on success/app error, spill
+        to the retrying slow path on any transport/lease failure."""
 
         def on_done(rf):
             retry = False
@@ -1925,7 +2134,137 @@ class DistributedCoreWorker:
                 asyncio.ensure_future(self._run_task_to_completion_async(
                     spec, demand, sched, return_ids, fut))
 
-        rfut.add_done_callback(on_done)
+        return on_done
+
+    def _lane_stat(self, outcome: str) -> None:
+        self.lane_stats[outcome] += 1
+        self._m_lane.inc(tags={"outcome": outcome})
+
+    def _maybe_lane_submit(self, spec, demand, sched, return_ids,
+                           fut) -> bool:
+        """Pinned-lane fast path. True => the call was admitted to a
+        warm lane; False => caller proceeds down the lease-reuse path
+        (signature still cold, lane ineligible, or backlog spill)."""
+        cfg = get_config()
+        opts = spec["options"]
+        if (not cfg.task_lane_enabled or opts.get("max_calls")
+                or opts.get("streaming") or sched["placement"]):
+            return False
+        from ray_tpu.runtime_env import env_hash
+
+        key = (spec["fn_key"], tuple(sorted(demand.items())),
+               sched["strategy"], sched["affinity"], sched["soft"],
+               env_hash(sched.get("runtime_env")))
+        lane = self._pinned_lanes.get(key)
+        if lane is None:
+            n = self._lane_calls.get(key, 0) + 1
+            self._lane_calls[key] = n
+            if n < cfg.task_lane_min_calls:
+                self._lane_stat("misses")
+                return False
+            while len(self._lane_calls) > 4096:  # bound cold signatures
+                del self._lane_calls[next(iter(self._lane_calls))]
+            lane = _PinnedLane(self, key, demand, sched, spec["fn_key"],
+                               opts.get("name", "task"))
+            self._pinned_lanes[key] = lane
+            self._ensure_lane_reaper()
+        rfut = self.loop_thread.loop.create_future()
+        if not lane.try_submit(spec, rfut):
+            self._lane_stat("spills")
+            return False
+        self._lane_stat("hits")
+        rfut.add_done_callback(
+            self._task_reply_cb(spec, demand, sched, return_ids, fut))
+        return True
+
+    def _ensure_lane_reaper(self) -> None:
+        if self._lane_reaper is not None and not self._lane_reaper.done():
+            return
+        self._lane_reaper = asyncio.ensure_future(self._lane_reaper_loop())
+
+    async def _lane_reaper_loop(self) -> None:
+        """Release idle pinned lanes: a lane that stops being called
+        gives its worker back after task_lane_idle_s, so the daemon's
+        idle reaping / cold-start accounting works as without lanes."""
+        try:
+            while True:
+                idle_s = max(0.05, get_config().task_lane_idle_s)
+                await asyncio.sleep(min(0.5, idle_s / 2))
+                now = time.monotonic()
+                for lane in list(self._pinned_lanes.values()):
+                    if lane.state == "ready" and lane.inflight == 0 \
+                            and now - lane.last_used > idle_s:
+                        lane.close("idle")
+                if not self._pinned_lanes:
+                    return
+        except asyncio.CancelledError:
+            raise
+
+    async def _close_pinned_lanes(self) -> None:
+        """Shutdown: unpin every warm lane while the daemons are still
+        alive to take the lease back."""
+        if self._lane_reaper is not None:
+            self._lane_reaper.cancel()
+            self._lane_reaper = None
+        lanes = list(self._pinned_lanes.values())
+        self._pinned_lanes.clear()
+        closers = []
+        for lane in lanes:
+            if lane.state != "closed":
+                lane.state = "closed"
+                self._lane_stat("closed")
+                closers.append(lane._close_async())
+        if closers:
+            await asyncio.gather(*closers, return_exceptions=True)
+
+    # ------------------------------------------------------------------
+    # exclusive lanes (compiled-DAG FunctionNode stages)
+    # ------------------------------------------------------------------
+    def open_exclusive_lane(self, fn, *, num_cpus: float = 1.0,
+                            resources: Optional[Dict[str, float]] = None,
+                            timeout: float = 120.0) -> "_PinnedLane":
+        """Sync facade: lease + pin a dedicated worker for one
+        compiled-DAG FunctionNode stage and open a lane on it. The lane
+        is NOT in the shared registry — the DAG owns its lifecycle (and
+        the idle reaper never touches it)."""
+        fn_key = self._export_function(fn)
+        demand = {"CPU": float(num_cpus)} if num_cpus else {}
+        for k, v in (resources or {}).items():
+            demand[k] = float(v)
+        sched = self._scheduling_fields(TaskOptions())
+        name = getattr(fn, "__qualname__", "dag_stage")
+
+        async def open_lane():
+            lane = _PinnedLane(self, None, demand, sched, fn_key, name,
+                               exclusive=True)
+            try:
+                await lane._open_task
+            finally:
+                lane._open_task = None
+            return lane
+
+        return self.loop_thread.run(open_lane(), timeout=timeout)
+
+    def lane_apply(self, lane: "_PinnedLane", blob: bytes,
+                   name: str = "dag_stage") -> Future:
+        """Kick off a long-running lane body (a stage loop); returns a
+        concurrent future resolving to the worker's {"error": ...} reply
+        when the loop exits — the compiled DAG's loop-ref analogue."""
+        return asyncio.run_coroutine_threadsafe(
+            lane.apply_async(blob, name), self.loop_thread.loop)
+
+    def close_exclusive_lane(self, lane: "_PinnedLane",
+                             timeout: float = 10.0) -> None:
+        async def close():
+            if lane.state != "closed":
+                lane.state = "closed"
+                self._lane_stat("closed")
+                await lane._close_async()
+
+        try:
+            self.loop_thread.run(close(), timeout=timeout)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
 
     async def _run_task_to_completion_async(self, spec, demand, sched,
                                             return_ids, fut):
@@ -2512,6 +2851,11 @@ class DistributedCoreWorker:
         uninstall_refcounter()
         with self._lock:
             self._flush_frees_locked()
+        if self._pinned_lanes or self._lane_reaper is not None:
+            try:
+                self.loop_thread.run(self._close_pinned_lanes(), timeout=8)
+            except Exception:  # noqa: BLE001
+                pass
         if self.is_driver:
             try:
                 self.gcs.call("JobManager", "finish_job", job_id=self.job_id,

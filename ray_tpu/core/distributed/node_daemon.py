@@ -18,9 +18,11 @@ import asyncio
 import logging
 import os
 import random
+import struct
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from collections import deque
@@ -214,6 +216,11 @@ class NodeDaemon:
         # Pooled clients to peer daemons (push/relay/broadcast): one
         # multiplexed connection per peer instead of a dial per chunk.
         self._peer_clients: Dict[str, AsyncRpcClient] = {}
+        # Cross-host channel rings this daemon hosts or pushes into:
+        # path -> {"ch": Channel, "lock": threading.Lock}. The lock
+        # serializes channel_push executor threads per ring, which also
+        # makes the versioned-write dedupe check sound.
+        self._channels: Dict[str, dict] = {}
         self._view = ClusterView()
         # Versioned delta reporter + cluster-view receiver (syncer.py);
         # None when RAY_TPU_SYNCER_ENABLED=0 (legacy full-state
@@ -332,6 +339,13 @@ class NodeDaemon:
             except Exception:  # noqa: BLE001
                 pass
         self._peer_clients.clear()
+        for ent in list(self._channels.values()):
+            try:
+                ent["ch"].close()
+                ent["ch"].unlink()
+            except Exception:  # noqa: BLE001
+                pass
+        self._channels.clear()
         await self.server.stop()
         self.store.disconnect()
         ObjectStore.destroy(self.store_dir)
@@ -1460,7 +1474,8 @@ class NodeDaemon:
             self.syncer.mark_dirty()  # availability changed: sync promptly
         self._ledger(f"grant:{lease_id[:8]}:pid{worker.proc.pid}", demand)
         return {"granted": True, "worker_address": worker.address,
-                "lease_id": lease_id}
+                "lease_id": lease_id, "node_id": self.node_id,
+                "daemon_address": self.server.address}
 
     def _ledger(self, tag: str, demand) -> None:
         import os as _os
@@ -1499,6 +1514,131 @@ class NodeDaemon:
         if self.syncer is not None:
             self.syncer.mark_dirty()  # resources freed: sync promptly
         self._pump_lease_queue()
+
+    def pin_lease(self, lease_id: str) -> dict:
+        """Pin a granted lease for a pre-leased task lane.
+
+        The lease's resources go back to the pool — a pinned lane worker
+        holds 0 resources while alive, exactly the actor model — but the
+        worker stays busy/bound: it is never re-leased, never reaped,
+        and keeps executing lane frames until `return_lease` unpins it
+        (which returns it to the idle pool). The Lease record stays in
+        `_leases` with empty demand so the dead-worker sweep's automatic
+        lease return needs no special case."""
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return {"ok": False, "error": f"no such lease {lease_id[:8]}"}
+        if lease.worker.proc.poll() is not None:
+            return {"ok": False, "error": "worker dead"}
+        self._release_demand(lease.demand, lease.placement)
+        self._ledger(f"pin:{lease_id[:8]}", lease.demand)
+        lease.demand = {}
+        if self.syncer is not None:
+            self.syncer.mark_dirty()  # resources freed: sync promptly
+        self._pump_lease_queue()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # cross-host channel endpoints (compiled execution plane): remote
+    # writers push serialized ring payloads as raw frames; this daemon
+    # lands them in the LOCAL shm ring its readers poll.
+    # ------------------------------------------------------------------
+    def _channel_entry(self, path: str, capacity: int, n_readers: int,
+                       n_slots: int) -> dict:
+        from ray_tpu.experimental.channel import Channel
+
+        ent = self._channels.get(path)
+        if ent is None:
+            ent = {"ch": Channel(path, capacity, n_readers, n_slots),
+                   "lock": threading.Lock()}
+            self._channels[path] = ent
+        return ent
+
+    def channel_create(self, n_readers: int,
+                       capacity: Optional[int] = None,
+                       n_slots: Optional[int] = None) -> dict:
+        """Create a ring on THIS node for readers that live here."""
+        from ray_tpu.experimental import channel as chmod
+
+        os.makedirs(self.store_dir, exist_ok=True)
+        ch = chmod.Channel.create(
+            n_readers, capacity or chmod.DEFAULT_CAPACITY,
+            n_slots or chmod.DEFAULT_SLOTS, directory=self.store_dir)
+        self._channels[ch.path] = {"ch": ch, "lock": threading.Lock()}
+        return {"path": ch.path, "capacity": ch.capacity,
+                "n_readers": ch.n_readers, "n_slots": ch.n_slots}
+
+    async def channel_push(self, path: str, capacity: int, n_readers: int,
+                           n_slots: int, version: int, data,
+                           push_timeout: Optional[float] = None) -> dict:
+        """Land one versioned payload in a local ring. Blocks (in an
+        executor thread) until the ring has a free slot, so the writer's
+        backpressure crosses the RPC hop. `version <= w_seq` is acked
+        without writing — the dedupe that makes writer retries safe."""
+        from ray_tpu.experimental.channel import (
+            ChannelClosedError, ChannelTimeoutError)
+
+        if not os.path.exists(path):
+            return {"closed": True}
+        ent = self._channel_entry(path, capacity, n_readers, n_slots)
+        ch, lock = ent["ch"], ent["lock"]
+        version = int(version)
+
+        def _push():
+            with lock:
+                if version <= ch.version():
+                    return {"ok": True, "version": version,
+                            "deduped": True}
+                ch.write_bytes(data, timeout=push_timeout)
+                return {"ok": True, "version": version}
+
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, _push)
+        except ChannelClosedError:
+            return {"closed": True}
+        except ChannelTimeoutError:
+            return {"timeout": True}
+        except Exception as e:  # noqa: BLE001
+            return {"error": str(e)}
+
+    def channel_version(self, path: str) -> dict:
+        from ray_tpu.experimental.channel import _HDR
+
+        try:
+            with open(path, "rb") as f:
+                hdr = f.read(_HDR.size)
+            _, closed, _, _, _, wseq = _HDR.unpack_from(hdr, 0)
+            return {"version": wseq, "closed": bool(closed)}
+        except (OSError, struct.error):
+            return {"version": 0, "closed": True}
+
+    def channel_close(self, path: str) -> dict:
+        """Set the ring's closed flag: every blocked read/write raises."""
+        try:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                os.pwrite(fd, struct.pack("<I", 1), 4)
+            finally:
+                os.close(fd)
+            return {"ok": True}
+        except OSError as e:
+            return {"ok": False, "error": str(e)}
+
+    def channel_unlink(self, path: str) -> dict:
+        if "rtpu_chan_" not in os.path.basename(path):
+            return {"ok": False, "error": "not a channel path"}
+        ent = self._channels.pop(path, None)
+        if ent is not None:
+            try:
+                ent["ch"].unlink()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return {"ok": True}
 
     def _find_pg_bundle(self, pg_id: str, demand) -> Optional[int]:
         for (pid, idx), bundle in self._pg_bundles.items():

@@ -242,6 +242,14 @@ class WorkerService:
         # watchdog (`running_tasks` RPC). Plain dict, GIL-atomic
         # set/pop of whole entries; readers snapshot with list().
         self._running_info: Dict[bytes, dict] = {}
+        # Pre-leased task lanes pinned to this worker: lane_id -> the
+        # spec template the per-call delta frames are expanded against
+        # (fn_key/name/job_id travel ONCE at lane_open, never per call).
+        self._lanes: Dict[str, dict] = {}
+        # Compiled-DAG stage loops (lane_apply) get their own threads:
+        # they run for the DAG's lifetime, and parking one in
+        # _task_pool would wedge the retirement drain.
+        self._lane_pool: Optional[ThreadPoolExecutor] = None
 
     def _record_event(self, spec: dict, state: str, start_ts: float,
                       end_ts: float, error: Optional[str] = None,
@@ -789,6 +797,98 @@ class WorkerService:
 
             pool_fut.add_done_callback(_consume)
             self._maybe_retire()
+
+    # ---- pre-leased task lanes (compiled execution plane) -------------
+    async def lane_open(self, lane_id: str, fn_key: bytes,
+                        name: str = "task",
+                        job_id: Optional[str] = None,
+                        submit_ctx=None) -> dict:
+        """Open a lane on this (pinned) worker: prefetch the function and
+        record the spec template, so each subsequent `lane_execute` delta
+        frame carries only (task id, arg blob, counters) — no TaskSpec
+        pickle, no function-table lookup on the hot path."""
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(self._task_pool,
+                                       self.core.fetch_function, fn_key)
+        except RuntimeError:
+            return {"requeue": True, "ok": False}   # retiring; re-lease
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": str(e)}
+        self._lanes[lane_id] = {"fn_key": fn_key, "name": name,
+                                "job_id": job_id,
+                                "submit_ctx": submit_ctx}
+        return {"ok": True}
+
+    async def lane_execute(self, lane_id: str, task_id: bytes,
+                           args_blob, num_returns: int = 1,
+                           attempt: int = 0,
+                           lane_retries: int = 0,
+                           submit_ts: Optional[float] = None,
+                           lease_ts: Optional[float] = None) -> dict:
+        """One lane call: expand the delta frame against the lane's spec
+        template and run it through the ordinary tracked executor (same
+        memoization, cancellation, retirement and result-storing
+        semantics as push_task)."""
+        lane = self._lanes.get(lane_id)
+        if lane is None:
+            # Lane evaporated (worker restarted under the same address,
+            # or close raced a call): hand the call back untouched.
+            return {"requeue": True, "results": [], "error": None}
+        spec = {
+            "task_id": task_id,
+            "fn_key": lane["fn_key"],
+            "args_blob": args_blob,
+            "num_returns": num_returns,
+            "options": {"name": lane["name"]},
+            "attempt": attempt,
+            "_lane_retries": lane_retries,
+            "job_id": lane["job_id"],
+            # Submission history rides the delta frame (two floats), so
+            # laned attempts report the same SUBMITTED→LEASED→RUNNING→
+            # terminal transitions as fully-specced ones.
+            "submit_ts": submit_ts,
+            "lease_ts": lease_ts,
+            "submit_ctx": lane["submit_ctx"],
+        }
+        loop = asyncio.get_running_loop()
+        try:
+            reply = await loop.run_in_executor(self._task_pool,
+                                               self._execute, spec)
+        except RuntimeError:
+            # Retirement drain closed the pool mid-call: never executed.
+            return {"requeue": True, "results": [], "error": None}
+        self._maybe_retire()
+        return reply
+
+    async def lane_apply(self, blob, name: str = "dag_stage") -> dict:
+        """Run a long-lived body (a compiled-DAG FunctionNode stage loop)
+        in this pinned worker: `blob` is a cloudpickled zero-arg
+        callable; the call returns when the loop exits (channel close at
+        teardown). The RPC reply doubles as the loop ref."""
+        loop = asyncio.get_running_loop()
+        if self._lane_pool is None:
+            self._lane_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="lane")
+
+        def run():
+            fn = serialization.cloudpickle.loads(blob)
+            return fn()
+
+        try:
+            await loop.run_in_executor(self._lane_pool, run)
+            return {"error": None}
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, rexc.RayTpuError):
+                err = e
+            else:
+                err = rexc.TaskError.from_exception(
+                    e, name, pid=os.getpid(), node_id=self.core.node_id)
+            return {"error": err}
+
+    async def lane_close(self, lane_id: str) -> dict:
+        self._lanes.pop(lane_id, None)
+        return {"ok": True}
 
     async def create_actor(self, actor_id: str, cls_blob_key: bytes,
                            args_blob: bytes,

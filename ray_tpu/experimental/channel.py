@@ -26,11 +26,21 @@ seqlock: 2v-1 while the writer fills it, 2v once published.
   read():   wait for state == 2v of the next version's slot, copy out,
             re-check the state (a concurrent overwrite restarts), ack v.
 
-Synchronization is polling with exponential backoff (1µs..200µs): at
+Synchronization is polling with exponential backoff (bounds from
+`RAY_TPU_CHANNEL_BACKOFF_US_MIN/MAX`, default 1µs..200µs): at
 compiled-DAG rates the next version is almost always already there, so
-the fast path is two mmap reads — no syscalls, no locks. Same-host only
-(TPU pipelines co-locate a slice's stages on a host; cross-host stages
-belong to shard_map collectives, not channels).
+the fast path is two mmap reads — no syscalls, no locks. Once the
+backoff saturates the waiter also sched_yield()s so a busy peer pinned
+to the same core can make progress.
+
+Cross-host edges: readers always consume a LOCAL ring; a producer on a
+different host writes through `RemoteChannelWriter`, which pushes the
+serialized payload as a raw frame to the reader node's daemon
+(`NodeDaemon.channel_push`) where it lands in the ring via the same
+publish path. Ring backpressure propagates across the hop because the
+push reply waits for the ring write. `FanoutWriter` fans one producer
+out to consumer groups on several nodes (serialize once, publish per
+node).
 """
 from __future__ import annotations
 
@@ -40,7 +50,9 @@ import pickle
 import struct
 import time
 import uuid
-from typing import Any, Optional
+from typing import Any, List, Optional
+
+from ray_tpu.core.config import get_config
 
 try:
     import cloudpickle  # type: ignore
@@ -148,7 +160,9 @@ class Channel:
 
     # -- protocol -------------------------------------------------------
     def _wait(self, cond, mm, timeout: Optional[float], what: str):
-        backoff = 1e-6
+        cfg = get_config()
+        backoff = max(cfg.channel_backoff_us_min, 0.01) * 1e-6
+        cap = max(cfg.channel_backoff_us_max * 1e-6, backoff)
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             v = cond()
@@ -158,16 +172,29 @@ class Channel:
                 raise ChannelClosedError(self.path)
             if deadline is not None and time.monotonic() >= deadline:
                 raise ChannelTimeoutError(f"{what} timed out on {self.path}")
+            if backoff >= cap:
+                # Saturated: stop trusting the timer alone — explicitly
+                # cede the core so a same-core peer can publish/ack.
+                os.sched_yield()
             time.sleep(backoff)
-            backoff = min(backoff * 2, 2e-4)
+            backoff = min(backoff * 2, cap)
 
     def _min_ack(self, mm) -> int:
         return min(_U64.unpack_from(mm, _ACKS_OFF + 8 * i)[0]
                    for i in range(self.n_readers))
 
+    def version(self) -> int:
+        """Last published version (0 before the first write)."""
+        return _U64.unpack_from(self._map(), _WSEQ_OFF)[0]
+
     def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
+        self.write_bytes(cloudpickle.dumps(value), timeout)
+
+    def write_bytes(self, data, timeout: Optional[float] = 30.0) -> int:
+        """Publish one already-serialized payload; returns the version it
+        landed as. Split from `write` so the daemon's channel_push can
+        land raw-frame payloads without a deserialize/re-serialize hop."""
         mm = self._map()
-        data = cloudpickle.dumps(value)
         if len(data) > self.capacity:
             raise ValueError(
                 f"serialized value ({len(data)}B) exceeds channel slot "
@@ -191,6 +218,7 @@ class Channel:
         _U64.pack_into(mm, off, 2 * v)               # published
         _U64.pack_into(mm, _WSEQ_OFF, v)
         self._w_seq = v
+        return v
 
     def _recover_last_read(self, mm, reader_idx: int) -> int:
         """First touch in this process: resume from the reader's ack word
@@ -231,3 +259,157 @@ class Channel:
     def __reduce__(self):
         return (Channel,
                 (self.path, self.capacity, self.n_readers, self.n_slots))
+
+
+class RemoteChannelWriter:
+    """Writer endpoint for a ring that lives on ANOTHER node.
+
+    The ring file is mmap'd only on the reader's node; this side pushes
+    each serialized payload as a raw frame (wire codec 2) to that node's
+    daemon, which lands it in the ring through the same `write_bytes`
+    publish path. Backpressure crosses the hop because the push reply is
+    not sent until the ring write completes (or times out).
+
+    Writes are versioned and the daemon dedupes (`version <= w_seq` is
+    an ack for an already-landed write), so a reply lost to a transport
+    error can be retried without double-publishing.
+    """
+
+    def __init__(self, daemon_address: str, path: str, capacity: int,
+                 n_readers: int, n_slots: int = DEFAULT_SLOTS):
+        self.daemon_address = daemon_address
+        self.path = path
+        self.capacity = capacity
+        self.n_readers = n_readers
+        self.n_slots = n_slots
+        self._client = None
+        self._w_seq: Optional[int] = None
+
+    def _rpc(self):
+        if self._client is None:
+            from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+            self._client = SyncRpcClient(self.daemon_address)
+        return self._client
+
+    def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
+        self.write_bytes(cloudpickle.dumps(value), timeout)
+
+    def write_bytes(self, data, timeout: Optional[float] = 30.0) -> int:
+        from ray_tpu.core.distributed import wire
+
+        if len(data) > self.capacity:
+            raise ValueError(
+                f"serialized value ({len(data)}B) exceeds channel slot "
+                f"capacity ({self.capacity}B); recreate the DAG with a "
+                f"larger buffer_size_bytes")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self._w_seq is None:  # attach: recover from the ring's w_seq
+            rep = self._rpc().call(
+                "NodeDaemon", "channel_version", path=self.path,
+                timeout=30.0, idempotent=True)
+            if rep.get("closed"):
+                raise ChannelClosedError(self.path)
+            self._w_seq = int(rep.get("version", 0))
+        v = self._w_seq + 1
+        attempts = 0
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise ChannelTimeoutError(
+                    f"remote write timed out on {self.path}")
+            try:
+                rep = self._rpc().call(
+                    "NodeDaemon", "channel_push", path=self.path,
+                    capacity=self.capacity, n_readers=self.n_readers,
+                    n_slots=self.n_slots, version=v,
+                    push_timeout=remaining, data=wire.Raw(data),
+                    timeout=None if remaining is None else remaining + 10)
+            except (ChannelClosedError, ChannelTimeoutError):
+                raise
+            except Exception as e:  # noqa: BLE001 — transport failure
+                attempts += 1
+                # Versioned dedupe makes the retry safe; but a dead
+                # daemon means dead readers, so don't spin forever.
+                if attempts >= 3 and deadline is None:
+                    raise ChannelClosedError(
+                        f"push to {self.daemon_address} failed: {e}")
+                time.sleep(min(0.05 * attempts, 0.5))
+                continue
+            if rep.get("closed"):
+                raise ChannelClosedError(self.path)
+            if rep.get("timeout"):
+                raise ChannelTimeoutError(
+                    f"remote write (readers lagging) timed out on "
+                    f"{self.path}")
+            if rep.get("error"):
+                raise RuntimeError(
+                    f"channel_push {self.path}: {rep['error']}")
+            self._w_seq = v
+            return v
+
+    def close(self) -> None:
+        try:
+            self._rpc().call("NodeDaemon", "channel_close",
+                             path=self.path, timeout=10.0)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+
+    def unlink(self) -> None:
+        try:
+            self._rpc().call("NodeDaemon", "channel_unlink",
+                             path=self.path, timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+
+    def __reduce__(self):
+        return (RemoteChannelWriter,
+                (self.daemon_address, self.path, self.capacity,
+                 self.n_readers, self.n_slots))
+
+
+class FanoutWriter:
+    """One producer, consumer groups on several nodes: serialize once,
+    publish into each group's ring (local `Channel` or
+    `RemoteChannelWriter`). Aggregate backpressure is the slowest
+    group's — version v+n_slots can't publish anywhere until every
+    group acked v."""
+
+    def __init__(self, endpoints: List[Any]):
+        self.endpoints = list(endpoints)
+        self._iter = 0                      # completed fan-out writes
+        self._done = [0] * len(self.endpoints)
+
+    def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
+        self.write_bytes(cloudpickle.dumps(value), timeout)
+
+    def write_bytes(self, data, timeout: Optional[float] = 30.0) -> None:
+        # A timeout on a slow group leaves the fan-out PARTIAL; callers
+        # retry the same payload, so remember which endpoints already
+        # landed this iteration and skip them (a local ring has no
+        # version dedupe — re-writing it would double-publish).
+        target = self._iter + 1
+        for i, ep in enumerate(self.endpoints):
+            if self._done[i] >= target:
+                continue
+            ep.write_bytes(data, timeout)
+            self._done[i] = target
+        self._iter = target
+
+    def close(self) -> None:
+        for ep in self.endpoints:
+            ep.close()
+
+    def unlink(self) -> None:
+        for ep in self.endpoints:
+            ep.unlink()
+
+    def __reduce__(self):
+        return (FanoutWriter, (self.endpoints,))

@@ -250,15 +250,91 @@ def test_compiled_out_of_order_get(cluster):
         compiled.teardown()
 
 
-def test_compiled_rejects_function_nodes(cluster):
+def test_compiled_function_node_chain(cluster):
+    """Stateless FunctionNodes compile: each stage runs its loop on an
+    exclusive pre-leased lane worker instead of being rejected."""
     @ray_tpu.remote
-    def f(x):
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(double.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(5)]
+        assert [r.get(timeout=60) for r in refs] == [2 * i + 1
+                                                    for i in range(5)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_mixed_actor_and_function_stages(cluster):
+    a = Adder.remote(10)
+
+    @ray_tpu.remote
+    def halve(x):
+        return x // 2
+
+    with InputNode() as inp:
+        dag = halve.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(4).get(timeout=60) == 7   # (4+10)//2
+        assert compiled.execute(0).get(timeout=60) == 5
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_function_stage_exception_propagates(cluster):
+    @ray_tpu.remote
+    def kaboom(x):
+        if x < 0:
+            raise RuntimeError(f"kaboom {x}")
         return x
 
     with InputNode() as inp:
-        dag = f.bind(inp)
-    with pytest.raises(ValueError, match="actor-method"):
-        dag.experimental_compile()
+        dag = kaboom.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=60) == 1
+        with pytest.raises(RuntimeError, match="kaboom -3"):
+            compiled.execute(-3).get(timeout=60)
+        # The pipeline still serves after a failed iteration.
+        assert compiled.execute(2).get(timeout=60) == 2
+    finally:
+        compiled.teardown()
+
+
+def test_teardown_timeout_surfaces_stragglers(cluster):
+    """teardown() waits RAY_TPU_DAG_TEARDOWN_TIMEOUT_S for stage loops
+    to drain and names the ones that did not, instead of silently
+    abandoning them after a hardcoded wait."""
+    from ray_tpu.core.config import get_config
+
+    @ray_tpu.remote
+    class Sleeper:
+        def slow(self, x):
+            time.sleep(3)
+            return x
+
+    s = Sleeper.remote()
+    with InputNode() as inp:
+        dag = s.slow.bind(inp)
+    compiled = dag.experimental_compile()
+    compiled.execute(1)
+    time.sleep(0.5)               # the loop is inside slow() now
+    cfg = get_config()
+    old = cfg.dag_teardown_timeout_s
+    cfg.dag_teardown_timeout_s = 0.2
+    try:
+        with pytest.raises(RuntimeError, match="slow"):
+            compiled.teardown()
+    finally:
+        cfg.dag_teardown_timeout_s = old
 
 
 def test_compiled_rejects_two_methods_of_same_actor(cluster):

@@ -1,0 +1,109 @@
+"""Compiled DAGs spanning two nodes: the per-edge transport planner
+keeps same-node edges on shm rings and routes cross-node edges through
+the reader node's daemon as versioned raw-frame pushes. The second
+node is a REAL in-process NodeDaemon (own RPC server, own store, real
+spawned workers) registered to the driver's GCS; custom resources pin
+each stage to a specific node so both push directions are exercised."""
+import asyncio
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def two_node():
+    core = ray_tpu.init(num_cpus=2, resources={"alpha": 4},
+                        ignore_reinit_error=True)
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.node_daemon import NodeDaemon
+
+    cfg = get_config()
+    saved = (cfg.zygote_enabled, cfg.worker_prestart_enabled)
+    # Daemon B lives in THIS process: no zygote fork, no prestart.
+    cfg.zygote_enabled = False
+    cfg.worker_prestart_enabled = False
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    daemon = NodeDaemon(gcs_address=core.gcs_address, num_cpus=2,
+                        custom_resources={"beta": 4},
+                        object_store_memory=64 << 20)
+    asyncio.run_coroutine_threadsafe(daemon.start(), loop).result(60)
+    try:
+        yield core, daemon
+    finally:
+        asyncio.run_coroutine_threadsafe(daemon.stop(), loop).result(60)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        cfg.zygote_enabled, cfg.worker_prestart_enabled = saved
+        ray_tpu.shutdown()
+
+
+def test_compiled_dag_spans_two_nodes(two_node):
+    core, daemon = two_node
+
+    @ray_tpu.remote(resources={"beta": 1})
+    def double(x):                      # pinned to node B
+        return x * 2
+
+    @ray_tpu.remote(resources={"alpha": 1})
+    def inc(x):                         # pinned to the driver's node
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(double.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        # The planner placed the stages on different nodes...
+        nodes = {name.rsplit(".", 1)[-1]: lane.node_id
+                 for name, lane in compiled._stage_lanes}
+        assert nodes["double"] == daemon.node_id
+        assert nodes["inc"] != daemon.node_id
+        # ...and created at least one ring on the remote node (the
+        # input edge lands on node B through its daemon).
+        assert any(r["daemon"] is not None for r in compiled._rings)
+        refs = [compiled.execute(i) for i in range(6)]
+        assert [r.get(timeout=120) for r in refs] == [
+            2 * i + 1 for i in range(6)]
+        # Out-of-order consumption across the remote edges.
+        r0 = compiled.execute(10)
+        r1 = compiled.execute(11)
+        assert r1.get(timeout=120) == 23
+        assert r0.get(timeout=120) == 21
+    finally:
+        compiled.teardown()
+
+
+def test_cross_node_lane_stage_death_is_clean(two_node):
+    """Chaos: kill the lane-pinned stage worker mid-iteration. The
+    next get() surfaces a clean error (no hang), teardown completes
+    (no wedged channel), and node B grants fresh leases afterwards
+    (no leaked lease)."""
+    core, daemon = two_node
+
+    @ray_tpu.remote(resources={"beta": 1})
+    def fragile(x):
+        import os
+        if x == "die":
+            os._exit(1)
+        return x
+
+    with InputNode() as inp:
+        dag = fragile.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute("ok").get(timeout=120) == "ok"
+        ref = compiled.execute("die")
+        with pytest.raises(Exception):
+            ref.get(timeout=60)
+    finally:
+        compiled.teardown()
+
+    @ray_tpu.remote(resources={"beta": 1})
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=120) == "pong"

@@ -1,0 +1,108 @@
+"""Pre-leased task lanes: after a few calls of one (function,
+resources, runtime-env) signature the driver pins a warm lease and
+drives subsequent calls as compact delta frames into the pinned
+worker's executor queue — no TaskSpec pickle, no GCS/scheduler/daemon
+visit. Backlog and worker death spill back to the ordinary lease path
+transparently."""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import get_config
+
+
+@pytest.fixture(scope="module")
+def core():
+    worker = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+def test_lane_warms_after_repeated_calls(core):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    base = dict(core.lane_stats)
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(20)]
+    assert core.lane_stats["opened"] > base["opened"], core.lane_stats
+    assert core.lane_stats["hits"] > base["hits"], core.lane_stats
+
+
+def test_lane_spillback_on_backlog(core):
+    """Saturating the pinned worker's in-flight window must fall back
+    to the normal scheduler without errors or lost results."""
+    cfg = get_config()
+    saved = cfg.task_lane_max_inflight
+    cfg.task_lane_max_inflight = 4
+    try:
+        @ray_tpu.remote
+        def slow_sq(x):
+            time.sleep(0.05)
+            return x * x
+
+        base = dict(core.lane_stats)
+        refs = [slow_sq.remote(i) for i in range(40)]
+        assert ray_tpu.get(refs, timeout=180) == [i * i
+                                                 for i in range(40)]
+        assert core.lane_stats["spills"] > base["spills"], \
+            core.lane_stats
+        assert core.lane_stats["hits"] > base["hits"], core.lane_stats
+    finally:
+        cfg.task_lane_max_inflight = saved
+
+
+def test_lane_worker_death_spills_and_recovers(core, tmp_path):
+    """Chaos: the pinned lane worker dies mid-call. Every in-flight
+    lane call spills to the slow path and retries; the lane is torn
+    down; the daemon auto-returns the dead worker's pinned lease, so
+    later work (and a re-warmed lane) proceeds normally."""
+    flag = str(tmp_path / "died_once")
+
+    @ray_tpu.remote
+    def maybe_die(x, flag_path):
+        if x == 13 and not os.path.exists(flag_path):
+            open(flag_path, "w").close()
+            os._exit(1)           # kill the pinned worker mid-call
+        return x + 1
+
+    cfg = get_config()
+    saved = cfg.task_lane_max_inflight
+    cfg.task_lane_max_inflight = 64   # keep the whole burst ON the lane
+    try:
+        base = dict(core.lane_stats)
+        refs = [maybe_die.remote(i, flag) for i in range(25)]
+        assert ray_tpu.get(refs, timeout=180) == [i + 1
+                                                 for i in range(25)]
+        assert os.path.exists(flag), "the lane worker was never killed"
+        assert core.lane_stats["closed"] > base["closed"], core.lane_stats
+        # No leaked lease / wedged pool: a fresh burst still completes.
+        refs = [maybe_die.remote(100 + i, flag) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=120) == [101 + i
+                                                 for i in range(8)]
+    finally:
+        cfg.task_lane_max_inflight = saved
+
+
+def test_lane_released_when_idle(core):
+    """An idle lane returns its pinned worker to the pool after
+    task_lane_idle_s, so lanes never strand capacity."""
+    cfg = get_config()
+    saved = cfg.task_lane_idle_s
+    cfg.task_lane_idle_s = 0.3
+    try:
+        @ray_tpu.remote
+        def ident(x):
+            return x
+
+        refs = [ident.remote(i) for i in range(10)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(10))
+        deadline = time.monotonic() + 30
+        while core._pinned_lanes and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not core._pinned_lanes, "idle lane was never reaped"
+    finally:
+        cfg.task_lane_idle_s = saved
