@@ -21,6 +21,12 @@ Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
                     RAY_TPU_TASK_EVENTS_RESOURCES) on vs off in paired
                     subprocess runs; asserts the best-pair slowdown is
                     <5% (--only opt-in, same reason as obs_overhead)
+  elastic_recovery  kill one rank of an 8-rank training gang mid-step;
+                    wall time from kill to the replacement rank's first
+                    completed step, elastic supervisor (PG kept, restart
+                    onto the reserved bundles) vs the cold path (tear
+                    down + re-reserve the whole gang) (--only opt-in:
+                    boots its own driver cluster and runs train jobs)
   many_tasks        10k short tasks through 4 submitters   (ref 589/s)
   many_actors       1k actor create+ping+kill              (ref 580/s)
   queued_flood      1M tasks queued behind a blocker       (ref 5163/s*)
@@ -385,6 +391,132 @@ def bench_attribution_overhead(quick: bool) -> None:
         f"{pairs}")
 
 
+def bench_elastic_recovery(quick: bool) -> None:
+    """Elastic-recovery probe (ISSUE 8): SIGKILL one rank of an 8-rank
+    gang mid-step and measure kill -> training-resumed wall time, where
+    "resumed" is the victim rank's replacement completing its first
+    step (pid beacon changes). Elastic mode keeps the placement group —
+    the restart lands on already-reserved bundles with prewarmed zygote
+    workers — vs the cold path which tears the gang down and re-runs
+    the whole two-phase reserve/commit. Both runs resume from the same
+    rank-0 checkpoint discipline, so the delta is pure scheduling."""
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train import (Checkpoint, DataParallelTrainer,
+                               FailureConfig, RunConfig, ScalingConfig)
+    from ray_tpu.util import chaos
+
+    world = 8
+    steps = 6 if quick else 10
+    victim = world - 1
+
+    def loop(config):
+        import json as _json
+        import os as _os
+        import tempfile as _tf
+        import time as _t
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(_os.path.join(ckpt.path, "state.json")) as f:
+                start = _json.load(f)["step"] + 1
+        for step in range(start, config["steps"]):
+            ck = None
+            if ctx.get_world_rank() == 0:   # rank 0 owns checkpoints
+                d = _tf.mkdtemp()
+                with open(_os.path.join(d, "state.json"), "w") as f:
+                    _json.dump({"step": step}, f)
+                ck = Checkpoint(d)
+            train.report({"step": step, "world": ctx.get_world_size()},
+                         checkpoint=ck)
+            with open(_os.path.join(
+                    config["dir"],
+                    f"pid_rank{ctx.get_world_rank()}"), "w") as f:
+                f.write(str(_os.getpid()))
+            _t.sleep(0.25)
+
+    def read_pid(path):
+        with open(path) as f:
+            return int(f.read())
+
+    def one_run(label: str, elastic: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix=f"elastic_probe_{label}_")
+        fc = FailureConfig(
+            elastic=elastic, max_failures=3, replace_timeout_s=60,
+            backoff_initial_s=0.05, backoff_max_s=0.1,
+            backoff_jitter=0.0, hang_timeout_s=120, grow_check_s=3600)
+        trainer = DataParallelTrainer(
+            loop, train_loop_config={"dir": tmp, "steps": steps},
+            scaling_config=ScalingConfig(
+                num_workers=world, resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(name=f"erec_{label}", storage_path=tmp,
+                                 failure_config=fc),
+            backend=None)
+        timing = {}
+        beacon = os.path.join(tmp, f"pid_rank{victim}")
+
+        def inject():
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                try:
+                    old = read_pid(beacon)
+                    break
+                except (OSError, ValueError):
+                    time.sleep(0.02)
+            else:
+                timing["error"] = "no pid beacon"
+                return
+            from types import SimpleNamespace
+
+            t0 = time.perf_counter()
+            chaos.kill_rank(SimpleNamespace(pids=[old]), 0)
+            while time.monotonic() < deadline:
+                try:
+                    if read_pid(beacon) != old:
+                        timing["recovery_s"] = time.perf_counter() - t0
+                        return
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.02)
+            timing["error"] = "rank never resumed"
+
+        th = threading.Thread(target=inject, daemon=True)
+        th.start()
+        result = trainer.fit()
+        th.join(timeout=30)
+        assert result.error is None, result.error
+        assert result.metrics["step"] == steps - 1, result.metrics
+        assert result.metrics["world"] == world, result.metrics
+        assert "recovery_s" in timing, timing
+        return timing
+
+    ray_tpu.init(num_cpus=world)
+    try:
+        # Warmup: pay worker-pool fill + import costs outside the
+        # measured runs so both modes see the same warm cluster.
+        one_run("warmup", True)
+        elastic = one_run("elastic", True)
+        cold = one_run("cold", False)
+    finally:
+        ray_tpu.shutdown()
+    emit("elastic_recovery_seconds", elastic["recovery_s"], "s",
+         world=world)
+    emit("cold_restart_recovery_seconds", cold["recovery_s"], "s",
+         world=world)
+    emit("elastic_recovery_speedup",
+         cold["recovery_s"] / elastic["recovery_s"], "x", world=world)
+    # The elastic path skips PG teardown + two-phase re-reserve of all
+    # 8 bundles; it must not LOSE to the cold restart (small tolerance
+    # for timeshared-host jitter).
+    assert elastic["recovery_s"] <= cold["recovery_s"] * 1.10, (
+        elastic, cold)
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     out_path = "BENCH_SCALE_r05.json"
@@ -401,7 +533,8 @@ def main() -> None:
     # Standalone probes first: each hosts its own in-process GCS/daemons
     # and must not share the driver's cluster.
     standalone = {"many_nodes", "object_transfer", "broadcast",
-                  "obs_overhead", "attribution_overhead"}
+                  "obs_overhead", "attribution_overhead",
+                  "elastic_recovery"}
     if want("many_nodes"):
         bench_many_nodes(quick)
     if want("object_transfer"):
@@ -415,6 +548,10 @@ def main() -> None:
     if want("attribution_overhead") and only is not None:
         # Subprocess-spawning probe, same opt-in rule as obs_overhead.
         bench_attribution_overhead(quick)
+    if want("elastic_recovery") and only is not None:
+        # Boots a driver cluster + three train jobs: opt-in so the
+        # default full suite doesn't triple its wall time.
+        bench_elastic_recovery(quick)
     if only is not None and not (only - standalone):
         _write_results(out_path, quick)
         return

@@ -250,6 +250,34 @@ class Config:
     memory_monitor_refresh_ms: int = 250
     memory_usage_threshold: float = 0.95
 
+    # ---- placement groups / gang scheduling ----
+    # Two-phase gang reserve (ref: gcs_placement_group_scheduler.h:274
+    # prepare/commit): a PREPAREd bundle the GCS never commits (GCS
+    # crash, peer-node prepare failure) auto-expires on the daemon after
+    # this long and its resources return to the pool — the timeout-
+    # bounded rollback that keeps a half-placed gang from leaking.
+    pg_prepare_ttl_s: float = 30.0
+    # On bundle COMMIT the daemon pre-warms one pool worker per bundle
+    # so gang start rides ~3ms zygote forks instead of cold spawns
+    # (RAY_TPU_PG_PREWARM_ENABLED=0 disables).
+    pg_prewarm_enabled: bool = True
+
+    # ---- elastic training plane (train/elastic.py) ----
+    # How long the elastic supervisor waits for a replacement bundle
+    # (gang back to CREATED) after a rank dies/hangs before it shrinks
+    # the gang to the largest feasible world size.
+    elastic_replace_timeout_s: float = 30.0
+    # Capped exponential backoff + jitter between gang restarts
+    # (RAY_TPU_ELASTIC_BACKOFF_*; FailureConfig fields override).
+    elastic_backoff_initial_s: float = 0.5
+    elastic_backoff_max_s: float = 15.0
+    elastic_backoff_multiplier: float = 2.0
+    # Fraction of the delay randomized away (0.2 => +/-20%).
+    elastic_backoff_jitter: float = 0.2
+    # Cadence of the shrunk supervisor's capacity probe for growing the
+    # gang back toward the target world size.
+    elastic_grow_check_s: float = 10.0
+
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout
     rpc_connect_timeout_s: int = 30

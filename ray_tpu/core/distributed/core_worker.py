@@ -2732,14 +2732,24 @@ class DistributedCoreWorker:
     # placement groups
     # ------------------------------------------------------------------
     def create_placement_group(self, pg_id, bundles, strategy,
-                               name=None, detached=False) -> None:
+                               name=None, detached=False,
+                               bundle_labels=None) -> None:
         self.gcs.call("PlacementGroups", "create_pg", pg_id=pg_id.hex(),
                       bundles=bundles, strategy=strategy, name=name,
-                      owner_job=self.job_id, detached=detached, timeout=60)
+                      owner_job=self.job_id, detached=detached,
+                      bundle_labels=bundle_labels, timeout=60)
 
     def get_placement_group(self, pg_id) -> Optional[dict]:
         return self.gcs.call("PlacementGroups", "get_pg", pg_id=pg_id.hex(),
                              timeout=30)
+
+    def wait_placement_group(self, pg_id, known_state: str = "",
+                             park_s: float = 2.0) -> Optional[dict]:
+        """Long-poll get_placement_group: returns when the gang's state
+        differs from `known_state`, or after `park_s`."""
+        return self.gcs.call("PlacementGroups", "wait_pg",
+                             pg_id=pg_id.hex(), known_state=known_state,
+                             park_s=park_s, timeout=park_s + 30)
 
     def remove_placement_group(self, pg_id) -> None:
         self.gcs.call("PlacementGroups", "remove_pg", pg_id=pg_id.hex(),

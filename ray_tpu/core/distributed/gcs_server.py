@@ -615,9 +615,15 @@ class PgRecord:
     strategy: str
     name: Optional[str] = None
     state: str = PG_PENDING
-    nodes: List[str] = dataclasses.field(default_factory=list)
+    # One entry per bundle once any placement happened; None marks a
+    # hole (bundle-granular gang repair re-places only the holes while
+    # surviving bundles stay reserved on their nodes).
+    nodes: List[Optional[str]] = dataclasses.field(default_factory=list)
     owner_job: str = ""
     detached: bool = False
+    # Soft per-bundle node-label preferences (ICI-topology ordering
+    # hint from tpu_slice_placement_group).
+    bundle_labels: Optional[List[Optional[Dict[str, str]]]] = None
 
 
 class PlacementGroupManager:
@@ -633,6 +639,8 @@ class PlacementGroupManager:
         self._store = store or NullStore()
         self.groups: Dict[str, PgRecord] = {}
         self._pending: asyncio.Queue = asyncio.Queue()
+        # Long-poll wait_pg futures, woken on any state transition.
+        self._state_waiters: Dict[str, List[asyncio.Future]] = {}
         for rec_dict in self._store.all("pg").values():
             rec = PgRecord(**rec_dict)
             self.groups[rec.pg_id] = rec
@@ -658,26 +666,37 @@ class PlacementGroupManager:
             if rec is None or rec.state != PG_CREATED:
                 continue
             missing = [nid for nid in rec.nodes
-                       if nid not in view.nodes
-                       or not view.nodes[nid].alive]
+                       if nid is not None
+                       and (nid not in view.nodes
+                            or not view.nodes[nid].alive)]
             if missing:
                 logger.warning(
                     "pg %s lost node(s) %s during GCS outage; "
-                    "re-reserving the gang", pg_id[:8],
+                    "re-reserving the lost bundles", pg_id[:8],
                     [m[:8] for m in missing])
+                rec.nodes = [None if nid in missing else nid
+                             for nid in rec.nodes]
                 rec.state = PG_PENDING
-                rec.nodes = []
                 self._persist(rec)
+                self._wake_waiters(pg_id)
                 self._pending.put_nowait(pg_id)
 
     def _persist(self, rec: PgRecord) -> None:
         self._store.put("pg", rec.pg_id, dataclasses.asdict(rec))
 
+    def _wake_waiters(self, pg_id: str) -> None:
+        for fut in self._state_waiters.pop(pg_id, ()):
+            if not fut.done():
+                fut.set_result(None)
+
     async def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
                         strategy: str, name: Optional[str] = None,
-                        owner_job: str = "", detached: bool = False) -> dict:
+                        owner_job: str = "", detached: bool = False,
+                        bundle_labels: Optional[List[Optional[Dict[
+                            str, str]]]] = None) -> dict:
         rec = PgRecord(pg_id=pg_id, bundles=bundles, strategy=strategy,
-                       name=name, owner_job=owner_job, detached=detached)
+                       name=name, owner_job=owner_job, detached=detached,
+                       bundle_labels=bundle_labels)
         self.groups[pg_id] = rec
         self._persist(rec)
         await self._pending.put(pg_id)
@@ -688,7 +707,34 @@ class PlacementGroupManager:
         if rec is None:
             return None
         return {"pg_id": rec.pg_id, "state": rec.state, "nodes": rec.nodes,
-                "bundles": rec.bundles, "strategy": rec.strategy}
+                "bundles": rec.bundles, "strategy": rec.strategy,
+                "placed": sum(1 for n in rec.nodes if n is not None),
+                "bundle_count": len(rec.bundles)}
+
+    async def wait_pg(self, pg_id: str, known_state: str = "",
+                      park_s: float = 2.0) -> Optional[dict]:
+        """Long-poll get_pg (same pattern as ActorManager.wait_actor):
+        return when the gang's state differs from `known_state`
+        (immediately if it already does), or after `timeout`. Drivers
+        blocking in PlacementGroup.ready() park here instead of
+        polling get_pg on a 50ms cadence."""
+        rec = self.groups.get(pg_id)
+        if rec is None or rec.state != known_state:
+            return self.get_pg(pg_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._state_waiters.setdefault(pg_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, park_s)
+        except asyncio.TimeoutError:
+            waiters = self._state_waiters.get(pg_id)
+            if waiters is not None:
+                try:
+                    waiters.remove(fut)
+                except ValueError:
+                    pass
+                if not waiters:
+                    self._state_waiters.pop(pg_id, None)
+        return self.get_pg(pg_id)
 
     def list_pgs(self) -> List[dict]:
         return [self.get_pg(pid) for pid in self.groups]
@@ -708,6 +754,8 @@ class PlacementGroupManager:
         if rec is None or rec.state == PG_REMOVED:
             return {"ok": False}
         for idx, nid in enumerate(rec.nodes):
+            if nid is None:
+                continue
             client = self._gcs.daemon_client(nid)
             if client is None:
                 continue
@@ -719,21 +767,32 @@ class PlacementGroupManager:
         rec.state = PG_REMOVED
         rec.nodes = []
         self._persist(rec)
+        self._wake_waiters(pg_id)
         return {"ok": True}
 
     def on_node_dead(self, node_id: str) -> None:
         for rec in self.groups.values():
-            if rec.state == PG_CREATED and node_id in rec.nodes:
-                # Re-reserve the whole gang (gang-granular recovery: a TPU
-                # slice loses a host => the slice's gang must re-form).
-                rec.state = PG_PENDING
-                rec.nodes = []
-                self._persist(rec)
-                self._gcs.event_log.emit(
-                    "placement_group", "WARNING",
-                    f"pg {rec.pg_id[:8]} gang lost node "
-                    f"{node_id[:8]}; re-reserving", pg_id=rec.pg_id)
-                self._pending.put_nowait(rec.pg_id)
+            if rec.state == PG_REMOVED or node_id not in rec.nodes:
+                continue
+            # Bundle-granular recovery: only the dead node's bundles
+            # become holes; surviving bundles stay reserved on their
+            # nodes while the scheduler re-places the holes (the
+            # elastic supervisor meanwhile keeps ranks on the
+            # survivors warm for the gang restart).
+            rec.nodes = [None if nid == node_id else nid
+                         for nid in rec.nodes]
+            was_created = rec.state == PG_CREATED
+            rec.state = PG_PENDING
+            self._persist(rec)
+            self._gcs.event_log.emit(
+                "placement_group", "WARNING",
+                f"pg {rec.pg_id[:8]} gang lost node "
+                f"{node_id[:8]}; re-reserving "
+                f"{sum(1 for n in rec.nodes if n is None)} bundle(s)",
+                pg_id=rec.pg_id)
+            if was_created:
+                self._wake_waiters(rec.pg_id)
+            self._pending.put_nowait(rec.pg_id)
 
     def on_job_finished(self, job_id: str) -> None:
         for rec in list(self.groups.values()):
@@ -767,39 +826,86 @@ class PlacementGroupManager:
                 except Exception:  # noqa: BLE001
                     pass
 
+    async def _call_bundle(self, nid: str, method: str, **kwargs) -> bool:
+        client = self._gcs.daemon_client(nid)
+        if client is None:
+            return False
+        try:
+            reply = await client.call("NodeDaemon", method,
+                                      timeout=10, **kwargs)
+            return bool(reply.get("ok", False))
+        except Exception:  # noqa: BLE001
+            return False
+
     async def _try_reserve(self, rec: PgRecord) -> bool:
+        """Two-phase atomic gang reserve (ref:
+        gcs_placement_group_scheduler.h:274 PREPARE then COMMIT).
+
+        All missing bundles are PREPAREd concurrently; any failure rolls
+        back every bundle prepared this round, so a half-placed gang
+        never leaks (daemon-side prepare TTLs backstop a GCS crash
+        between phases). Only after every prepare lands does COMMIT make
+        the bundles usable — and trigger the per-bundle worker prewarm.
+        Bundles already placed from a previous round (`rec.nodes`
+        non-None entries — gang repair after a node death) are kept, not
+        re-reserved."""
+        nodes_snapshot = list(rec.nodes)
+        preplaced: List[Optional[str]] = (
+            list(rec.nodes) if len(rec.nodes) == len(rec.bundles)
+            else [None] * len(rec.bundles))
         placement = place_bundles(self._gcs.nodes.view, rec.bundles,
-                                  rec.strategy)
+                                  rec.strategy, preplaced=preplaced,
+                                  bundle_labels=rec.bundle_labels)
         if placement is None:
             return False
-        reserved: List[Tuple[str, int]] = []
-        for idx, (nid, bundle) in enumerate(zip(placement, rec.bundles)):
-            client = self._gcs.daemon_client(nid)
-            ok = False
-            if client is not None:
-                try:
-                    reply = await client.call(
-                        "NodeDaemon", "reserve_pg_bundle", pg_id=rec.pg_id,
-                        bundle_idx=idx, resources=bundle, timeout=10)
-                    ok = reply.get("ok", False)
-                except Exception:  # noqa: BLE001
-                    ok = False
-            if ok:
-                reserved.append((nid, idx))
-            if not ok:
-                await self._return_bundles(rec.pg_id, reserved)
+        new_idxs = [i for i, pre in enumerate(preplaced) if pre is None]
+        if new_idxs:
+            prepared = await asyncio.gather(*[
+                self._call_bundle(placement[i], "reserve_pg_bundle",
+                                  pg_id=rec.pg_id, bundle_idx=i,
+                                  resources=rec.bundles[i])
+                for i in new_idxs])
+            this_round = [(placement[i], i)
+                          for i, ok in zip(new_idxs, prepared) if ok]
+            if not all(prepared):
+                await self._return_bundles(rec.pg_id, this_round)
+                return False
+            committed = await asyncio.gather(*[
+                self._call_bundle(placement[i], "commit_pg_bundle",
+                                  pg_id=rec.pg_id, bundle_idx=i)
+                for i in new_idxs])
+            if not all(committed):
+                # A daemon died (or expired the prepare) between the
+                # phases: the gang is not whole — release this round
+                # and retry from the survivors.
+                await self._return_bundles(rec.pg_id, this_round)
                 return False
         if rec.state == PG_REMOVED:
-            # remove_pg ran while we were reserving: it saw nodes=[] and
-            # made no return calls itself, so release everything here.
-            await self._return_bundles(rec.pg_id, reserved)
+            # remove_pg ran while we were reserving: it returned the
+            # bundles it knew of (rec.nodes at the time), not this
+            # round's — release those here.
+            await self._return_bundles(
+                rec.pg_id, [(placement[i], i) for i in new_idxs])
             return True
+        if list(rec.nodes) != nodes_snapshot:
+            # on_node_dead punched holes mid-reserve: committing
+            # `placement` would resurrect a dead node's bundle. Release
+            # this round and re-place against the updated holes.
+            await self._return_bundles(
+                rec.pg_id, [(placement[i], i) for i in new_idxs])
+            return False
         rec.nodes = placement
         rec.state = PG_CREATED
         self._persist(rec)
+        self._gcs.event_log.emit(
+            "placement_group", "INFO",
+            f"pg {rec.pg_id[:8]} gang committed "
+            f"({len(new_idxs)}/{len(rec.bundles)} bundles new)",
+            pg_id=rec.pg_id)
         self._gcs.pubsub.publish("pg", {"pg_id": rec.pg_id,
                                         "state": PG_CREATED,
                                         "nodes": placement})
+        self._wake_waiters(rec.pg_id)
         return True
 
 

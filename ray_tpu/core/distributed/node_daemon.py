@@ -182,6 +182,14 @@ class NodeDaemon:
                                               custom=custom_resources)
         self.available = dict(self.total)
         self.labels = labels or {}
+        # Auto-label with this host's TPU worker id so ICI-aware gangs
+        # (tpu_slice_placement_group bundle ordering) can prefer it.
+        if "TPU_WORKER_ID" not in self.labels:
+            from ray_tpu.core.distributed.accelerators import get_worker_id
+
+            wid = get_worker_id()
+            if wid is not None:
+                self.labels["TPU_WORKER_ID"] = str(wid)
         self.store_dir = store_dir or f"/dev/shm/raytpu_{self.node_id[:12]}"
         self.store = ObjectStore(self.store_dir,
                                  capacity=object_store_memory or 0)
@@ -685,6 +693,10 @@ class NodeDaemon:
             "raytpu_workers_prestarted_total",
             "Warm workers prestarted against lease backlog"
         ).set_default_tags(tags)
+        self._m_pg_prewarmed = Counter(
+            "raytpu_pg_prewarmed_workers_total",
+            "Warm workers prestarted on pg bundle commit"
+        ).set_default_tags(tags)
         self._m_heartbeat_failures = Counter(
             "raytpu_heartbeat_failures_total",
             "Heartbeat RPCs to the GCS that failed").set_default_tags(tags)
@@ -804,6 +816,9 @@ class NodeDaemon:
             "busy_workers": sum(1 for h in self._workers.values()
                                 if h.busy),
             "pg_bundles": len(self._pg_bundles),
+            "pg_bundles_uncommitted": sum(
+                1 for b in self._pg_bundles.values()
+                if not b.get("committed", True)),
             "zygotes": sum(1 for z in self._zygotes.values()
                            if z.alive()),
             "syncer": (dict(self.syncer.stats,
@@ -831,6 +846,20 @@ class NodeDaemon:
                     return {"ok": False}
                 return {"ok": True, "pid": h.proc.pid}
         return {"ok": False}
+
+    def signal_worker(self, sig: int, worker_id: Optional[str] = None,
+                      pid: Optional[int] = None) -> dict:
+        """Chaos-harness hook: deliver an arbitrary signal to one of
+        this node's workers (SIGSTOP makes a deterministic straggler,
+        SIGCONT heals it). Only pids the daemon owns are signalable."""
+        for h in self._workers.values():
+            if h.worker_id == worker_id or (pid and h.proc.pid == pid):
+                try:
+                    os.kill(h.proc.pid, int(sig))
+                except Exception as e:  # noqa: BLE001
+                    return {"ok": False, "error": str(e)}
+                return {"ok": True, "pid": h.proc.pid}
+        return {"ok": False, "error": "no such worker"}
 
     def kill_random_worker(self, include_actor_workers: bool = False,
                            seed: Optional[int] = None) -> dict:
@@ -1172,6 +1201,7 @@ class NodeDaemon:
                 min(1.0, max(0.25, len(self._workers) / 1000.0)))
             self._reap_idle_workers()
             self._maybe_prestart_workers()
+            self._expire_prepared_bundles()
             # Crashed zygotes: drop the handle (and relaunch the
             # default-env one eagerly — it is the hot path for every
             # pool/actor spawn; per-env zygotes relaunch on demand).
@@ -1259,6 +1289,8 @@ class NodeDaemon:
                                      f"bundle fitting {demand} here"}
                 placement = (pg_id, bundle_idx)
             bundle = self._pg_bundles.get((pg_id, bundle_idx))
+            if bundle is not None and not bundle.get("committed", True):
+                bundle = None  # prepared-only: unusable until commit
             if bundle is None:
                 spill = await self._pg_spill_target(pg_id, bundle_idx)
                 if spill:
@@ -1432,8 +1464,8 @@ class NodeDaemon:
             ok = False
             if placement is not None:
                 bundle = self._pg_bundles.get(tuple(placement))
-                if bundle is not None and rs.fits(bundle["available"],
-                                                  demand):
+                if (bundle is not None and bundle.get("committed", True)
+                        and rs.fits(bundle["available"], demand)):
                     rs.subtract(bundle["available"], demand)
                     ok = True
             elif rs.fits(self.available, demand):
@@ -1642,7 +1674,8 @@ class NodeDaemon:
 
     def _find_pg_bundle(self, pg_id: str, demand) -> Optional[int]:
         for (pid, idx), bundle in self._pg_bundles.items():
-            if pid == pg_id and rs.fits(bundle["available"], demand):
+            if (pid == pg_id and bundle.get("committed", True)
+                    and rs.fits(bundle["available"], demand)):
                 return idx
         return None
 
@@ -1683,15 +1716,86 @@ class NodeDaemon:
     # placement groups (ref: placement_group_resource_manager.h)
     # ------------------------------------------------------------------
     def reserve_pg_bundle(self, pg_id: str, bundle_idx: int,
-                          resources: Dict[str, float]) -> dict:
+                          resources: Dict[str, float],
+                          ttl_s: Optional[float] = None) -> dict:
+        """PREPARE phase of the two-phase gang reserve (ref:
+        gcs_placement_group_scheduler.h:274 prepare/commit): resources
+        leave the pool immediately, but the bundle is unusable (leases
+        and actors reject it) until commit_pg_bundle. If the GCS dies or
+        a peer node's prepare fails, the TTL sweep returns the resources
+        — a half-placed gang can never leak bundles."""
+        existing = self._pg_bundles.get((pg_id, bundle_idx))
+        if existing is not None:
+            # Idempotent re-prepare (GCS retry of a timed-out RPC whose
+            # first attempt actually landed): refresh the TTL.
+            if not existing["committed"]:
+                existing["expires_at"] = time.monotonic() + float(
+                    ttl_s or get_config().pg_prepare_ttl_s)
+            return {"ok": True}
         if not rs.fits(self.available, resources):
             return {"ok": False, "error": "insufficient resources"}
         rs.subtract(self.available, resources)
         self._pg_bundles[(pg_id, bundle_idx)] = {
             "resources": dict(resources),
             "available": dict(resources),
+            "committed": False,
+            "expires_at": time.monotonic() + float(
+                ttl_s or get_config().pg_prepare_ttl_s),
         }
         return {"ok": True}
+
+    def commit_pg_bundle(self, pg_id: str, bundle_idx: int) -> dict:
+        """COMMIT phase: the whole gang prepared, so this bundle becomes
+        usable (and permanent until returned). Pre-warms one pool worker
+        so the gang's actor/lease start rides a zygote fork."""
+        bundle = self._pg_bundles.get((pg_id, bundle_idx))
+        if bundle is None:
+            # Prepared bundle already expired or was rolled back — the
+            # GCS must treat the gang as failed and retry from scratch.
+            return {"ok": False, "error": "bundle not prepared"}
+        bundle["committed"] = True
+        bundle["expires_at"] = None
+        self._maybe_prewarm_for_bundle()
+        self._pump_lease_queue()
+        return {"ok": True}
+
+    def _maybe_prewarm_for_bundle(self) -> None:
+        """One warm default-env worker per committed bundle (bounded by
+        the warm-pool cap): gang start pops these instead of forking
+        inside the critical path."""
+        cfg = get_config()
+        if not (cfg.pg_prewarm_enabled and cfg.worker_prestart_enabled):
+            return
+        idle = len(self._idle)
+        starting = sum(1 for h in self._workers.values()
+                       if h.address is None and h.actor_id is None)
+        cap = int(cfg.zygote_warm_pool_cap or self._soft_limit)
+        if idle + starting >= cap:
+            return
+        try:
+            self._spawn_worker()
+        except Exception as e:  # noqa: BLE001
+            logger.debug("pg prewarm spawn failed: %s", e)
+            return
+        self._m_prestarted.inc()
+        self._m_pg_prewarmed.inc()
+
+    def _expire_prepared_bundles(self) -> None:
+        """TTL backstop for the prepare phase (runs from the monitor
+        loop): uncommitted bundles whose GCS never came back roll back
+        on their own."""
+        now = time.monotonic()
+        for key, bundle in list(self._pg_bundles.items()):
+            exp = bundle.get("expires_at")
+            if bundle.get("committed") or exp is None or now < exp:
+                continue
+            self._pg_bundles.pop(key, None)
+            rs.add(self.available, bundle["resources"])
+            logger.warning("prepared pg bundle %s:%d expired after "
+                           "%.1fs without commit; resources returned",
+                           key[0][:8], key[1],
+                           get_config().pg_prepare_ttl_s)
+            self._pump_lease_queue()
 
     def return_pg_bundle(self, pg_id: str, bundle_idx: int) -> dict:
         bundle = self._pg_bundles.pop((pg_id, bundle_idx), None)
@@ -1713,7 +1817,8 @@ class NodeDaemon:
         if placement is not None:
             placement = tuple(placement)
             bundle = self._pg_bundles.get(placement)
-            if bundle is None or not rs.fits(bundle["available"], demand):
+            if (bundle is None or not bundle.get("committed", True)
+                    or not rs.fits(bundle["available"], demand)):
                 return {"ok": False, "error": "pg bundle unavailable"}
             rs.subtract(bundle["available"], demand)
         else:

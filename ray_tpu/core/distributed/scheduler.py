@@ -201,7 +201,9 @@ def place_bundles(
     view: ClusterView,
     bundles: List[rs.ResourceSet],
     strategy: str,
-) -> Optional[List[str]]:
+    preplaced: Optional[List[Optional[str]]] = None,
+    bundle_labels: Optional[List[Optional[Dict[str, str]]]] = None,
+) -> Optional[List[Optional[str]]]:
     """Map each bundle to a node id, or None if unplaceable.
 
     PACK: minimize node count (all on one node if possible).
@@ -209,31 +211,54 @@ def place_bundles(
     STRICT_PACK: all bundles on a single node or fail — on TPU this is the
     slice-atomic gang (a pjit program's hosts must share an ICI domain).
     STRICT_SPREAD: each bundle on a distinct node or fail.
+
+    `preplaced[i]` pins bundle i to a node it is ALREADY reserved on
+    (bundle-granular gang repair: only the holes are placed; preplaced
+    bundles' resources are not re-counted — the daemons subtracted them
+    at reserve time). `bundle_labels[i]` is a soft per-bundle node-label
+    preference (ICI-topology hint): matching nodes are tried first, but
+    a non-matching node still satisfies the bundle.
     """
     alive = sorted(view.alive_nodes(),
                    key=lambda n: rs.utilization(n.total, n.available))
     if not alive:
         return None
+    preplaced = preplaced or [None] * len(bundles)
+    missing = [i for i, nid in enumerate(preplaced) if nid is None]
+    if not missing:
+        return list(preplaced)
 
     def try_fit_all_on(node: NodeView) -> bool:
         avail = dict(node.available)
-        for b in bundles:
-            if not rs.fits(avail, b):
+        for i in missing:
+            if not rs.fits(avail, bundles[i]):
                 return False
-            rs.subtract(avail, b)
+            rs.subtract(avail, bundles[i])
         return True
 
     if strategy in ("PACK", "STRICT_PACK"):
+        pinned = {nid for nid in preplaced if nid is not None}
+        if strategy == "STRICT_PACK" and pinned:
+            # The gang already lives on one node: holes must land there.
+            n = view.nodes.get(next(iter(pinned)))
+            if n is None or not n.alive or not try_fit_all_on(n):
+                return None
+            return [n.node_id if nid is None else nid for nid in preplaced]
         for n in alive:
             if try_fit_all_on(n):
-                return [n.node_id] * len(bundles)
+                return [n.node_id if nid is None else nid
+                        for nid in preplaced]
         if strategy == "STRICT_PACK":
             return None
         # PACK fallback: greedy first-fit over nodes.
-        return _greedy(alive, bundles, prefer_distinct=False)
+        return _greedy(alive, bundles, prefer_distinct=False,
+                       preplaced=preplaced, bundle_labels=bundle_labels)
 
     if strategy in ("SPREAD", "STRICT_SPREAD"):
-        placement = _greedy(alive, bundles, prefer_distinct=True)
+        placement = _greedy(alive, bundles, prefer_distinct=True,
+                            preplaced=preplaced,
+                            bundle_labels=bundle_labels,
+                            exclusive=(strategy == "STRICT_SPREAD"))
         if placement is None:
             return None
         if strategy == "STRICT_SPREAD" and len(set(placement)) != len(bundles):
@@ -243,18 +268,35 @@ def place_bundles(
     raise ValueError(f"unknown placement strategy {strategy}")
 
 
+def _labels_match(node: NodeView,
+                  selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(node.labels.get(k) == v for k, v in selector.items())
+
+
 def _greedy(nodes: List[NodeView], bundles: List[rs.ResourceSet],
-            prefer_distinct: bool) -> Optional[List[str]]:
+            prefer_distinct: bool,
+            preplaced: Optional[List[Optional[str]]] = None,
+            bundle_labels: Optional[List[Optional[Dict[str, str]]]] = None,
+            exclusive: bool = False) -> Optional[List[Optional[str]]]:
     avail = {n.node_id: dict(n.available) for n in nodes}
-    placement: List[str] = []
-    used_nodes: set = set()
-    for b in bundles:
+    preplaced = preplaced or [None] * len(bundles)
+    placement: List[Optional[str]] = list(preplaced)
+    used_nodes: set = {nid for nid in preplaced if nid is not None}
+    for i, b in enumerate(bundles):
+        if placement[i] is not None:
+            continue
         chosen = None
+        sel = bundle_labels[i] if bundle_labels else None
         candidates = sorted(
             nodes, key=lambda n: (n.node_id in used_nodes
                                   if prefer_distinct else False,
+                                  not _labels_match(n, sel),
                                   rs.utilization(n.total, avail[n.node_id])))
         for n in candidates:
+            if exclusive and n.node_id in used_nodes:
+                continue  # STRICT: preplaced/used nodes are off limits
             if rs.fits(avail[n.node_id], b):
                 chosen = n
                 break
@@ -262,5 +304,5 @@ def _greedy(nodes: List[NodeView], bundles: List[rs.ResourceSet],
             return None
         rs.subtract(avail[chosen.node_id], b)
         used_nodes.add(chosen.node_id)
-        placement.append(chosen.node_id)
+        placement[i] = chosen.node_id
     return placement

@@ -194,6 +194,25 @@ class ActorRuntime:
         pool.submit(run_sync)
 
 
+# Module-level progress probes: long-running in-process loops (e.g. the
+# train session) register a zero-arg callable returning a running-task
+# style entry whose `start_ts` is the loop's LAST PROGRESS timestamp.
+# `running_tasks` folds these in, so the daemon's hung-task watchdog
+# flags a loop that stopped reporting — not one that is merely long.
+_progress_probes: Dict[str, Any] = {}
+_progress_lock = threading.Lock()
+
+
+def register_progress_probe(name: str, fn) -> None:
+    with _progress_lock:
+        _progress_probes[name] = fn
+
+
+def unregister_progress_probe(name: str) -> None:
+    with _progress_lock:
+        _progress_probes.pop(name, None)
+
+
 class WorkerService:
     def __init__(self, core: DistributedCoreWorker, worker_id: str):
         self.core = core
@@ -1196,9 +1215,17 @@ class WorkerService:
         because a task is wedged holding the GIL)."""
         import time as _time
 
-        return {"now": _time.time(), "pid": os.getpid(),
-                "tasks": [dict(v)
-                          for v in list(self._running_info.values())]}
+        tasks = [dict(v) for v in list(self._running_info.values())]
+        with _progress_lock:
+            probes = list(_progress_probes.values())
+        for probe in probes:
+            try:
+                entry = probe()
+            except Exception:  # noqa: BLE001
+                continue
+            if entry:
+                tasks.append(dict(entry))
+        return {"now": _time.time(), "pid": os.getpid(), "tasks": tasks}
 
     def ping(self) -> dict:
         return {"ok": True, "pid": os.getpid(),

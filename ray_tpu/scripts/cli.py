@@ -109,7 +109,22 @@ def cmd_status(gcs: _Gcs, args) -> None:
     print(f"  actors: {len(actors)} "
           f"({', '.join(f'{k}={v}' for k, v in sorted(states.items()))})"
           if actors else "  actors: 0")
-    print(f"  placement groups: {len(pgs)}")
+    if pgs:
+        by_state: dict = {}
+        for pg in pgs:
+            by_state[pg["state"]] = by_state.get(pg["state"], 0) + 1
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(by_state.items()))
+        print(f"  placement groups: {len(pgs)} ({detail})")
+        # A gang mid-repair: some bundles placed, some holes being
+        # re-reserved — worth a line while it lasts.
+        for pg in pgs:
+            placed = pg.get("placed", 0)
+            total_b = pg.get("bundle_count", 0)
+            if pg["state"] == "PENDING" and 0 < placed < total_b:
+                print(f"    {pg['pg_id'][:12]} repairing: "
+                      f"{placed}/{total_b} bundles placed")
+    else:
+        print("  placement groups: 0")
     running = [j for j in jobs if not j.get("finished")]
     print(f"  jobs: {len(running)} running / {len(jobs)} total")
     # Observability rollup: task-event completeness + federation health.
@@ -136,6 +151,15 @@ def cmd_status(gcs: _Gcs, args) -> None:
         more = f" (+{len(hung) - 5} more)" if len(hung) > 5 else ""
         print(f"  HUNG tasks: {len(hung)} — {names}{more}  "
               f"(`ray-tpu stack --task <id>` for stacks)")
+    # Elastic training plane: recent gang restarts / shrinks / grows.
+    try:
+        ev = gcs.call("EventLog", "list_events", source="elastic", limit=5)
+    except Exception:  # noqa: BLE001 — pre-elastic GCS
+        return
+    if ev:
+        print(f"  elastic events (latest {len(ev)}):")
+        for e in ev:
+            print(f"    [{e.get('severity', '?')}] {e.get('message', '')}")
 
 
 def cmd_list(gcs: _Gcs, args) -> None:

@@ -29,16 +29,45 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     topology: Optional[str] = None       # e.g. "v5e-16" — slice-atomic gang
     mesh: Optional[MeshConfig] = None    # per-gang device mesh spec
+    # Elastic bounds (train/elastic.py): when a replacement bundle never
+    # materializes the supervisor may shrink the gang down to
+    # `min_workers` (default 1) and grow it back up to `max_workers`
+    # (default num_workers) when capacity returns.
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:
             return dict(self.resources_per_worker)
         return {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
 
+    def world_bounds(self) -> "tuple[int, int]":
+        lo = self.min_workers if self.min_workers is not None else 1
+        hi = self.max_workers if self.max_workers is not None \
+            else self.num_workers
+        return max(1, lo), max(1, hi)
+
 
 @dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0  # -1 = unlimited restarts
+    # Elastic fault tolerance (train/elastic.py): instead of tearing the
+    # whole gang down on one rank's death/hang, kill the flagged rank,
+    # reserve a replacement bundle, and gang-restart from the latest
+    # checkpoint — shrinking to a smaller world size when no
+    # replacement capacity appears within `replace_timeout_s`.
+    elastic: bool = False
+    # None => the RAY_TPU_ELASTIC_* config knobs.
+    replace_timeout_s: Optional[float] = None
+    backoff_initial_s: Optional[float] = None
+    backoff_max_s: Optional[float] = None
+    backoff_multiplier: Optional[float] = None
+    backoff_jitter: Optional[float] = None
+    grow_check_s: Optional[float] = None
+    # Per-rank poll deadline before the supervisor declares a rank hung
+    # (None => RAY_TPU_HANG_THRESHOLD_S; the daemon-side watchdog uses
+    # the same knob, so its verdicts and the supervisor's agree).
+    hang_timeout_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -77,6 +106,10 @@ class Result:
     # Result.config) — a real field set by both Tune and Trainer, not
     # smuggled through the metrics namespace.
     config: Optional[Dict[str, Any]] = None
+    # Elastic-run accounting (train/elastic.py): per-cause restart
+    # counts, shrink/grow events, and the final world size. None for
+    # non-elastic runs.
+    elastic: Optional[Dict[str, Any]] = None
 
     @property
     def best_checkpoint(self):

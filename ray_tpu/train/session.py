@@ -14,6 +14,7 @@ import os
 import queue
 import shutil
 import threading
+import time
 from typing import Any, Dict, Iterable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -36,6 +37,11 @@ class TrainSession:
         self.dataset_shards = dataset_shards or {}
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
+        # Last moment this rank made observable progress (a report()).
+        # The worker's progress probe ships this as a running-task
+        # start_ts, so the daemon's hung-task watchdog flags a loop
+        # that STOPPED reporting — not one that is merely long-running.
+        self.last_progress_ts = time.time()
         # Seed past any checkpoints a previous (failed) attempt persisted:
         # restarting from 0 would re-target checkpoint_000001... and mix
         # stale files into — or clobber — the dir we may be restoring from.
@@ -71,6 +77,7 @@ class TrainSession:
                 shutil.copytree(checkpoint.path, dest)
             persisted = dest
             self.latest_checkpoint = Checkpoint(persisted)
+        self.last_progress_ts = time.time()
         self.results.put({"metrics": dict(metrics), "checkpoint": persisted})
 
     def get_checkpoint(self) -> Optional[Checkpoint]:

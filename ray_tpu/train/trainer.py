@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (FailureConfig, Result, RunConfig,
                                   ScalingConfig)
+from ray_tpu.train.elastic import (RESTARTS_TOTAL, RestartBackoff,
+                                   classify_failure)
 from ray_tpu.train.worker_group import WorkerGroup
 
 logger = logging.getLogger(__name__)
@@ -72,7 +74,15 @@ class DataParallelTrainer:
 
     def fit(self) -> Result:
         fc: FailureConfig = self.run_config.failure_config
+        if fc.elastic:
+            # Elastic plane: per-rank verdicts, single-rank replacement
+            # on a kept PG, shrink-to-feasible-world when no replacement
+            # bundle appears, opportunistic grow-back.
+            from ray_tpu.train.elastic import ElasticSupervisor
+
+            return ElasticSupervisor(self).fit()
         max_failures = fc.max_failures
+        backoff = RestartBackoff(fc)
         attempt = 0
         latest_ckpt: Optional[str] = (
             self._resume.path if self._resume else None)
@@ -101,6 +111,7 @@ class DataParallelTrainer:
                               config=self._config)
             except _WorkerGroupFailure as e:
                 attempt += 1
+                RESTARTS_TOTAL.inc(tags={"cause": e.cause})
                 history.extend(e.history)
                 if e.latest_checkpoint:
                     latest_ckpt = e.latest_checkpoint
@@ -110,8 +121,11 @@ class DataParallelTrainer:
                                   error=RuntimeError(e.error),
                                   metrics_history=history,
                                   config=self._config)
-                logger.warning("train attempt %d failed, restarting from %s",
-                               attempt, latest_ckpt)
+                delay = backoff.next_delay()
+                logger.warning(
+                    "train attempt %d failed (%s), restarting from %s "
+                    "in %.1fs", attempt, e.cause, latest_ckpt, delay)
+                time.sleep(delay)
             finally:
                 group.shutdown()
 
@@ -128,7 +142,8 @@ class DataParallelTrainer:
                 # A worker actor/process died (the canonical failure
                 # FailureConfig covers) — surface as restartable.
                 raise _WorkerGroupFailure(
-                    f"worker group poll failed: {e!r}", latest_ckpt, history)
+                    f"worker group poll failed: {e!r}", latest_ckpt, history,
+                    cause=classify_failure(repr(e)))
             for rank, p in enumerate(polls):
                 for item in p["results"]:
                     if item["checkpoint"]:
@@ -146,11 +161,12 @@ class DataParallelTrainer:
 
 class _WorkerGroupFailure(Exception):
     def __init__(self, error: str, latest_checkpoint: Optional[str],
-                 history: list):
+                 history: list, cause: str = "error"):
         super().__init__(error)
         self.error = error
         self.latest_checkpoint = latest_checkpoint
         self.history = history
+        self.cause = cause  # death | hang | preemption | error
 
 
 class JaxTrainer(DataParallelTrainer):

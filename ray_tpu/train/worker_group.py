@@ -8,6 +8,11 @@ TPU-native difference: the gang is slice-atomic — bundles are per-host and
 STRICT_* strategies map a whole ICI domain; the user loop runs in a
 background thread inside each actor and results are drained by polling
 (the actor stays responsive without concurrency groups).
+
+Elastic additions: the group can be built ON an existing placement group
+(gang restart after a rank replacement keeps the surviving bundles), a
+single rank can be killed without tearing the gang down, and shutdown
+can leave the PG alive for the next attempt.
 """
 from __future__ import annotations
 
@@ -20,7 +25,8 @@ import ray_tpu
 from ray_tpu.train.session import TrainSession, install_session, uninstall_session
 from ray_tpu.train.backend import resolve_backend
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 
@@ -55,10 +61,13 @@ class TrainWorker:
         s.close()
         return socket.gethostbyname(socket.gethostname()), port
 
+    def pid(self) -> int:
+        return os.getpid()
+
     def start_loop(self, fn: Callable, config: Optional[dict],
                    master_env: Dict[str, str],
                    latest_checkpoint: Optional[str],
-                   dataset_shards: Optional[Dict[str, Any]] = None) -> bool:
+                   dataset_shards: Optional[Dict[str, Any]] = None) -> int:
         os.makedirs(self.trial_dir, exist_ok=True)
         ckpt = Checkpoint(latest_checkpoint) if latest_checkpoint else None
         self.session = TrainSession(
@@ -67,6 +76,7 @@ class TrainWorker:
             trial_dir=self.trial_dir, latest_checkpoint=ckpt,
             dataset_shards=dataset_shards,
             experiment_name=self.experiment_name)
+        self._install_progress_probe(self.session)
 
         def target():
             install_session(self.session)
@@ -82,10 +92,43 @@ class TrainWorker:
                 self.backend.on_shutdown()
                 uninstall_session()
                 self.session.finished.set()
+                self._remove_progress_probe()
 
         self._thread = threading.Thread(target=target, daemon=True)
         self._thread.start()
-        return True
+        return os.getpid()
+
+    def _install_progress_probe(self, session: TrainSession) -> None:
+        """Expose the train loop to the daemon's hung-task watchdog as a
+        synthetic running task whose start_ts is the LAST report() time:
+        a rank that stops reporting past RAY_TPU_HANG_THRESHOLD_S gets
+        flagged hung (and, SIGSTOPped, stops answering running_tasks —
+        the daemon's stale-snapshot fallback blames it the same way)."""
+        try:
+            from ray_tpu.core.distributed.worker_main import (
+                register_progress_probe)
+        except Exception:  # noqa: BLE001
+            return
+        rank = self.rank
+
+        def probe():
+            if session.finished.is_set():
+                return None
+            return {"task_id": f"train-loop-rank{rank}",
+                    "attempt": 0, "name": "train_loop",
+                    "job_id": None, "actor_id": None,
+                    "start_ts": session.last_progress_ts}
+
+        register_progress_probe(f"train-loop-rank{rank}", probe)
+
+    def _remove_progress_probe(self) -> None:
+        try:
+            from ray_tpu.core.distributed.worker_main import (
+                unregister_progress_probe)
+
+            unregister_progress_probe(f"train-loop-rank{self.rank}")
+        except Exception:  # noqa: BLE001
+            pass
 
     def poll(self) -> dict:
         """Drain queued results; report liveness + error state."""
@@ -97,18 +140,24 @@ class TrainWorker:
             "results": out,
             "finished": self.session.finished.is_set() if self.session else False,
             "error": self._error,
+            "pid": os.getpid(),
+            "last_progress_ts": (self.session.last_progress_ts
+                                 if self.session else None),
         }
 
 
 class WorkerGroup:
     def __init__(self, *, num_workers: int, resources: Dict[str, float],
                  strategy: str, backend_name, trial_dir: str,
-                 experiment_name: str):
+                 experiment_name: str, pg: Optional[PlacementGroup] = None,
+                 ready_timeout: float = 60.0):
         self.num_workers = num_workers
-        self.pg = placement_group([dict(resources)] * num_workers,
-                                  strategy=strategy)
-        if not self.pg.ready(timeout=60):
-            remove_placement_group(self.pg)
+        self._owns_pg = pg is None
+        self.pg = pg if pg is not None else placement_group(
+            [dict(resources)] * num_workers, strategy=strategy)
+        if not self.pg.ready(timeout=ready_timeout):
+            if self._owns_pg:
+                remove_placement_group(self.pg)
             raise ray_tpu.exceptions.PlacementGroupUnavailableError(
                 f"could not reserve {num_workers} x {resources}")
         cls = ray_tpu.remote(TrainWorker)
@@ -120,34 +169,49 @@ class WorkerGroup:
             ).remote(i, num_workers, backend_name, trial_dir, experiment_name)
             for i in range(num_workers)
         ]
+        # rank -> worker pid, learned from start_all (chaos/status use).
+        self.pids: List[Optional[int]] = [None] * num_workers
 
     def master_ip(self) -> str:
         return ray_tpu.get(self.workers[0].get_ip.remote())
 
-    def master_addr(self) -> "tuple[str, int]":
+    def master_addr(self, timeout: float = 60.0) -> "tuple[str, int]":
         """Rank-0's (ip, free-port), probed on rank-0's own host."""
         return tuple(ray_tpu.get(
-            self.workers[0].get_address_and_port.remote()))
+            self.workers[0].get_address_and_port.remote(), timeout=timeout))
 
     def start_all(self, fn, config, master_env, latest_checkpoint,
-                  shard_fn=None) -> None:
+                  shard_fn=None, timeout: Optional[float] = None) -> None:
         refs = []
         for i, w in enumerate(self.workers):
             shards = shard_fn(i, self.num_workers) if shard_fn else None
             refs.append(w.start_loop.remote(fn, config, master_env,
                                             latest_checkpoint, shards))
-        ray_tpu.get(refs)
+        self.pids = list(ray_tpu.get(refs, timeout=timeout))
 
     def poll_all(self) -> List[dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers])
 
-    def shutdown(self) -> None:
+    def poll_rank(self, rank: int, timeout: Optional[float] = None) -> dict:
+        """One rank's poll with a deadline (elastic supervisor: a rank
+        that cannot answer within the hang threshold is a straggler)."""
+        return ray_tpu.get(self.workers[rank].poll.remote(), timeout=timeout)
+
+    def kill_rank(self, rank: int) -> None:
+        """Kill ONE rank's actor process; the gang (and PG) survives."""
+        try:
+            ray_tpu.kill(self.workers[rank])
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self, remove_pg: bool = True) -> None:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
             except Exception:  # noqa: BLE001
                 pass
-        try:
-            remove_placement_group(self.pg)
-        except Exception:  # noqa: BLE001
-            pass
+        if remove_pg:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:  # noqa: BLE001
+                pass

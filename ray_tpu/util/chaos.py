@@ -8,6 +8,7 @@ daemons — the workload under test must complete correctly anyway.
 from __future__ import annotations
 
 import random
+import signal
 import threading
 import time
 from typing import List, Optional
@@ -118,3 +119,93 @@ class NodeKiller:
         if self._thread is not None:
             self._thread.join(timeout=10)
         return self.kills
+
+
+# ---- deterministic gang-targeted injectors ----------------------------
+#
+# The random killers above answer "does the cluster survive churn?"; the
+# elastic-training tests need the sharper question "does the gang survive
+# THIS rank failing THIS way?". These target one rank by its worker pid
+# (WorkerGroup.pids) and fan the signal across every node daemon — only
+# the daemon owning the pid acts on it.
+
+def _signal_pid(pid: int, sig: int) -> bool:
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core.distributed.rpc import SyncRpcClient
+
+    import ray_tpu
+
+    w = _global_worker()
+    for n in ray_tpu.nodes():
+        if not n["Alive"]:
+            continue
+        try:
+            client = SyncRpcClient(n["Address"], w.loop_thread)
+            try:
+                reply = client.call("NodeDaemon", "signal_worker",
+                                    sig=int(sig), pid=pid, timeout=10)
+            finally:
+                client.close()
+        except Exception:  # noqa: BLE001 — that daemon may be dying
+            continue
+        if reply.get("ok"):
+            return True
+    return False
+
+
+def kill_rank(group, rank: int) -> bool:
+    """SIGKILL one rank's worker process mid-step (death injection)."""
+    pid = group.pids[rank]
+    return pid is not None and _signal_pid(pid, signal.SIGKILL)
+
+
+def sigstop_rank(group, rank: int) -> bool:
+    """Freeze one rank (SIGSTOP): a deterministic straggler that still
+    holds its lease — exactly what the hang watchdog must catch."""
+    pid = group.pids[rank]
+    return pid is not None and _signal_pid(pid, signal.SIGSTOP)
+
+
+def sigcont_rank(group, rank: int) -> bool:
+    """Thaw a SIGSTOPped rank."""
+    pid = group.pids[rank]
+    return pid is not None and _signal_pid(pid, signal.SIGCONT)
+
+
+class DelayedPartition:
+    """SIGSTOPs one cluster_utils node's DAEMON process after a delay —
+    the node falls silent (misses heartbeats, drops RPCs) without its
+    workers dying: a network partition as the control plane sees one.
+    heal() SIGCONTs it; stop() heals and joins."""
+
+    def __init__(self, node, delay_s: float = 1.0):
+        self.node = node
+        self.delay_s = delay_s
+        self._timer: Optional[threading.Timer] = None
+        self.partitioned = threading.Event()
+
+    def start(self) -> "DelayedPartition":
+        self._timer = threading.Timer(self.delay_s, self._partition)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def _partition(self) -> None:
+        try:
+            self.node.proc.send_signal(signal.SIGSTOP)
+            self.partitioned.set()
+        except Exception:  # noqa: BLE001 — node already gone
+            pass
+
+    def heal(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        if self.partitioned.is_set():
+            try:
+                self.node.proc.send_signal(signal.SIGCONT)
+            except Exception:  # noqa: BLE001
+                pass
+            self.partitioned.clear()
+
+    def stop(self) -> None:
+        self.heal()

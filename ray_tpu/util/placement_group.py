@@ -9,7 +9,7 @@ are co-scheduled inside one ICI domain.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ray_tpu.core.ids import PlacementGroupID
 
@@ -28,18 +28,29 @@ class PlacementGroup:
         return len(self.bundle_specs)
 
     def ready(self, timeout: Optional[float] = None) -> bool:
-        """Block until reserved (or timeout); returns created-ness."""
+        """Block until reserved (or timeout); returns created-ness.
+
+        Long-polls the GCS (wait_pg, same pattern as actor resolution):
+        the reply arrives on the gang's next state TRANSITION, so a
+        pending gang costs one parked RPC per ~2s instead of a 50ms
+        polling loop per waiting driver."""
         from ray_tpu.api import _global_worker
 
         worker = _global_worker()
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            info = worker.get_placement_group(self.id)
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                info = worker.get_placement_group(self.id)
+                return info is not None and info["state"] == "CREATED"
+            park = 2.0 if remaining is None else min(2.0, remaining)
+            info = worker.wait_placement_group(
+                self.id, known_state="PENDING", park_s=park)
             if info is not None and info["state"] == "CREATED":
                 return True
-            if deadline is not None and time.monotonic() >= deadline:
+            if info is None or info["state"] == "REMOVED":
                 return False
-            time.sleep(0.05)
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         return self.ready(timeout=timeout_seconds)
@@ -51,7 +62,9 @@ class PlacementGroup:
 def placement_group(bundles: List[Dict[str, float]],
                     strategy: str = "PACK",
                     name: Optional[str] = None,
-                    lifetime: Optional[str] = None) -> PlacementGroup:
+                    lifetime: Optional[str] = None,
+                    bundle_labels: Optional[List[Optional[Dict[
+                        str, str]]]] = None) -> PlacementGroup:
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles or any(not b for b in bundles):
@@ -62,7 +75,7 @@ def placement_group(bundles: List[Dict[str, float]],
     pg_id = PlacementGroupID.generate()
     worker.create_placement_group(
         pg_id, [dict(b) for b in bundles], strategy, name=name,
-        detached=(lifetime == "detached"))
+        detached=(lifetime == "detached"), bundle_labels=bundle_labels)
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
 
 
@@ -78,12 +91,55 @@ def placement_group_table() -> List[dict]:
     return _global_worker().list_placement_groups()
 
 
-def tpu_slice_placement_group(num_hosts: int, chips_per_host: int = 4,
-                              cpus_per_host: float = 1.0) -> PlacementGroup:
+def ici_snake_order(num_hosts: int,
+                    topology: Optional[str] = None) -> List[int]:
+    """Bundle index -> TPU worker id, snaking through the host grid.
+
+    A pjit program's collectives run fastest when consecutive ranks are
+    ICI neighbours; a boustrophedon walk of the host grid keeps every
+    adjacent pair one hop apart. `topology` is the host grid as "XxY"
+    (e.g. "4x4"); None or a 1-D grid degrades to identity."""
+    if not topology or "x" not in topology:
+        return list(range(num_hosts))
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        return list(range(num_hosts))
+    cols = dims[0]
+    rows = max(1, num_hosts // cols) if cols else 1
+    order: List[int] = []
+    for r in range(rows):
+        row = list(range(r * cols, min((r + 1) * cols, num_hosts)))
+        order.extend(reversed(row) if r % 2 else row)
+    order.extend(range(len(order), num_hosts))  # ragged tail
+    return order[:num_hosts]
+
+
+def tpu_slice_placement_group(
+        num_hosts: int, chips_per_host: int = 4,
+        cpus_per_host: float = 1.0,
+        topology: Optional[str] = None,
+        bundle_order: Optional[Callable[[int, Optional[str]],
+                                        List[int]]] = None
+) -> PlacementGroup:
     """A slice-atomic gang: one bundle per TPU host, STRICT_SPREAD across
     hosts (the TPU-native replacement for the reference's
     `TPU-{pod_type}-head` + per-host TPU resource pattern,
-    ref: _private/accelerators/tpu.py:382)."""
+    ref: _private/accelerators/tpu.py:382).
+
+    `topology`/`bundle_order` pick an ICI-aware bundle ordering: bundle
+    i carries a soft label preference for the TPU host whose worker id
+    is order[i], so rank i of the gang lands on an ICI neighbour of
+    rank i±1 (snake order by default; pass `bundle_order` for other
+    wirings). The preference is soft — placement still succeeds on
+    clusters without TPU_WORKER_ID labels."""
+    order = (bundle_order(num_hosts, topology) if bundle_order is not None
+             else ici_snake_order(num_hosts, topology))
+    if sorted(order) != list(range(num_hosts)):
+        raise ValueError(f"bundle_order must permute 0..{num_hosts - 1}, "
+                         f"got {order}")
     bundles = [{"CPU": cpus_per_host, "TPU": float(chips_per_host)}
                for _ in range(num_hosts)]
-    return placement_group(bundles, strategy="STRICT_SPREAD")
+    labels = [{"TPU_WORKER_ID": str(order[i])} for i in range(num_hosts)]
+    return placement_group(bundles, strategy="STRICT_SPREAD",
+                           bundle_labels=labels)

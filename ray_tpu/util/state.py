@@ -167,6 +167,20 @@ def hung_tasks() -> List[dict]:
                        timeout=30).get("hung_tasks", [])
 
 
+def elastic_events(limit: int = 100) -> List[dict]:
+    """Elastic-training plane events (gang restarts, shrinks, grows,
+    replacement timeouts) emitted by the ElasticSupervisor via the GCS
+    event log."""
+    return _gcs().call("EventLog", "list_events", source="elastic",
+                       limit=limit, timeout=30)
+
+
+def placement_groups() -> List[dict]:
+    """All placement groups with gang state: per-PG `placed`/
+    `bundle_count` shows a gang mid-repair (holes being re-reserved)."""
+    return _gcs().call("PlacementGroups", "list_pgs", timeout=30)
+
+
 def cluster_status() -> dict:
     """The autoscaler's view: demand, idle times, resource requests —
     enriched with the observability rollup (metrics federation

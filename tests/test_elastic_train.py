@@ -1,0 +1,328 @@
+"""Elastic training chaos matrix (ISSUE 8 tentpole layers 2-3).
+
+Each test injects one gang failure and asserts the SAME two invariants:
+the job finishes with the right final metrics, and checkpoint steps are
+monotonic across every restart (a resume must never replay or clobber a
+committed step). Injection is driver-side via the deterministic chaos
+injectors (util/chaos.py) targeting rank pids the workers beacon into
+the trial dir.
+"""
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, DataParallelTrainer, FailureConfig,
+                           RunConfig, ScalingConfig)
+from ray_tpu.util import chaos
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _make_loop(total_steps: int):
+    """Checkpoint-per-step loop that beacons each rank's pid so the
+    driver can aim chaos at a specific rank. Optional gate: at
+    config["gate_step"], while the world size still equals
+    config["gate_world"], dawdle (bounded) — keeps fast ranks from
+    finishing the whole job before the injected failure lands, without
+    ever deadlocking the suite."""
+    def loop(config):
+        import tempfile
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        for step in range(start, total_steps):
+            # Rank 0 owns checkpointing (the usual DP discipline): the
+            # latest checkpoint then never regresses to a slower rank's
+            # step, which keeps resume monotonic.
+            ck = None
+            if ctx.get_world_rank() == 0:
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "state.json"), "w") as f:
+                    json.dump({"step": step}, f)
+                ck = Checkpoint(d)
+            train.report({"step": step, "world": ctx.get_world_size()},
+                         checkpoint=ck)
+            with open(os.path.join(
+                    config["dir"],
+                    f"pid_rank{ctx.get_world_rank()}"), "w") as f:
+                f.write(str(os.getpid()))
+            if (step == config.get("gate_step")
+                    and ctx.get_world_size() == config.get("gate_world")):
+                deadline = time.time() + 45
+                while time.time() < deadline:
+                    time.sleep(0.2)
+            time.sleep(config.get("sleep", 0.3))
+    return loop
+
+
+def _wait_pid(path: str, timeout: float = 60.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                return int(f.read())
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise TimeoutError(f"no pid beacon at {path}")
+
+
+def _assert_ckpt_monotonic(trial_dir: str) -> None:
+    """checkpoint_NNNNNN sequence order must imply non-decreasing train
+    steps — a restart that replayed or clobbered a committed step would
+    break this."""
+    seqs = sorted(
+        n for n in os.listdir(trial_dir) if n.startswith("checkpoint_"))
+    steps = []
+    for n in seqs:
+        with open(os.path.join(trial_dir, n, "state.json")) as f:
+            steps.append(json.load(f)["step"])
+    assert steps == sorted(steps), f"non-monotonic steps {steps} in {seqs}"
+
+
+def _elastic_fc(**overrides) -> FailureConfig:
+    base = dict(elastic=True, max_failures=3, replace_timeout_s=20,
+                backoff_initial_s=0.1, backoff_max_s=0.5,
+                backoff_jitter=0.0, hang_timeout_s=60, grow_check_s=3600)
+    base.update(overrides)
+    return FailureConfig(**base)
+
+
+def test_kill_rank_mid_step_replaced_in_place(ray_cluster, tmp_path_factory):
+    """SIGKILL rank 1 mid-step: the supervisor classifies a death,
+    keeps the PG (worker-only death leaves the bundle reserved), and
+    gang-restarts from the latest checkpoint at the SAME world size."""
+    tmp = str(tmp_path_factory.mktemp("ek"))
+    run = RunConfig(name="ekill", storage_path=tmp,
+                    failure_config=_elastic_fc())
+    trainer = DataParallelTrainer(
+        _make_loop(6), train_loop_config={"dir": tmp, "sleep": 0.3},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=run, backend=None)
+
+    def inject():
+        pid = _wait_pid(os.path.join(tmp, "pid_rank1"))
+        assert chaos.kill_rank(SimpleNamespace(pids=[pid]), 0)
+
+    th = threading.Thread(target=inject, daemon=True)
+    th.start()
+    result = trainer.fit()
+    th.join(timeout=10)
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
+    assert result.metrics["world"] == 2          # replaced, not shrunk
+    assert result.elastic["restarts"]["death"] >= 1, result.elastic
+    assert result.elastic["shrinks"] == 0, result.elastic
+    _assert_ckpt_monotonic(run.resolve_storage())
+
+
+def test_sigstop_straggler_flagged_and_replaced(ray_cluster,
+                                                tmp_path_factory):
+    """SIGSTOP rank 1 past the hang threshold: the supervisor's
+    progress/ responsiveness verdict (same RAY_TPU_HANG_THRESHOLD_S knob
+    as the daemon watchdog) kills the straggler — SIGKILL lands on a
+    stopped process — and the job still finishes."""
+    tmp = str(tmp_path_factory.mktemp("es"))
+    run = RunConfig(name="estop", storage_path=tmp,
+                    failure_config=_elastic_fc(hang_timeout_s=2))
+    trainer = DataParallelTrainer(
+        _make_loop(6), train_loop_config={"dir": tmp, "sleep": 0.2},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=run, backend=None)
+
+    def inject():
+        pid = _wait_pid(os.path.join(tmp, "pid_rank1"))
+        assert chaos.sigstop_rank(SimpleNamespace(pids=[pid]), 0)
+
+    th = threading.Thread(target=inject, daemon=True)
+    th.start()
+    result = trainer.fit()
+    th.join(timeout=10)
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
+    assert result.elastic["restarts"]["hang"] >= 1, result.elastic
+    _assert_ckpt_monotonic(run.resolve_storage())
+
+
+def test_jax_psum_survives_mid_step_kill(ray_cluster, tmp_path_factory):
+    """Acceptance criterion: kill a worker mid-psum-loop; the elastic
+    restart re-forms jax.distributed over fresh processes and the final
+    collective is still correct for the full world."""
+    tmp = str(tmp_path_factory.mktemp("ej"))
+
+    def loop(config):
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        n_local = jax.local_device_count()
+        psum = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")
+        for step in range(start, 4):
+            out = psum(jnp.ones((n_local,)))
+            ck = None
+            if ctx.get_world_rank() == 0:
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "state.json"), "w") as f:
+                    json.dump({"step": step}, f)
+                ck = Checkpoint(d)
+            train.report({"step": step, "psum": float(out[0]),
+                          "procs": jax.process_count(),
+                          "global_devices": jax.device_count()},
+                         checkpoint=ck)
+            with open(os.path.join(
+                    config["dir"],
+                    f"pid_rank{ctx.get_world_rank()}"), "w") as f:
+                f.write(str(os.getpid()))
+            time.sleep(0.3)
+
+    run = RunConfig(name="ejax", storage_path=tmp,
+                    failure_config=_elastic_fc())
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"dir": tmp},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=run, backend="jax")
+
+    def inject():
+        pid = _wait_pid(os.path.join(tmp, "pid_rank1"), timeout=120)
+        assert chaos.kill_rank(SimpleNamespace(pids=[pid]), 0)
+
+    th = threading.Thread(target=inject, daemon=True)
+    th.start()
+    result = trainer.fit()
+    th.join(timeout=10)
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+    assert result.metrics["procs"] == 2
+    # psum of ones over the global axis == total devices: the collective
+    # crossed the (replaced) process boundary correctly after restart.
+    assert result.metrics["psum"] == result.metrics["global_devices"]
+    assert result.elastic["restarts"]["death"] >= 1, result.elastic
+    _assert_ckpt_monotonic(run.resolve_storage())
+
+
+# ---- standalone-cluster scenarios (own GCS; run after the module
+# fixture tests so they can ray_tpu.shutdown() freely) ------------------
+
+def test_no_capacity_shrinks_then_resumes(tmp_path_factory, monkeypatch):
+    """Remove a whole node mid-run with nowhere to re-place the bundle:
+    within RAY_TPU_ELASTIC_REPLACE_TIMEOUT_S the supervisor gives up on
+    replacement, re-forms the gang at world=1 (>= min_workers), and the
+    job finishes from the latest checkpoint."""
+    from ray_tpu.cluster_utils import Cluster
+
+    # Fast node-death verdicts (the GCS subprocess inherits these): the
+    # test exercises the shrink path, not the health-check default.
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_MS", "500")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", "3")
+    ray_tpu.shutdown()
+    tmp = str(tmp_path_factory.mktemp("eshrink"))
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    second = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes(2)
+    try:
+        run = RunConfig(
+            name="eshrink", storage_path=tmp,
+            failure_config=_elastic_fc(replace_timeout_s=3,
+                                       max_failures=5))
+        trainer = DataParallelTrainer(
+            _make_loop(8),
+            train_loop_config={"dir": tmp, "sleep": 0.2,
+                               "gate_step": 5, "gate_world": 2},
+            scaling_config=ScalingConfig(num_workers=2, min_workers=1,
+                                         resources_per_worker={"CPU": 1}),
+            run_config=run, backend=None)
+
+        def inject():
+            # Both ranks running + first checkpoint committed, then the
+            # second node vanishes for good.
+            _wait_pid(os.path.join(tmp, "pid_rank0"))
+            _wait_pid(os.path.join(tmp, "pid_rank1"))
+            deadline = time.monotonic() + 60
+            trial = run.resolve_storage()
+            while time.monotonic() < deadline:
+                if any(n.startswith("checkpoint_")
+                       for n in os.listdir(trial)):
+                    break
+                time.sleep(0.1)
+            cluster.remove_node(second)
+
+        th = threading.Thread(target=inject, daemon=True)
+        th.start()
+        result = trainer.fit()
+        th.join(timeout=30)
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 7
+        assert result.metrics["world"] == 1      # finished shrunk
+        assert result.elastic["shrinks"] >= 1, result.elastic
+        assert result.elastic["final_world"] == 1, result.elastic
+        _assert_ckpt_monotonic(run.resolve_storage())
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_grow_back_when_capacity_returns(tmp_path_factory):
+    """Shrunk gang grows back: start at world=1 on a 1-node cluster with
+    target 2, add a node mid-run, and the grow probe re-forms the gang
+    at world=2 before the job finishes."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    tmp = str(tmp_path_factory.mktemp("egrow"))
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.connect()
+    cluster.wait_for_nodes(1)
+    try:
+        run = RunConfig(
+            name="egrow", storage_path=tmp,
+            failure_config=_elastic_fc(replace_timeout_s=3,
+                                       grow_check_s=1.0, max_failures=5))
+        trainer = DataParallelTrainer(
+            _make_loop(10),
+            train_loop_config={"dir": tmp, "sleep": 0.2,
+                               "gate_step": 5, "gate_world": 1},
+            scaling_config=ScalingConfig(num_workers=2, min_workers=1,
+                                         resources_per_worker={"CPU": 1}),
+            run_config=run, backend=None)
+
+        def inject():
+            _wait_pid(os.path.join(tmp, "pid_rank0"), timeout=120)
+            cluster.add_node(num_cpus=1)
+
+        th = threading.Thread(target=inject, daemon=True)
+        th.start()
+        result = trainer.fit()
+        th.join(timeout=30)
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 9
+        assert result.metrics["world"] == 2      # finished grown
+        assert result.elastic["grows"] >= 1, result.elastic
+        assert result.elastic["final_world"] == 2, result.elastic
+        _assert_ckpt_monotonic(run.resolve_storage())
+    finally:
+        cluster.shutdown()
