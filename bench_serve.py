@@ -1,12 +1,23 @@
-"""Serve benchmark: req/s + p50/p95 TTFT for the continuous-batching LLM
-deployment over the async HTTP proxy with chunked token streaming.
+"""Serve benchmark: three probes over the serving plane.
 
-North-star metrics from BASELINE.json ("Serve req/s + p50 TTFT") — no
-reference numbers exist in-repo (BASELINE.md: "must be established by our
-own runs"), so vs_baseline is null. Prints one JSON line per metric.
+  http_stream   legacy end-to-end probe: continuous-batching deployment
+                behind the async HTTP proxy with chunked token streaming
+                (req/s + TTFT percentiles; comparable to
+                BENCH_SERVE_TPU_LAST_GOOD.json).
+  engine_fixed  fixed-slot LLMEngine driven directly by N concurrent
+                streaming clients (tokens/s, p50/p99 TTFT + ITL).
+  engine_paged  paged KV-cache PagedLLMEngine at EQUAL HBM (same
+                KV-token budget as engine_fixed: num_slots*max_len
+                tokens carved into blocks) under the same N streams —
+                the apples-to-apples claim for the paged engine.
 
-Usage: python bench_serve.py [--model tiny] [--requests 64]
-       [--concurrency 16] [--max-tokens 32]
+At stream counts far above the fixed engine's slot count, TTFT is
+admission-LIMITED (queueing behind slot admission dominates prefill);
+the artifact labels the regime explicitly so percentiles aren't
+misread.
+
+Usage: python bench_serve.py [--only http,fixed,paged] [--round 13]
+       [--streams 1024] [--out BENCH_SERVE_r13.json]
 """
 from __future__ import annotations
 
@@ -23,59 +34,27 @@ def emit(metric: str, value: float, unit: str) -> None:
                       "unit": unit, "vs_baseline": None}), flush=True)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="tiny")
-    ap.add_argument("--requests", type=int, default=64)
-    # TTFT is only interpretable when every in-flight request holds an
-    # engine slot: at concurrency > num_slots half the requests queue
-    # behind slot admission and p50 TTFT measures queueing, not prefill
-    # (round-3 artifact pitfall). Default concurrency == num_slots;
-    # push it higher only to measure saturation throughput.
-    ap.add_argument("--concurrency", type=int, default=None,
-                    help="default: num-slots (admission-free TTFT)")
-    ap.add_argument("--max-tokens", type=int, default=32)
-    ap.add_argument("--num-slots", type=int, default=8)
-    ap.add_argument("--max-len", type=int, default=256)
-    # The bench sends ONE repeated prompt, so the engine's prefix cache
-    # (default-on in production) would turn every measured TTFT into an
-    # HBM copy instead of prefill — exactly what the ttft_regime claim
-    # says this measures. Off by default HERE; pass >0 to measure the
-    # hit path explicitly.
-    ap.add_argument("--prefix-cache-size", type=int, default=0)
-    ap.add_argument("--out", default=None,
-                    help="also write a committed artifact JSON "
-                         "(metrics + engine config + host context)")
-    args = ap.parse_args()
-    if args.concurrency is None:
-        args.concurrency = args.num_slots
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
 
-    import os
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # Child workers re-run sitecustomize, which re-registers the real
-        # TPU plugin and overrides JAX_PLATFORMS — any jax call in a
-        # replica then hangs when the TPU tunnel is down. Dropping the
-        # trigger env makes children honor the requested CPU platform
-        # (same guard as tests/conftest.py; bench.py probes instead).
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        # Pin THIS driver too: the axon register hook beats the env var
-        # via the config API, and the artifact-metadata
-        # jax.default_backend() call at the end would otherwise hang
-        # initializing the tunnel backend when it is down (observed:
-        # the whole bench completed, then hung writing metadata).
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
+# ---------------------------------------------------------------------------
+# probe: http_stream (legacy end-to-end path)
+# ---------------------------------------------------------------------------
+def probe_http(args) -> dict:
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve.llm import LLMDeployment
 
+    concurrency = args.concurrency or args.num_slots
     ray_tpu.init(num_cpus=4)
     serve.run(
         serve.deployment(LLMDeployment).bind(
-            args.model, num_slots=args.num_slots, max_len=args.max_len,
+            args.model, engine="fixed", num_slots=args.num_slots,
+            max_len=args.max_len,
             prefix_cache_size=args.prefix_cache_size),
         name="llm", _http=True, route_prefix="/llm")
     port = serve.http_port()
@@ -94,20 +73,19 @@ def main() -> None:
         raise RuntimeError(f"llm replicas never became ready: "
                            f"{serve.status()}")
 
-    # Warmup: trigger prefill/decode compiles before timing.
     def one_request(prompt_len: int = 16):
         body = json.dumps({"tokens": list(range(1, prompt_len + 1)),
                            "max_tokens": args.max_tokens}).encode()
         t0 = time.perf_counter()
         resp = urllib.request.urlopen(
             urllib.request.Request(url, data=body), timeout=600)
-        first = resp.readline()
+        resp.readline()
         ttft = time.perf_counter() - t0
         ntok = 1 + sum(1 for _ in resp)
         total = time.perf_counter() - t0
         return ttft, total, ntok
 
-    one_request()
+    one_request()   # warmup: trigger prefill/decode compiles
     one_request(64)
 
     ttfts: list = []
@@ -129,9 +107,9 @@ def main() -> None:
                 totals.append(total)
                 tokens[0] += ntok
 
-    per = max(1, args.requests // args.concurrency)
+    per = max(1, args.requests // concurrency)
     threads = [threading.Thread(target=worker, args=(per,))
-               for _ in range(args.concurrency)]
+               for _ in range(concurrency)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -139,24 +117,283 @@ def main() -> None:
         t.join()
     wall = time.perf_counter() - t0
 
+    serve.shutdown()
+    ray_tpu.shutdown()
+
     n = len(ttfts)
     if n == 0:
-        raise SystemExit("all requests failed")
+        raise SystemExit("http probe: all requests failed")
     ttfts.sort()
-    results = {
-        "serve_requests_per_second": (round(n / wall, 2), "req/s"),
-        "serve_ttft_p50_ms": (round(1000 * ttfts[n // 2], 1), "ms"),
-        "serve_ttft_p95_ms": (
-            round(1000 * ttfts[min(n - 1, int(n * 0.95))], 1), "ms"),
-        "serve_latency_mean_ms": (
-            round(1000 * statistics.mean(totals), 1), "ms"),
-        "serve_tokens_per_second": (round(tokens[0] / wall, 1),
-                                    "tokens/s"),
+    return {
+        "requests_per_second": {"value": round(n / wall, 2),
+                                "unit": "req/s"},
+        "ttft_p50_ms": {"value": round(1000 * ttfts[n // 2], 1),
+                        "unit": "ms"},
+        "ttft_p95_ms": {"value": round(1000 * _pct(ttfts, 0.95), 1),
+                        "unit": "ms"},
+        "latency_mean_ms": {"value": round(1000 * statistics.mean(totals),
+                                           1), "unit": "ms"},
+        "tokens_per_second": {"value": round(tokens[0] / wall, 1),
+                              "unit": "tokens/s"},
+        "errors": errors[0],
+        "config": {
+            "num_slots": args.num_slots, "max_len": args.max_len,
+            "requests": args.requests, "concurrency": concurrency,
+            "prefix_cache_size": args.prefix_cache_size,
+            "ttft_regime": (
+                "admission-free (concurrency <= num_slots): TTFT "
+                "measures prefill" if concurrency <= args.num_slots
+                else "saturated (concurrency > num_slots): TTFT "
+                     "includes slot-admission queueing"),
+        },
     }
-    for metric, (value, unit) in results.items():
-        emit(metric, value, unit)
-    if errors[0]:
-        emit("serve_errors", errors[0], "count")
+
+
+# ---------------------------------------------------------------------------
+# probes: engine_fixed / engine_paged (direct engine, 1k+ streams)
+# ---------------------------------------------------------------------------
+def _drive_streams(engine, n_streams: int, prompt_len: int,
+                   max_tokens: int) -> dict:
+    """N concurrent streaming clients against one engine: per-stream
+    TTFT + inter-token gaps, zero-drop accounting."""
+    lock = threading.Lock()
+    ttfts: list = []
+    itls: list = []
+    tokens = [0]
+    errors = [0]
+    dropped = [0]
+
+    def client(i: int):
+        # Unique prompts (vary by stream) so throughput measures real
+        # prefill+decode, not the prefix cache.
+        prompt = [(i * 7 + j) % 251 + 1 for j in range(prompt_len)]
+        t0 = time.perf_counter()
+        last = t0
+        got = 0
+        gaps = []
+        try:
+            for _ in engine.generate_stream(prompt,
+                                            max_tokens=max_tokens,
+                                            timeout=900):
+                now = time.perf_counter()
+                if got == 0:
+                    first = now - t0
+                else:
+                    gaps.append(now - last)
+                last = now
+                got += 1
+        except Exception as e:  # noqa: BLE001
+            from ray_tpu.serve.llm import StreamQueueFullError
+
+            with lock:
+                if isinstance(e, StreamQueueFullError):
+                    dropped[0] += 1
+                else:
+                    errors[0] += 1
+            return
+        with lock:
+            tokens[0] += got
+            if got:
+                ttfts.append(first)
+            itls.extend(gaps)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    ttfts.sort()
+    itls.sort()
+    return {
+        "streams": n_streams,
+        "completed": len(ttfts),
+        "errors": errors[0],
+        "dropped_streams": dropped[0],
+        "wall_s": round(wall, 2),
+        "tokens_per_second": {"value": round(tokens[0] / wall, 1),
+                              "unit": "tokens/s"},
+        "ttft_p50_ms": {"value": round(1000 * (_pct(ttfts, 0.50) or 0), 1),
+                        "unit": "ms"},
+        "ttft_p99_ms": {"value": round(1000 * (_pct(ttfts, 0.99) or 0), 1),
+                        "unit": "ms"},
+        "itl_p50_ms": {"value": round(1000 * (_pct(itls, 0.50) or 0), 1),
+                       "unit": "ms"},
+        "itl_p99_ms": {"value": round(1000 * (_pct(itls, 0.99) or 0), 1),
+                       "unit": "ms"},
+    }
+
+
+def _build_params(args):
+    import jax
+
+    from ray_tpu.models import configs, init_params
+
+    cfg = configs.get(args.model)
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+def probe_engine_fixed(args) -> dict:
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = _build_params(args)
+    eng = LLMEngine(cfg, params, num_slots=args.num_slots,
+                    max_len=args.max_len, prefix_cache_size=0)
+    eng.generate([1, 2, 3], max_tokens=2, timeout=300)  # warmup/compile
+    out = _drive_streams(eng, args.streams, args.prompt_len,
+                         args.max_tokens)
+    stats = eng.engine_stats()
+    eng.shutdown()
+    out["config"] = {
+        "engine": "fixed", "num_slots": args.num_slots,
+        "max_len": args.max_len,
+        "kv_hbm_tokens": args.num_slots * args.max_len,
+        "ttft_regime": "admission-limited (streams >> num_slots): TTFT "
+                       "is dominated by slot-admission queueing",
+    }
+    out["engine_stats"] = {k: stats[k] for k in
+                           ("requests", "completed", "tokens_generated")}
+    return out
+
+
+def probe_engine_paged(args) -> dict:
+    from ray_tpu.core.config import get_config
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg, params = _build_params(args)
+    bs = args.block_size or get_config().kv_block_size
+    # EQUAL HBM: same KV-token budget as the fixed probe, carved into
+    # blocks (+1 for the reserved null block).
+    num_blocks = (args.num_slots * args.max_len) // bs + 1
+    eng = PagedLLMEngine(cfg, params, num_slots=args.paged_width,
+                         max_len=args.max_len, block_size=bs,
+                         num_blocks=num_blocks,
+                         prefill_chunk=args.prefill_chunk)
+    eng.warmup()   # compile all width/chunk tiers outside the timing
+    eng.generate([1, 2, 3], max_tokens=2, timeout=300)
+    out = _drive_streams(eng, args.streams, args.prompt_len,
+                         args.max_tokens)
+    stats = eng.engine_stats()
+    eng.shutdown()
+    out["config"] = {
+        "engine": "paged", "decode_width": args.paged_width,
+        "max_len": args.max_len, "block_size": bs,
+        "num_blocks": num_blocks,
+        "kv_hbm_tokens": (num_blocks - 1) * bs,
+        "prefill_chunk": args.prefill_chunk,
+        "ttft_regime": "admission-limited (streams >> decode width): "
+                       "TTFT is dominated by block-pool admission "
+                       "queueing",
+    }
+    out["engine_stats"] = {
+        k: stats[k] for k in
+        ("requests", "completed", "tokens_generated", "reuse_hits",
+         "cow_copies", "prefill_chunks", "queue_waits", "blocks_total")}
+    return out
+
+
+# ---------------------------------------------------------------------------
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--only", default="http,fixed,paged",
+                    help="comma-set of probes: http,fixed,paged")
+    ap.add_argument("--round", type=int, default=13,
+                    help="bench round number recorded in the artifact")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact JSON here")
+    # http probe knobs (legacy)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=None,
+                    help="http probe: default num-slots "
+                         "(admission-free TTFT)")
+    ap.add_argument("--prefix-cache-size", type=int, default=0)
+    # shared engine shape (the equal-HBM budget)
+    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--max-tokens", type=int, default=16)
+    # engine probe knobs
+    ap.add_argument("--streams", type=int, default=1024)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--paged-width", type=int, default=64,
+                    help="paged engine decode width (slots)")
+    ap.add_argument("--block-size", type=int, default=0,
+                    help="0: RAY_TPU_KV_BLOCK_SIZE / config default")
+    ap.add_argument("--prefill-chunk", type=int, default=128)
+    args = ap.parse_args()
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Child workers re-run sitecustomize, which re-registers the real
+        # TPU plugin and overrides JAX_PLATFORMS — any jax call in a
+        # replica then hangs when the TPU tunnel is down. Dropping the
+        # trigger env makes children honor the requested CPU platform
+        # (same guard as tests/conftest.py; bench.py probes instead).
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    only = {p.strip() for p in args.only.split(",") if p.strip()}
+    probes: dict = {}
+    if "fixed" in only:
+        probes["engine_fixed"] = probe_engine_fixed(args)
+        emit("serve_fixed_tokens_per_second",
+             probes["engine_fixed"]["tokens_per_second"]["value"],
+             "tokens/s")
+    if "paged" in only:
+        probes["engine_paged"] = probe_engine_paged(args)
+        emit("serve_paged_tokens_per_second",
+             probes["engine_paged"]["tokens_per_second"]["value"],
+             "tokens/s")
+    if "http" in only:
+        probes["http_stream"] = probe_http(args)
+        emit("serve_requests_per_second",
+             probes["http_stream"]["requests_per_second"]["value"],
+             "req/s")
+        emit("serve_ttft_p50_ms",
+             probes["http_stream"]["ttft_p50_ms"]["value"], "ms")
+        emit("serve_tokens_per_second",
+             probes["http_stream"]["tokens_per_second"]["value"],
+             "tokens/s")
+
+    comparison: dict = {}
+    if "engine_fixed" in probes and "engine_paged" in probes:
+        f = probes["engine_fixed"]["tokens_per_second"]["value"]
+        p = probes["engine_paged"]["tokens_per_second"]["value"]
+        comparison["paged_vs_fixed_equal_hbm"] = {
+            "fixed_tokens_per_second": f,
+            "paged_tokens_per_second": p,
+            "speedup": round(p / f, 2) if f else None,
+            "note": (f"both engines hold "
+                     f"{args.num_slots * args.max_len} KV tokens of "
+                     f"HBM; the paged engine decodes "
+                     f"{args.paged_width} streams wide vs "
+                     f"{args.num_slots} fixed slots"),
+        }
+    if "http_stream" in probes:
+        try:
+            with open("BENCH_SERVE_TPU_LAST_GOOD.json") as fobj:
+                last = json.load(fobj)
+            lg = {k: v["value"] for k, v in last["results"].items()}
+            cur = probes["http_stream"]
+            comparison["http_vs_last_good"] = {
+                "last_good_requests_per_second":
+                    lg.get("serve_requests_per_second"),
+                "requests_per_second":
+                    cur["requests_per_second"]["value"],
+                "last_good_tokens_per_second":
+                    lg.get("serve_tokens_per_second"),
+                "tokens_per_second":
+                    cur["tokens_per_second"]["value"],
+                "last_good_ttft_p50_ms": lg.get("serve_ttft_p50_ms"),
+                "ttft_p50_ms": cur["ttft_p50_ms"]["value"],
+            }
+        except Exception:  # noqa: BLE001 no baseline on this host
+            comparison["http_vs_last_good"] = None
 
     if args.out:
         import datetime
@@ -164,30 +401,14 @@ def main() -> None:
         import jax
 
         artifact = {
+            "round": args.round,
             "recorded_at_utc": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(),
             "backend": jax.default_backend(),
             "host": {"nproc": len(os.sched_getaffinity(0))},
-            "engine_config": {
-                "model": args.model, "num_slots": args.num_slots,
-                "max_len": args.max_len, "max_tokens": args.max_tokens,
-                "requests": args.requests,
-                "concurrency": args.concurrency,
-                "prefix_cache_size": args.prefix_cache_size,
-                "ttft_regime": (
-                    "admission-free (concurrency <= num_slots): TTFT "
-                    "measures prefill" if args.concurrency
-                    <= args.num_slots else
-                    "saturated (concurrency > num_slots): TTFT "
-                    "includes slot-admission queueing"),
-                "path": ("async HTTP proxy, chunked token streaming, "
-                         "continuous-batching engine; prefill/decode "
-                         "compiled once per replica and reused across "
-                         "requests (serve/llm.py)"),
-            },
-            "results": {k: {"value": v, "unit": u}
-                        for k, (v, u) in results.items()},
-            "errors": errors[0],
+            "model": args.model,
+            "probes": probes,
+            "comparison": comparison,
             "tpu_note": (
                 "serving the TINY model through the tunneled single chip "
                 "is per-dispatch latency-bound (~10ms/step through the "
@@ -198,9 +419,7 @@ def main() -> None:
         }
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2)
-
-    serve.shutdown()
-    ray_tpu.shutdown()
+        print(f"wrote {args.out}", flush=True)
 
 
 if __name__ == "__main__":

@@ -278,6 +278,37 @@ class Config:
     # gang back toward the target world size.
     elastic_grow_check_s: float = 10.0
 
+    # ---- serving plane (paged KV cache engine; serve/llm.py,
+    # serve/kv_cache.py — RAY_TPU_KV_BLOCK_* / RAY_TPU_SERVE_*) ----
+    # Tokens per KV block. Small blocks waste less HBM on short tails
+    # but deepen block tables; 16 matches the vLLM default.
+    kv_block_size: int = 16
+    # Blocks in the pool (block 0 is the reserved null block and never
+    # allocated). 0 => derived from the engine's num_slots * max_len
+    # budget so paged and fixed-slot engines reserve equal HBM.
+    kv_block_count: int = 0
+    # Refcounted prefix-block sharing + copy-on-write (vLLM automatic
+    # prefix caching at block granularity). 0 disables: every request
+    # prefills from scratch.
+    kv_block_prefix_sharing: bool = True
+    # Prompt tokens admitted per engine tick during prefill: long
+    # prompts prefill in chunks interleaved with decode bursts so
+    # active streams' inter-token latency stays bounded.
+    serve_prefill_chunk: int = 128
+    # Per-request streaming token queue bound: a consumer that falls
+    # this many tokens behind has its stream dropped with an explicit
+    # error instead of growing replica RSS without limit.
+    serve_stream_queue_max: int = 1024
+    # Daemon-side TTL for per-replica serve gauges: a replica that
+    # stopped pushing (crash, scale-down) ages out of the syncer's
+    # "serve" entry instead of pinning stale queue depth.
+    serve_gauge_ttl_s: float = 10.0
+    # Controller-side TTL for handle-pushed autoscale stats (the
+    # fallback signal when the syncer view is absent): entries from a
+    # handle process that exited between pushes expire instead of
+    # flapping the replica target.
+    serve_autoscale_stats_ttl_s: float = 5.0
+
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout
     rpc_connect_timeout_s: int = 30

@@ -243,6 +243,12 @@ class NodeDaemon:
         # NOT in self._workers: the OOM sweep and idle reaping never see
         # them — killing the template would re-cold-start the node.
         self._zygotes: Dict[str, ZygoteHandle] = {}
+        # Serve replica gauges: (app, replica) -> {"ts", "gauges"}.
+        # Replicas on this node push queue depth / KV-pool occupancy
+        # here; the aggregate rides the SYNCER delta to the GCS so the
+        # serve controller reads one merged view instead of polling
+        # every replica per autoscale decision.
+        self._serve_gauges: Dict[tuple, dict] = {}
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -373,7 +379,39 @@ class NodeDaemon:
             "workers": len(self._workers),
             "idle_workers": len(self._idle),
             "busy_workers": busy,
+            "serve": self._serve_state(),
         }
+
+    def _serve_state(self) -> Dict[str, Any]:
+        """Per-app aggregate of this node's replica gauges (TTL-swept so
+        a dead replica's numbers stop counting).  Values are rounded so
+        tiny float jitter doesn't defeat the syncer's delta suppression."""
+        ttl = get_config().serve_gauge_ttl_s
+        now = time.monotonic()
+        apps: Dict[str, Dict[str, float]] = {}
+        for key, ent in list(self._serve_gauges.items()):
+            if now - ent["ts"] > ttl:
+                del self._serve_gauges[key]
+                continue
+            app = key[0]
+            agg = apps.setdefault(app, {"replicas": 0.0})
+            agg["replicas"] += 1
+            for name, val in ent["gauges"].items():
+                try:
+                    agg[name] = round(agg.get(name, 0.0) + float(val), 3)
+                except (TypeError, ValueError):
+                    continue
+        return apps
+
+    async def report_serve_gauges(self, app: str, replica: str,
+                                  gauges: Dict[str, float]) -> dict:
+        """Replica -> local daemon gauge push (the serve-autoscaling
+        leg of the syncer plane; replicas never talk to the GCS)."""
+        self._serve_gauges[(app, replica)] = {
+            "ts": time.monotonic(), "gauges": dict(gauges)}
+        if self.syncer is not None:
+            self.syncer.mark_dirty()
+        return {"ok": True}
 
     async def _re_register(self) -> None:
         """(Re-)register this node and force the syncer to full-resync —

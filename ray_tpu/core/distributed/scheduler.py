@@ -41,12 +41,17 @@ class NodeView:
     workers: int = 0
     idle_workers: int = 0
     busy_workers: int = 0
+    # Serve replica gauges aggregated per app on this node (queue depth,
+    # active streams, KV-pool occupancy) — the controller's autoscale
+    # signal rides the syncer instead of per-decision replica polls.
+    serve: Dict[str, dict] = dataclasses.field(default_factory=dict)
 
 
 # Dynamic NodeView attributes the syncer may overwrite from a reported
 # state dict (the "available"/"queued" pair keeps heartbeat parity).
 _SYNCED_ATTRS = ("available", "queued", "store_used", "store_objects",
-                 "spilled_bytes", "workers", "idle_workers", "busy_workers")
+                 "spilled_bytes", "workers", "idle_workers", "busy_workers",
+                 "serve")
 # Everything a daemon needs of a peer to make spillback decisions —
 # the cluster-view fan-out entry.
 _WIRE_ATTRS = ("node_id", "address", "total", "available", "alive",

@@ -63,6 +63,7 @@ STATE_KEYS = (
     "workers",          # worker-pool depth: live worker processes
     "idle_workers",     # ... of which idle (warm pool)
     "busy_workers",     # ... of which leased/actor-bound
+    "serve",            # per-app serve replica gauges (autoscale input)
 )
 
 

@@ -260,6 +260,64 @@ class PartialBuffer:
             pass
 
 
+class ArenaBuffer:
+    """A long-lived writable reservation carved out of the shm store —
+    the backing pool of the paged KV-cache block allocator
+    (serve/kv_cache.py).
+
+    Rides the same create-then-fill seam as PartialBuffer but closes it
+    immediately: the slot is sealed right after creation (the writable
+    mmap stays valid across the seal's rename — same inode) and then
+    pinned with a reader ref.  Sealing dodges the store's stale-kCreating
+    reclaim (kStaleCreatingSecs sweeps unsealed slots under pressure);
+    the pin keeps the sealed arena off the LRU eviction list.  release()
+    unpins and deletes, returning the store to quiescence — the leak
+    guard tests assert used/num_objects return to baseline.
+
+    When shm is full even after eviction the arena falls back to an
+    anonymous private mapping (`in_store=False`): the pool still works,
+    it just isn't accounted in the store.
+    """
+
+    def __init__(self, state: Optional[_StoreState], oid: Optional[ObjectID],
+                 mm: mmap.mmap, size: int, in_store: bool):
+        self._state = state
+        self._oid = oid
+        self._mm = mm
+        self.size = size
+        self.in_store = in_store
+        self.view = memoryview(mm)
+        if in_store:
+            state.buffer_acquired()
+        self._finalizer = weakref.finalize(
+            self, ArenaBuffer._release_static, state,
+            oid.binary() if oid is not None else None, mm, self.view,
+            in_store)
+
+    def release(self) -> None:
+        self._finalizer()
+
+    @staticmethod
+    def _release_static(state: Optional[_StoreState],
+                        oid_binary: Optional[bytes], mm: mmap.mmap,
+                        view: memoryview, in_store: bool) -> None:
+        try:
+            view.release()
+            mm.close()
+        except BufferError:
+            pass  # outstanding views; mmap closes when they drop
+        if not in_store:
+            return
+        try:
+            # buffer_released drops the rts_get pin; with no other
+            # readers the delete frees the slot immediately.
+            state.buffer_released(oid_binary)
+            if state.handle:
+                get_lib().rts_delete(state.handle, oid_binary, 1)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class ObjectStore:
     """One connection to the node-local shm store.
 
@@ -438,6 +496,48 @@ class ObjectStore:
             raise
         os.close(fd.value)
         return PartialBuffer(self._state, oid, size, mm)
+
+    def create_arena(self, oid: ObjectID, size: int) -> ArenaBuffer:
+        """Reserve a long-lived writable arena in shm (the paged
+        KV-cache block pool).  create -> mmap -> seal -> pin: see
+        ArenaBuffer for why the seam is closed immediately.  Falls back
+        to an anonymous mapping when shm is exhausted."""
+        if size <= 0:
+            raise ValueError("arena size must be positive")
+        lib = get_lib()
+        fd = ctypes.c_int(-1)
+        rc = lib.rts_create(self._handle, oid.binary(), size,
+                            ctypes.byref(fd))
+        if rc == RTS_ERR_EXISTS:
+            raise ObjectExistsError(oid.hex())
+        if rc == RTS_ERR_FULL:
+            return ArenaBuffer(None, None, mmap.mmap(-1, size), size,
+                               in_store=False)
+        if rc != RTS_OK:
+            raise RuntimeError(f"rts_create failed: {rc}")
+        try:
+            mm = mmap.mmap(fd.value, size)
+        except BaseException:
+            os.close(fd.value)
+            lib.rts_abort(self._handle, oid.binary())
+            raise
+        os.close(fd.value)
+        rc = lib.rts_seal(self._handle, oid.binary())
+        if rc != RTS_OK:
+            mm.close()
+            lib.rts_abort(self._handle, oid.binary())
+            raise RuntimeError(f"rts_seal failed: {rc}")
+        # Reader pin: a sealed refcount-0 object is LRU-evictable; the
+        # arena must survive store pressure for the engine's lifetime.
+        sz = ctypes.c_uint64(0)
+        pin_fd = ctypes.c_int(-1)
+        rc = lib.rts_get(self._handle, oid.binary(), ctypes.byref(sz),
+                         ctypes.byref(pin_fd))
+        if rc != RTS_OK:
+            mm.close()
+            raise RuntimeError(f"rts_get failed pinning arena: {rc}")
+        os.close(pin_fd.value)
+        return ArenaBuffer(self._state, oid, mm, size, in_store=True)
 
     # -- read path ------------------------------------------------------
     def get_buffer(self, oid: ObjectID) -> Optional[SharedBuffer]:

@@ -415,6 +415,210 @@ def make_spec_fns(cfg: TransformerConfig, donate: bool = True):
                    donate_argnums=(1,) if donate else ())
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (vLLM-style block tables; serve/kv_cache.py allocator)
+# ---------------------------------------------------------------------------
+#
+# The contiguous cache above reserves S * T_max positions of HBM up front
+# and caps concurrency at the slot count.  The paged layout stores KV in a
+# flat pool of fixed-size blocks — (L, N_blocks, block_size, Hkv, D) — and
+# each request holds an int32 block table mapping its sequence positions to
+# pool blocks.  Compiled shapes depend only on (S, B_max, block_size), so
+# memory management (alloc/free/share/COW) moves entirely to the host-side
+# allocator while the decode step stays a single fused program
+# (arXiv:2011.03641: keep the compiled step shape-stable).
+#
+# Convention: pool block 0 is the NULL block.  The allocator never hands it
+# out; unallocated table entries and inactive slots point at it, so every
+# gather/scatter is in-bounds without conditionals.  Writes routed to block
+# 0 are garbage that no attention mask ever reads.
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    k: jax.Array          # (L, N_blocks, block_size, Hkv, D)
+    v: jax.Array
+
+
+jax.tree_util.register_dataclass(PagedKVCache, ["k", "v"], [])
+
+
+def init_paged_cache(cfg: TransformerConfig, num_blocks: int,
+                     block_size: int, dtype=None) -> PagedKVCache:
+    dtype = dtype or cfg.compute_dtype
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, dtype),
+                        v=jnp.zeros(shape, dtype))
+
+
+def paged_decode_step(params, cache: PagedKVCache, tokens: jax.Array,
+                      block_tables: jax.Array, lengths: jax.Array,
+                      active: jax.Array, cfg: TransformerConfig
+                      ) -> Tuple[PagedKVCache, jax.Array]:
+    """One token for every slot through the block pool: tokens (S,),
+    block_tables (S, B_max) int32, lengths (S,) int32, active (S,) bool.
+    Returns (cache, logits (S, vocab)).
+
+    Scatter-then-gather: each slot's new KV is written to
+    table[len // bs] at offset len % bs FIRST, so the gathered window
+    already contains it and the mask is simply kv_pos <= len.  Inactive
+    slots write the null block and read garbage that the engine drops.
+    """
+    cd = cfg.compute_dtype
+    s_count = tokens.shape[0]
+    bs = cache.k.shape[2]
+    b_max = block_tables.shape[1]
+    t_w = b_max * bs
+    pos = lengths                                        # (S,)
+    positions = pos[:, None]                             # (S, 1)
+    x = params["embed"].astype(cd)[tokens[:, None]]      # (S, 1, d)
+    wb = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                             axis=1)[:, 0]               # (S,)
+    wb = jnp.where(active, wb, 0)
+    off = jnp.where(active, pos % bs, 0)
+    kv_pos = jnp.arange(t_w)
+    attn_mask = kv_pos[None, None, :] <= positions[:, :, None]  # (S,1,T_w)
+
+    def layer(carry, layer_in):
+        x = carry
+        bp, k_cache, v_cache = layer_in                  # (N,bs,Hkv,D)
+        q, k, v = _qkv(bp, x, cfg, positions)            # (S,1,H,D)
+        k_cache = k_cache.at[wb, off].set(k[:, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[wb, off].set(v[:, 0].astype(v_cache.dtype))
+        kb = k_cache[block_tables]                       # (S,B,bs,Hkv,D)
+        vb = v_cache[block_tables]
+        kh = kb.reshape(s_count, t_w, *kb.shape[3:])
+        vh = vb.reshape(s_count, t_w, *vb.shape[3:])
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        s = jnp.einsum("sqhd,sthd->sqht", q.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(attn_mask[:, :, None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("sqht,sthd->sqhd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(s_count, 1, cfg.n_heads * cfg.head_dim)
+        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
+                           bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k_cache, v_cache)
+
+    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    new_k, new_v = new_kv
+    logits = _final_logits(params, x, cfg)[:, 0]         # (S, vocab)
+    return PagedKVCache(k=new_k, v=new_v), logits
+
+
+def paged_decode_and_sample(params, cache: PagedKVCache, tokens,
+                            block_tables, lengths, active, temps, rng,
+                            cfg: TransformerConfig):
+    cache, logits = paged_decode_step(params, cache, tokens, block_tables,
+                                      lengths, active, cfg)
+    rng, sub = jax.random.split(rng)
+    return cache, sample_per_slot(logits, sub, temps), rng
+
+
+def paged_decode_burst(params, cache: PagedKVCache, tokens, block_tables,
+                       lengths, active, temps, rng,
+                       cfg: TransformerConfig, n_steps: int):
+    """`n_steps` fused paged decode+sample ticks in one device call.
+    Block tables are static across the burst — the engine pre-extends
+    each active slot's table to cover lengths + n_steps before issuing.
+    Returns (cache, token_matrix (n_steps, S), rng)."""
+
+    def tick(carry, _):
+        cache, toks, lengths, rng = carry
+        cache, nxt, rng = paged_decode_and_sample(
+            params, cache, toks, block_tables, lengths, active, temps,
+            rng, cfg)
+        lengths = jnp.where(active, lengths + 1, lengths)
+        return (cache, nxt, lengths, rng), nxt
+
+    (cache, _, _, rng), toks = jax.lax.scan(
+        tick, (cache, tokens, lengths, rng), None, length=n_steps)
+    return cache, toks, rng
+
+
+def paged_prefill_chunk(params, cache: PagedKVCache, tokens: jax.Array,
+                        block_tables: jax.Array, start: jax.Array,
+                        n_valid: jax.Array, cfg: TransformerConfig
+                        ) -> Tuple[PagedKVCache, jax.Array]:
+    """One chunk of a prompt through the block pool: tokens (C,) (padded
+    with zeros past `n_valid`), block_tables (B_max,), start = absolute
+    position of tokens[0].  Chunk KV scatters into the table's blocks at
+    positions start..start+C-1; attention covers the already-prefilled
+    context (kv_pos < start) plus the in-chunk causal prefix — both fall
+    out of the single mask kv_pos <= start+i after the scatter.  Padded
+    positions write garbage that the next chunk overwrites and no real
+    query's mask reaches.  Returns (cache, logits of token n_valid-1
+    (vocab,)) — the engine samples from the FINAL chunk's logits.
+    """
+    cd = cfg.compute_dtype
+    c = tokens.shape[0]
+    bs = cache.k.shape[2]
+    t_w = block_tables.shape[0] * bs
+    positions = start + jnp.arange(c, dtype=jnp.int32)   # (C,)
+    x = params["embed"].astype(cd)[tokens][None]         # (1, C, d)
+    wb = block_tables[positions // bs]                   # (C,)
+    off = positions % bs
+    kv_pos = jnp.arange(t_w)
+    attn_mask = kv_pos[None, :] <= positions[:, None]    # (C, T_w)
+
+    def layer(carry, layer_in):
+        x = carry
+        bp, k_cache, v_cache = layer_in
+        q, k, v = _qkv(bp, x, cfg, positions)            # (1,C,H,D)
+        k_cache = k_cache.at[wb, off].set(k[0].astype(k_cache.dtype))
+        v_cache = v_cache.at[wb, off].set(v[0].astype(v_cache.dtype))
+        kh = k_cache[block_tables].reshape(t_w, *k_cache.shape[2:])[None]
+        vh = v_cache[block_tables].reshape(t_w, *v_cache.shape[2:])[None]
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(attn_mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(1, c, cfg.n_heads * cfg.head_dim)
+        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
+                           bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k_cache, v_cache)
+
+    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    new_k, new_v = new_kv
+    logits = _final_logits(params, x, cfg)[0]            # (C, vocab)
+    last = logits[n_valid - 1]
+    return PagedKVCache(k=new_k, v=new_v), last
+
+
+def copy_block(cache: PagedKVCache, dst: jax.Array, src: jax.Array
+               ) -> PagedKVCache:
+    """Copy one pool block across all layers (the device half of
+    copy-on-write: a shared partial block is duplicated before its new
+    owner appends into it)."""
+    return PagedKVCache(k=cache.k.at[:, dst].set(cache.k[:, src]),
+                        v=cache.v.at[:, dst].set(cache.v[:, src]))
+
+
+def make_paged_engine_fns(cfg: TransformerConfig, donate: bool = True):
+    """Jitted (prefill_chunk, decode_burst, copy_block) with cache
+    donation.  Chunk width C and table depth B_max ride in the argument
+    shapes (one compile per distinct pair, same discipline as prefill
+    buckets); the burst takes a static n_steps."""
+    chunk_jit = jax.jit(functools.partial(paged_prefill_chunk, cfg=cfg),
+                        donate_argnums=(1,) if donate else ())
+    burst_jit = jax.jit(functools.partial(paged_decode_burst, cfg=cfg),
+                        static_argnames=("n_steps",),
+                        donate_argnums=(1,) if donate else ())
+    copy_jit = jax.jit(copy_block, donate_argnums=(0,) if donate else ())
+    return chunk_jit, burst_jit, copy_jit
+
+
 def make_prefix_cache_fns(donate: bool = True):
     """Jitted (extract, insert, sample) for the engine's prefix cache.
     Insert donates the live cache (it is immediately replaced); extract

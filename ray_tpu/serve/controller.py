@@ -35,6 +35,15 @@ class ServeController:
         self._lock = threading.RLock()
         self._stop = False
         self._last_scale: Dict[str, float] = {}
+        # app -> {handle_id: (ongoing, monotonic ts)} — TTL'd in
+        # _autoscale_signal so dead handles stop counting.
+        self._handle_stats: Dict[str, Dict[str, tuple]] = {}
+        from ray_tpu.core.config import get_config
+
+        self._handle_stats_ttl_s = get_config().serve_autoscale_stats_ttl_s
+        # Last syncer-merged per-app replica gauges (None outside a
+        # distributed cluster); refreshed once per reconcile tick.
+        self._merged_gauges: Optional[Dict[str, dict]] = None
         # Startup bookkeeping: a replica whose constructor is still
         # running (model load + jit compile can take minutes) must not
         # be killed by the health probe — grace until its FIRST
@@ -95,28 +104,79 @@ class ServeController:
                 "version": st["version"],
             }
 
-    def record_autoscale_stats(self, app_name: str, ongoing: float) -> None:
+    def record_autoscale_stats(self, app_name: str, ongoing: float,
+                               handle_id: Optional[str] = None) -> None:
+        """Per-handle outstanding-count report.  Entries are TTL'd: a
+        handle that stops reporting (caller exited, process died) ages
+        out instead of pinning its last count into the autoscale signal
+        forever.  Decisions happen in `_autoscale_tick` on the reconcile
+        cadence, not here — one report must not flap the target."""
         with self._lock:
-            tgt = self._targets.get(app_name)
-            if tgt is None:
+            per_handle = self._handle_stats.setdefault(app_name, {})
+            per_handle[handle_id or "_anon"] = (float(ongoing),
+                                                time.monotonic())
+
+    def _autoscale_signal(self, app_name: str) -> Optional[float]:
+        """Cluster-wide in-flight estimate for one app.  Preferred
+        source: the syncer-merged replica gauges (one GCS RPC per tick,
+        fetched by the caller) — replica-reported ongoing + engine queue
+        depth.  Fallback: the TTL-filtered per-handle reports."""
+        merged = (self._merged_gauges or {}).get(app_name)
+        if merged and merged.get("replicas"):
+            return (merged.get("ongoing", 0.0)
+                    + merged.get("queue_depth", 0.0))
+        per_handle = self._handle_stats.get(app_name)
+        if not per_handle:
+            return None
+        now = time.monotonic()
+        ttl = self._handle_stats_ttl_s
+        for hid, (_, ts) in list(per_handle.items()):
+            if now - ts > ttl:
+                del per_handle[hid]
+        if not per_handle:
+            return None
+        return sum(v for v, _ in per_handle.values())
+
+    def _fetch_merged_gauges(self) -> None:
+        """One `Serve.merged` RPC per reconcile tick (the syncer-fed
+        view); local mode / standalone keeps the handle fallback."""
+        self._merged_gauges = None
+        try:
+            from ray_tpu.api import _global_worker, is_initialized
+
+            if not is_initialized():
                 return
-            asc = tgt["config"].get("autoscaling_config")
-            if not asc:
+            gcs = getattr(_global_worker(), "gcs", None)
+            if gcs is None:
                 return
-            n = max(1, tgt["num_replicas"])
-            per = ongoing / n
-            now = time.time()
-            last = self._last_scale.get(app_name, 0.0)
-            if per > asc["target_ongoing_requests"] \
-                    and n < asc["max_replicas"] \
-                    and now - last > asc["upscale_delay_s"]:
-                tgt["num_replicas"] = n + 1
-                self._last_scale[app_name] = now
-            elif per < asc["target_ongoing_requests"] / 2 \
-                    and n > asc["min_replicas"] \
-                    and now - last > asc["downscale_delay_s"]:
-                tgt["num_replicas"] = n - 1
-                self._last_scale[app_name] = now
+            self._merged_gauges = gcs.call("Serve", "merged", timeout=5)
+        except Exception:  # noqa: BLE001 gauge plane is best-effort
+            self._merged_gauges = None
+
+    def _autoscale_tick(self) -> None:
+        self._fetch_merged_gauges()
+        with self._lock:
+            for app_name, tgt in self._targets.items():
+                asc = tgt["config"].get("autoscaling_config")
+                if not asc:
+                    continue
+                signal = self._autoscale_signal(app_name)
+                if signal is None:
+                    continue
+                n = max(1, tgt["num_replicas"])
+                per = signal / n
+                now = time.time()
+                last = self._last_scale.get(app_name, 0.0)
+                if per > asc["target_ongoing_requests"] \
+                        and n < asc["max_replicas"] \
+                        and now - last > asc["upscale_delay_s"]:
+                    tgt["num_replicas"] = n + 1
+                    self._last_scale[app_name] = now
+                elif per < asc["target_ongoing_requests"] / 2 \
+                        and n > asc["min_replicas"] \
+                        and now - last > asc["downscale_delay_s"]:
+                    tgt["num_replicas"] = n - 1
+                    self._last_scale[app_name] = now
 
     def shutdown(self) -> bool:
         self._stop = True
@@ -133,6 +193,7 @@ class ServeController:
     def _reconcile_loop(self):
         while not self._stop:
             try:
+                self._autoscale_tick()
                 self._reconcile_once()
                 self._publish_status()
             except Exception:  # noqa: BLE001

@@ -12,6 +12,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 import ray_tpu
@@ -75,6 +76,9 @@ class DeploymentHandle:
         self._replicas: Dict[str, Any] = {}
         self._outstanding: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # Stable id for controller-side per-handle stats (TTL'd there:
+        # when this handle goes away its count ages out).
+        self._handle_id = uuid.uuid4().hex
         self._last_stats_push = 0.0
         self._last_refresh = 0.0
         self._refresh_ttl = 0.5
@@ -192,7 +196,8 @@ class DeploymentHandle:
         self._last_stats_push = now
         total = sum(self._outstanding.values())
         try:
-            self._controller.record_autoscale_stats.remote(self._app, total)
+            self._controller.record_autoscale_stats.remote(
+                self._app, total, handle_id=self._handle_id)
         except Exception:  # noqa: BLE001
             pass
 

@@ -1,32 +1,57 @@
-"""Continuous-batching LLM engine for TPU serving.
+"""Continuous-batching LLM engines for TPU serving.
 
-The north-star Serve workload (BASELINE.json: "Serve req/s + p50 TTFT",
-continuous batching).  Requests share a fixed pool of KV-cache slots:
-prefill admits one request into a free slot (bucketed prompt padding keeps
-the compile set small); every engine tick advances ALL active slots one
-token with a single fused `decode_step`.  Admission interleaves with
-decoding — new requests don't wait for the batch to drain (continuous, not
-static, batching).
+Two engines share one public surface (generate / generate_stream /
+engine_stats):
 
-Use standalone (`LLMEngine`) or as a Serve deployment (`LLMDeployment`) —
-replicas each own an engine; the pow-2 router spreads requests.
+  LLMEngine       fixed-slot: requests share a fixed pool of contiguous
+                  KV-cache slots, prefill admits whole (bucket-padded)
+                  prompts, every tick advances ALL active slots with one
+                  fused decode burst.  HBM is reserved for worst-case
+                  sequence length and concurrency is capped at the slot
+                  count.
+
+  PagedLLMEngine  paged/block KV cache: KV lives in a flat pool of
+                  fixed-size blocks (models/decoding.py PagedKVCache);
+                  each request holds a block table, blocks are allocated
+                  on demand (serve/kv_cache.py KVBlockAllocator), shared
+                  between requests with a common prompt prefix
+                  (refcounted copy-on-write), and long prompts prefill
+                  in chunks interleaved with decode bursts so active
+                  streams' inter-token latency stays bounded during
+                  prefill storms.  Concurrency is bounded by pool
+                  occupancy, not slot count.
+
+Use standalone or as a Serve deployment (`LLMDeployment`, paged by
+default) — replicas each own an engine; the pow-2 router spreads
+requests.
 """
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 
+class StreamQueueFullError(RuntimeError):
+    """A streaming consumer fell serve_stream_queue_max tokens behind
+    and its stream was dropped (backpressure instead of unbounded
+    replica RSS growth). RAY_TPU_SERVE_STREAM_QUEUE_MAX tunes the
+    bound."""
+
+
 class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "out_tokens",
                  "done", "error", "slot", "submitted_at", "first_token_at",
-                 "token_q")
+                 "token_q", "dropped", "blocks", "pos", "prefilling")
 
     def __init__(self, prompt, max_tokens, temperature, stream=False):
+        from ray_tpu.core.config import get_config
+
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
@@ -37,16 +62,107 @@ class _Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         # Streaming consumers read tokens as the engine emits them.
+        # BOUNDED: a consumer that stops reading must not grow replica
+        # RSS without limit — at the bound the stream drops with an
+        # explicit error (the engine frees the slot/blocks).
         self.token_q: Optional["queue.Queue"] = (
-            queue.Queue() if stream else None)
+            queue.Queue(maxsize=max(1, get_config().serve_stream_queue_max))
+            if stream else None)
+        self.dropped = False
+        self.blocks: List[int] = []   # paged engine: owned pool blocks
+        self.pos = 0                  # paged engine: tokens prefilled
+        self.prefilling = True        # paged engine: not yet decoding
 
     def emit(self, tok: int) -> None:
         self.out_tokens.append(tok)
-        if self.token_q is not None:
-            self.token_q.put(tok)
+        if self.token_q is not None and not self.dropped:
+            try:
+                self.token_q.put_nowait(tok)
+            except queue.Full:
+                self.dropped = True
+                self.error = StreamQueueFullError(
+                    f"stream consumer fell {self.token_q.maxsize} tokens "
+                    f"behind; stream dropped "
+                    f"(RAY_TPU_SERVE_STREAM_QUEUE_MAX)")
 
 
-class LLMEngine:
+class _EngineBase:
+    """Shared request-facing surface of both engines. Subclasses provide
+    `max_len`, `stats`, `_pending_put(req)`, and a background loop that
+    completes requests."""
+
+    def generate(self, prompt_tokens: List[int], *, max_tokens: int = 64,
+                 temperature: float = 0.0,
+                 timeout: Optional[float] = 300) -> List[int]:
+        if len(prompt_tokens) >= self.max_len:
+            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
+        req = _Request(list(prompt_tokens), max_tokens, temperature)
+        self.stats["requests"] += 1
+        self._pending_put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.out_tokens
+
+    def generate_stream(self, prompt_tokens: List[int], *,
+                        max_tokens: int = 64, temperature: float = 0.0,
+                        timeout: Optional[float] = 300):
+        """Yield tokens as the engine produces them (TTFT = first yield;
+        the continuous-batching loop keeps decoding other slots while the
+        consumer reads)."""
+        if len(prompt_tokens) >= self.max_len:
+            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
+        req = _Request(list(prompt_tokens), max_tokens, temperature,
+                       stream=True)
+        self.stats["requests"] += 1
+        self._pending_put(req)
+        deadline = time.monotonic() + (timeout or 300)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("generation timed out")
+            try:
+                tok = req.token_q.get(timeout=min(remaining, 0.25))
+            except queue.Empty:
+                # A dropped stream may not fit its end sentinel into the
+                # full queue — the done event is the fallback signal.
+                if req.done.is_set() and req.token_q.empty():
+                    if req.error is not None:
+                        raise req.error
+                    return
+                continue
+            if tok is None:
+                if req.error is not None:
+                    raise req.error
+                return
+            yield tok
+
+    def engine_stats(self) -> Dict[str, Any]:
+        s = dict(self.stats)
+        s["p_ttft_mean"] = (s["ttft_sum"] / s["completed"]
+                            if s["completed"] else None)
+        return s
+
+    def shutdown(self):
+        self._stop = True
+        self._work.set()
+
+    def _finish_request(self, req: "_Request") -> None:
+        """Complete one request: stats + stream sentinel + done event."""
+        self.stats["completed"] += 1
+        if req.first_token_at is not None:
+            self.stats["ttft_sum"] += (req.first_token_at
+                                       - req.submitted_at)
+        if req.token_q is not None:
+            try:
+                req.token_q.put_nowait(None)  # stream sentinel
+            except queue.Full:
+                pass  # dropped stream: done event carries the signal
+        req.done.set()
+
+
+class LLMEngine(_EngineBase):
     def __init__(self, cfg, params, *, num_slots: int = 8,
                  max_len: int = 1024, prefill_buckets=(64, 128, 256, 512),
                  eos_id: Optional[int] = None, seed: int = 0,
@@ -157,58 +273,8 @@ class LLMEngine:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    # -- public ---------------------------------------------------------
-    def generate(self, prompt_tokens: List[int], *, max_tokens: int = 64,
-                 temperature: float = 0.0,
-                 timeout: Optional[float] = 300) -> List[int]:
-        if len(prompt_tokens) >= self.max_len:
-            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
-        req = _Request(list(prompt_tokens), max_tokens, temperature)
-        self.stats["requests"] += 1
+    def _pending_put(self, req: "_Request") -> None:
         self._pending.put(req)
-        self._work.set()
-        if not req.done.wait(timeout):
-            raise TimeoutError("generation timed out")
-        if req.error is not None:
-            raise req.error
-        return req.out_tokens
-
-    def generate_stream(self, prompt_tokens: List[int], *,
-                        max_tokens: int = 64, temperature: float = 0.0,
-                        timeout: Optional[float] = 300):
-        """Yield tokens as the engine produces them (TTFT = first yield;
-        the continuous-batching loop keeps decoding other slots while the
-        consumer reads)."""
-        if len(prompt_tokens) >= self.max_len:
-            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
-        req = _Request(list(prompt_tokens), max_tokens, temperature,
-                       stream=True)
-        self.stats["requests"] += 1
-        self._pending.put(req)
-        self._work.set()
-        deadline = time.monotonic() + (timeout or 300)
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError("generation timed out")
-            try:
-                tok = req.token_q.get(timeout=remaining)
-            except queue.Empty:
-                raise TimeoutError("generation timed out") from None
-            if tok is None:
-                if req.error is not None:
-                    raise req.error
-                return
-            yield tok
-
-    def engine_stats(self) -> Dict[str, Any]:
-        s = dict(self.stats)
-        s["p_ttft_mean"] = (s["ttft_sum"] / s["completed"]
-                            if s["completed"] else None)
-        return s
-
-    def shutdown(self):
-        self._stop = True
         self._work.set()
 
     # -- engine loop ----------------------------------------------------
@@ -280,7 +346,10 @@ class LLMEngine:
         except BaseException as e:  # noqa: BLE001
             req.error = e
             if req.token_q is not None:
-                req.token_q.put(None)
+                try:
+                    req.token_q.put_nowait(None)
+                except queue.Full:
+                    pass
             req.done.set()
         return True
 
@@ -296,14 +365,10 @@ class LLMEngine:
         full = (len(req.prompt) + len(req.out_tokens)
                 >= self.max_len - 1 - getattr(self, "_advance_margin",
                                               self.max_burst))
-        if hit_eos or full or len(req.out_tokens) >= req.max_tokens:
-            self.stats["completed"] += 1
-            self.stats["ttft_sum"] += (req.first_token_at
-                                       - req.submitted_at)
+        if hit_eos or full or len(req.out_tokens) >= req.max_tokens \
+                or req.dropped:
             self._slots[slot] = None
-            if req.token_q is not None:
-                req.token_q.put(None)  # stream sentinel
-            req.done.set()
+            self._finish_request(req)
 
     def _spec_tick(self, active_mask, temps) -> bool:
         """One speculative verify tick. Returns False when NO slot has
@@ -414,9 +479,479 @@ class LLMEngine:
                     if req is not None:
                         req.error = e
                         if req.token_q is not None:
-                            req.token_q.put(None)
+                            try:
+                                req.token_q.put_nowait(None)
+                            except queue.Full:
+                                pass
                         req.done.set()
                         self._slots[i] = None
+
+
+class PagedLLMEngine(_EngineBase):
+    """Paged/block KV-cache engine (the tentpole of ROADMAP item 1).
+
+    Engine tick: [admit waiting requests] -> [one fused decode burst
+    over every DECODING slot] -> [one prefill chunk for the oldest
+    PREFILLING slot].  Decode never waits for a whole prompt: a
+    max-length prompt occupies at most `prefill_chunk` tokens of device
+    time per tick, bounding the inter-token latency of active streams.
+
+    Admission: a request needs pool blocks covering its (non-shared)
+    prompt remainder.  When the pool can't cover it, the request WAITS
+    at the head of the queue (no error) until completions free blocks.
+    """
+
+    def __init__(self, cfg, params, *, num_slots: int = 32,
+                 max_len: int = 1024, block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 max_burst: int = 8, prefix_sharing: Optional[bool] = None,
+                 store=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.core.config import get_config
+        from ray_tpu.models.decoding import (
+            init_paged_cache,
+            make_paged_engine_fns,
+            sample_one,
+        )
+        from ray_tpu.serve.kv_cache import KVBlockAllocator
+
+        knobs = get_config()
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.block_size = block_size or knobs.kv_block_size
+        # Default pool budget == the fixed-slot engine's reservation for
+        # the same (num_slots, max_len): equal-HBM comparisons are the
+        # bench's apples-to-apples claim.  +1 for the null block.
+        self.num_blocks = (num_blocks or knobs.kv_block_count
+                           or (num_slots * max_len) // self.block_size + 1)
+        self.prefill_chunk = prefill_chunk or knobs.serve_prefill_chunk
+        # Shape tiers (power-of-two) keep device work proportional to
+        # LOAD, not capacity: a burst over 3 active streams runs at
+        # width 4, not num_slots; a 16-token chunk compiles at width 32,
+        # not prefill_chunk.  One compile per tier — the same bucket
+        # discipline as fixed-engine prefill.
+        self._width_tiers = self._tiers(4, num_slots)
+        self._chunk_tiers = self._tiers(32, self.prefill_chunk)
+        self.eos_id = eos_id
+        self.max_burst = max(1, max_burst if eos_id is None else
+                             min(max_burst, 4))
+        self._advance_margin = self.max_burst
+        self._b_max = math.ceil(max_len / self.block_size)
+        prefix_sharing = (knobs.kv_block_prefix_sharing
+                          if prefix_sharing is None else prefix_sharing)
+        self._jax = jax
+        self._jnp = jnp
+        self._rng = jax.random.key(seed)
+        self.cache = init_paged_cache(cfg, self.num_blocks, self.block_size)
+        self._prefill_chunk_fn, self._decode, self._copy_block = \
+            make_paged_engine_fns(cfg)
+        self._sample_one = jax.jit(sample_one)
+        bytes_per_block = (2 * cfg.n_layers * self.block_size
+                           * cfg.n_kv_heads * cfg.head_dim
+                           * jnp.zeros((), cfg.compute_dtype).dtype.itemsize)
+        self.allocator = KVBlockAllocator(
+            self.num_blocks, self.block_size, store=store,
+            bytes_per_block=bytes_per_block if store is not None else 0,
+            prefix_sharing=prefix_sharing)
+        # Host-side engine state: per-slot block tables + lengths (the
+        # compiled step only ever sees fixed (S, B_max) arrays).
+        self._tables = np.zeros((num_slots, self._b_max), np.int32)
+        self._lengths = np.zeros((num_slots,), np.int32)
+        self._last_tokens = np.zeros((num_slots,), np.int32)
+        self._slots: List[Optional[_Request]] = [None] * num_slots
+        self._prefillq: deque = deque()   # slots awaiting prefill chunks
+        self._pending: deque = deque()
+        self._pending_lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = False
+        self.stats = {"requests": 0, "tokens_generated": 0,
+                      "ttft_sum": 0.0, "completed": 0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefill_chunks": 0, "queue_waits": 0,
+                      "preemptions": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _pending_put(self, req: "_Request") -> None:
+        with self._pending_lock:
+            self._pending.append(req)
+        self._work.set()
+
+    def shutdown(self):
+        super().shutdown()
+        self._thread.join(timeout=5)
+        self.allocator.release()
+
+    def engine_stats(self) -> Dict[str, Any]:
+        s = super().engine_stats()
+        s.update(self.allocator.snapshot())
+        s["queue_depth"] = len(self._pending)
+        s["active"] = sum(1 for r in self._slots if r is not None)
+        return s
+
+    def warmup(self) -> None:
+        """Compile every width/chunk tier up front (benchmarks; serving
+        just compiles tiers lazily as load ramps).  Inactive-lane calls
+        scatter into the null block — garbage no request reads."""
+        import jax.numpy as jnp
+
+        for w in self._width_tiers:
+            z = np.zeros((w,), np.int32)
+            self.cache, _, self._rng = self._decode(
+                self.params, self.cache, jnp.asarray(z),
+                jnp.zeros((w, self._b_max), jnp.int32), jnp.asarray(z),
+                jnp.zeros((w,), bool), jnp.zeros((w,), jnp.float32),
+                self._rng, n_steps=self.max_burst)
+        for c in self._chunk_tiers:
+            self.cache, _ = self._prefill_chunk_fn(
+                self.params, self.cache, jnp.zeros((c,), jnp.int32),
+                jnp.zeros((self._b_max,), jnp.int32), jnp.int32(0),
+                jnp.int32(0))
+
+    def gauges(self) -> Dict[str, float]:
+        """Cheap autoscaling signals (riding the syncer push)."""
+        snap = self.allocator.snapshot()
+        return {"queue_depth": float(len(self._pending)),
+                "active": float(sum(1 for r in self._slots
+                                    if r is not None)),
+                "occupancy": snap["occupancy"]}
+
+    # -- engine loop ----------------------------------------------------
+    @staticmethod
+    def _tiers(lo: int, hi: int) -> List[int]:
+        out = []
+        w = lo
+        while w < hi:
+            out.append(w)
+            w *= 2
+        out.append(hi)
+        return out
+
+    def _tier_for(self, tiers: List[int], n: int) -> int:
+        for t in tiers:
+            if n <= t:
+                return t
+        return tiers[-1]
+
+    def _free_slot(self) -> int:
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return -1
+
+    def _table_row(self, slot: int, blocks: List[int]) -> None:
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+
+    def _admit_one(self) -> bool:
+        import jax.numpy as jnp
+
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        with self._pending_lock:
+            req = self._pending[0] if self._pending else None
+        if req is None:
+            return False
+        bs = self.block_size
+        n = len(req.prompt)
+        shared, covered, meta = self.allocator.lookup_prefix(req.prompt)
+        if covered == n and meta is None and shared:
+            # Whole-prompt chain without stored logits (evicted): fall
+            # back to re-prefilling the tail chunk.
+            self.allocator.free(shared[-1:])
+            shared = shared[:-1]
+            covered = len(shared) * bs
+        need = math.ceil(n / bs) - len(shared)
+        # Admission wants one burst of decode growth on top of the
+        # prompt — cuts (but can't eliminate; preemption is the
+        # backstop) admit-then-deadlock on growth blocks.
+        headroom = need + math.ceil(self.max_burst / bs)
+        alloc = ((self.allocator.alloc(need)
+                  if self.allocator.can_alloc(headroom) else None)
+                 if need > 0 else [])
+        if alloc is None:
+            # Pool exhausted: the request WAITS at the queue head (no
+            # error); completions free blocks and wake the loop.
+            self.allocator.free(shared)
+            self.stats["queue_waits"] += 1
+            return False
+        with self._pending_lock:
+            self._pending.popleft()
+        blocks = shared + alloc
+        req.blocks = blocks
+        req.slot = slot
+        req.pos = covered
+        self._slots[slot] = req
+        self._table_row(slot, blocks)
+        self._lengths[slot] = 0
+        if covered > 0:
+            self.stats["prefix_hits"] += 1
+        if covered == n:
+            # Whole-prompt hit: sample the first token from the stored
+            # last-logits under THIS request's temperature — no prompt
+            # forward at all.  COW the (shared) partial tail before
+            # decode appends into it.
+            try:
+                self._cow_tail(req)
+                tok, self._rng = self._sample_one(
+                    meta, jnp.float32(req.temperature), self._rng)
+                self._begin_decode(req, int(tok))
+            except BaseException as e:  # noqa: BLE001
+                self._fail_request(req, e)
+            return True
+        if covered == 0:
+            self.stats["prefix_misses"] += 1
+        self._prefillq.append(slot)
+        return True
+
+    def _cow_tail(self, req: "_Request", n_ctx: Optional[int] = None
+                  ) -> None:
+        """Give `req` an exclusively-owned, writable tail block (device
+        copy when the tail is shared or registered)."""
+        import jax.numpy as jnp
+
+        n = len(req.prompt) if n_ctx is None else n_ctx
+        if n % self.block_size == 0 or not req.blocks:
+            return  # aligned: first append allocates a fresh block
+        tail = req.blocks[-1]
+        new, copied = self.allocator.cow(tail)
+        if copied:
+            self.cache = self._copy_block(self.cache, jnp.int32(new),
+                                          jnp.int32(tail))
+            req.blocks[-1] = new
+            self._table_row(req.slot, req.blocks)
+
+    def _begin_decode(self, req: "_Request", first_tok: int) -> None:
+        # KV written so far = the prefilled context (a preempted request
+        # re-enters here with out_tokens already emitted).
+        n_ctx = len(req.prompt) + len(req.out_tokens)
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
+        req.prefilling = False
+        req.emit(first_tok)
+        self._last_tokens[req.slot] = first_tok
+        self._lengths[req.slot] = n_ctx
+        self._maybe_finish(req.slot)
+
+    def _fail_request(self, req: "_Request", e: BaseException) -> None:
+        req.error = e
+        slot = req.slot
+        if 0 <= slot < self.num_slots and self._slots[slot] is req:
+            self._slots[slot] = None
+            self._tables[slot, :] = 0
+        if slot in self._prefillq:
+            self._prefillq.remove(slot)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        if req.token_q is not None:
+            try:
+                req.token_q.put_nowait(None)
+            except queue.Full:
+                pass
+        req.done.set()
+
+    def _prefill_tick(self) -> bool:
+        """Prefill chunks in FIFO order under a TOKEN budget of
+        `prefill_chunk` per engine tick: a max-length prompt consumes
+        the whole budget in one wide chunk (then yields the device back
+        to decode — the ITL bound), while a tickful of short prompts
+        batches several narrow chunks into the same budget (admission
+        isn't serialized to one prompt per tick)."""
+        import jax.numpy as jnp
+
+        budget = self.prefill_chunk
+        progressed = False
+        while self._prefillq and budget > 0:
+            slot = self._prefillq[0]
+            req = self._slots[slot]
+            if req is None:
+                self._prefillq.popleft()
+                continue
+            try:
+                # Preempted requests re-prefill their WHOLE context —
+                # prompt plus the tokens already emitted (the stream
+                # keeps every token; only the KV is recomputed).
+                ctx = req.prompt + req.out_tokens
+                n = len(ctx)
+                if not req.blocks:   # preemption freed them: re-alloc
+                    # Resume only with one burst of growth headroom on
+                    # top of the context — otherwise the resumed
+                    # request immediately re-stalls on the blocks it
+                    # just freed and ping-pongs with the survivor.
+                    bs = self.block_size
+                    headroom = math.ceil((n + self.max_burst) / bs)
+                    alloc = (self.allocator.alloc(math.ceil(n / bs))
+                             if self.allocator.can_alloc(headroom)
+                             else None)
+                    if alloc is None:
+                        self.stats["queue_waits"] += 1
+                        break        # wait for completions to free blocks
+                    req.blocks = alloc
+                    self._table_row(slot, req.blocks)
+                nv = min(budget, n - req.pos)
+                c = self._tier_for(self._chunk_tiers, nv)
+                nv = min(nv, c)
+                toks = np.zeros((c,), np.int32)
+                toks[:nv] = ctx[req.pos:req.pos + nv]
+                self.cache, last_logits = self._prefill_chunk_fn(
+                    self.params, self.cache, jnp.asarray(toks),
+                    jnp.asarray(self._tables[slot]), jnp.int32(req.pos),
+                    jnp.int32(nv))
+                req.pos += nv
+                budget -= nv
+                progressed = True
+                self.stats["prefill_chunks"] += 1
+                if req.pos >= n:
+                    self._prefillq.popleft()
+                    if not req.out_tokens:
+                        # Publish the prompt's blocks for prefix reuse
+                        # BEFORE our own appends diverge the tail (COW
+                        # keeps the registered copy pristine).  Resumed
+                        # contexts contain generated tokens — not
+                        # reusable prompts; skip.
+                        self.allocator.register_prefix(
+                            req.prompt, req.blocks, meta=last_logits)
+                    self._cow_tail(req, n)
+                    tok, self._rng = self._sample_one(
+                        last_logits, jnp.float32(req.temperature),
+                        self._rng)
+                    self._begin_decode(req, int(tok))
+            except BaseException as e:  # noqa: BLE001
+                if self._prefillq and self._prefillq[0] == slot:
+                    self._prefillq.popleft()
+                self._fail_request(req, e)
+        return progressed
+
+    def _ensure_blocks(self, req: "_Request", upto: int) -> bool:
+        """Extend `req`'s table to cover positions [0, upto) — alloc on
+        demand.  False = pool exhausted; the slot sits out this burst
+        (it resumes when completions free blocks)."""
+        need = math.ceil(upto / self.block_size) - len(req.blocks)
+        if need <= 0:
+            return True
+        alloc = self.allocator.alloc(need)
+        if alloc is None:
+            return False
+        req.blocks.extend(alloc)
+        self._table_row(req.slot, req.blocks)
+        return True
+
+    def _decode_tick(self) -> bool:
+        import jax.numpy as jnp
+
+        burst = self.max_burst
+        idx: List[int] = []
+        stalled: List[int] = []
+        for i, req in enumerate(self._slots):
+            if req is None or req.prefilling:
+                continue
+            if self._ensure_blocks(req, int(self._lengths[i]) + burst):
+                idx.append(i)
+            else:
+                stalled.append(i)
+        if not idx:
+            if len(stalled) >= 2:
+                # Deadlock: every decoder needs growth blocks and the
+                # pool is exhausted by the decoders themselves — nobody
+                # can finish to free blocks.  Preempt the youngest
+                # (vLLM-style recompute preemption): its blocks free the
+                # others; it re-prefills prompt+emitted later.
+                self._preempt(max(stalled,
+                                  key=lambda i:
+                                  self._slots[i].submitted_at))
+            return False
+        # Compact the active slots into the smallest width tier: device
+        # work tracks the number of LIVE streams, not the configured
+        # capacity (a ramp-up tick with 3 decoders runs a width-4 burst,
+        # not a num_slots-wide one).  All per-slot state is host-side,
+        # so lane mapping is just row selection.
+        w = self._tier_for(self._width_tiers, len(idx))
+        tokens = np.zeros((w,), np.int32)
+        tables = np.zeros((w, self._b_max), np.int32)
+        lengths = np.zeros((w,), np.int32)
+        active = np.zeros((w,), bool)
+        temps = np.zeros((w,), np.float32)
+        for j, i in enumerate(idx):
+            tokens[j] = self._last_tokens[i]
+            tables[j] = self._tables[i]
+            lengths[j] = self._lengths[i]
+            active[j] = True
+            temps[j] = self._slots[i].temperature
+        try:
+            self.cache, tok_mat, self._rng = self._decode(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(active), jnp.asarray(temps), self._rng,
+                n_steps=burst)
+            tok_mat = np.asarray(tok_mat)              # (burst, w)
+            for j, i in enumerate(idx):
+                req = self._slots[i]
+                self._lengths[i] += burst   # KV written for every step
+                for step in range(burst):
+                    tok = int(tok_mat[step, j])
+                    if len(req.out_tokens) >= req.max_tokens:
+                        break  # over-generated tail: trim
+                    req.emit(tok)
+                    self._last_tokens[i] = tok
+                    self.stats["tokens_generated"] += 1
+                    if self.eos_id is not None and tok == self.eos_id:
+                        break
+                self._maybe_finish(i)
+        except BaseException as e:  # noqa: BLE001
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    self._fail_request(req, e)
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a stalled decoder: free its blocks (unblocking the
+        others) and queue it for full-context re-prefill.  The stream
+        keeps every emitted token — only KV is recomputed."""
+        req = self._slots[slot]
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        self._tables[slot, :] = 0
+        self._lengths[slot] = 0
+        req.pos = 0
+        req.prefilling = True
+        self._prefillq.append(slot)
+        self.stats["preemptions"] += 1
+
+    def _maybe_finish(self, slot: int) -> None:
+        req = self._slots[slot]
+        if req is None:
+            return
+        tok = req.out_tokens[-1] if req.out_tokens else None
+        hit_eos = self.eos_id is not None and tok == self.eos_id
+        full = (len(req.prompt) + len(req.out_tokens)
+                >= self.max_len - 1 - self._advance_margin)
+        if hit_eos or full or len(req.out_tokens) >= req.max_tokens \
+                or req.dropped:
+            self._slots[slot] = None
+            self._tables[slot, :] = 0
+            self.allocator.free(req.blocks)
+            req.blocks = []
+            self._finish_request(req)
+            self._work.set()   # freed blocks may unblock the queue head
+
+    def _loop(self):
+        while not self._stop:
+            progressed = False
+            # Admit as many waiting requests as slots + blocks allow.
+            while self._admit_one():
+                progressed = True
+            progressed |= self._decode_tick()
+            progressed |= self._prefill_tick()
+            if not progressed:
+                self._work.wait(timeout=0.02)
+                self._work.clear()
 
 
 def dryrun_tp_serving(cfg, tp: int, *, timeout: float = 45.0) -> None:
@@ -444,10 +979,18 @@ def dryrun_tp_serving(cfg, tp: int, *, timeout: float = 45.0) -> None:
 
 class LLMDeployment:
     """Serve-deployable wrapper: __call__({"tokens": [...], ...}) →
-    {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...)."""
+    {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...).
 
-    def __init__(self, cfg_name, *, num_slots: int = 8,
-                 max_len: int = 512, seed: int = 0,
+    `engine="paged"` (default) serves through the paged KV-cache engine;
+    `engine="fixed"` keeps the fixed-slot engine.  Tensor-parallel
+    deployments fall back to the fixed engine (the paged kernels are
+    single-device for now)."""
+
+    def __init__(self, cfg_name, *, engine: str = "paged",
+                 num_slots: int = 8, max_len: int = 512, seed: int = 0,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  prefix_cache_size: int = 4, speculation_k: int = 0,
                  tensor_parallel: int = 0,
                  params_loader: Optional[Callable] = None):
@@ -479,10 +1022,25 @@ class LLMDeployment:
                     f"{len(jax.devices())} visible devices")
             mesh = build_mesh(MeshConfig(tp=tensor_parallel, fsdp=1),
                               devices=devs)
-        self.engine = LLMEngine(cfg, params, num_slots=num_slots,
-                                max_len=max_len,
-                                prefix_cache_size=prefix_cache_size,
-                                speculation_k=speculation_k, mesh=mesh)
+            engine = "fixed"
+        if engine == "paged":
+            store = None
+            try:
+                import ray_tpu.api as _api
+
+                if _api.is_initialized():
+                    store = getattr(_api._global_worker(), "store", None)
+            except Exception:  # noqa: BLE001 standalone use
+                store = None
+            self.engine = PagedLLMEngine(
+                cfg, params, num_slots=num_slots, max_len=max_len,
+                block_size=block_size, num_blocks=num_blocks,
+                prefill_chunk=prefill_chunk, seed=seed, store=store)
+        else:
+            self.engine = LLMEngine(cfg, params, num_slots=num_slots,
+                                    max_len=max_len,
+                                    prefix_cache_size=prefix_cache_size,
+                                    speculation_k=speculation_k, mesh=mesh)
 
     def __call__(self, request: dict) -> dict:
         toks = self.engine.generate(
@@ -500,5 +1058,17 @@ class LLMDeployment:
                 temperature=float(request.get("temperature", 0.0))):
             yield {"token": tok}
 
-    def stats(self) -> dict:
+    def stats(self, _request: Optional[dict] = None) -> dict:
         return self.engine.engine_stats()
+
+    def engine_gauges(self) -> dict:
+        """Replica gauge hook: the Replica actor piggybacks these on the
+        node daemon's syncer push (serve autoscaling input)."""
+        g = getattr(self.engine, "gauges", None)
+        if g is not None:
+            return g()
+        s = self.engine.engine_stats()
+        return {"queue_depth": 0.0,
+                "active": float(s.get("requests", 0)
+                                - s.get("completed", 0)),
+                "occupancy": 0.0}

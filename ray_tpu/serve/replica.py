@@ -90,6 +90,39 @@ class Replica:
         else:
             self._callable = cls_or_fn
             self._is_func = True
+        self._gauge_stop = threading.Event()
+        threading.Thread(target=self._gauge_loop, daemon=True).start()
+
+    # -- autoscaling gauges ---------------------------------------------
+    def _gauge_loop(self, period_s: float = 1.0) -> None:
+        """Push this replica's gauges (ongoing count + whatever the user
+        callable's `engine_gauges()` reports, e.g. the paged engine's
+        queue depth / KV occupancy) to the LOCAL node daemon; the
+        daemon's syncer delta carries the aggregate to the GCS, where
+        the controller reads one merged per-app view per autoscale tick
+        instead of polling replicas."""
+        # replica_id format: "serve:<app>#g<gen>#<idx>"
+        app = self.replica_id.split(":", 1)[-1].split("#", 1)[0]
+        while not self._gauge_stop.wait(period_s):
+            try:
+                from ray_tpu.api import _global_worker, is_initialized
+
+                if not is_initialized():
+                    continue
+                daemon = getattr(_global_worker(), "daemon", None)
+                if daemon is None:  # local mode: no daemon, no syncer
+                    return
+                gauges = {"ongoing": float(self._ongoing),
+                          "streams": float(len(self._streams))}
+                hook = getattr(self._callable, "engine_gauges", None)
+                if callable(hook):
+                    for k, v in (hook() or {}).items():
+                        gauges[k] = float(v)
+                daemon.call("NodeDaemon", "report_serve_gauges",
+                            app=app, replica=self.replica_id,
+                            gauges=gauges, timeout=2)
+            except Exception:  # noqa: BLE001 best-effort telemetry
+                continue
 
     def _resolve(self, method: str):
         if self._is_func or method == "__call__":

@@ -1,0 +1,106 @@
+"""Serving at scale: hundreds of concurrent HTTP token streams through
+the proxy into one paged-engine replica — zero drops, deterministic
+per-prompt output.  Slow (compiles + real load); run with `-m slow`.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.llm import LLMDeployment
+
+N_STREAMS = 256
+N_PROMPTS = 16          # distinct prompts; each repeated N_STREAMS/N_PROMPTS x
+MAX_TOKENS = 16
+PROMPT_LEN = 8
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _prompt(i):
+    base = (i % N_PROMPTS) * 31
+    return [(base + j) % 251 + 1 for j in range(PROMPT_LEN)]
+
+
+def test_256_concurrent_http_streams_zero_drops():
+    serve.run(
+        serve.deployment(LLMDeployment).bind(
+            "tiny", engine="paged", num_slots=8, max_len=128),
+        name="llm_scale", _http=True, route_prefix="/llm_scale")
+    port = serve.http_port()
+    url = f"http://127.0.0.1:{port}/llm_scale?stream=1&method=stream"
+
+    # Replica readiness: the engine compiles in the constructor.
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if serve.status().get("llm_scale", {}).get("ready", 0) >= 1:
+            break
+        time.sleep(1.0)
+    else:
+        raise RuntimeError(f"replica never ready: {serve.status()}")
+
+    def one_stream(i):
+        body = json.dumps({"tokens": _prompt(i),
+                           "max_tokens": MAX_TOKENS}).encode()
+        resp = urllib.request.urlopen(
+            urllib.request.Request(url, data=body), timeout=600)
+        toks = []
+        for line in resp:
+            item = json.loads(line)
+            if "error" in item:
+                raise AssertionError(f"stream {i} error: {item['error']}")
+            toks.append(item["token"])
+        return toks
+
+    one_stream(0)   # warmup: trigger the first prefill/decode compiles
+
+    results = [None] * N_STREAMS
+    failures = []
+    lock = threading.Lock()
+
+    def worker(i):
+        try:
+            results[i] = one_stream(i)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                failures.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_STREAMS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+
+    assert not failures, f"{len(failures)} failed streams: {failures[:5]}"
+    # Every stream completed in full — bounded token queues never dropped.
+    for i, toks in enumerate(results):
+        assert toks is not None and len(toks) == MAX_TOKENS, (
+            f"stream {i}: {None if toks is None else len(toks)} tokens")
+    # Greedy decoding is deterministic: all repeats of a prompt must have
+    # produced the identical token sequence despite 256-way interleaving.
+    by_prompt = {}
+    for i, toks in enumerate(results):
+        by_prompt.setdefault(i % N_PROMPTS, set()).add(tuple(toks))
+    for p, outs in by_prompt.items():
+        assert len(outs) == 1, f"prompt {p} diverged across repeats"
+
+    # Engine-side accounting agrees: nothing dropped, pool fully freed.
+    stats_url = (f"http://127.0.0.1:{port}/llm_scale?method=stats")
+    req = urllib.request.Request(stats_url, data=b"null")
+    st = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert st.get("completed", 0) >= N_STREAMS
+    assert st.get("blocks_active", 0) == 0
+    serve.delete("llm_scale")
