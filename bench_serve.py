@@ -1,4 +1,4 @@
-"""Serve benchmark: three probes over the serving plane.
+"""Serve benchmark: four probes over the serving plane.
 
   http_stream   legacy end-to-end probe: continuous-batching deployment
                 behind the async HTTP proxy with chunked token streaming
@@ -10,14 +10,20 @@
                 KV-token budget as engine_fixed: num_slots*max_len
                 tokens carved into blocks) under the same N streams —
                 the apples-to-apples claim for the paged engine.
+  chaos         fault-tolerance probe: N concurrent handle-level token
+                streams across 2 replicas, one replica SIGKILLed
+                mid-run; records the fraction of in-flight streams that
+                complete (via resumable-stream failover + recompute)
+                and the p99 ITL degradation vs an identical kill-free
+                baseline phase.
 
 At stream counts far above the fixed engine's slot count, TTFT is
 admission-LIMITED (queueing behind slot admission dominates prefill);
 the artifact labels the regime explicitly so percentiles aren't
 misread.
 
-Usage: python bench_serve.py [--only http,fixed,paged] [--round 13]
-       [--streams 1024] [--out BENCH_SERVE_r13.json]
+Usage: python bench_serve.py [--only http,fixed,paged,chaos]
+       [--round 14] [--streams 1024] [--out BENCH_SERVE_r14.json]
 """
 from __future__ import annotations
 
@@ -295,12 +301,157 @@ def probe_engine_paged(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# probe: chaos (mid-run replica kill under concurrent streams)
+# ---------------------------------------------------------------------------
+def probe_chaos(args) -> dict:
+    """Two identical phases of N concurrent handle-level token streams
+    over a 2-replica LLM deployment; phase two SIGKILLs one replica once
+    the run is underway. Streams on the dead replica fail over via the
+    handle's resume protocol (prompt + emitted tokens recomputed on the
+    survivor), so the headline numbers are the recovered-stream fraction
+    and how much the failover + recompute stretches tail ITL."""
+    import os
+    import signal
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.serve.llm import LLMDeployment
+
+    n_streams = args.chaos_streams
+    max_tokens = args.max_tokens
+    ray_tpu.init(num_cpus=4)
+    app = "llm_chaos"
+    serve.run(
+        serve.deployment(LLMDeployment, num_replicas=2).bind(
+            args.model, engine="fixed", num_slots=args.num_slots,
+            max_len=args.max_len),
+        name=app)
+    controller = get_or_create_controller()
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        st = serve.status().get(app, {})
+        if st.get("ready", 0) >= 2:
+            break
+        time.sleep(1.0)
+    else:
+        raise RuntimeError(f"chaos replicas never ready: {serve.status()}")
+
+    handle = serve.get_app_handle(app).options(method_name="stream")
+    # warmup: compile prefill/decode on both replicas
+    for _ in range(2):
+        list(handle.remote_streaming(
+            {"tokens": [1, 2, 3], "max_tokens": 2}))
+
+    def drive(phase_kill: bool) -> dict:
+        lock = threading.Lock()
+        itls: list = []
+        completed = [0]
+        resumed = [0]
+        errors = [0]
+        tokens_seen = [0]
+        underway = threading.Event()
+
+        def client(i: int):
+            prompt = [(i * 7 + j) % 251 + 1 for j in range(16)]
+            resp = handle.remote_streaming(
+                {"tokens": prompt, "max_tokens": max_tokens})
+            last = None
+            got = 0
+            gaps = []
+            try:
+                for _ in resp:
+                    now = time.perf_counter()
+                    if last is not None:
+                        gaps.append(now - last)
+                    last = now
+                    got += 1
+                    with lock:
+                        tokens_seen[0] += 1
+                        if tokens_seen[0] >= n_streams:
+                            underway.set()
+            except Exception:  # noqa: BLE001
+                with lock:
+                    errors[0] += 1
+                return
+            with lock:
+                itls.extend(gaps)
+                if got == max_tokens:
+                    completed[0] += 1
+                if getattr(resp, "resumes", 0):
+                    resumed[0] += 1
+
+        def killer():
+            # Wait until ~one token per stream has flowed, then SIGKILL
+            # one replica process (crash, not graceful drain).
+            if not underway.wait(timeout=120):
+                return
+            routing = ray_tpu.get(
+                controller.get_routing.remote(app), timeout=30)
+            victim = sorted(routing["replicas"])[0]
+            try:
+                h = ray_tpu.get_actor(victim)
+                pid = ray_tpu.get(h.getpid.remote(), timeout=10)
+                os.kill(pid, signal.SIGKILL)
+            except Exception:  # noqa: BLE001  fallback: actor-level kill
+                ray_tpu.kill(ray_tpu.get_actor(victim))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_streams)]
+        kt = (threading.Thread(target=killer, daemon=True)
+              if phase_kill else None)
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if kt:
+            kt.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        itls.sort()
+        return {
+            "streams": n_streams,
+            "completed": completed[0],
+            "completed_fraction": round(completed[0] / n_streams, 4),
+            "resumed_streams": resumed[0],
+            "errors": errors[0],
+            "wall_s": round(wall, 2),
+            "itl_p50_ms": {"value": round(
+                1000 * (_pct(itls, 0.50) or 0), 1), "unit": "ms"},
+            "itl_p99_ms": {"value": round(
+                1000 * (_pct(itls, 0.99) or 0), 1), "unit": "ms"},
+        }
+
+    baseline = drive(phase_kill=False)
+    chaos = drive(phase_kill=True)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    base_p99 = baseline["itl_p99_ms"]["value"] or 1e-9
+    return {
+        "baseline": baseline,
+        "replica_kill": chaos,
+        "recovered_fraction": chaos["completed_fraction"],
+        "itl_p99_degradation_x": round(
+            chaos["itl_p99_ms"]["value"] / base_p99, 2),
+        "config": {
+            "num_replicas": 2, "engine": "fixed",
+            "num_slots": args.num_slots, "max_len": args.max_len,
+            "max_tokens": max_tokens, "chaos_streams": n_streams,
+            "kill": "SIGKILL one of 2 replicas once >= 1 token/stream "
+                    "has flowed; streams resume on the survivor via "
+                    "prompt+emitted recompute (exactly-once)",
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny")
-    ap.add_argument("--only", default="http,fixed,paged",
-                    help="comma-set of probes: http,fixed,paged")
-    ap.add_argument("--round", type=int, default=13,
+    ap.add_argument("--only", default="http,fixed,paged,chaos",
+                    help="comma-set of probes: http,fixed,paged,chaos")
+    ap.add_argument("--round", type=int, default=14,
                     help="bench round number recorded in the artifact")
     ap.add_argument("--out", default=None,
                     help="write the artifact JSON here")
@@ -322,6 +473,9 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=0,
                     help="0: RAY_TPU_KV_BLOCK_SIZE / config default")
     ap.add_argument("--prefill-chunk", type=int, default=128)
+    # chaos probe knobs
+    ap.add_argument("--chaos-streams", type=int, default=256,
+                    help="concurrent streams in the replica-kill probe")
     args = ap.parse_args()
 
     import os
@@ -349,6 +503,12 @@ def main() -> None:
         emit("serve_paged_tokens_per_second",
              probes["engine_paged"]["tokens_per_second"]["value"],
              "tokens/s")
+    if "chaos" in only:
+        probes["chaos"] = probe_chaos(args)
+        emit("serve_chaos_recovered_fraction",
+             probes["chaos"]["recovered_fraction"], "fraction")
+        emit("serve_chaos_itl_p99_degradation",
+             probes["chaos"]["itl_p99_degradation_x"], "x")
     if "http" in only:
         probes["http_stream"] = probe_http(args)
         emit("serve_requests_per_second",

@@ -308,6 +308,31 @@ class Config:
     # handle process that exited between pushes expire instead of
     # flapping the replica target.
     serve_autoscale_stats_ttl_s: float = 5.0
+    # ---- serving-plane robustness (PR: fault-tolerant serving) ----
+    # Handle-side retry budget for replica-death/draining failures:
+    # attempts (total tries) and capped exponential backoff + jitter
+    # between them, mirroring the elastic-train knobs.  Also bounds the
+    # number of mid-stream failover resumes per streaming response.
+    serve_retry_max: int = 3
+    serve_retry_backoff_initial_s: float = 0.05
+    serve_retry_backoff_max_s: float = 2.0
+    serve_retry_backoff_multiplier: float = 2.0
+    serve_retry_backoff_jitter: float = 0.2
+    # Graceful drain on downscale/redeploy: a retiring replica stops
+    # admission, keeps serving in-flight streams up to this long, then
+    # exits; whatever remains migrates-by-recompute through the handle
+    # resume path.
+    serve_drain_timeout_s: float = 30.0
+    # HTTP proxy admission bound: requests beyond this many in flight
+    # are shed with 503 + Retry-After instead of queueing without limit.
+    serve_proxy_max_inflight: int = 256
+    # Per-request deadline on proxied unary calls and per-pull deadline
+    # on proxied/handle streams (replaces the old hardcoded 120 s).
+    serve_request_deadline_s: float = 120.0
+    # Per-tick wall budget for the controller's concurrent replica
+    # health probes (shared deadline across the bounded gather, not
+    # per-replica).
+    serve_health_timeout_s: float = 10.0
 
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout

@@ -82,6 +82,22 @@ class ActorUnavailableError(RayTpuError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class ReplicaDrainingError(RayTpuError):
+    """The serve replica is draining (downscale/redeploy) and no longer
+    admits new requests.  Retry through the handle: routing excludes the
+    draining replica after the next refresh.  Subclasses RayTpuError so
+    the worker executor forwards it TYPED across the actor wire (see
+    worker_main's RayTpuError passthrough) — callers catch it by type."""
+
+    def __init__(self, replica_id: str = ""):
+        self.replica_id = replica_id
+        super().__init__(f"replica {replica_id!r} is draining; "
+                         f"re-route this request")
+
+    def __reduce__(self):  # see TaskError.__reduce__
+        return (type(self), (self.replica_id,))
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before or during execution."""
 

@@ -13,6 +13,28 @@ _proxy_handle = None
 _proxy_port: Optional[int] = None
 
 
+def _update_persisted_routes(mutate) -> None:
+    """Read-modify-write the durable route table ("serve"/"routes" in
+    the GCS KV): a restarted HTTP proxy — or one started after a
+    controller/GCS restart — re-installs routes from here instead of
+    coming back empty.  Best-effort: local mode has no KV."""
+    import json as _json
+
+    try:
+        from ray_tpu.api import _global_worker, is_initialized
+
+        if not is_initialized():
+            return
+        w = _global_worker()
+        blob = w.kv_get("serve", b"routes")
+        routes = _json.loads(blob.decode()) if blob else {}
+        mutate(routes)
+        w.kv_put("serve", b"routes",
+                 _json.dumps(routes, sort_keys=True).encode())
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _deploy_one(controller, name: str, dep: Deployment, init_args,
                 init_kwargs) -> None:
     cfg = {
@@ -136,6 +158,8 @@ def run(app: Application | Deployment, *, name: str = "default",
             break
         time.sleep(0.1)
     if _http and route_prefix:
+        _update_persisted_routes(lambda r: r.__setitem__(route_prefix,
+                                                         name))
         # Await route installation: a request racing a fire-and-forget
         # set_route would 404.
         ray_tpu.get(start_http_proxy().set_route.remote(route_prefix, name),
@@ -227,6 +251,15 @@ def delete(app_name: str) -> None:
     # Ingress first: once it is gone no request can route into the
     # children, so their teardown never strands an in-flight call.
     doomed.sort(key=lambda a: (a != app_name, a))
+    _update_persisted_routes(
+        lambda r: [r.pop(p) for p, a in list(r.items()) if a in doomed])
+    if _proxy_handle is not None:
+        for a in doomed:
+            try:
+                ray_tpu.get(_proxy_handle.remove_routes_for.remote(a),
+                            timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
     for a in doomed:
         ray_tpu.get(controller.delete_app.remote(a), timeout=30)
 

@@ -24,6 +24,21 @@ from ray_tpu.serve.replica import Replica
 CONTROLLER_NAME = "serve:controller"
 
 
+def _worker_kv():
+    """Best-effort handle to the GCS internal KV (None outside a
+    cluster).  Backed by the GCS PersistentStore when the cluster runs
+    with gcs_storage_dir, so serve state survives both controller death
+    and GCS restart."""
+    try:
+        from ray_tpu.api import _global_worker, is_initialized
+
+        if not is_initialized():
+            return None
+        return _global_worker()
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class ServeController:
     """Runs inside a detached actor; reconciliation on a background thread."""
 
@@ -52,9 +67,93 @@ class ServeController:
         self._ready: set = set()
         self._startup_grace_s = float(
             os.environ.get("RAY_TPU_SERVE_STARTUP_GRACE_S", "600"))
+        self._health_timeout_s = get_config().serve_health_timeout_s
+        self._drain_timeout_s = get_config().serve_drain_timeout_s
+        # Retiring replica names -> wall deadline.  Entries block actor-
+        # name reuse while the draining process may still be alive and
+        # keep the name out of routing; they age out after the drain
+        # window (the replica self-terminates at its own deadline).
+        self._draining: Dict[str, float] = {}
+        # Controller failover: a restarted controller rebuilds targets
+        # from the GCS KV and ADOPTS still-running replicas instead of
+        # redeploying the world.
+        self._recover()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
         self._thread.start()
+
+    # ---- persistence / recovery (GCS KV, "serve" namespace) ----------
+    def _persist_app(self, app_name: str) -> None:
+        w = _worker_kv()
+        if w is None:
+            return
+        try:
+            import cloudpickle
+
+            spec = self._targets.get(app_name)
+            key = b"app:" + app_name.encode()
+            if spec is None:
+                w.kv_del("serve", key)
+            else:
+                # cloudpickle: deployment targets are often classes/
+                # closures defined in driver scope, not importable names.
+                w.kv_put("serve", key, cloudpickle.dumps(spec))
+        except Exception:  # noqa: BLE001 persistence is best-effort
+            pass
+
+    def _recover(self) -> None:
+        w = _worker_kv()
+        if w is None:
+            return
+        try:
+            keys = w.kv_keys("serve", b"app:")
+        except Exception:  # noqa: BLE001
+            return
+        import cloudpickle
+
+        for key in keys or []:
+            try:
+                blob = w.kv_get("serve", key)
+                if not blob:
+                    continue
+                app = key[len(b"app:"):].decode()
+                self._targets[app] = cloudpickle.loads(blob)
+                self._state[app] = {"replicas": {}, "gens": {},
+                                    "version": 0}
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        if not self._targets:
+            return
+        # Adopt live replicas recorded in the last published status blob:
+        # ping each named actor and re-take ownership of the healthy ones
+        # (no duplicate replicas); dead ones are replaced by the first
+        # reconcile tick.
+        try:
+            import json as _json
+
+            blob = w.kv_get("serve", b"status")
+            status = _json.loads(blob.decode()) if blob else {}
+        except Exception:  # noqa: BLE001
+            status = {}
+        for app, info in status.items():
+            st = self._state.get(app)
+            if st is None:
+                continue
+            for name in info.get("replicas", []):
+                try:
+                    h = ray_tpu.get_actor(name)
+                    ray_tpu.get(h.check_health.remote(), timeout=5)
+                except Exception:  # noqa: BLE001
+                    continue
+                try:
+                    gen = int(name.rsplit("#g", 1)[1].split("#", 1)[0])
+                except (IndexError, ValueError):
+                    gen = self._targets[app]["gen"]
+                st["replicas"][name] = h
+                st["gens"][name] = gen
+                self._started_at[name] = time.monotonic()
+                self._ready.add(name)
+            st["version"] += 1
 
     # ---- API used by serve.run / handles -----------------------------
     def deploy(self, app_name: str, cls_or_fn, init_args, init_kwargs,
@@ -71,11 +170,13 @@ class ServeController:
             self._state.setdefault(app_name,
                                    {"replicas": {}, "gens": {}, "version": 0})
             self._state[app_name]["version"] += 1
+            self._persist_app(app_name)
         return True
 
     def delete_app(self, app_name: str) -> bool:
         with self._lock:
             self._targets.pop(app_name, None)
+            self._persist_app(app_name)
         return True
 
     def get_routing(self, app_name: str) -> dict:
@@ -172,16 +273,28 @@ class ServeController:
                         and now - last > asc["upscale_delay_s"]:
                     tgt["num_replicas"] = n + 1
                     self._last_scale[app_name] = now
+                    self._persist_app(app_name)
                 elif per < asc["target_ongoing_requests"] / 2 \
                         and n > asc["min_replicas"] \
                         and now - last > asc["downscale_delay_s"]:
                     tgt["num_replicas"] = n - 1
                     self._last_scale[app_name] = now
+                    self._persist_app(app_name)
 
     def shutdown(self) -> bool:
         self._stop = True
         with self._lock:
             self._targets.clear()
+        # Clear persisted serve state: an intentional shutdown must not
+        # be resurrected by the next controller's recovery pass.
+        w = _worker_kv()
+        if w is not None:
+            try:
+                for key in (w.kv_keys("serve", b"app:") or []):
+                    w.kv_del("serve", key)
+                w.kv_del("serve", b"routes")
+            except Exception:  # noqa: BLE001
+                pass
         self._reconcile_once()
         # Publish the now-empty snapshot: the loop exits on _stop, so
         # without this the dashboard would show the dead apps as
@@ -231,6 +344,13 @@ class ServeController:
         with self._lock:
             apps = dict(self._state)
             targets = dict(self._targets)
+        # Age out drain records once the replica's own deadline (plus
+        # slack for the exit itself) has certainly passed — their actor
+        # names become reusable again.
+        now_wall = time.monotonic()
+        for name, dl in list(self._draining.items()):
+            if now_wall > dl + 5.0:
+                self._draining.pop(name, None)
         RemoteReplica = ray_tpu.remote(Replica)
 
         for app, st in apps.items():
@@ -240,29 +360,51 @@ class ServeController:
             have = dict(st["replicas"])
             gens = dict(st.get("gens", {}))
 
-            def _kill(name):
-                try:
-                    ray_tpu.kill(have[name])
-                except Exception:  # noqa: BLE001
-                    pass
-                have.pop(name)
+            def _forget(name):
+                have.pop(name, None)
                 gens.pop(name, None)
                 self._started_at.pop(name, None)
                 self._ready.discard(name)
 
+            def _kill(name):
+                # Hard stop: health-failed replicas only (a wedged
+                # process cannot drain).
+                try:
+                    ray_tpu.kill(have[name])
+                except Exception:  # noqa: BLE001
+                    pass
+                _forget(name)
+
+            def _retire(name):
+                # Graceful drain (downscale / redeploy): the replica
+                # stops admission, finishes in-flight streams up to the
+                # drain deadline, then exits on its own; routing drops it
+                # NOW, and still-attached streams migrate-by-recompute
+                # through the handle resume path when it exits.
+                handle = have[name]
+                self._draining[name] = (time.monotonic()
+                                        + self._drain_timeout_s)
+                try:
+                    handle.drain.remote(self._drain_timeout_s)
+                except Exception:  # noqa: BLE001 already dead
+                    _kill(name)
+                    return
+                _forget(name)
+
             # replace replicas from an older deploy generation (redeploy
             # with new code/args must not leave old-version replicas serving)
             for name in [n for n, g in list(gens.items()) if g != gen]:
-                _kill(name)
+                _retire(name)
             # scale down
             while len(have) > want:
-                _kill(sorted(have)[-1])
-            # scale up
+                _retire(sorted(have)[-1])
+            # scale up (never reuse a name whose draining process may
+            # still be alive)
             idx = 0
             while len(have) < want:
                 while True:
                     name = f"serve:{app}#g{gen}#{idx}"
-                    if name not in have:
+                    if name not in have and name not in self._draining:
                         break
                     idx += 1
                 opts = dict(tgt["config"].get("ray_actor_options") or {})
@@ -276,10 +418,24 @@ class ServeController:
                 self._started_at[name] = time.monotonic()
             # health check: starting replicas get grace until their first
             # successful probe; after that a failed probe means dead.
-            now = time.monotonic()
+            # Probes run CONCURRENTLY under one shared wall deadline
+            # (bounded gather): all refs are submitted first, then
+            # collected — one wedged replica costs the tick
+            # serve_health_timeout_s total, not timeout x replicas.
+            refs = {}
             for name in list(have):
                 try:
-                    ray_tpu.get(have[name].check_health.remote(), timeout=10)
+                    refs[name] = have[name].check_health.remote()
+                except Exception:  # noqa: BLE001
+                    refs[name] = None
+            now = time.monotonic()
+            deadline = now + self._health_timeout_s
+            for name, ref in refs.items():
+                try:
+                    if ref is None:
+                        raise RuntimeError("health submit failed")
+                    ray_tpu.get(ref, timeout=max(
+                        0.1, deadline - time.monotonic()))
                     self._ready.add(name)
                 except Exception:  # noqa: BLE001
                     still_starting = (

@@ -19,12 +19,36 @@ import ray_tpu
 from ray_tpu.serve.controller import get_or_create_controller
 
 
+def _retry_backoff(attempt: int) -> float:
+    """Capped exponential + jitter between replica-failure retries
+    (RAY_TPU_SERVE_RETRY_BACKOFF_*, same shape as the elastic-train
+    gang-restart backoff)."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    delay = min(cfg.serve_retry_backoff_max_s,
+                cfg.serve_retry_backoff_initial_s
+                * cfg.serve_retry_backoff_multiplier ** attempt)
+    jitter = cfg.serve_retry_backoff_jitter
+    return max(0.0, delay * (1 + random.uniform(-jitter, jitter)))
+
+
+def _retryable_errors():
+    import ray_tpu.exceptions as rexc
+
+    return (rexc.ActorDiedError, rexc.ActorUnavailableError,
+            rexc.ReplicaDrainingError)
+
+
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef.
 
     Replica death between routing and completion is retried through the
     handle (refresh + re-pick), like the reference router's transparent
-    replica-failure retries (ref: _private/router.py)."""
+    replica-failure retries (ref: _private/router.py).  Attempts and
+    backoff come from RAY_TPU_SERVE_RETRY_MAX /
+    RAY_TPU_SERVE_RETRY_BACKOFF_*; a draining replica (graceful
+    downscale) is retried the same way as a dead one."""
 
     def __init__(self, ref, on_done=None, retry_fn=None):
         self._ref = ref
@@ -33,18 +57,18 @@ class DeploymentResponse:
         self._done = False
 
     def result(self, timeout: Optional[float] = None) -> Any:
-        import ray_tpu.exceptions as rexc
+        from ray_tpu.core.config import get_config
 
+        attempts = max(1, get_config().serve_retry_max)
         try:
-            for attempt in range(3):
+            for attempt in range(attempts):
                 try:
                     out = ray_tpu.get(self._ref, timeout=timeout)
                     break
-                except (rexc.ActorDiedError,
-                        rexc.ActorUnavailableError):
-                    if self._retry_fn is None or attempt == 2:
+                except _retryable_errors():
+                    if self._retry_fn is None or attempt == attempts - 1:
                         raise
-                    time.sleep(0.2 * (attempt + 1))
+                    time.sleep(_retry_backoff(attempt))
                     self._ref = self._retry_fn()
         finally:
             self._settle()
@@ -133,8 +157,27 @@ class DeploymentHandle:
         if not force and now - self._last_refresh < self._refresh_ttl:
             return
         self._last_refresh = now
-        routing = ray_tpu.get(
-            self._controller.get_routing.remote(self._app), timeout=30)
+        try:
+            routing = ray_tpu.get(
+                self._controller.get_routing.remote(self._app), timeout=30)
+        except Exception:  # noqa: BLE001
+            # Controller died: re-resolve (get_or_create restarts it; the
+            # new one recovers targets from the GCS KV and re-adopts live
+            # replicas).  Version counters reset across controller
+            # incarnations, so force a routing rebuild.  If the
+            # controller plane is entirely down, keep serving from the
+            # cached replica set rather than failing the request path.
+            try:
+                self._controller = get_or_create_controller()
+                routing = ray_tpu.get(
+                    self._controller.get_routing.remote(self._app),
+                    timeout=30)
+                force = True
+                self._version = -2
+            except Exception:  # noqa: BLE001
+                if self._replicas:
+                    return
+                raise
         with self._lock:
             if routing["version"] != self._version or force:
                 names = routing["replicas"]
@@ -148,13 +191,17 @@ class DeploymentHandle:
                                      for n in self._replicas}
                 self._version = routing["version"]
 
-    def _pick_replica(self):
+    def _pick_replica(self, exclude: Optional[str] = None):
         deadline = time.monotonic() + 30
         while True:
             # Sample and index under one lock hold — a concurrent _refresh
             # may rebuild self._replicas between reads otherwise.
             with self._lock:
                 names = list(self._replicas)
+                # Failover re-picks avoid the replica that just failed —
+                # unless it is the only one left (it may have restarted).
+                if exclude in names and len(names) > 1:
+                    names.remove(exclude)
                 if names:
                     pick = None
                     # Multiplexed locality: prefer the replica that already
@@ -162,7 +209,7 @@ class DeploymentHandle:
                     # clearly the most loaded one.
                     if self._model_id:
                         cand = self._model_affinity.get(self._model_id)
-                        if cand in self._replicas:
+                        if cand in names:
                             load = self._outstanding.get(cand, 0)
                             if load <= 2 + min(
                                     (self._outstanding.get(n, 0)
@@ -235,31 +282,68 @@ class DeploymentHandle:
     def remote_streaming(self, *args, **kwargs) -> "StreamingResponse":
         """Streaming call: the replica runs a generator; items arrive in
         pulled batches (ref: streaming ObjectRefGenerator replies,
-        proxy.py:747 streaming responses)."""
+        proxy.py:747 streaming responses).  The response carries a
+        request id and its emitted-item offset; replica death mid-stream
+        fails over to a surviving replica via the resume protocol
+        (re-admit args + emitted prefix, dedupe the overlap)."""
         self._refresh()
         name, replica = self._pick_replica()
         self._push_stats()
+        request_id = uuid.uuid4().hex
+        # Mutable cell: failovers re-route to a new replica; on_done must
+        # decrement whichever replica CURRENTLY carries the stream.
+        holder = {"name": name}
 
-        def on_done(n=name):
+        def on_done():
             with self._lock:
+                n = holder["name"]
                 self._outstanding[n] = max(0, self._outstanding.get(n, 1) - 1)
+
+        def resume_fn(emitted):
+            failed = holder["name"]
+            on_done()  # release the failed pick before re-picking
+            self._refresh(force=True)
+            name2, replica2 = self._pick_replica(exclude=failed)
+            holder["name"] = name2
+            self._push_stats()
+            sid_ref2 = replica2.handle_request_streaming.remote(
+                self._method, args, kwargs, model_id=self._model_id,
+                resume={"request_id": request_id,
+                        "offset": len(emitted), "items": list(emitted)})
+            return replica2, sid_ref2
 
         sid_ref = replica.handle_request_streaming.remote(
             self._method, args, kwargs, model_id=self._model_id)
-        return StreamingResponse(replica, sid_ref, on_done)
+        return StreamingResponse(replica, sid_ref, on_done,
+                                 resume_fn=resume_fn,
+                                 request_id=request_id)
 
 
 class StreamingResponse:
     """Iterator over a replica-side stream; batches pulls to amortize the
-    per-call RPC cost."""
+    per-call RPC cost.
 
-    def __init__(self, replica, sid_ref, on_done, max_items: int = 32):
+    Fault tolerance: the response keeps the items it has already yielded
+    (the resume prefix).  When the serving replica dies, becomes
+    unreachable, or refuses admission because it is draining, the
+    iterator re-admits the request on another replica with
+    `resume={"offset": N, "items": [...]}` — the engine recomputes KV
+    for prompt + emitted tokens and continues from there — so consumers
+    (including the HTTP proxy mid-stream) observe one exactly-once item
+    sequence across the failover."""
+
+    def __init__(self, replica, sid_ref, on_done, max_items: int = 32,
+                 resume_fn=None, request_id: Optional[str] = None):
         self._replica = replica
         self._sid_ref = sid_ref
         self._sid = None
         self._on_done = on_done
         self._max_items = max_items
         self._settled = False
+        self._resume_fn = resume_fn
+        self._emitted: list = []
+        self.request_id = request_id or uuid.uuid4().hex
+        self.resumes = 0  # failovers survived (observability/tests)
 
     def _settle(self):
         if not self._settled:
@@ -278,14 +362,34 @@ class StreamingResponse:
         self._settle()
 
     def __iter__(self):
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        pull_timeout = cfg.serve_request_deadline_s
+        max_resumes = max(1, cfg.serve_retry_max)
         try:
-            self._sid = ray_tpu.get(self._sid_ref, timeout=120)
             while True:
-                batch = ray_tpu.get(
-                    self._replica.stream_next.remote(
-                        self._sid, max_items=self._max_items),
-                    timeout=120)
-                yield from batch["items"]
+                try:
+                    if self._sid is None:
+                        self._sid = ray_tpu.get(self._sid_ref,
+                                                timeout=pull_timeout)
+                    batch = ray_tpu.get(
+                        self._replica.stream_next.remote(
+                            self._sid, max_items=self._max_items),
+                        timeout=pull_timeout)
+                except _retryable_errors():
+                    if (self._resume_fn is None
+                            or self.resumes >= max_resumes):
+                        raise
+                    time.sleep(_retry_backoff(self.resumes))
+                    self.resumes += 1
+                    self._sid = None
+                    self._replica, self._sid_ref = \
+                        self._resume_fn(self._emitted)
+                    continue
+                for item in batch["items"]:
+                    self._emitted.append(item)
+                    yield item
                 if batch["done"]:
                     return
         finally:

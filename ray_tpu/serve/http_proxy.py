@@ -8,6 +8,16 @@ on an executor pool; streaming deployments answer with chunked JSONL —
 one line per yielded item — so token streams reach the client as they
 are generated (TTFT == first chunk).
 
+Robustness: every request gets an id (X-Request-Id in, generated
+otherwise) echoed in error bodies, logs, and the response header;
+admission is bounded at RAY_TPU_SERVE_PROXY_MAX_INFLIGHT in-flight
+requests — beyond it the proxy SHEDS with 503 + Retry-After instead of
+queueing without limit; replica-death/draining failures map to 503 (the
+client should retry), client mistakes stay 404/422, and unary calls run
+under the RAY_TPU_SERVE_REQUEST_DEADLINE_S deadline.  Mid-stream replica
+death is invisible here: the handle's StreamingResponse fails over and
+resumes exactly-once underneath the JSONL writer.
+
 Routes: POST/GET <prefix>            -> unary   {"...": ...}
         POST/GET <prefix>?stream=1   -> chunked JSONL stream
 Headers: X-Model-Id (or body {"model_id": ...}) -> multiplexed routing.
@@ -16,18 +26,50 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
+
+logger = logging.getLogger("ray_tpu.serve.http_proxy")
+
+
+def _error_status(e: BaseException) -> tuple:
+    """(status, retryable) for a dispatch failure — 503 + Retry-After
+    for transient routing/capacity conditions, 504 for deadline, 500
+    otherwise."""
+    import ray_tpu.exceptions as rexc
+    from ray_tpu.serve.llm import StreamQueueFullError
+
+    if isinstance(e, (rexc.ActorDiedError, rexc.ActorUnavailableError,
+                      rexc.ReplicaDrainingError, StreamQueueFullError)):
+        return 503, True
+    if isinstance(e, (rexc.GetTimeoutError, TimeoutError)):
+        return 504, False
+    return 500, False
 
 
 class HTTPProxy:
     """Actor: owns the aiohttp server + route table {prefix: app_name}."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 executor_threads: int = 64):
+                 executor_threads: int = 64,
+                 max_inflight: Optional[int] = None,
+                 request_deadline_s: Optional[float] = None):
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
         self._routes: Dict[str, str] = {}
         self._handles: Dict[str, object] = {}
+        self._max_inflight = (max_inflight if max_inflight is not None
+                              else cfg.serve_proxy_max_inflight)
+        self._deadline_s = (request_deadline_s
+                            if request_deadline_s is not None
+                            else cfg.serve_request_deadline_s)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._shed_total = 0
         self._executor = ThreadPoolExecutor(max_workers=executor_threads,
                                             thread_name_prefix="proxy")
         # Separate pool for stream pulls: long-running unary calls must
@@ -39,9 +81,26 @@ class HTTPProxy:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._host = host
         self._want_port = port
+        self._load_persisted_routes()
         threading.Thread(target=self._serve_thread, daemon=True).start()
         if not self._started.wait(30):
             raise RuntimeError("HTTP proxy failed to start")
+
+    def _load_persisted_routes(self) -> None:
+        """A restarted proxy re-installs the route table from the GCS KV
+        ("serve"/"routes", written by serve.run) instead of coming back
+        empty — routes survive proxy AND controller death, and the GCS
+        PersistentStore carries them across GCS restarts."""
+        try:
+            from ray_tpu.api import _global_worker, is_initialized
+
+            if not is_initialized():
+                return
+            blob = _global_worker().kv_get("serve", b"routes")
+            if blob:
+                self._routes.update(json.loads(blob.decode()))
+        except Exception:  # noqa: BLE001 best-effort recovery
+            pass
 
     # -- aiohttp server on a dedicated loop -----------------------------
     def _serve_thread(self) -> None:
@@ -83,17 +142,63 @@ class HTTPProxy:
             h = self._handles[app_name] = DeploymentHandle(app_name)
         return h
 
+    def _error_response(self, e: BaseException, rid: str, path: str):
+        from aiohttp import web
+
+        status, retryable = _error_status(e)
+        logger.warning("request %s %s failed (%d): %s",
+                       rid, path, status, e)
+        headers = {"X-Request-Id": rid}
+        if retryable:
+            headers["Retry-After"] = "1"
+        return web.json_response(
+            {"error": str(e), "request_id": rid},
+            status=status, headers=headers)
+
     async def _dispatch(self, request):
         from aiohttp import web
 
+        rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex
         app_name = self._match_route(request.path)
         if app_name is None:
-            return web.json_response({"error": "no route"}, status=404)
+            return web.json_response(
+                {"error": "no route", "request_id": rid}, status=404,
+                headers={"X-Request-Id": rid})
         body = await request.read()
         try:
             arg = json.loads(body) if body else None
         except ValueError:
-            return web.json_response({"error": "invalid JSON"}, status=400)
+            return web.json_response(
+                {"error": "invalid JSON", "request_id": rid}, status=422,
+                headers={"X-Request-Id": rid})
+        # Bounded admission: shed beyond max_inflight with an explicit
+        # 503 + Retry-After — the proxy stays responsive under overload
+        # instead of parking every extra request on a 120 s blocking
+        # executor wait.
+        with self._inflight_lock:
+            if self._inflight >= self._max_inflight:
+                self._shed_total += 1
+                shed = True
+            else:
+                self._inflight += 1
+                shed = False
+        if shed:
+            logger.warning("request %s %s shed (inflight >= %d)",
+                           rid, request.path, self._max_inflight)
+            return web.json_response(
+                {"error": "overloaded", "request_id": rid}, status=503,
+                headers={"Retry-After": "1", "X-Request-Id": rid})
+        try:
+            return await self._dispatch_admitted(request, arg, app_name,
+                                                 rid)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    async def _dispatch_admitted(self, request, arg, app_name: str,
+                                 rid: str):
+        from aiohttp import web
+
         model_id = request.headers.get("X-Model-Id") or (
             arg.get("model_id") if isinstance(arg, dict) else None)
         stream = (request.query.get("stream") in ("1", "true")
@@ -107,28 +212,33 @@ class HTTPProxy:
                 multiplexed_model_id=model_id,
                 method_name=method)
         loop = asyncio.get_running_loop()
+        deadline = self._deadline_s
 
         if not stream:
             try:
                 out = await loop.run_in_executor(
                     self._executor,
-                    lambda: handle.remote(arg).result(timeout=120))
+                    lambda: handle.remote(arg).result(timeout=deadline))
             except Exception as e:  # noqa: BLE001
-                return web.json_response({"error": str(e)}, status=500)
-            return web.json_response(out)
+                return self._error_response(e, rid, request.path)
+            return web.json_response(out,
+                                     headers={"X-Request-Id": rid})
 
         # Streaming: chunked JSONL, one line per yielded item. Routing
         # happens BEFORE headers go out so routing failures are clean
-        # 500s, not truncated 200s.
+        # status codes, not truncated 200s.  Mid-stream replica death is
+        # handled UNDER this loop by StreamingResponse's resume protocol;
+        # only exhausted-failover errors surface here.
         try:
             stream_resp = await loop.run_in_executor(
                 self._stream_executor, lambda: handle.remote_streaming(arg))
             it = iter(stream_resp)
         except Exception as e:  # noqa: BLE001
-            return web.json_response({"error": str(e)}, status=500)
+            return self._error_response(e, rid, request.path)
 
         resp = web.StreamResponse(headers={
-            "Content-Type": "application/jsonl; charset=utf-8"})
+            "Content-Type": "application/jsonl; charset=utf-8",
+            "X-Request-Id": rid})
         resp.enable_chunked_encoding()
         await resp.prepare(request)
 
@@ -149,9 +259,12 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001
             # Best-effort error line — the socket may already be gone
             # (client disconnect); the finally still cancels the stream.
+            logger.warning("stream %s %s aborted: %s",
+                           rid, request.path, e)
             try:
                 await resp.write(
-                    (json.dumps({"error": str(e)}) + "\n").encode())
+                    (json.dumps({"error": str(e), "request_id": rid})
+                     + "\n").encode())
             except Exception:  # noqa: BLE001
                 pass
         finally:
@@ -166,12 +279,25 @@ class HTTPProxy:
     def port(self) -> int:
         return self._port
 
+    def proxy_stats(self) -> dict:
+        with self._inflight_lock:
+            return {"inflight": self._inflight,
+                    "max_inflight": self._max_inflight,
+                    "shed_total": self._shed_total}
+
     def set_route(self, prefix: str, app_name: str) -> bool:
         self._routes[prefix] = app_name
         return True
 
     def remove_route(self, prefix: str) -> bool:
         self._routes.pop(prefix, None)
+        return True
+
+    def remove_routes_for(self, app_name: str) -> bool:
+        for prefix, app in list(self._routes.items()):
+            if app == app_name:
+                self._routes.pop(prefix, None)
+        self._handles.pop(app_name, None)
         return True
 
     def stop(self) -> bool:

@@ -47,7 +47,8 @@ class StreamQueueFullError(RuntimeError):
 class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "out_tokens",
                  "done", "error", "slot", "submitted_at", "first_token_at",
-                 "token_q", "dropped", "blocks", "pos", "prefilling")
+                 "token_q", "dropped", "blocks", "pos", "prefilling",
+                 "no_register")
 
     def __init__(self, prompt, max_tokens, temperature, stream=False):
         from ray_tpu.core.config import get_config
@@ -72,6 +73,9 @@ class _Request:
         self.blocks: List[int] = []   # paged engine: owned pool blocks
         self.pos = 0                  # paged engine: tokens prefilled
         self.prefilling = True        # paged engine: not yet decoding
+        # Resumed contexts embed generated tokens in `prompt` — never
+        # publish them as a reusable prompt prefix.
+        self.no_register = False
 
     def emit(self, tok: int) -> None:
         self.out_tokens.append(tok)
@@ -91,12 +95,31 @@ class _EngineBase:
     `max_len`, `stats`, `_pending_put(req)`, and a background loop that
     completes requests."""
 
+    @staticmethod
+    def _resume_ctx(prompt_tokens, max_tokens, resume_tokens):
+        """Fold an interrupted stream's already-emitted tokens into the
+        admission context.  The resumed request prefills
+        `prompt + resume` — the same full-context recompute the paged
+        engine's preemption path runs — and generates only the REMAINING
+        `max_tokens - len(resume)` tokens, so a failover caller that
+        kept the emitted prefix sees an exactly-once token sequence."""
+        if not resume_tokens:
+            return list(prompt_tokens), max_tokens, False
+        ctx = list(prompt_tokens) + list(resume_tokens)
+        return ctx, max(0, max_tokens - len(resume_tokens)), True
+
     def generate(self, prompt_tokens: List[int], *, max_tokens: int = 64,
                  temperature: float = 0.0,
-                 timeout: Optional[float] = 300) -> List[int]:
-        if len(prompt_tokens) >= self.max_len:
-            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
-        req = _Request(list(prompt_tokens), max_tokens, temperature)
+                 timeout: Optional[float] = 300,
+                 resume_tokens: Optional[List[int]] = None) -> List[int]:
+        ctx, remaining, resumed = self._resume_ctx(
+            prompt_tokens, max_tokens, resume_tokens)
+        if len(ctx) >= self.max_len:
+            raise ValueError(f"prompt ({len(ctx)}) >= max_len")
+        if resumed and remaining == 0:
+            return []
+        req = _Request(ctx, remaining, temperature)
+        req.no_register = resumed
         self.stats["requests"] += 1
         self._pending_put(req)
         if not req.done.wait(timeout):
@@ -107,14 +130,21 @@ class _EngineBase:
 
     def generate_stream(self, prompt_tokens: List[int], *,
                         max_tokens: int = 64, temperature: float = 0.0,
-                        timeout: Optional[float] = 300):
+                        timeout: Optional[float] = 300,
+                        resume_tokens: Optional[List[int]] = None):
         """Yield tokens as the engine produces them (TTFT = first yield;
         the continuous-batching loop keeps decoding other slots while the
-        consumer reads)."""
-        if len(prompt_tokens) >= self.max_len:
-            raise ValueError(f"prompt ({len(prompt_tokens)}) >= max_len")
-        req = _Request(list(prompt_tokens), max_tokens, temperature,
-                       stream=True)
+        consumer reads).  `resume_tokens` re-admits an interrupted
+        stream: the engine recomputes KV for prompt+resume and yields
+        only the continuation."""
+        ctx, remaining, resumed = self._resume_ctx(
+            prompt_tokens, max_tokens, resume_tokens)
+        if len(ctx) >= self.max_len:
+            raise ValueError(f"prompt ({len(ctx)}) >= max_len")
+        if resumed and remaining == 0:
+            return
+        req = _Request(ctx, remaining, temperature, stream=True)
+        req.no_register = resumed
         self.stats["requests"] += 1
         self._pending_put(req)
         deadline = time.monotonic() + (timeout or 300)
@@ -810,7 +840,7 @@ class PagedLLMEngine(_EngineBase):
                 self.stats["prefill_chunks"] += 1
                 if req.pos >= n:
                     self._prefillq.popleft()
-                    if not req.out_tokens:
+                    if not req.out_tokens and not req.no_register:
                         # Publish the prompt's blocks for prefix reuse
                         # BEFORE our own appends diverge the tail (COW
                         # keeps the registered copy pristine).  Resumed
@@ -1049,13 +1079,22 @@ class LLMDeployment:
             temperature=float(request.get("temperature", 0.0)))
         return {"tokens": toks}
 
-    def stream(self, request: dict):
+    def stream(self, request: dict, _serve_resume: Optional[dict] = None):
         """Streaming entry: yields {"token": t} dicts (served over
-        chunked HTTP by the proxy; call via handle.remote_streaming)."""
+        chunked HTTP by the proxy; call via handle.remote_streaming).
+
+        `_serve_resume` is the replica-injected failover context
+        ({"offset": n, "items": [...]}): the tokens a dead replica
+        already delivered are re-admitted through the engine's recompute
+        path (resume_tokens) so this replica yields only the
+        continuation — no duplicated or re-generated tokens."""
+        resume = [it["token"] for it in (_serve_resume or {}).get(
+            "items", []) if isinstance(it, dict) and "token" in it]
         for tok in self.engine.generate_stream(
                 request["tokens"],
                 max_tokens=int(request.get("max_tokens", 32)),
-                temperature=float(request.get("temperature", 0.0))):
+                temperature=float(request.get("temperature", 0.0)),
+                resume_tokens=resume or None):
             yield {"token": tok}
 
     def stats(self, _request: Optional[dict] = None) -> dict:

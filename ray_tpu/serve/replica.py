@@ -84,6 +84,10 @@ class Replica:
         self._total = 0
         self._start = time.time()
         self._streams: Dict[str, _Stream] = {}
+        self._draining = False
+        # method name -> whether the resolved target accepts the
+        # replica-injected `_serve_resume` failover context.
+        self._resume_aware: Dict[str, bool] = {}
         if inspect.isclass(cls_or_fn):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_func = False
@@ -141,8 +145,15 @@ class Replica:
             if token is not None:
                 _model_id_ctx.reset(token)
 
+    def _check_admission(self) -> None:
+        if self._draining:
+            from ray_tpu.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(self.replica_id)
+
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        model_id: Optional[str] = None) -> Any:
+        self._check_admission()
         self._ongoing += 1
         self._total += 1
         try:
@@ -151,15 +162,48 @@ class Replica:
             self._ongoing -= 1
 
     # -- streaming ------------------------------------------------------
+    def _accepts_resume(self, method: str) -> bool:
+        cached = self._resume_aware.get(method)
+        if cached is not None:
+            return cached
+        try:
+            params = inspect.signature(self._resolve(method)).parameters
+            ok = ("_serve_resume" in params
+                  or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in params.values()))
+        except (TypeError, ValueError):
+            ok = False
+        self._resume_aware[method] = ok
+        return ok
+
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: dict,
-                                 model_id: Optional[str] = None) -> str:
+                                 model_id: Optional[str] = None,
+                                 resume: Optional[dict] = None) -> str:
         """Start a streaming call; returns a stream id the caller pulls
-        with stream_next()."""
+        with stream_next().
+
+        `resume` carries a failed-over stream's already-delivered prefix
+        ({"offset": n, "items": [...]}).  Resume-aware callables (those
+        accepting `_serve_resume`, e.g. LLMDeployment.stream) get it
+        injected and recompute only the continuation; for everything
+        else the generator is re-run and the first `offset` items are
+        skipped server-side — either way the caller appends an
+        exactly-once continuation."""
+        self._check_admission()
         self._total += 1
+        skip = 0
+        if resume and self._accepts_resume(method):
+            kwargs = dict(kwargs, _serve_resume=resume)
+        elif resume:
+            skip = int(resume.get("offset", 0))
         out = self._invoke(method, args, kwargs, model_id)
         if not hasattr(out, "__next__"):
             out = iter(out if hasattr(out, "__iter__") else [out])
+        if skip > 0:
+            import itertools
+
+            out = itertools.islice(out, skip, None)
         sid = uuid.uuid4().hex
         self._gc_streams()
         self._streams[sid] = _Stream(out, model_id=model_id)
@@ -196,9 +240,48 @@ class Replica:
             if now - st.last_touch > idle_s:
                 self._drop_stream(sid)
 
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Stop admission and retire this replica gracefully: in-flight
+        requests/streams keep running up to `timeout_s` (default
+        RAY_TPU_SERVE_DRAIN_TIMEOUT_S), then the process exits.  Clients
+        still attached past the deadline observe ActorDiedError and
+        migrate-by-recompute through the handle's stream-resume path.
+        Self-terminating: a controller that dies right after sending the
+        drain RPC leaks no orphan replica."""
+        if timeout_s is None:
+            from ray_tpu.core.config import get_config
+
+            timeout_s = get_config().serve_drain_timeout_s
+        first = not self._draining
+        self._draining = True
+
+        def reaper():
+            import os
+
+            deadline = time.monotonic() + max(0.0, float(timeout_s))
+            while time.monotonic() < deadline:
+                if self._ongoing <= 0 and not self._streams:
+                    break
+                time.sleep(0.1)
+            self._gauge_stop.set()
+            os._exit(0)
+
+        if first:
+            threading.Thread(target=reaper, daemon=True).start()
+        return self.stats()
+
     def stats(self) -> dict:
         return {"replica_id": self.replica_id, "ongoing": self._ongoing,
-                "total": self._total, "uptime": time.time() - self._start}
+                "total": self._total, "streams": len(self._streams),
+                "draining": self._draining,
+                "uptime": time.time() - self._start}
+
+    def getpid(self) -> int:
+        """Worker-process pid — lets chaos tooling SIGKILL the actual
+        process (crash semantics) rather than an actor-level kill."""
+        import os
+
+        return os.getpid()
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

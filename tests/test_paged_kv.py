@@ -412,3 +412,47 @@ def test_daemon_serve_state_aggregates_and_expires(monkeypatch):
     assert state["app"]["ongoing"] == 5.0
     assert state["app"]["queue_depth"] == 1.0
     assert ("app", "dead") not in d._serve_gauges
+
+
+def test_queue_full_drop_releases_kv_blocks_promptly(tmp_path, tiny_model,
+                                                     monkeypatch):
+    """Leak guard: a stream failed by StreamQueueFullError must release
+    its KV blocks promptly (the engine frees them in _maybe_finish on
+    the dropped flag, not at consumer GC time), and the arena still
+    returns the store to baseline afterwards (store-quiescence)."""
+    from ray_tpu.core.object_store import ObjectStore
+
+    monkeypatch.setenv("RAY_TPU_SERVE_STREAM_QUEUE_MAX", "4")
+    reset_config()
+    store = ObjectStore(str(tmp_path / "kvleak"),
+                        capacity=32 * 1024 * 1024, num_slots=64)
+    try:
+        base_used, base_objs = store.used, store.num_objects
+        eng = make_engine(tiny_model, store=store)
+        stream = eng.generate_stream([1, 2, 3], max_tokens=64,
+                                     timeout=120)
+        with pytest.raises(StreamQueueFullError):
+            for i, _ in enumerate(stream):
+                time.sleep(1.0)    # stalled consumer: queue overflows
+                if i > 10:
+                    raise AssertionError("stream never dropped")
+        # The dropped request's blocks free on the engine loop's next
+        # finish pass — promptly, NOT when the consumer object dies.
+        deadline = time.monotonic() + 10
+        active = None
+        while time.monotonic() < deadline:
+            active = eng.allocator.snapshot()["blocks_active"]
+            if active == 0:
+                break
+            time.sleep(0.05)
+        assert active == 0, f"dropped stream leaked {active} blocks"
+        # Engine stays healthy and the pool is genuinely reusable.
+        assert len(eng.generate([4, 5, 6], max_tokens=4,
+                                timeout=120)) == 4
+        eng.shutdown()
+        assert store.used == base_used
+        assert store.num_objects == base_objs
+    finally:
+        reset_config()
+        store.disconnect()
+        ObjectStore.destroy(str(tmp_path / "kvleak"))

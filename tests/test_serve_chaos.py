@@ -1,0 +1,458 @@
+"""Serve chaos suite: resumable streams, graceful drain, controller
+failover, and overload shedding (the serving-plane analogue of the
+training plane's chaos matrix).
+
+Covers: replica killed mid-stream -> exactly-once continuation on a
+survivor; draining replicas reject admission but finish in-flight
+streams; controller kill -> state recovered from the GCS KV, live
+replicas adopted (no redeploy); proxy overload -> 503 + Retry-After,
+never a deadlock; SIGSTOP'd replica -> health-flagged and replaced
+(slow)."""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+import ray_tpu.exceptions as rexc
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine-level resume: the recompute path yields an exactly-once
+# continuation
+# ---------------------------------------------------------------------------
+def test_engine_resume_tokens_exact_continuation():
+    import jax
+
+    from ray_tpu.models import configs, init_params
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg = configs.get("tiny")
+    params = init_params(jax.random.key(0), cfg)
+    eng = PagedLLMEngine(cfg, params, num_slots=4, max_len=64,
+                         block_size=4, prefill_chunk=8)
+    try:
+        prompt = [5, 7, 11, 13]
+        full = eng.generate(prompt, max_tokens=24, temperature=0.0,
+                            timeout=60)
+        assert len(full) > 8
+        # Resume as a failed-over stream would: prompt + emitted prefix.
+        for cut in (1, len(full) // 2, len(full) - 1):
+            tail = eng.generate(prompt, max_tokens=24, temperature=0.0,
+                                timeout=60, resume_tokens=full[:cut])
+            assert full[:cut] + tail == full, f"diverged at cut={cut}"
+        # Stream variant, and the degenerate everything-already-emitted
+        # resume.
+        tail = list(eng.generate_stream(
+            prompt, max_tokens=24, temperature=0.0, timeout=60,
+            resume_tokens=full[: len(full) // 2]))
+        assert full[: len(full) // 2] + tail == full
+        assert eng.generate(prompt, max_tokens=24, temperature=0.0,
+                            timeout=60, resume_tokens=full) == []
+    finally:
+        eng.shutdown()
+
+
+def test_resume_context_not_registered_as_prefix():
+    """A resumed context embeds generated tokens — it must never be
+    published into the prefix cache as a reusable prompt."""
+    import jax
+
+    from ray_tpu.models import configs, init_params
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg = configs.get("tiny")
+    params = init_params(jax.random.key(0), cfg)
+    eng = PagedLLMEngine(cfg, params, num_slots=4, max_len=64,
+                         block_size=4, prefill_chunk=8)
+    try:
+        prompt = [3, 9, 27]
+        full = eng.generate(prompt, max_tokens=12, temperature=0.0,
+                            timeout=60)
+        before = len(eng.allocator._by_key)
+        eng.generate(prompt, max_tokens=12, temperature=0.0, timeout=60,
+                     resume_tokens=full[:4])
+        assert len(eng.allocator._by_key) == before
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# replica-level resume protocol (no cluster needed)
+# ---------------------------------------------------------------------------
+def _bare_replica(target):
+    from ray_tpu.serve.replica import Replica
+
+    r = Replica.__new__(Replica)
+    r.replica_id = "serve:unit#g1#0"
+    r._ongoing = 0
+    r._total = 0
+    r._start = time.time()
+    r._streams = {}
+    r._draining = False
+    r._resume_aware = {}
+    r._callable = target
+    r._is_func = not isinstance(target, type) and callable(target)
+    return r
+
+
+def _drain_all(replica, sid):
+    out = []
+    while True:
+        batch = replica.stream_next(sid, max_items=64)
+        out.extend(batch["items"])
+        if batch["done"]:
+            return out
+
+
+def test_replica_resume_skips_offset_for_generic_generators():
+    def gen(request):
+        for i in range(int(request["n"])):
+            yield {"i": i}
+
+    r = _bare_replica(gen)
+    sid = r.handle_request_streaming(
+        "__call__", ({"n": 6},), {},
+        resume={"offset": 2, "items": [{"i": 0}, {"i": 1}]})
+    assert _drain_all(r, sid) == [{"i": i} for i in range(2, 6)]
+
+
+def test_replica_resume_injected_into_aware_callables():
+    seen = {}
+
+    def aware(request, _serve_resume=None):
+        seen["resume"] = _serve_resume
+        start = (_serve_resume or {}).get("offset", 0)
+        for i in range(start, int(request["n"])):
+            yield {"i": i}
+
+    r = _bare_replica(aware)
+    resume = {"request_id": "rid-1", "offset": 3,
+              "items": [{"i": 0}, {"i": 1}, {"i": 2}]}
+    sid = r.handle_request_streaming("__call__", ({"n": 5},), {},
+                                     resume=resume)
+    assert _drain_all(r, sid) == [{"i": 3}, {"i": 4}]
+    assert seen["resume"] == resume
+
+
+def test_draining_replica_rejects_admission():
+    r = _bare_replica(lambda req: req)
+    r._draining = True
+    with pytest.raises(rexc.ReplicaDrainingError):
+        r.handle_request("__call__", (1,), {})
+    with pytest.raises(rexc.ReplicaDrainingError):
+        r.handle_request_streaming("__call__", (1,), {})
+    # typed across the pickle boundary (the actor wire passthrough)
+    import pickle
+
+    err = pickle.loads(pickle.dumps(rexc.ReplicaDrainingError("x")))
+    assert isinstance(err, rexc.ReplicaDrainingError)
+    assert err.replica_id == "x"
+
+
+# ---------------------------------------------------------------------------
+# kill a replica mid-stream: the handle fails over and the client sees
+# an exactly-once item sequence
+# ---------------------------------------------------------------------------
+def test_replica_kill_midstream_exactly_once():
+    @serve.deployment(num_replicas=2)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.03)
+            yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(ticker.bind(), name="chaos_kill")
+    try:
+        resp = h.remote_streaming({"n": 40})
+        assert resp.request_id
+        got, killed = [], False
+        for item in resp:
+            got.append(item)
+            if len(got) == 5 and not killed:
+                killed = True
+                os.kill(item["pid"], signal.SIGKILL)
+        assert [x["i"] for x in got] == list(range(40))  # exactly once
+        assert len({x["pid"] for x in got}) == 2  # continued elsewhere
+        assert resp.resumes >= 1
+    finally:
+        serve.delete("chaos_kill")
+
+
+def test_http_stream_fails_over_midstream():
+    """The proxy's JSONL stream rides the same resume path: a replica
+    kill mid-response continues on a survivor with no duplicated or
+    dropped lines."""
+    @serve.deployment(num_replicas=2)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.03)
+            yield {"i": i, "pid": os.getpid()}
+
+    serve.run(ticker.bind(), name="chaos_http", _http=True,
+              route_prefix="/chaos_http")
+    try:
+        port = serve.http_port()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chaos_http?stream=1",
+            data=json.dumps({"n": 30}).encode(),
+            headers={"Content-Type": "application/json"})
+        got, killed = [], False
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers.get("X-Request-Id")
+            for line in r:
+                item = json.loads(line)
+                assert "error" not in item, item
+                got.append(item)
+                if len(got) == 4 and not killed:
+                    killed = True
+                    os.kill(item["pid"], signal.SIGKILL)
+        assert [x["i"] for x in got] == list(range(30))
+        assert len({x["pid"] for x in got}) == 2
+    finally:
+        serve.delete("chaos_http")
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: downscale/redeploy completes in-flight streams
+# ---------------------------------------------------------------------------
+def test_drain_on_downscale_completes_inflight_streams():
+    @serve.deployment(num_replicas=2)
+    def slow(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.05)
+            yield {"i": i}
+
+    h = serve.run(slow.bind(), name="chaos_drain")
+    try:
+        results, errors = {}, {}
+
+        def consume(k):
+            try:
+                results[k] = [x["i"] for x in h.remote_streaming(
+                    {"n": 30})]
+            except Exception as e:  # noqa: BLE001
+                errors[k] = e
+
+        threads = [threading.Thread(target=consume, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # streams are mid-flight on both replicas
+        serve.run(slow.options(num_replicas=1).bind(),
+                  name="chaos_drain")  # downscale (gen bump retires all)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        # zero drops: every stream delivered its full sequence
+        assert all(results[k] == list(range(30)) for k in range(4))
+        ctrl = ray_tpu.get_actor("serve:controller")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctrl.app_status.remote("chaos_drain"),
+                             timeout=30)
+            if st["running"] == 1:
+                break
+            time.sleep(0.25)
+        assert st["running"] == 1
+    finally:
+        serve.delete("chaos_drain")
+
+
+# ---------------------------------------------------------------------------
+# controller failover: state recovered from the GCS KV, live replicas
+# adopted instead of redeployed
+# ---------------------------------------------------------------------------
+def test_controller_kill_preserves_replicas_and_routes():
+    @serve.deployment(num_replicas=2,
+                      autoscaling_config={"min_replicas": 2,
+                                          "max_replicas": 4})
+    class Who:
+        def __call__(self, _req=None):
+            return os.getpid()
+
+    serve.run(Who.bind(), name="chaos_ctl", _http=True,
+              route_prefix="/chaos_ctl")
+    try:
+        h = serve.get_app_handle("chaos_ctl")
+        pids_before = {h.remote().result(timeout=60) for _ in range(20)}
+        assert len(pids_before) == 2
+        port = serve.http_port()
+
+        ctrl = ray_tpu.get_actor("serve:controller")
+        ray_tpu.kill(ctrl)
+
+        # A fresh handle restarts the controller, which recovers the
+        # deployment record from the KV and ADOPTS the running replicas:
+        # same processes, no duplicates.
+        h2 = serve.get_app_handle("chaos_ctl")
+        pids_after = {h2.remote().result(timeout=120) for _ in range(20)}
+        assert pids_after == pids_before
+
+        ctrl2 = ray_tpu.get_actor("serve:controller")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctrl2.app_status.remote("chaos_ctl"),
+                             timeout=30)
+            if st["running"] == 2 and st["ready"] == 2:
+                break
+            time.sleep(0.25)
+        assert st["running"] == 2 and st["target"] == 2
+
+        # Routes survived: the proxy still serves the prefix, and the
+        # in-flight handle kept working across the failover.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chaos_ctl", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert out in pids_before
+        assert h.remote().result(timeout=60) in pids_before
+    finally:
+        serve.delete("chaos_ctl")
+
+
+# ---------------------------------------------------------------------------
+# overload shedding: bounded admission, 503 + Retry-After, no deadlock
+# ---------------------------------------------------------------------------
+def test_overload_sheds_instead_of_deadlocking():
+    from ray_tpu.serve.http_proxy import HTTPProxy
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    def slow(_req):
+        time.sleep(0.8)
+        return {"ok": True}
+
+    serve.run(slow.bind(), name="chaos_shed")
+    proxy = ray_tpu.remote(HTTPProxy).options(max_concurrency=32).remote(
+        "127.0.0.1", 0, max_inflight=2)
+    try:
+        ray_tpu.get(proxy.set_route.remote("/shed", "chaos_shed"),
+                    timeout=30)
+        port = ray_tpu.get(proxy.port.remote(), timeout=30)
+        statuses, lock = [], threading.Lock()
+
+        def hit():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/shed", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    code, retry_after = r.status, None
+            except urllib.error.HTTPError as e:
+                code, retry_after = e.code, e.headers.get("Retry-After")
+            with lock:
+                statuses.append((code, retry_after))
+
+        start = time.monotonic()
+        threads = [threading.Thread(target=hit) for _ in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        elapsed = time.monotonic() - start
+        shed = [s for s in statuses if s[0] == 503]
+        ok = [s for s in statuses if s[0] == 200]
+        assert len(statuses) == 10
+        assert shed and ok  # some shed, some served
+        assert all(ra == "1" for _, ra in shed)  # Retry-After present
+        # responsive, not deadlocked: overload answered well inside the
+        # old 120 s blocking-wait regime
+        assert elapsed < 30
+        stats = ray_tpu.get(proxy.proxy_stats.remote(), timeout=30)
+        assert stats["shed_total"] >= len(shed)
+        assert stats["inflight"] == 0
+    finally:
+        ray_tpu.kill(proxy)
+        serve.delete("chaos_shed")
+
+
+def test_http_error_codes_and_request_id():
+    @serve.deployment
+    def boom(_req):
+        raise ValueError("kaput")
+
+    serve.run(boom.bind(), name="chaos_err", _http=True,
+              route_prefix="/chaos_err")
+    try:
+        port = serve.http_port()
+        # invalid JSON -> 422 with an echoed request id
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chaos_err", data=b"{not json",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "rid-zz"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 422
+        body = json.loads(ei.value.read())
+        assert body["request_id"] == "rid-zz"
+        assert ei.value.headers.get("X-Request-Id") == "rid-zz"
+        # user exception -> 500, request id generated and echoed
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chaos_err", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 500
+        body = json.loads(ei.value.read())
+        assert body["request_id"]
+        assert "kaput" in body["error"]
+    finally:
+        serve.delete("chaos_err")
+
+
+# ---------------------------------------------------------------------------
+# SIGSTOP chaos: wedged (not dead) replica is health-flagged, replaced,
+# and its stream fails over. Slow: rides the real health-probe timeout.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_sigstop_replica_flagged_and_stream_fails_over():
+    @serve.deployment(num_replicas=2)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.05)
+            yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(ticker.bind(), name="chaos_stop")
+    try:
+        resp = h.remote_streaming({"n": 600})
+        got, stopped_pid = [], None
+        for item in resp:
+            got.append(item)
+            if len(got) == 5 and stopped_pid is None:
+                stopped_pid = item["pid"]
+                os.kill(stopped_pid, signal.SIGSTOP)
+        try:
+            assert [x["i"] for x in got] == list(range(600))
+            assert len({x["pid"] for x in got}) == 2
+            assert resp.resumes >= 1
+            # the wedged replica was flagged and replaced
+            ctrl = ray_tpu.get_actor("serve:controller")
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = ray_tpu.get(ctrl.app_status.remote("chaos_stop"),
+                                 timeout=30)
+                if st["running"] == 2 and st["ready"] == 2:
+                    break
+                time.sleep(0.5)
+            assert st["running"] == 2
+        finally:
+            if stopped_pid is not None:
+                try:
+                    os.kill(stopped_pid, signal.SIGCONT)
+                except ProcessLookupError:
+                    pass
+    finally:
+        serve.delete("chaos_stop")
