@@ -1,15 +1,25 @@
-"""Serve benchmark: four probes over the serving plane.
+"""Serve benchmark: five probes over the serving plane.
 
   http_stream   legacy end-to-end probe: continuous-batching deployment
                 behind the async HTTP proxy with chunked token streaming
                 (req/s + TTFT percentiles; comparable to
                 BENCH_SERVE_TPU_LAST_GOOD.json).
   engine_fixed  fixed-slot LLMEngine driven directly by N concurrent
-                streaming clients (tokens/s, p50/p99 TTFT + ITL).
+                streaming clients (tokens/s, p50/p99 TTFT + ITL), plus
+                the engine-side per-phase latency attribution
+                (queue_wait / prefill / decode_step means from the
+                raytpu_serve_phase_seconds histogram).
   engine_paged  paged KV-cache PagedLLMEngine at EQUAL HBM (same
                 KV-token budget as engine_fixed: num_slots*max_len
                 tokens carved into blocks) under the same N streams —
-                the apples-to-apples claim for the paged engine.
+                the apples-to-apples claim for the paged engine — with
+                the same phase attribution plus KV hit-rate fields
+                (block reuse and whole-prefix hit rates, COW copies,
+                evictions, preemptions).
+  overhead      paired on/off probe for request tracing: the SAME paged
+                engine driven with RAY_TPU_SERVE_TRACE_ENABLED toggled
+                per run (best-of-N pairs); records the tokens/s cost of
+                span recording, expected < 5%.
   chaos         fault-tolerance probe: N concurrent handle-level token
                 streams across 2 replicas, one replica SIGKILLed
                 mid-run; records the fraction of in-flight streams that
@@ -22,8 +32,8 @@ admission-LIMITED (queueing behind slot admission dominates prefill);
 the artifact labels the regime explicitly so percentiles aren't
 misread.
 
-Usage: python bench_serve.py [--only http,fixed,paged,chaos]
-       [--round 14] [--streams 1024] [--out BENCH_SERVE_r14.json]
+Usage: python bench_serve.py [--only http,fixed,paged,overhead,chaos]
+       [--round 15] [--streams 1024] [--out BENCH_SERVE_r15.json]
 """
 from __future__ import annotations
 
@@ -241,6 +251,67 @@ def _build_params(args):
     return cfg, init_params(jax.random.key(0), cfg)
 
 
+def _serve_hist_snapshot() -> dict:
+    """(sum, count) per labelset for the in-process serve latency
+    histograms — engines observe TTFT/ITL/phase locally, so diffing two
+    snapshots isolates one probe's attribution from the shared
+    registry."""
+    from ray_tpu.serve import observability
+
+    m = observability.metrics()
+    out = {}
+    for name in ("phase", "ttft", "itl"):
+        _counts, sums, totals = m[name].snapshot()
+        out[name] = {key: (sums[key], totals[key]) for key in sums}
+    return out
+
+
+def _latency_attribution(before: dict, after: dict) -> dict:
+    """Engine-side per-phase breakdown between two snapshots: mean ms +
+    sample count for each phase, plus histogram-level TTFT/ITL means
+    (the same series `ray-tpu serve status` reads cluster-wide)."""
+    def delta(name):
+        rows = {}
+        for key, (s1, c1) in after.get(name, {}).items():
+            s0, c0 = before.get(name, {}).get(key, (0.0, 0))
+            ds, dc = s1 - s0, c1 - c0
+            if dc > 0:
+                rows[key] = (ds, dc)
+        return rows
+
+    phases = {}
+    for key, (ds, dc) in delta("phase").items():
+        phase = dict(key).get("phase", "?")
+        s, c = phases.get(phase, (0.0, 0))
+        phases[phase] = (s + ds, c + dc)
+    out = {"phase": {p: {"mean_ms": round(1000 * s / c, 3), "count": c}
+                     for p, (s, c) in sorted(phases.items())}}
+    for name, label in (("ttft", "ttft_mean_ms"), ("itl", "itl_mean_ms")):
+        s = sum(ds for ds, _ in delta(name).values())
+        c = sum(dc for _, dc in delta(name).values())
+        if c:
+            out[label] = round(1000 * s / c, 3)
+    return out
+
+
+def _kv_hit_rates(stats: dict) -> dict:
+    """KV-cache effectiveness fields from a paged engine's cumulative
+    stats: block-level reuse (allocator lookups) and whole-prefix hits
+    (engine-level prefill skips)."""
+    out = {}
+    for hits_k, miss_k, rate_k in (
+            ("reuse_hits", "reuse_misses", "block_reuse_hit_rate"),
+            ("prefix_hits", "prefix_misses", "prefix_hit_rate")):
+        h, ms = stats.get(hits_k, 0), stats.get(miss_k, 0)
+        out[hits_k] = h
+        out[miss_k] = ms
+        out[rate_k] = round(h / (h + ms), 4) if h + ms else None
+    for k in ("cow_copies", "evictions", "preemptions",
+              "alloc_failures"):
+        out[k] = stats.get(k, 0)
+    return out
+
+
 def probe_engine_fixed(args) -> dict:
     from ray_tpu.serve.llm import LLMEngine
 
@@ -248,8 +319,11 @@ def probe_engine_fixed(args) -> dict:
     eng = LLMEngine(cfg, params, num_slots=args.num_slots,
                     max_len=args.max_len, prefix_cache_size=0)
     eng.generate([1, 2, 3], max_tokens=2, timeout=300)  # warmup/compile
+    before = _serve_hist_snapshot()
     out = _drive_streams(eng, args.streams, args.prompt_len,
                          args.max_tokens)
+    out["latency_attribution"] = _latency_attribution(
+        before, _serve_hist_snapshot())
     stats = eng.engine_stats()
     eng.shutdown()
     out["config"] = {
@@ -279,9 +353,13 @@ def probe_engine_paged(args) -> dict:
                          prefill_chunk=args.prefill_chunk)
     eng.warmup()   # compile all width/chunk tiers outside the timing
     eng.generate([1, 2, 3], max_tokens=2, timeout=300)
+    before = _serve_hist_snapshot()
     out = _drive_streams(eng, args.streams, args.prompt_len,
                          args.max_tokens)
+    out["latency_attribution"] = _latency_attribution(
+        before, _serve_hist_snapshot())
     stats = eng.engine_stats()
+    out["kv_cache"] = _kv_hit_rates(stats)
     eng.shutdown()
     out["config"] = {
         "engine": "paged", "decode_width": args.paged_width,
@@ -298,6 +376,86 @@ def probe_engine_paged(args) -> dict:
         ("requests", "completed", "tokens_generated", "reuse_hits",
          "cow_copies", "prefill_chunks", "queue_waits", "blocks_total")}
     return out
+
+
+# ---------------------------------------------------------------------------
+# probe: trace overhead (paired on/off runs of the SAME engine)
+# ---------------------------------------------------------------------------
+def probe_trace_overhead(args) -> dict:
+    """Tokens/s cost of request tracing: the same warmed paged engine is
+    driven with RAY_TPU_SERVE_TRACE_ENABLED toggled per run (the kill
+    switch zeroes every span while phase/TTFT metrics record in both
+    modes, so the pair isolates span recording).  Best-of-N pairs damp
+    scheduler noise; the serve-trace acceptance bar is < 5%."""
+    import os
+
+    from ray_tpu.core import config as cfg_mod
+    from ray_tpu.core.config import get_config
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg, params = _build_params(args)
+    bs = args.block_size or get_config().kv_block_size
+    num_blocks = (args.num_slots * args.max_len) // bs + 1
+    eng = PagedLLMEngine(cfg, params, num_slots=args.paged_width,
+                         max_len=args.max_len, block_size=bs,
+                         num_blocks=num_blocks,
+                         prefill_chunk=args.prefill_chunk)
+    eng.warmup()
+    eng.generate([1, 2, 3], max_tokens=2, timeout=300)
+    saved = os.environ.get("RAY_TPU_SERVE_TRACE_ENABLED")
+
+    def run_once(enabled: bool) -> float:
+        os.environ["RAY_TPU_SERVE_TRACE_ENABLED"] = \
+            "1" if enabled else "0"
+        cfg_mod.reset_config()
+        r = _drive_streams(eng, args.overhead_streams, args.prompt_len,
+                           args.max_tokens)
+        if r["errors"]:
+            raise SystemExit(f"overhead probe: {r['errors']} errors")
+        return r["tokens_per_second"]["value"]
+
+    pairs = []
+    try:
+        for i in range(args.overhead_pairs):
+            # Alternate order inside the pair so warm-cache drift never
+            # systematically favors one mode.
+            if i % 2 == 0:
+                on = run_once(True)
+                off = run_once(False)
+            else:
+                off = run_once(False)
+                on = run_once(True)
+            pairs.append({"traced_tokens_per_s": on,
+                          "untraced_tokens_per_s": off})
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_SERVE_TRACE_ENABLED", None)
+        else:
+            os.environ["RAY_TPU_SERVE_TRACE_ENABLED"] = saved
+        cfg_mod.reset_config()
+        eng.shutdown()
+    best_on = max(p["traced_tokens_per_s"] for p in pairs)
+    best_off = max(p["untraced_tokens_per_s"] for p in pairs)
+    overhead_pct = round(100.0 * (best_off - best_on) / best_off, 2) \
+        if best_off else None
+    return {
+        "pairs": pairs,
+        "traced_tokens_per_second": best_on,
+        "untraced_tokens_per_second": best_off,
+        "overhead_pct": overhead_pct,
+        "within_5pct": (overhead_pct is not None
+                        and overhead_pct < 5.0),
+        "config": {
+            "engine": "paged", "decode_width": args.paged_width,
+            "streams": args.overhead_streams,
+            "max_tokens": args.max_tokens,
+            "pairs": args.overhead_pairs,
+            "method": "best-of-N paired runs on one warmed engine, "
+                      "RAY_TPU_SERVE_TRACE_ENABLED toggled per run "
+                      "(spans off; phase/TTFT metrics record in both "
+                      "modes)",
+        },
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -449,9 +607,10 @@ def probe_chaos(args) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny")
-    ap.add_argument("--only", default="http,fixed,paged,chaos",
-                    help="comma-set of probes: http,fixed,paged,chaos")
-    ap.add_argument("--round", type=int, default=14,
+    ap.add_argument("--only", default="http,fixed,paged,overhead,chaos",
+                    help="comma-set of probes: "
+                         "http,fixed,paged,overhead,chaos")
+    ap.add_argument("--round", type=int, default=15,
                     help="bench round number recorded in the artifact")
     ap.add_argument("--out", default=None,
                     help="write the artifact JSON here")
@@ -473,6 +632,11 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=0,
                     help="0: RAY_TPU_KV_BLOCK_SIZE / config default")
     ap.add_argument("--prefill-chunk", type=int, default=128)
+    # trace-overhead probe knobs
+    ap.add_argument("--overhead-streams", type=int, default=256,
+                    help="streams per run in the trace on/off probe")
+    ap.add_argument("--overhead-pairs", type=int, default=3,
+                    help="paired on/off runs (best-of damping)")
     # chaos probe knobs
     ap.add_argument("--chaos-streams", type=int, default=256,
                     help="concurrent streams in the replica-kill probe")
@@ -503,6 +667,10 @@ def main() -> None:
         emit("serve_paged_tokens_per_second",
              probes["engine_paged"]["tokens_per_second"]["value"],
              "tokens/s")
+    if "overhead" in only:
+        probes["trace_overhead"] = probe_trace_overhead(args)
+        emit("serve_trace_overhead_pct",
+             probes["trace_overhead"]["overhead_pct"], "%")
     if "chaos" in only:
         probes["chaos"] = probe_chaos(args)
         emit("serve_chaos_recovered_fraction",

@@ -333,6 +333,21 @@ class Config:
     # health probes (shared deadline across the bounded gather, not
     # per-replica).
     serve_health_timeout_s: float = 10.0
+    # ---- serving-plane observability (PR: request observability) ----
+    # Per-request serve tracing: the proxy/handle mint a trace context
+    # (trace id == request id) and every hop — proxy admission, handle
+    # routing, replica queue, engine admission, prefill chunks, decode
+    # bursts, stream pulls, failover resumes — records a span into the
+    # GCS TaskEvents sink (`ray-tpu serve trace <request-id>`).  On by
+    # default (spans are dict appends off the device path); kill switch
+    # RAY_TPU_SERVE_TRACE_ENABLED=0 also disables the engines'
+    # per-token latency attribution.
+    serve_trace_enabled: bool = True
+    # Cadence of the replica/proxy worker-process metrics push to the
+    # local node daemon (the daemon folds worker registry dumps into
+    # its syncer federation payload so serve TTFT/ITL histograms and
+    # KV-cache counters appear in `ray-tpu metrics --federated`).
+    serve_metrics_push_s: float = 2.0
 
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout

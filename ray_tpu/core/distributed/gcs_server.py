@@ -1030,6 +1030,7 @@ class MetricsFederation:
             "metrics": self.stats(),
             "task_events": self._gcs.task_events.stats(),
             "hung_tasks": self._gcs.task_events.hung_tasks(),
+            "serve": self._gcs.serve_gauges.summary(),
         }
 
 
@@ -1059,6 +1060,60 @@ class ServeGauges:
                     except (TypeError, ValueError):
                         continue
         return out
+
+    def summary(self) -> dict:
+        """`ray-tpu serve status` / cluster_status()["observability"]
+        ["serve"] payload: the merged autoscaling gauges plus a
+        latency/counter rollup mined from the federated serve metrics —
+        per-app TTFT/ITL means, per-phase means (queue_wait / prefill /
+        decode_step / stream_transport), and the serve counter totals
+        (tokens, requests by status, KV events, sheds, resumes)."""
+        lat: Dict[str, dict] = {}
+        counters: Dict[str, Dict[str, float]] = {}
+        for holder in self._gcs.metrics._node_dumps.values():
+            for rec in holder["dump"]:
+                name = rec.get("name", "")
+                if not name.startswith("raytpu_serve_"):
+                    continue
+                short = name[len("raytpu_serve_"):]
+                if rec.get("kind") == "histogram":
+                    for key, _buckets, hsum, total in rec.get("hist", []):
+                        tags = dict(map(tuple, key))
+                        ent = lat.setdefault(tags.get("app", "-"), {})
+                        if name == "raytpu_serve_phase_seconds":
+                            slot = ent.setdefault("phases", {}).setdefault(
+                                tags.get("phase", "?"), [0.0, 0])
+                        elif name == "raytpu_serve_ttft_seconds":
+                            slot = ent.setdefault("ttft", [0.0, 0])
+                        elif name == "raytpu_serve_itl_seconds":
+                            slot = ent.setdefault("itl", [0.0, 0])
+                        else:
+                            continue
+                        slot[0] += hsum
+                        slot[1] += total
+                elif rec.get("kind") == "counter":
+                    for key, value in rec.get("samples", []):
+                        tags = dict(map(tuple, key))
+                        dst = counters.setdefault(tags.get("app", "-"), {})
+                        sub = tags.get("event") or tags.get("status")
+                        k = f"{short}.{sub}" if sub else short
+                        dst[k] = round(dst.get(k, 0.0) + float(value), 3)
+        latency: Dict[str, dict] = {}
+        for app, ent in lat.items():
+            row: Dict[str, Any] = {}
+            for field, label in (("ttft", "ttft_mean_s"),
+                                 ("itl", "itl_mean_s")):
+                s, c = ent.get(field, (0.0, 0))
+                if c:
+                    row[label] = round(s / c, 6)
+            phases = {p: round(s / c, 6)
+                      for p, (s, c) in ent.get("phases", {}).items() if c}
+            if phases:
+                row["phase_mean_s"] = phases
+            if row:
+                latency[app] = row
+        return {"apps": self.merged(), "latency": latency,
+                "counters": counters}
 
 
 class DiagnosisManager:

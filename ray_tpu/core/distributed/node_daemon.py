@@ -249,6 +249,12 @@ class NodeDaemon:
         # serve controller reads one merged view instead of polling
         # every replica per autoscale decision.
         self._serve_gauges: Dict[tuple, dict] = {}
+        # Worker-process metric registry dumps: origin -> {"ts", "dump"}.
+        # Replicas piggyback theirs on the gauge push, other serve
+        # workers (HTTP proxy) use report_metrics; _metrics_dump merges
+        # them into the federation payload so worker-side serve series
+        # (TTFT/ITL histograms, KV counters) reach the GCS exposition.
+        self._worker_metric_dumps: Dict[str, dict] = {}
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -392,6 +398,13 @@ class NodeDaemon:
         for key, ent in list(self._serve_gauges.items()):
             if now - ent["ts"] > ttl:
                 del self._serve_gauges[key]
+                # Drop the dead replica's mirrored gauge rows too —
+                # a stale exposition row is worse than a missing one.
+                mirror = getattr(self, "_m_serve_gauge", None)
+                for name in ent["gauges"]:
+                    if mirror is not None:
+                        mirror.remove({"app": key[0], "replica": key[1],
+                                       "gauge": name})
                 continue
             app = key[0]
             agg = apps.setdefault(app, {"replicas": 0.0})
@@ -404,13 +417,39 @@ class NodeDaemon:
         return apps
 
     async def report_serve_gauges(self, app: str, replica: str,
-                                  gauges: Dict[str, float]) -> dict:
+                                  gauges: Dict[str, float],
+                                  metrics: Optional[list] = None) -> dict:
         """Replica -> local daemon gauge push (the serve-autoscaling
-        leg of the syncer plane; replicas never talk to the GCS)."""
+        leg of the syncer plane; replicas never talk to the GCS).
+
+        Each gauge is also mirrored into this daemon's own registry as
+        raytpu_serve_replica_gauge{app,replica,gauge} so the engine
+        gauges appear verbatim in the federated exposition, and the
+        optional `metrics` registry dump piggybacks into
+        _metrics_dump's merge (histograms/counters the replica process
+        records)."""
         self._serve_gauges[(app, replica)] = {
             "ts": time.monotonic(), "gauges": dict(gauges)}
+        for name, val in gauges.items():
+            try:
+                self._m_serve_gauge.set(float(val), {
+                    "app": app, "replica": replica, "gauge": name})
+            except (TypeError, ValueError):
+                continue
+        if metrics is not None:
+            self._worker_metric_dumps[f"replica:{replica}"] = {
+                "ts": time.monotonic(), "dump": metrics}
         if self.syncer is not None:
             self.syncer.mark_dirty()
+        return {"ok": True}
+
+    async def report_metrics(self, origin: str, dump: list) -> dict:
+        """Generic worker -> local daemon metrics push (serve HTTP
+        proxy and friends): the dump is merged into this node's
+        federation payload under the node's label, TTL-swept so a dead
+        worker's series age out."""
+        self._worker_metric_dumps[str(origin)] = {
+            "ts": time.monotonic(), "dump": dump}
         return {"ok": True}
 
     async def _re_register(self) -> None:
@@ -683,6 +722,11 @@ class NodeDaemon:
         from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
         tags = {"node_id": self.node_id[:12]}
+        self._m_serve_gauge = Gauge(
+            "raytpu_serve_replica_gauge",
+            "Serve replica engine gauges (queue depth, active, KV "
+            "occupancy...) mirrored from report_serve_gauges",
+            tag_keys=("app", "replica", "gauge")).set_default_tags(tags)
         self._m_leases = Counter(
             "raytpu_leases_granted_total",
             "Worker leases granted by this daemon").set_default_tags(tags)
@@ -800,11 +844,25 @@ class NodeDaemon:
 
     def _metrics_dump(self):
         """Structured registry snapshot for the syncer's federation
-        piggyback (gauges refreshed first, like the text exposition)."""
-        from ray_tpu.util.metrics import registry_dump
+        piggyback (gauges refreshed first, like the text exposition),
+        merged with the TTL-live worker-process dumps pushed via
+        report_serve_gauges / report_metrics — counters and histograms
+        with identical labelsets sum (several replicas of one app on a
+        node aggregate per app), gauges last-write-win."""
+        from ray_tpu.util.metrics import merge_dump_lists, registry_dump
 
         self._refresh_gauges()
-        return registry_dump()
+        dumps = [registry_dump()]
+        ttl = get_config().serve_gauge_ttl_s
+        now = time.monotonic()
+        for origin, ent in list(self._worker_metric_dumps.items()):
+            if now - ent["ts"] > ttl:
+                del self._worker_metric_dumps[origin]
+                continue
+            dumps.append(ent["dump"])
+        if len(dumps) == 1:
+            return dumps[0]
+        return merge_dump_lists(dumps)
 
     def _start_metrics_http(self) -> None:
         port = get_config().metrics_export_port

@@ -377,7 +377,8 @@ class TaskEventBuffer:
         delay = self.flush_period_s
         while not self._stop:
             await asyncio.sleep(delay)
-            if get_config().tracing_enabled:
+            cfg = get_config()
+            if cfg.tracing_enabled or cfg.serve_trace_enabled:
                 from ray_tpu.util import tracing
 
                 self._spans_pending.extend(tracing.drain())
@@ -570,6 +571,15 @@ class GcsTaskManager:
             extra = list(self._spans) + list(self._profile)
             rows.extend(extra[-room:])
         return rows
+
+    def list_spans(self, trace_id: Optional[str] = None,
+                   limit: int = 10000) -> List[dict]:
+        """Tracing spans oldest-first, optionally filtered to one trace.
+        Serve traces use the request id as trace id, so this is the
+        `ray-tpu serve trace <request-id>` backend."""
+        rows = [dict(s) for s in self._spans
+                if trace_id is None or s.get("trace_id") == trace_id]
+        return rows[-limit:]
 
     def get_task(self, task_id: str) -> List[dict]:
         """Every stored attempt of one task (ref: `ray get tasks`)."""

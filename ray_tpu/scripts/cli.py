@@ -16,6 +16,8 @@ Usage:
     python -m ray_tpu.scripts.cli top [--per-node]   # cpu/rss per task
     python -m ray_tpu.scripts.cli profile -d 5 [--task N|--actor A]
     python -m ray_tpu.scripts.cli logs [--dead [WORKER]]
+    python -m ray_tpu.scripts.cli serve status
+    python -m ray_tpu.scripts.cli serve trace <request-id> [-o out.json]
     python -m ray_tpu.scripts.cli start --head [--num-cpus N ...]
     python -m ray_tpu.scripts.cli start --address <gcs> [--num-cpus N]
 """
@@ -278,6 +280,65 @@ def cmd_metrics(gcs: _Gcs, args) -> None:
                                                 timeout=10))
         except Exception as e:  # noqa: BLE001
             print(f"# unreachable: {e}")
+
+
+def cmd_serve(gcs: _Gcs, args) -> None:
+    """Serving-plane observability (`ray-tpu serve status|trace`):
+    status renders the GCS rollup (per-app autoscaling gauges + the
+    TTFT/ITL/phase means and counter totals mined from the federated
+    serve metrics); trace dumps ONE request's end-to-end span track
+    (proxy -> handle -> replica -> engine, resumed hops on their own
+    rows) as a perfetto/chrome trace."""
+    if args.serve_cmd == "trace":
+        from ray_tpu.util.timeline import request_chrome_trace
+
+        spans = gcs.call("TaskEvents", "list_spans",
+                         trace_id=args.request_id, limit=10000,
+                         timeout=30)
+        if not spans:
+            sys.exit(f"no spans for request {args.request_id!r} "
+                     f"(RAY_TPU_SERVE_TRACE_ENABLED=0, or the span "
+                     f"buffer has not flushed yet?)")
+        out = args.out or f"trace-{args.request_id[:12]}.json"
+        with open(out, "w") as f:
+            json.dump(request_chrome_trace(spans), f)
+        print(f"wrote {len(spans)} spans to {out} "
+              f"(open in https://ui.perfetto.dev)")
+        return
+    try:
+        summary = gcs.call("Metrics", "cluster_summary").get("serve", {})
+    except Exception as e:  # noqa: BLE001 — pre-observability GCS
+        sys.exit(f"no serve summary from GCS: {e}")
+    apps = summary.get("apps") or {}
+    latency = summary.get("latency") or {}
+    counters = summary.get("counters") or {}
+    names = sorted(set(apps) | set(latency) | set(counters))
+    if not names:
+        print("no serve apps reporting")
+        return
+    print(f"serve @ {gcs.address}")
+    for app in names:
+        print(f"  app {app}:")
+        gauges = apps.get(app) or {}
+        if gauges:
+            print("    gauges: " + "  ".join(
+                f"{k}={v:g}" for k, v in sorted(gauges.items())))
+        lat = latency.get(app) or {}
+        line = []
+        if "ttft_mean_s" in lat:
+            line.append(f"ttft_mean={lat['ttft_mean_s'] * 1e3:.1f}ms")
+        if "itl_mean_s" in lat:
+            line.append(f"itl_mean={lat['itl_mean_s'] * 1e3:.1f}ms")
+        if line:
+            print("    latency: " + "  ".join(line))
+        phases = lat.get("phase_mean_s") or {}
+        if phases:
+            print("    phases: " + "  ".join(
+                f"{p}={v * 1e3:.1f}ms" for p, v in sorted(phases.items())))
+        cts = counters.get(app) or {}
+        if cts:
+            print("    counters: " + "  ".join(
+                f"{k}={v:g}" for k, v in sorted(cts.items())))
 
 
 def cmd_job(args) -> None:
@@ -716,6 +777,17 @@ def main(argv: Optional[List[str]] = None) -> None:
         jpx = jsub.add_parser(name)
         jpx.add_argument("submission_id")
     jsub.add_parser("list")
+    svp = sub.add_parser(
+        "serve", help="serving-plane observability: per-app latency/"
+                      "KV rollup (status) and per-request span traces "
+                      "(trace <request-id>)")
+    ssub = svp.add_subparsers(dest="serve_cmd", required=True)
+    ssub.add_parser("status")
+    stp = ssub.add_parser("trace")
+    stp.add_argument("request_id", help="request id (== trace id; the "
+                                        "X-Request-Id header value)")
+    stp.add_argument("-o", "--out", default=None,
+                     help="output path (default trace-<id>.json)")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--host", default="127.0.0.1")
     dp.add_argument("--port", type=int, default=8265)
@@ -801,7 +873,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     gcs = _Gcs(_resolve_address(args))
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
      "metrics": cmd_metrics, "stack": cmd_stack, "top": cmd_top,
-     "profile": cmd_profile, "logs": cmd_logs}[args.cmd](gcs, args)
+     "profile": cmd_profile, "logs": cmd_logs,
+     "serve": cmd_serve}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

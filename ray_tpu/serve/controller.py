@@ -323,11 +323,17 @@ class ServeController:
 
         with self._lock:  # RLock: app_status re-enters safely
             snap = {}
+            merged = getattr(self, "_merged_gauges", None) or {}
             for app in self._targets:
                 st = self._state.get(app,
                                      {"replicas": {}, "version": 0})
                 snap[app] = {**self.app_status(app),
                              "replicas": sorted(st["replicas"])}
+                # Observability ride-along: the syncer-fed per-app gauge
+                # aggregate (queue depth, active, tokens/s, occupancy)
+                # the autoscaler already fetched this tick.
+                if merged.get(app):
+                    snap[app]["gauges"] = merged[app]
         if snap == getattr(self, "_last_published", None):
             return
         self._last_published = snap

@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve.controller import get_or_create_controller
+from ray_tpu.util import tracing
 
 
 def _retry_backoff(attempt: int) -> float:
@@ -251,8 +252,17 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         if self._stream:
             return self.remote_streaming(*args, **kwargs)
+        # Reserved keys ride the kwargs channel from the proxy (popped
+        # here so user callables never see them); direct handle calls
+        # mint their own request id so `ray-tpu serve trace` works
+        # without the HTTP front.
+        request_id = kwargs.pop("_request_id", None) or uuid.uuid4().hex
+        ctx = kwargs.pop("_trace", None) or tracing.serve_ctx(request_id)
         self._refresh()
-        name, replica = self._pick_replica()
+        with tracing.serve_span(ctx, "serve.handle.route",
+                                app=self._app, method=self._method) as s:
+            name, replica = self._pick_replica()
+            trace = tracing.child_ctx(ctx, s)
         self._push_stats()
         # Mutable cell: retries re-route to a new replica; on_done must
         # decrement whichever replica CURRENTLY carries the request.
@@ -266,14 +276,18 @@ class DeploymentHandle:
         def retry():
             on_done()  # release the failed pick before re-picking
             self._refresh(force=True)
-            name2, replica2 = self._pick_replica()
+            with tracing.serve_span(ctx, "serve.handle.resume",
+                                    app=self._app, resumed=1):
+                name2, replica2 = self._pick_replica()
             holder["name"] = name2
             return replica2.handle_request.remote(
-                self._method, args, kwargs, model_id=self._model_id)
+                self._method, args, kwargs, model_id=self._model_id,
+                trace=trace)
 
         try:
             ref = replica.handle_request.remote(
-                self._method, args, kwargs, model_id=self._model_id)
+                self._method, args, kwargs, model_id=self._model_id,
+                trace=trace)
         except Exception:
             # replica may have just died; refresh and retry once
             ref = retry()
@@ -286,10 +300,18 @@ class DeploymentHandle:
         request id and its emitted-item offset; replica death mid-stream
         fails over to a surviving replica via the resume protocol
         (re-admit args + emitted prefix, dedupe the overlap)."""
+        # The request id doubles as the trace id; a proxy-minted one
+        # arrives via the reserved `_request_id`/`_trace` kwargs, direct
+        # handle users get a fresh one (same id the resume protocol and
+        # `ray-tpu serve trace` key on).
+        request_id = kwargs.pop("_request_id", None) or uuid.uuid4().hex
+        ctx = kwargs.pop("_trace", None) or tracing.serve_ctx(request_id)
         self._refresh()
-        name, replica = self._pick_replica()
+        with tracing.serve_span(ctx, "serve.handle.route",
+                                app=self._app, method=self._method) as s:
+            name, replica = self._pick_replica()
+            trace = tracing.child_ctx(ctx, s)
         self._push_stats()
-        request_id = uuid.uuid4().hex
         # Mutable cell: failovers re-route to a new replica; on_done must
         # decrement whichever replica CURRENTLY carries the stream.
         holder = {"name": name}
@@ -303,17 +325,30 @@ class DeploymentHandle:
             failed = holder["name"]
             on_done()  # release the failed pick before re-picking
             self._refresh(force=True)
-            name2, replica2 = self._pick_replica(exclude=failed)
+            with tracing.serve_span(ctx, "serve.handle.resume",
+                                    app=self._app, resumed=1,
+                                    offset=len(emitted)) as rs:
+                name2, replica2 = self._pick_replica(exclude=failed)
+                trace2 = tracing.child_ctx(ctx, rs)
             holder["name"] = name2
             self._push_stats()
+            try:
+                from ray_tpu.serve import observability
+
+                observability.metrics()["resumes"].inc(
+                    1, {"app": self._app})
+            except Exception:  # noqa: BLE001 best-effort telemetry
+                pass
             sid_ref2 = replica2.handle_request_streaming.remote(
                 self._method, args, kwargs, model_id=self._model_id,
                 resume={"request_id": request_id,
-                        "offset": len(emitted), "items": list(emitted)})
+                        "offset": len(emitted), "items": list(emitted)},
+                trace=trace2)
             return replica2, sid_ref2
 
         sid_ref = replica.handle_request_streaming.remote(
-            self._method, args, kwargs, model_id=self._model_id)
+            self._method, args, kwargs, model_id=self._model_id,
+            trace=trace)
         return StreamingResponse(replica, sid_ref, on_done,
                                  resume_fn=resume_fn,
                                  request_id=request_id)

@@ -27,10 +27,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
+
+from ray_tpu.util import tracing
 
 logger = logging.getLogger("ray_tpu.serve.http_proxy")
 
@@ -82,6 +86,14 @@ class HTTPProxy:
         self._host = host
         self._want_port = port
         self._load_persisted_routes()
+        # The proxy has no gauge loop to piggyback its registry on, so
+        # it runs the generic worker->daemon metrics pusher (no-op in
+        # local mode).
+        from ray_tpu.serve import observability
+
+        self._metrics = observability.metrics()
+        self._metrics_push_stop = observability.start_push_loop(
+            f"proxy:{os.getpid()}")
         threading.Thread(target=self._serve_thread, daemon=True).start()
         if not self._started.wait(30):
             raise RuntimeError("HTTP proxy failed to start")
@@ -185,18 +197,36 @@ class HTTPProxy:
         if shed:
             logger.warning("request %s %s shed (inflight >= %d)",
                            rid, request.path, self._max_inflight)
+            self._metrics["shed"].inc(1, {"app": app_name})
+            self._metrics["requests"].inc(
+                1, {"app": app_name, "status": "503"})
             return web.json_response(
                 {"error": "overloaded", "request_id": rid}, status=503,
                 headers={"Retry-After": "1", "X-Request-Id": rid})
+        # The request id IS the trace id: spans from every downstream
+        # hop (handle routing, replica, engine ticks) join this trace,
+        # and `ray-tpu serve trace <X-Request-Id>` renders the track.
+        ctx = tracing.serve_ctx(rid)
+        status = "500"
         try:
-            return await self._dispatch_admitted(request, arg, app_name,
-                                                 rid)
+            with tracing.serve_span(ctx, "serve.proxy.request",
+                                    app=app_name,
+                                    path=request.path) as s:
+                resp = await self._dispatch_admitted(
+                    request, arg, app_name, rid,
+                    trace=tracing.child_ctx(ctx, s))
+                status = str(resp.status)
+                if s is not None:
+                    s.attrs["status"] = resp.status
+                return resp
         finally:
+            self._metrics["requests"].inc(
+                1, {"app": app_name, "status": status})
             with self._inflight_lock:
                 self._inflight -= 1
 
     async def _dispatch_admitted(self, request, arg, app_name: str,
-                                 rid: str):
+                                 rid: str, trace: Optional[dict] = None):
         from aiohttp import web
 
         model_id = request.headers.get("X-Model-Id") or (
@@ -218,7 +248,9 @@ class HTTPProxy:
             try:
                 out = await loop.run_in_executor(
                     self._executor,
-                    lambda: handle.remote(arg).result(timeout=deadline))
+                    lambda: handle.remote(
+                        arg, _request_id=rid, _trace=trace,
+                    ).result(timeout=deadline))
             except Exception as e:  # noqa: BLE001
                 return self._error_response(e, rid, request.path)
             return web.json_response(out,
@@ -231,7 +263,9 @@ class HTTPProxy:
         # only exhausted-failover errors surface here.
         try:
             stream_resp = await loop.run_in_executor(
-                self._stream_executor, lambda: handle.remote_streaming(arg))
+                self._stream_executor,
+                lambda: handle.remote_streaming(
+                    arg, _request_id=rid, _trace=trace))
             it = iter(stream_resp)
         except Exception as e:  # noqa: BLE001
             return self._error_response(e, rid, request.path)
@@ -248,14 +282,19 @@ class HTTPProxy:
             except StopIteration:
                 return None, True
 
+        n_items = 0
+        n_bytes = 0
+        t0 = time.time()
         try:
             while True:
                 item, done = await loop.run_in_executor(
                     self._stream_executor, pull_next)
                 if done:
                     break
-                await resp.write(
-                    (json.dumps(item) + "\n").encode())
+                line = (json.dumps(item) + "\n").encode()
+                n_items += 1
+                n_bytes += len(line)
+                await resp.write(line)
         except Exception as e:  # noqa: BLE001
             # Best-effort error line — the socket may already be gone
             # (client disconnect); the finally still cancels the stream.
@@ -269,6 +308,12 @@ class HTTPProxy:
                 pass
         finally:
             stream_resp.cancel()  # idempotent; frees the replica stream
+            # One span for the whole streamed body (per-batch spans come
+            # from the replica's stream_next; this one carries totals +
+            # how many failovers the resume protocol absorbed).
+            tracing.record_serve_span(
+                trace, "serve.proxy.stream", t0, items=n_items,
+                bytes=n_bytes, resumes=stream_resp.resumes)
         try:
             await resp.write_eof()
         except Exception:  # noqa: BLE001
@@ -301,6 +346,7 @@ class HTTPProxy:
         return True
 
     def stop(self) -> bool:
+        self._metrics_push_stop.set()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         return True

@@ -57,8 +57,8 @@ class KVBlockAllocator:
         # full-prompt key -> metadata (last-token logits) so a whole-
         # prompt hit can sample its first token without any forward.
         self._meta: Dict[tuple, Any] = {}
-        self.stats = {"reuse_hits": 0, "cow_copies": 0, "evictions": 0,
-                      "alloc_failures": 0}
+        self.stats = {"reuse_hits": 0, "reuse_misses": 0, "cow_copies": 0,
+                      "evictions": 0, "alloc_failures": 0}
         self._arena = None
         self.arena_bytes = 0
         if store is not None and bytes_per_block > 0:
@@ -167,6 +167,7 @@ class KVBlockAllocator:
                     meta = (self._meta.get(whole)
                             if k * bs == len(tokens) else None)
                     return chain, k * bs, meta
+            self.stats["reuse_misses"] += 1
             return [], 0, None
 
     def _chain_locked(self, tokens, k: int) -> Optional[List[int]]:

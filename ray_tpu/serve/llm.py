@@ -31,10 +31,13 @@ import math
 import queue
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+from ray_tpu.util import tracing
 
 
 class StreamQueueFullError(RuntimeError):
@@ -48,7 +51,7 @@ class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "out_tokens",
                  "done", "error", "slot", "submitted_at", "first_token_at",
                  "token_q", "dropped", "blocks", "pos", "prefilling",
-                 "no_register")
+                 "no_register", "trace", "submitted_wall", "last_emit_wall")
 
     def __init__(self, prompt, max_tokens, temperature, stream=False):
         from ray_tpu.core.config import get_config
@@ -62,6 +65,12 @@ class _Request:
         self.slot = -1
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
+        # Serve trace context ({"trace_id": <request id>, ...}, None when
+        # tracing is off) — engine tick spans parent under it.  Wall
+        # clocks alongside the perf counters: spans need epoch stamps.
+        self.trace: Optional[dict] = None
+        self.submitted_wall = time.time()
+        self.last_emit_wall: Optional[float] = None
         # Streaming consumers read tokens as the engine emits them.
         # BOUNDED: a consumer that stops reading must not grow replica
         # RSS without limit — at the bound the stream drops with an
@@ -111,7 +120,8 @@ class _EngineBase:
     def generate(self, prompt_tokens: List[int], *, max_tokens: int = 64,
                  temperature: float = 0.0,
                  timeout: Optional[float] = 300,
-                 resume_tokens: Optional[List[int]] = None) -> List[int]:
+                 resume_tokens: Optional[List[int]] = None,
+                 trace: Optional[dict] = None) -> List[int]:
         ctx, remaining, resumed = self._resume_ctx(
             prompt_tokens, max_tokens, resume_tokens)
         if len(ctx) >= self.max_len:
@@ -120,6 +130,7 @@ class _EngineBase:
             return []
         req = _Request(ctx, remaining, temperature)
         req.no_register = resumed
+        self._obs_submit(req, trace)
         self.stats["requests"] += 1
         self._pending_put(req)
         if not req.done.wait(timeout):
@@ -131,7 +142,8 @@ class _EngineBase:
     def generate_stream(self, prompt_tokens: List[int], *,
                         max_tokens: int = 64, temperature: float = 0.0,
                         timeout: Optional[float] = 300,
-                        resume_tokens: Optional[List[int]] = None):
+                        resume_tokens: Optional[List[int]] = None,
+                        trace: Optional[dict] = None):
         """Yield tokens as the engine produces them (TTFT = first yield;
         the continuous-batching loop keeps decoding other slots while the
         consumer reads).  `resume_tokens` re-admits an interrupted
@@ -145,6 +157,7 @@ class _EngineBase:
             return
         req = _Request(ctx, remaining, temperature, stream=True)
         req.no_register = resumed
+        self._obs_submit(req, trace)
         self.stats["requests"] += 1
         self._pending_put(req)
         deadline = time.monotonic() + (timeout or 300)
@@ -190,6 +203,76 @@ class _EngineBase:
             except queue.Full:
                 pass  # dropped stream: done event carries the signal
         req.done.set()
+
+    # -- serving observability ------------------------------------------
+    # Spans attribute each engine phase (queue_wait / prefill_chunk /
+    # decode_burst) to the request's trace; histograms decompose TTFT /
+    # ITL per app.  Spans gate on req.trace (None when the
+    # RAY_TPU_SERVE_TRACE_ENABLED kill switch is off); histograms record
+    # either way.  The app tag is learned lazily from traced requests —
+    # standalone engines (bench, unit tests) report under "-".
+    _app_hint = "-"
+
+    def _obs_submit(self, req: "_Request",
+                    trace: Optional[dict]) -> None:
+        # Direct engine use (no proxy/handle upstream) mints its own
+        # trace so span coverage — and the overhead the kill switch
+        # removes — is identical with and without the HTTP front.
+        req.trace = (trace if trace is not None
+                     else tracing.serve_ctx(uuid.uuid4().hex))
+
+    def _obs_app(self, req: "_Request") -> str:
+        app = req.trace.get("app") if req.trace else None
+        if app:
+            self._app_hint = app
+            return app
+        return self._app_hint
+
+    def _obs_admitted(self, req: "_Request") -> None:
+        from ray_tpu.serve import observability
+
+        now = time.time()
+        tracing.record_serve_span(req.trace, "serve.engine.queue_wait",
+                                  req.submitted_wall, now,
+                                  tokens=len(req.prompt))
+        observability.observe_phase(self._obs_app(req), "queue_wait",
+                                    now - req.submitted_wall)
+
+    def _obs_first_token(self, req: "_Request") -> None:
+        from ray_tpu.serve import observability
+
+        observability.metrics()["ttft"].observe(
+            req.first_token_at - req.submitted_at,
+            {"app": self._obs_app(req)})
+        req.last_emit_wall = time.time()
+
+    def _obs_prefill(self, req: "_Request", t0: float,
+                     n_tokens: int) -> None:
+        from ray_tpu.serve import observability
+
+        t1 = time.time()
+        tracing.record_serve_span(req.trace, "serve.engine.prefill_chunk",
+                                  t0, t1, tokens=n_tokens, pos=req.pos)
+        observability.observe_phase(self._obs_app(req), "prefill", t1 - t0)
+
+    def _obs_burst(self, req: "_Request", t0: float, t1: float,
+                   n_new: int) -> None:
+        """Per fused-burst, per-request: one decode_burst span, one
+        decode_step phase sample, and ONE inter-token-latency sample at
+        the burst-mean gap (per-token observes would cost more than the
+        decode itself at small models)."""
+        if n_new <= 0:
+            return
+        from ray_tpu.serve import observability
+
+        app = self._obs_app(req)
+        tracing.record_serve_span(req.trace, "serve.engine.decode_burst",
+                                  t0, t1, tokens=n_new)
+        observability.observe_phase(app, "decode_step", t1 - t0)
+        if req.last_emit_wall is not None and t1 > req.last_emit_wall:
+            observability.metrics()["itl"].observe(
+                (t1 - req.last_emit_wall) / n_new, {"app": app})
+        req.last_emit_wall = t1
 
 
 class LLMEngine(_EngineBase):
@@ -331,6 +414,7 @@ class LLMEngine(_EngineBase):
         except queue.Empty:
             return False
         try:
+            self._obs_admitted(req)
             n = len(req.prompt)
             key = tuple(req.prompt)
             entry = (self._prefix_cache.get(key)
@@ -348,6 +432,7 @@ class LLMEngine(_EngineBase):
                 self._prefix_cache[key] = self._prefix_cache.pop(key)
                 self.stats["prefix_hits"] += 1
             else:
+                t0 = time.time()
                 bucket = self._bucket_for(n)
                 toks = np.zeros((1, bucket), np.int32)
                 toks[0, :n] = req.prompt
@@ -356,6 +441,7 @@ class LLMEngine(_EngineBase):
                     jnp.int32(slot), jnp.int32(n),
                     jnp.float32(req.temperature), self._rng)
                 self.stats["prefix_misses"] += 1
+                self._obs_prefill(req, t0, n)
                 if self._prefix_cache_size:
                     # Snapshot only the prompt's bucket worth of KV.
                     k_slice, v_slice = self._px_extract(
@@ -368,6 +454,7 @@ class LLMEngine(_EngineBase):
                         self._prefix_cache.pop(
                             next(iter(self._prefix_cache)))
             req.first_token_at = time.perf_counter()
+            self._obs_first_token(req)
             req.emit(int(tok))
             req.slot = slot
             self._slots[slot] = req
@@ -484,15 +571,18 @@ class LLMEngine(_EngineBase):
                 # hit max_tokens mid-burst over-generate and are trimmed;
                 # cache overflow is prevented by _maybe_finish's margin.
                 burst = self.max_burst
+                t0 = time.time()
                 self.cache, tok_mat, self._rng = self._decode(
                     self.params, self.cache,
                     jnp.asarray(self._last_tokens),
                     jnp.asarray(active_mask), jnp.asarray(temps), self._rng,
                     n_steps=burst)
                 tok_mat = np.asarray(tok_mat)          # (burst, S)
+                t1 = time.time()
                 for i, req in enumerate(self._slots):
                     if req is None:
                         continue
+                    n0 = len(req.out_tokens)
                     for step in range(burst):
                         tok = int(tok_mat[step, i])
                         if len(req.out_tokens) >= req.max_tokens:
@@ -503,6 +593,7 @@ class LLMEngine(_EngineBase):
                         if (self.eos_id is not None
                                 and tok == self.eos_id):
                             break
+                    self._obs_burst(req, t0, t1, len(req.out_tokens) - n0)
                     self._maybe_finish(i)
             except BaseException as e:  # noqa: BLE001
                 for i, req in enumerate(self._slots):
@@ -714,6 +805,7 @@ class PagedLLMEngine(_EngineBase):
             return False
         with self._pending_lock:
             self._pending.popleft()
+        self._obs_admitted(req)
         blocks = shared + alloc
         req.blocks = blocks
         req.slot = slot
@@ -764,6 +856,7 @@ class PagedLLMEngine(_EngineBase):
         n_ctx = len(req.prompt) + len(req.out_tokens)
         if req.first_token_at is None:
             req.first_token_at = time.perf_counter()
+            self._obs_first_token(req)
         req.prefilling = False
         req.emit(first_tok)
         self._last_tokens[req.slot] = first_tok
@@ -805,6 +898,7 @@ class PagedLLMEngine(_EngineBase):
                 self._prefillq.popleft()
                 continue
             try:
+                t0 = time.time()
                 # Preempted requests re-prefill their WHOLE context —
                 # prompt plus the tokens already emitted (the stream
                 # keeps every token; only the KV is recomputed).
@@ -838,6 +932,7 @@ class PagedLLMEngine(_EngineBase):
                 budget -= nv
                 progressed = True
                 self.stats["prefill_chunks"] += 1
+                self._obs_prefill(req, t0, nv)
                 if req.pos >= n:
                     self._prefillq.popleft()
                     if not req.out_tokens and not req.no_register:
@@ -915,15 +1010,18 @@ class PagedLLMEngine(_EngineBase):
             active[j] = True
             temps[j] = self._slots[i].temperature
         try:
+            t0 = time.time()
             self.cache, tok_mat, self._rng = self._decode(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(tables), jnp.asarray(lengths),
                 jnp.asarray(active), jnp.asarray(temps), self._rng,
                 n_steps=burst)
             tok_mat = np.asarray(tok_mat)              # (burst, w)
+            t1 = time.time()
             for j, i in enumerate(idx):
                 req = self._slots[i]
                 self._lengths[i] += burst   # KV written for every step
+                n0 = len(req.out_tokens)
                 for step in range(burst):
                     tok = int(tok_mat[step, j])
                     if len(req.out_tokens) >= req.max_tokens:
@@ -933,6 +1031,7 @@ class PagedLLMEngine(_EngineBase):
                     self.stats["tokens_generated"] += 1
                     if self.eos_id is not None and tok == self.eos_id:
                         break
+                self._obs_burst(req, t0, t1, len(req.out_tokens) - n0)
                 self._maybe_finish(i)
         except BaseException as e:  # noqa: BLE001
             for i, req in enumerate(self._slots):
@@ -1072,14 +1171,17 @@ class LLMDeployment:
                                     prefix_cache_size=prefix_cache_size,
                                     speculation_k=speculation_k, mesh=mesh)
 
-    def __call__(self, request: dict) -> dict:
+    def __call__(self, request: dict,
+                 _serve_trace: Optional[dict] = None) -> dict:
         toks = self.engine.generate(
             request["tokens"],
             max_tokens=int(request.get("max_tokens", 32)),
-            temperature=float(request.get("temperature", 0.0)))
+            temperature=float(request.get("temperature", 0.0)),
+            trace=_serve_trace)
         return {"tokens": toks}
 
-    def stream(self, request: dict, _serve_resume: Optional[dict] = None):
+    def stream(self, request: dict, _serve_resume: Optional[dict] = None,
+               _serve_trace: Optional[dict] = None):
         """Streaming entry: yields {"token": t} dicts (served over
         chunked HTTP by the proxy; call via handle.remote_streaming).
 
@@ -1094,7 +1196,8 @@ class LLMDeployment:
                 request["tokens"],
                 max_tokens=int(request.get("max_tokens", 32)),
                 temperature=float(request.get("temperature", 0.0)),
-                resume_tokens=resume or None):
+                resume_tokens=resume or None,
+                trace=_serve_trace):
             yield {"token": tok}
 
     def stats(self, _request: Optional[dict] = None) -> dict:

@@ -19,6 +19,7 @@ import uuid
 from typing import Any, Dict, Optional
 
 from ray_tpu.serve.multiplex import _model_id_ctx
+from ray_tpu.util import tracing
 
 
 class _Stream:
@@ -27,7 +28,10 @@ class _Stream:
     multiplexed-model-id context is re-established in the puller thread
     (generator bodies run HERE, not where the generator was created)."""
 
-    def __init__(self, iterator, model_id: Optional[str] = None):
+    def __init__(self, iterator, model_id: Optional[str] = None,
+                 ctx: Optional[dict] = None, resumed: bool = False):
+        self.ctx = ctx          # serve trace context (None = untraced)
+        self.resumed = resumed
         self.q: "queue.Queue" = queue.Queue(maxsize=256)
         self.error: Optional[BaseException] = None
         self.finished = threading.Event()
@@ -85,9 +89,12 @@ class Replica:
         self._start = time.time()
         self._streams: Dict[str, _Stream] = {}
         self._draining = False
+        # replica_id format: "serve:<app>#g<gen>#<idx>"
+        self._app = replica_id.split(":", 1)[-1].split("#", 1)[0]
         # method name -> whether the resolved target accepts the
-        # replica-injected `_serve_resume` failover context.
+        # replica-injected `_serve_resume` / `_serve_trace` context.
         self._resume_aware: Dict[str, bool] = {}
+        self._trace_aware: Dict[str, bool] = {}
         if inspect.isclass(cls_or_fn):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_func = False
@@ -105,8 +112,7 @@ class Replica:
         daemon's syncer delta carries the aggregate to the GCS, where
         the controller reads one merged per-app view per autoscale tick
         instead of polling replicas."""
-        # replica_id format: "serve:<app>#g<gen>#<idx>"
-        app = self.replica_id.split(":", 1)[-1].split("#", 1)[0]
+        app = self._app
         while not self._gauge_stop.wait(period_s):
             try:
                 from ray_tpu.api import _global_worker, is_initialized
@@ -122,9 +128,22 @@ class Replica:
                 if callable(hook):
                     for k, v in (hook() or {}).items():
                         gauges[k] = float(v)
+                # Fold the hosted engine's cumulative stats into this
+                # process's metric registry, then piggyback the whole
+                # registry dump on the gauge push — the daemon merges
+                # it into its federation payload so serve histograms /
+                # KV counters reach `ray-tpu metrics --federated`
+                # without a second RPC plane.
+                from ray_tpu.serve import observability
+                from ray_tpu.util.metrics import registry_dump
+
+                eng = getattr(self._callable, "engine", None)
+                if eng is not None and hasattr(eng, "engine_stats"):
+                    observability.mirror_engine(eng, app)
                 daemon.call("NodeDaemon", "report_serve_gauges",
                             app=app, replica=self.replica_id,
-                            gauges=gauges, timeout=2)
+                            gauges=gauges, metrics=registry_dump(),
+                            timeout=2)
             except Exception:  # noqa: BLE001 best-effort telemetry
                 continue
 
@@ -152,34 +171,51 @@ class Replica:
             raise ReplicaDrainingError(self.replica_id)
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       model_id: Optional[str] = None) -> Any:
+                       model_id: Optional[str] = None,
+                       trace: Optional[dict] = None) -> Any:
         self._check_admission()
         self._ongoing += 1
         self._total += 1
         try:
-            return self._invoke(method, args, kwargs, model_id)
+            with tracing.serve_span(trace, "serve.replica.request",
+                                    replica=self.replica_id,
+                                    method=method) as s:
+                if trace and self._accepts_kw(method, "_serve_trace",
+                                              self._trace_aware):
+                    inj = tracing.child_ctx(trace, s)
+                    kwargs = dict(kwargs, _serve_trace=(
+                        dict(inj, app=self._app) if inj else None))
+                return self._invoke(method, args, kwargs, model_id)
         finally:
             self._ongoing -= 1
 
     # -- streaming ------------------------------------------------------
-    def _accepts_resume(self, method: str) -> bool:
-        cached = self._resume_aware.get(method)
+    def _accepts_kw(self, method: str, kw: str,
+                    cache: Dict[str, bool]) -> bool:
+        """Whether the resolved target accepts the replica-injected
+        keyword `kw` (explicitly or via **kwargs); cached per method."""
+        cached = cache.get(method)
         if cached is not None:
             return cached
         try:
             params = inspect.signature(self._resolve(method)).parameters
-            ok = ("_serve_resume" in params
+            ok = (kw in params
                   or any(p.kind is inspect.Parameter.VAR_KEYWORD
                          for p in params.values()))
         except (TypeError, ValueError):
             ok = False
-        self._resume_aware[method] = ok
+        cache[method] = ok
         return ok
+
+    def _accepts_resume(self, method: str) -> bool:
+        return self._accepts_kw(method, "_serve_resume",
+                                self._resume_aware)
 
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: dict,
                                  model_id: Optional[str] = None,
-                                 resume: Optional[dict] = None) -> str:
+                                 resume: Optional[dict] = None,
+                                 trace: Optional[dict] = None) -> str:
         """Start a streaming call; returns a stream id the caller pulls
         with stream_next().
 
@@ -192,12 +228,31 @@ class Replica:
         exactly-once continuation."""
         self._check_admission()
         self._total += 1
+        # Trace continuity across failover: a resumed stream keeps the
+        # ORIGINAL request id as its trace id (the resume dict carries
+        # it) so the whole request renders as one perfetto track; the
+        # resumed=1 attribute marks post-failover spans.
+        if resume and resume.get("request_id"):
+            trace = tracing.serve_ctx(resume["request_id"],
+                                      (trace or {}).get("span_id"),
+                                      resumed=1) or trace
+        attrs = {"replica": self.replica_id, "method": method}
+        if resume:
+            attrs["resumed"] = 1
+            attrs["offset"] = int(resume.get("offset", 0))
         skip = 0
         if resume and self._accepts_resume(method):
             kwargs = dict(kwargs, _serve_resume=resume)
         elif resume:
             skip = int(resume.get("offset", 0))
-        out = self._invoke(method, args, kwargs, model_id)
+        with tracing.serve_span(trace, "serve.replica.request",
+                                **attrs) as s:
+            if trace and self._accepts_kw(method, "_serve_trace",
+                                          self._trace_aware):
+                inj = tracing.child_ctx(trace, s)
+                kwargs = dict(kwargs, _serve_trace=(
+                    dict(inj, app=self._app) if inj else None))
+            out = self._invoke(method, args, kwargs, model_id)
         if not hasattr(out, "__next__"):
             out = iter(out if hasattr(out, "__iter__") else [out])
         if skip > 0:
@@ -206,7 +261,8 @@ class Replica:
             out = itertools.islice(out, skip, None)
         sid = uuid.uuid4().hex
         self._gc_streams()
-        self._streams[sid] = _Stream(out, model_id=model_id)
+        self._streams[sid] = _Stream(out, model_id=model_id,
+                                     ctx=trace, resumed=bool(resume))
         self._ongoing += 1
         return sid
 
@@ -215,11 +271,25 @@ class Replica:
         st = self._streams.get(stream_id)
         if st is None:
             return {"items": [], "done": True}
+        t0 = time.time()
         try:
             batch = st.next_batch(max_items, timeout_s)
         except BaseException:
             self._drop_stream(stream_id)
             raise
+        if batch["items"] or batch["done"]:
+            # One span per DELIVERED batch (empty polls are elided so a
+            # slow generator doesn't flood the trace with idle waits).
+            attrs = {"items": len(batch["items"]), "done": batch["done"]}
+            if st.resumed:
+                attrs["resumed"] = 1
+            tracing.record_serve_span(st.ctx, "serve.replica.stream_next",
+                                      t0, **attrs)
+            if batch["items"]:
+                from ray_tpu.serve import observability
+
+                observability.observe_phase(self._app, "stream_transport",
+                                            time.time() - t0)
         if batch["done"]:
             self._drop_stream(stream_id)
         return batch

@@ -124,6 +124,13 @@ class Gauge(Metric):
         this way, so the gauge can never leak on an exception path)."""
         return _GaugeTrack(self, value, tags)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one labelset's sample entirely (a gauge mirroring an
+        external entity — e.g. a serve replica that aged out — must stop
+        exporting it, not pin the last value forever)."""
+        with self._lock:
+            self._values.pop(self._merged(tags), None)
+
     def _share_state(self, other: "Gauge") -> None:
         self._values = other._values
         self._lock = other._lock
@@ -364,6 +371,65 @@ def registry_dump() -> List[dict]:
                               for key, value in m.samples()]
         out.append(rec)
     return out
+
+
+def merge_dump_lists(dumps: Sequence[List[dict]]) -> List[dict]:
+    """Merge several registry_dump() lists into ONE dump (the node
+    daemon folds worker-pushed dumps — serve replicas, the HTTP proxy —
+    into its own federation payload, so one node still ships one dump).
+    Counters and histograms with identical (name, labelset) SUM (two
+    replicas of one app on a node yield one per-app series); gauges are
+    last-write-wins (distinguish them with labels — replica serve
+    gauges carry app/replica tags).  Shape mismatches keep the first
+    record seen."""
+    merged: Dict[str, dict] = {}
+    for dump in dumps:
+        for rec in dump:
+            name = rec.get("name")
+            cur = merged.get(name)
+            if cur is None:
+                cur = {"name": name,
+                       "description": rec.get("description", ""),
+                       "kind": rec.get("kind")}
+                if rec.get("kind") == "histogram":
+                    cur["boundaries"] = list(rec.get("boundaries", []))
+                    cur["hist"] = []
+                else:
+                    cur["samples"] = []
+                merged[name] = cur
+            if cur["kind"] != rec.get("kind"):
+                continue
+            if cur["kind"] == "histogram":
+                if cur["boundaries"] != list(rec.get("boundaries", [])):
+                    continue
+                by_key = {tuple(map(tuple, h[0])): h for h in cur["hist"]}
+                for key, buckets, hsum, total in rec.get("hist", []):
+                    k = tuple(map(tuple, key))
+                    have = by_key.get(k)
+                    if have is None:
+                        row = [list(key), list(buckets), hsum, total]
+                        cur["hist"].append(row)
+                        by_key[k] = row
+                    else:
+                        have[1] = [a + b for a, b in zip(have[1], buckets)]
+                        have[2] += hsum
+                        have[3] += total
+            else:
+                summing = cur["kind"] == "counter"
+                by_key = {tuple(map(tuple, s[0])): s
+                          for s in cur["samples"]}
+                for key, value in rec.get("samples", []):
+                    k = tuple(map(tuple, key))
+                    have = by_key.get(k)
+                    if have is None:
+                        row = [list(key), value]
+                        cur["samples"].append(row)
+                        by_key[k] = row
+                    elif summing:
+                        have[1] += value
+                    else:
+                        have[1] = value
+    return list(merged.values())
 
 
 def merge_dumps(dumps: Dict[str, List[dict]]) -> str:

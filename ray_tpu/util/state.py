@@ -200,3 +200,23 @@ def cluster_metrics() -> str:
     """The GCS's federated Prometheus exposition: every node's last
     syncer-shipped snapshot merged, node-labelled."""
     return _gcs().call("Metrics", "federated_text", timeout=30)
+
+
+def serve_summary() -> dict:
+    """Serving-plane observability rollup: per-app replica gauges plus
+    the latency/counter view mined from the federated serve metrics
+    ({"apps", "latency" (ttft/itl/phase means), "counters"}).  Same
+    blob as cluster_status()["observability"]["serve"]."""
+    return _gcs().call("Metrics", "cluster_summary",
+                       timeout=30).get("serve", {})
+
+
+def request_trace(request_id: str,
+                  filename: Optional[str] = None) -> str:
+    """Dump one serve request's end-to-end span track (proxy -> handle
+    -> replica -> engine) as a chrome/perfetto trace; returns the
+    written path. Convenience re-export of
+    ray_tpu.util.timeline.request_trace."""
+    from ray_tpu.util.timeline import request_trace as _rt
+
+    return _rt(request_id, filename=filename)

@@ -136,3 +136,75 @@ def timeline(filename: str = "timeline.json") -> str:
     with open(filename, "w") as f:
         json.dump(chrome_trace(), f)
     return filename
+
+
+# ---------------------------------------------------------------------------
+# Per-request serve traces (`ray-tpu serve trace <request-id>`): the
+# request id IS the trace id, so one trace_id filter over the GCS span
+# sink yields the request's whole serving path — proxy admission, handle
+# routing (and failover re-routes), replica hop, engine queue_wait /
+# prefill chunks / per-burst decode, stream batches.
+# ---------------------------------------------------------------------------
+
+def fetch_spans(trace_id: Optional[str] = None,
+                limit: int = 10000) -> List[dict]:
+    from ray_tpu.api import _global_worker
+
+    return _global_worker().gcs.call("TaskEvents", "list_spans",
+                                     trace_id=trace_id, limit=limit,
+                                     timeout=30)
+
+
+def request_chrome_trace(spans: List[dict]) -> List[dict]:
+    """Chrome-trace events for ONE request: a dedicated
+    `request:<id>` process whose threads are the serving hops, so the
+    track reads top-to-bottom in causal order (proxy -> handle ->
+    replica -> engine) and left-to-right in time.  Hop = the span-name
+    segment after "serve." ("proxy.request" -> "proxy"); resumed spans
+    render in their own `<hop> (resumed)` rows so a failover shows as a
+    visible second act on the same track."""
+    out: List[dict] = []
+    hop_order = {"proxy": 0, "handle": 1, "replica": 2, "engine": 3}
+    for s in spans:
+        if s.get("end_ts") is None or s.get("start_ts") is None:
+            continue
+        parts = s.get("name", "").split(".")
+        hop = parts[1] if len(parts) > 1 and parts[0] == "serve" \
+            else parts[0] or "span"
+        attrs = s.get("attrs", {}) or {}
+        tid = f"{hop_order.get(hop, 9)}:{hop}"
+        if attrs.get("resumed"):
+            tid += " (resumed)"
+        out.append({
+            "name": s.get("name", "span"),
+            "cat": "serve_request",
+            "ph": "X",
+            "ts": s["start_ts"] * 1e6,
+            "dur": max(1.0, (s["end_ts"] - s["start_ts"]) * 1e6),
+            "pid": f"request:{(s.get('trace_id') or '?')[:12]}",
+            "tid": tid,
+            "args": {**attrs,
+                     "trace_id": s.get("trace_id"),
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id"),
+                     "node_id": s.get("node_id"),
+                     "pid": s.get("pid")},
+        })
+    return out
+
+
+def request_trace(request_id: str,
+                  filename: Optional[str] = None) -> str:
+    """Dump one request's serving-path spans as a chrome trace; returns
+    the path (default `trace-<first 12 of id>.json`)."""
+    spans = fetch_spans(trace_id=request_id)
+    if not spans:
+        raise ValueError(
+            f"no spans recorded for request {request_id!r} (is "
+            f"RAY_TPU_SERVE_TRACE_ENABLED=0, or has the span buffer "
+            f"not flushed yet?)")
+    if filename is None:
+        filename = f"trace-{request_id[:12]}.json"
+    with open(filename, "w") as f:
+        json.dump(request_chrome_trace(spans), f)
+    return filename

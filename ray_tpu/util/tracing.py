@@ -45,6 +45,12 @@ def enabled() -> bool:
     return get_config().tracing_enabled
 
 
+def serve_enabled() -> bool:
+    """Serving-plane request tracing (independent of the generic task
+    tracing opt-in; RAY_TPU_SERVE_TRACE_ENABLED=0 is the kill switch)."""
+    return get_config().serve_trace_enabled
+
+
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs",
                  "start", "end")
@@ -59,10 +65,10 @@ class Span:
         self.start = time.time()
         self.end: Optional[float] = None
 
-    def finish(self) -> dict:
+    def finish(self, end_ts: Optional[float] = None) -> dict:
         import os
 
-        self.end = time.time()
+        self.end = time.time() if end_ts is None else end_ts
         record = {
             "kind": "span",
             "name": self.name,
@@ -128,6 +134,73 @@ def extract_and_span(ctx: Optional[Dict[str, str]], name: str, **attrs):
     finally:
         _current.reset(token)
         s.finish()
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane request traces: the serve path passes an EXPLICIT context
+# dict ({"trace_id": <request id>, "span_id": <parent>}) from hop to hop
+# (proxy -> handle -> replica -> engine) instead of relying on the
+# contextvar — the engine emits spans from its own tick thread, replicas
+# from puller threads, none of which inherit the request's context.  The
+# request id IS the trace id, so `ray-tpu serve trace <request-id>`
+# is a trace_id filter over the GCS span sink.
+# ---------------------------------------------------------------------------
+
+def serve_ctx(request_id: str, parent_span_id: Optional[str] = None,
+              **extra) -> Optional[Dict[str, Any]]:
+    """Mint a serve trace context from a request id; None when serve
+    tracing is off (every downstream helper no-ops on None)."""
+    if not serve_enabled() or not request_id:
+        return None
+    ctx: Dict[str, Any] = {"trace_id": request_id,
+                           "span_id": parent_span_id}
+    ctx.update(extra)
+    return ctx
+
+
+def child_ctx(ctx: Optional[Dict[str, Any]],
+              span: Optional["Span"]) -> Optional[Dict[str, Any]]:
+    """Context for the next hop: same trace, parented under `span`."""
+    if ctx is None:
+        return None
+    if span is None:
+        return ctx
+    out = dict(ctx)
+    out["span_id"] = span.span_id
+    return out
+
+
+@contextlib.contextmanager
+def serve_span(ctx: Optional[Dict[str, Any]], name: str, **attrs):
+    """Open a serve-plane span under an explicit request context.
+    No-op (yields None) when tracing is off or there is no context —
+    the caller never branches."""
+    if ctx is None or not serve_enabled():
+        yield None
+        return
+    if ctx.get("resumed"):
+        attrs.setdefault("resumed", 1)
+    s = Span(name, trace_id=ctx["trace_id"],
+             parent_id=ctx.get("span_id"), attrs=attrs)
+    try:
+        yield s
+    finally:
+        s.finish()
+
+
+def record_serve_span(ctx: Optional[Dict[str, Any]], name: str,
+                      start_ts: float, end_ts: Optional[float] = None,
+                      **attrs) -> None:
+    """Record an already-timed serve span (engine ticks measure their
+    own wall window; spans are minted after the fact)."""
+    if ctx is None or not serve_enabled():
+        return
+    if ctx.get("resumed"):
+        attrs.setdefault("resumed", 1)
+    s = Span(name, trace_id=ctx["trace_id"],
+             parent_id=ctx.get("span_id"), attrs=attrs)
+    s.start = start_ts
+    s.finish(end_ts)
 
 
 def drain() -> List[dict]:

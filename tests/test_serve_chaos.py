@@ -99,12 +99,14 @@ def _bare_replica(target):
 
     r = Replica.__new__(Replica)
     r.replica_id = "serve:unit#g1#0"
+    r._app = "unit"
     r._ongoing = 0
     r._total = 0
     r._start = time.time()
     r._streams = {}
     r._draining = False
     r._resume_aware = {}
+    r._trace_aware = {}
     r._callable = target
     r._is_func = not isinstance(target, type) and callable(target)
     return r

@@ -1,0 +1,386 @@
+"""Serving-plane observability: per-request traces (trace id == request
+id) through proxy -> handle -> replica -> engine, trace continuity
+across mid-stream failover, the RAY_TPU_SERVE_TRACE_ENABLED kill
+switch, and the serve metrics federation path (worker registry push ->
+daemon merge -> GCS rollup)."""
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _poll_spans(trace_id, want, timeout=60, pred=None):
+    """Poll the GCS span sink until every name in `want` appears for
+    `trace_id` — and `pred(spans)`, when given, holds (the worker
+    flushers back off to 16s when idle, so hops land at different
+    times)."""
+    from ray_tpu.api import _global_worker
+
+    gcs = _global_worker().gcs
+    deadline = time.monotonic() + timeout
+    spans = []
+    while time.monotonic() < deadline:
+        spans = gcs.call("TaskEvents", "list_spans", trace_id=trace_id,
+                         limit=10000, timeout=10)
+        if want <= {s["name"] for s in spans} and (
+                pred is None or pred(spans)):
+            return spans
+        time.sleep(0.5)
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# unit: trace context helpers + kill switch
+# ---------------------------------------------------------------------------
+def test_serve_ctx_and_child_ctx():
+    ctx = tracing.serve_ctx("rid-unit-1")
+    assert ctx == {"trace_id": "rid-unit-1", "span_id": None}
+    with tracing.serve_span(ctx, "serve.test.root", k=1) as s:
+        assert s.trace_id == "rid-unit-1" and s.parent_id is None
+        child = tracing.child_ctx(ctx, s)
+        assert child["trace_id"] == "rid-unit-1"
+        assert child["span_id"] == s.span_id
+    with tracing.serve_span(child, "serve.test.child") as c:
+        assert c.parent_id == s.span_id
+
+
+def test_resumed_flag_propagates_into_span_attrs():
+    rid = f"rid-unit-2-{os.getpid()}"
+    ctx = tracing.serve_ctx(rid, resumed=1)
+    with tracing.serve_span(ctx, "serve.test.hop") as s:
+        pass
+    assert s.attrs["resumed"] == 1
+    # record_serve_span (the engine's after-the-fact path) too; read it
+    # back through the GCS sink — the driver's flusher races any direct
+    # peek at the local buffer.
+    t0 = time.time()
+    tracing.record_serve_span(ctx, "serve.test.recorded", t0)
+    spans = _poll_spans(rid, {"serve.test.recorded"})
+    rec = [r for r in spans if r["name"] == "serve.test.recorded"]
+    assert rec and rec[-1]["attrs"]["resumed"] == 1
+    assert rec[-1]["start_ts"] == t0
+    # child_ctx keeps the resumed marker for downstream hops
+    assert tracing.child_ctx(ctx, s)["resumed"] == 1
+
+
+def test_kill_switch_disables_serve_tracing():
+    from ray_tpu.core import config as cfg_mod
+
+    os.environ["RAY_TPU_SERVE_TRACE_ENABLED"] = "0"
+    cfg_mod.reset_config()
+    try:
+        assert not tracing.serve_enabled()
+        assert tracing.serve_ctx("rid-off") is None
+        with tracing.serve_span({"trace_id": "rid-off"}, "serve.x") as s:
+            assert s is None
+        tracing.record_serve_span({"trace_id": "rid-off"}, "serve.y",
+                                  time.time())
+        assert not [r for r in tracing._buffer
+                    if r.get("trace_id") == "rid-off"]
+    finally:
+        os.environ.pop("RAY_TPU_SERVE_TRACE_ENABLED", None)
+        cfg_mod.reset_config()
+    assert tracing.serve_enabled()  # default is on
+
+
+# ---------------------------------------------------------------------------
+# unit: metrics plumbing (merge, gauge removal, engine mirror)
+# ---------------------------------------------------------------------------
+def test_merge_dump_lists_sums_counters_and_histograms():
+    from ray_tpu.util.metrics import merge_dump_lists
+
+    key = [["app", "a"]]
+    c1 = {"name": "raytpu_serve_tokens_total", "description": "",
+          "kind": "counter", "samples": [[key[0], 5.0]]}
+    c2 = {"name": "raytpu_serve_tokens_total", "description": "",
+          "kind": "counter", "samples": [[key[0], 7.0]]}
+    h1 = {"name": "raytpu_serve_ttft_seconds", "description": "",
+          "kind": "histogram", "boundaries": [0.1, 1.0],
+          "hist": [[key, [1, 0, 0], 0.05, 1]]}
+    h2 = {"name": "raytpu_serve_ttft_seconds", "description": "",
+          "kind": "histogram", "boundaries": [0.1, 1.0],
+          "hist": [[key, [0, 2, 0], 0.8, 2]]}
+    g1 = {"name": "raytpu_serve_inflight", "description": "",
+          "kind": "gauge", "samples": [[key[0], 3.0]]}
+    g2 = {"name": "raytpu_serve_inflight", "description": "",
+          "kind": "gauge", "samples": [[key[0], 1.0]]}
+    merged = {r["name"]: r for r in merge_dump_lists(
+        [[c1, h1, g1], [c2, h2, g2]])}
+    assert merged["raytpu_serve_tokens_total"]["samples"] == [
+        [["app", "a"], 12.0]]
+    hrow = merged["raytpu_serve_ttft_seconds"]["hist"][0]
+    assert hrow[1] == [1, 2, 0] and hrow[2] == pytest.approx(0.85)
+    assert hrow[3] == 3
+    # gauges: last write wins, no summing
+    assert merged["raytpu_serve_inflight"]["samples"] == [
+        [["app", "a"], 1.0]]
+
+
+def test_gauge_remove_drops_labelset():
+    from ray_tpu.util.metrics import Gauge
+
+    g = Gauge("test_obs_remove_gauge", tag_keys=("app",))
+    g.set(4.0, {"app": "x"})
+    g.set(9.0, {"app": "y"})
+    g.remove({"app": "x"})
+    samples = dict(g.samples())
+    assert [dict(k)["app"] for k in samples] == ["y"]
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.stats = {"tokens_generated": 0, "reuse_hits": 0,
+                      "preemptions": 0, "requests": 0, "completed": 0,
+                      "blocks_total": 8, "blocks_free": 8,
+                      "blocks_cached": 0, "blocks_active": 0,
+                      "occupancy": 0.0}
+
+    def engine_stats(self):
+        return dict(self.stats)
+
+
+def _sample(metric, **tags):
+    for key, value in metric.samples():
+        if all(dict(key).get(k) == v for k, v in tags.items()):
+            return value
+    return None
+
+
+def test_mirror_engine_counts_deltas_not_totals():
+    from ray_tpu.serve import observability as obs
+
+    m = obs.metrics()
+    eng = _FakeEngine()
+    app = f"mirrortest{os.getpid()}"
+    obs.mirror_engine(eng, app)          # baseline: all zeros
+    eng.stats.update(tokens_generated=10, reuse_hits=3, preemptions=1,
+                     blocks_active=4, blocks_free=4, occupancy=0.5)
+    obs.mirror_engine(eng, app)
+    assert _sample(m["tokens"], app=app) == 10.0
+    assert _sample(m["kv_events"], app=app, event="reuse_hit") == 3.0
+    assert _sample(m["kv_events"], app=app, event="preemption") == 1.0
+    assert _sample(m["kv_blocks"], app=app, state="active") == 4.0
+    assert _sample(m["kv_occupancy"], app=app) == 0.5
+    # a second mirror with unchanged stats must not double-count
+    obs.mirror_engine(eng, app)
+    assert _sample(m["tokens"], app=app) == 10.0
+    assert _sample(m["kv_events"], app=app, event="reuse_hit") == 3.0
+    # ...and further growth adds only the delta
+    eng.stats["tokens_generated"] = 15
+    obs.mirror_engine(eng, app)
+    assert _sample(m["tokens"], app=app) == 15.0
+
+
+def test_kv_allocator_counts_reuse_misses():
+    from ray_tpu.serve.kv_cache import KVBlockAllocator
+
+    a = KVBlockAllocator(9, 4)
+    assert a.lookup_prefix([1, 2, 3, 4]) == ([], 0, None)
+    assert a.stats["reuse_misses"] == 1
+    blocks = a.alloc(1)
+    a.register_prefix([1, 2, 3, 4], blocks, meta="m")
+    got, covered, _meta = a.lookup_prefix([1, 2, 3, 4, 5])
+    assert covered == 4 and got
+    assert a.stats["reuse_hits"] == 1
+    assert a.stats["reuse_misses"] == 1  # the hit did not count a miss
+    snap = a.snapshot()
+    assert snap["reuse_misses"] == 1 and snap["reuse_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# unit: perfetto rendering of a request track
+# ---------------------------------------------------------------------------
+def test_request_chrome_trace_renders_hop_rows():
+    from ray_tpu.util.timeline import request_chrome_trace
+
+    rid = "rid-render-000"
+    spans = [
+        {"name": "serve.proxy.request", "trace_id": rid, "span_id": "p",
+         "parent_id": None, "start_ts": 1.0, "end_ts": 2.0,
+         "attrs": {"app": "a"}},
+        {"name": "serve.handle.route", "trace_id": rid, "span_id": "h",
+         "parent_id": "p", "start_ts": 1.1, "end_ts": 1.2, "attrs": {}},
+        {"name": "serve.engine.decode_burst", "trace_id": rid,
+         "span_id": "e", "parent_id": "r", "start_ts": 1.3,
+         "end_ts": 1.4, "attrs": {"resumed": 1}},
+        {"name": "serve.handle.route", "trace_id": rid, "span_id": "x",
+         "parent_id": None, "start_ts": None, "end_ts": None,
+         "attrs": {}},  # unfinished: skipped
+    ]
+    rows = request_chrome_trace(spans)
+    assert len(rows) == 3
+    assert all(r["pid"] == f"request:{rid[:12]}" for r in rows)
+    tids = [r["tid"] for r in rows]
+    assert tids[0] == "0:proxy" and tids[1] == "1:handle"
+    assert tids[2] == "3:engine (resumed)"
+    assert rows[0]["args"]["span_id"] == "p"
+    assert rows[1]["args"]["parent_id"] == "p"
+    assert rows[0]["dur"] == pytest.approx(1e6)
+
+
+# ---------------------------------------------------------------------------
+# engine spans: direct engine use mints its own trace; spans cover
+# queue_wait / prefill chunks / per-burst decode
+# ---------------------------------------------------------------------------
+def test_paged_engine_emits_phase_spans():
+    import jax
+
+    from ray_tpu.models import configs, init_params
+    from ray_tpu.serve.llm import PagedLLMEngine
+
+    cfg = configs.get("tiny")
+    params = init_params(jax.random.key(0), cfg)
+    eng = PagedLLMEngine(cfg, params, num_slots=2, max_len=64,
+                         block_size=4, prefill_chunk=8)
+    rid = f"rid-engine-{os.getpid()}"
+    try:
+        out = eng.generate([5, 7, 11, 13], max_tokens=8,
+                           temperature=0.0, timeout=60,
+                           trace=tracing.serve_ctx(rid))
+        assert out
+    finally:
+        eng.shutdown()
+    spans = _poll_spans(rid, {"serve.engine.queue_wait",
+                              "serve.engine.prefill_chunk",
+                              "serve.engine.decode_burst"})
+    names = {s["name"] for s in spans}
+    assert {"serve.engine.queue_wait", "serve.engine.prefill_chunk",
+            "serve.engine.decode_burst"} <= names, names
+    assert all(s["trace_id"] == rid for s in spans)
+    bursts = [s for s in spans
+              if s["name"] == "serve.engine.decode_burst"]
+    assert all(s["end_ts"] >= s["start_ts"] for s in spans)
+    # The first generated token falls out of prefill's last step, so
+    # decode bursts account for every token after it.
+    assert sum(s["attrs"].get("tokens", 0)
+               for s in bursts) >= len(out) - 1
+
+
+# ---------------------------------------------------------------------------
+# cluster: the full proxy -> handle -> replica span chain for one HTTP
+# request, plus the federated serve metrics that request produces
+# ---------------------------------------------------------------------------
+def test_http_request_trace_parentage_and_federation():
+    @serve.deployment(num_replicas=1)
+    def echo(request):
+        return {"ok": True, "n": request.get("n")}
+
+    serve.run(echo.bind(), name="obs_http", _http=True,
+              route_prefix="/obs_http")
+    rid = f"rid-http-{os.getpid()}"
+    try:
+        port = serve.http_port()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/obs_http",
+            data=json.dumps({"n": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers.get("X-Request-Id") == rid
+            assert json.loads(r.read())["ok"] is True
+
+        want = {"serve.proxy.request", "serve.handle.route",
+                "serve.replica.request"}
+        spans = _poll_spans(rid, want)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], s)
+        assert want <= set(by_name), set(by_name)
+        # the request id IS the trace id on every hop
+        assert all(s["trace_id"] == rid for s in spans)
+        # causal parentage across process boundaries
+        proxy = by_name["serve.proxy.request"]
+        route = by_name["serve.handle.route"]
+        replica = by_name["serve.replica.request"]
+        assert proxy["parent_id"] is None
+        assert route["parent_id"] == proxy["span_id"]
+        assert replica["parent_id"] == route["span_id"]
+        assert proxy["attrs"]["app"] == "obs_http"
+        assert proxy["attrs"]["status"] == 200
+        assert replica["attrs"]["method"] == "__call__"
+
+        # federation: the proxy's requests counter reaches the GCS
+        # rollup (worker push -> daemon merge -> syncer -> federation)
+        from ray_tpu.api import _global_worker
+
+        gcs = _global_worker().gcs
+        deadline = time.monotonic() + 60
+        counters = {}
+        while time.monotonic() < deadline:
+            summary = gcs.call("Metrics", "cluster_summary",
+                               timeout=10).get("serve") or {}
+            counters = (summary.get("counters") or {}).get("obs_http", {})
+            if counters.get("requests_total.200", 0) >= 1:
+                break
+            time.sleep(0.5)
+        assert counters.get("requests_total.200", 0) >= 1, counters
+        # ...and the same series is in the federated exposition
+        text = gcs.call("Metrics", "federated_text", timeout=10)
+        assert "raytpu_serve_requests_total" in text
+    finally:
+        serve.delete("obs_http")
+
+
+# ---------------------------------------------------------------------------
+# cluster: mid-stream SIGKILL — the resumed stream keeps the ORIGINAL
+# request id, and the failover leg is marked resumed=1
+# ---------------------------------------------------------------------------
+def test_stream_failover_keeps_trace_id_and_marks_resumed():
+    @serve.deployment(num_replicas=2)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.03)
+            yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(ticker.bind(), name="obs_kill")
+    try:
+        resp = h.remote_streaming({"n": 30})
+        rid = resp.request_id
+        assert rid
+        got, killed = [], False
+        for item in resp:
+            got.append(item)
+            if len(got) == 5 and not killed:
+                killed = True
+                os.kill(item["pid"], signal.SIGKILL)
+        assert [x["i"] for x in got] == list(range(30))
+        assert resp.resumes >= 1
+
+        def has_resumed_replica(spans):
+            return any(s["name"].startswith("serve.replica.")
+                       and s["attrs"].get("resumed") for s in spans)
+
+        spans = _poll_spans(rid, {"serve.handle.route",
+                                  "serve.handle.resume"},
+                            pred=has_resumed_replica)
+        names = {s["name"] for s in spans}
+        assert "serve.handle.route" in names, names
+        assert "serve.handle.resume" in names, names
+        # every hop of BOTH legs shares the original request id
+        assert all(s["trace_id"] == rid for s in spans)
+        resume = [s for s in spans if s["name"] == "serve.handle.resume"]
+        assert all(s["attrs"].get("resumed") == 1 for s in resume)
+        assert any(s["attrs"].get("offset", 0) >= 5 for s in resume)
+        # the survivor's replica-side spans carry the marker too
+        resumed_replica = [
+            s for s in spans
+            if s["name"].startswith("serve.replica.")
+            and s["attrs"].get("resumed")]
+        assert resumed_replica
+    finally:
+        serve.delete("obs_kill")
