@@ -62,10 +62,11 @@ def init(
     if address is None:
         # Submitted jobs / child drivers join the ambient cluster, like
         # the reference's RAY_ADDRESS (ref: dashboard/modules/job —
-        # the supervisor exports it before running the entrypoint).
-        import os
+        # the supervisor exports RAY_TPU_ADDRESS before running the
+        # entrypoint; the registry picks it up at first get_config()).
+        from ray_tpu.core.config import get_config
 
-        address = os.environ.get("RAY_TPU_ADDRESS") or None
+        address = get_config().address or None
     with _worker_lock:
         if _worker is not None:
             if ignore_reinit_error:
@@ -117,12 +118,12 @@ def shutdown() -> None:
                 import os as _os
                 import tempfile as _tf
 
+                from ray_tpu.core.config import get_config as _get_config
                 from ray_tpu.util import usage_stats as _us
 
-                path = _os.environ.get(
-                    "RAY_TPU_USAGE_STATS_PATH",
-                    _os.path.join(_tf.gettempdir(),
-                                  f"raytpu_usage_{_os.getpid()}.json"))
+                path = _get_config().usage_stats_path or _os.path.join(
+                    _tf.gettempdir(),
+                    f"raytpu_usage_{_os.getpid()}.json")
                 _us.write_usage_snapshot(path)
                 _us.report_usage()
             except Exception:  # noqa: BLE001 — never block shutdown

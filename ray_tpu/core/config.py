@@ -349,6 +349,39 @@ class Config:
     # KV-cache counters appear in `ray-tpu metrics --federated`).
     serve_metrics_push_s: float = 2.0
 
+    # ---- client bootstrap / process-local paths ----
+    # Cluster address used by ray_tpu.init() and the CLI when none is
+    # passed explicitly ("host:port"; empty = start a local cluster).
+    # The supervisor exports RAY_TPU_ADDRESS into worker environments, so
+    # this knob is also the in-cluster handoff channel.
+    address: str = ""
+    # Directory for per-node daemon/worker logs (empty = the session
+    # temp dir under /tmp/ray_tpu).
+    log_dir: str = ""
+    # Explicit path to the native object-store plasma library; empty =
+    # build/discover next to the package (native/build.py).
+    store_lib: str = ""
+    # Mirror driver worker stdout/stderr lines back to the driver
+    # process (the reference's log_to_driver).
+    log_to_driver: bool = True
+    # fsync the GCS persistence WAL on every append. Durable by default;
+    # turn off for throughput when the control-plane store is scratch.
+    gcs_fsync: bool = True
+    # ---- workflow plane ----
+    # Root directory for workflow checkpoint storage.
+    workflow_storage: str = "/tmp/ray_tpu_workflows"
+    # ---- usage stats (opt-in, off by default like the reference's
+    # RAY_USAGE_STATS_ENABLED gate) ----
+    usage_stats_enabled: bool = False
+    # Report endpoint; empty disables the network hop (local file only).
+    usage_stats_url: str = ""
+    # Local spool file for usage reports (empty = session temp dir).
+    usage_stats_path: str = ""
+    # ---- serve controller bootstrap ----
+    # Grace window for replica actors to come up before the controller
+    # declares a deployment failed.
+    serve_startup_grace_s: float = 600.0
+
     # ---- timeouts ----
     get_timeout_milliseconds: int = 0  # 0 = no timeout
     rpc_connect_timeout_s: int = 30

@@ -62,6 +62,7 @@ def _metadata(key: str) -> Optional[str]:
     """
     if os.environ.get("TPU_SKIP_MDS_QUERY"):
         return None
+    # lint: allow-knob -- hardware-probe gate read before any config exists
     if os.environ.get("RAY_TPU_MDS_QUERY", "").lower() not in ("1", "true"):
         return None
     if key in _metadata_cache:

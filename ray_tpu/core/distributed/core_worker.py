@@ -768,8 +768,7 @@ class DistributedCoreWorker:
         install_refcounter(self._ref_added, self._ref_removed,
                            self._ref_serialized)
         if is_driver:
-            if log_to_driver and os.environ.get(
-                    "RAY_TPU_LOG_TO_DRIVER", "1") not in ("0", "false"):
+            if log_to_driver and get_config().log_to_driver:
                 self.loop_thread.submit(self._stream_logs_to_driver())
             atexit.register(self.shutdown)
 

@@ -114,6 +114,7 @@ def start_watch_parent_thread() -> None:
     monitoring). No-op unless RAY_TPU_WATCH_PPID is set."""
     import threading
 
+    # lint: allow-knob -- spawn-time lifecycle handshake between parent and child, pre-config
     want = os.environ.get("RAY_TPU_WATCH_PPID")
     if not want:
         return

@@ -36,8 +36,9 @@ class PersistentStore:
         self._snapshot_path = os.path.join(directory, "snapshot.pkl")
         self._wal_path = os.path.join(directory, "wal.pkl")
         self._snapshot_every = snapshot_every
-        self._fsync = os.environ.get(
-            "RAY_TPU_GCS_FSYNC", "1").lower() not in ("0", "false")
+        from ray_tpu.core.config import get_config
+
+        self._fsync = bool(get_config().gcs_fsync)
         self._lock = threading.Lock()
         self._tables: Dict[str, Dict[Any, Any]] = {}
         self._wal_count = 0

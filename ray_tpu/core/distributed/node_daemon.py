@@ -1607,6 +1607,7 @@ class NodeDaemon:
 
     def _ledger(self, tag: str, demand) -> None:
         import os as _os
+        # lint: allow-knob -- debug tracing gate toggled live on a running daemon
         if _os.environ.get("RAY_TPU_LEDGER"):
             import sys as _sys
             print(f"LEDGER {tag} {demand.get('CPU')} avail="

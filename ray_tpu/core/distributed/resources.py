@@ -109,9 +109,11 @@ def probe_tpu_count(timeout_s: Optional[float] = None) -> int:
     global _tpu_probe_cache
     import os
 
+    # lint: allow-knob -- detection override monkeypatched by tests mid-process; must stay dynamic
     forced = os.environ.get("RAY_TPU_NUM_TPUS")
     if forced is not None:
         return int(float(forced))
+    # lint: allow-knob -- the autoscaler exports this into child envs; must stay dynamic
     if os.environ.get("RAY_TPU_DISABLE_TPU_DETECTION", "").lower() in (
             "1", "true", "yes"):
         return 0
@@ -120,6 +122,7 @@ def probe_tpu_count(timeout_s: Optional[float] = None) -> int:
     if _tpu_probe_cache is not None:
         return _tpu_probe_cache
     if timeout_s is None:
+        # lint: allow-knob -- probe timeout read alongside the dynamic detection overrides above
         timeout_s = float(os.environ.get("RAY_TPU_TPU_DETECT_TIMEOUT_S", "30"))
 
     count, _ = run_tpu_probe(timeout_s)

@@ -253,6 +253,7 @@ class RuntimeEnvBuilder:
 
     # -- conda plugin (ref: _private/runtime_env/conda.py) -------------
     def _conda_exe(self) -> str:
+        # lint: allow-knob -- host toolchain discovery in the agent daemon, not a cluster knob
         exe = os.environ.get("RAY_TPU_CONDA_EXE") or shutil.which("conda")
         if not exe:
             raise RuntimeEnvBuildError(
@@ -314,6 +315,7 @@ class RuntimeEnvBuilder:
         if not image:
             raise RuntimeEnvBuildError("container runtime_env needs "
                                        "an 'image'")
+        # lint: allow-knob -- host toolchain discovery in the agent daemon, not a cluster knob
         runtime = (os.environ.get("RAY_TPU_CONTAINER_RUNTIME")
                    or shutil.which("podman") or shutil.which("docker"))
         if not runtime:

@@ -1313,6 +1313,7 @@ def run_worker(args) -> None:
     # must not spend the host's CPU on connect/teardown churn.
     failures = 0
     ping_client = AsyncRpcClient(args.daemon_address)
+    # lint: allow-knob -- per-worker bootstrap var set by the spawning daemon, read pre-config
     period = float(os.environ.get("RAY_TPU_WORKER_PING_PERIOD_S", "45"))
     while True:
         threading.Event().wait(period)
@@ -1340,6 +1341,7 @@ def boot_worker(args) -> None:
     # tpu_profiling runtime env (the nsight analogue): trace the whole
     # worker process with the JAX profiler, like `nsys profile` wraps
     # the reference's worker (_private/runtime_env/nsight.py).
+    # lint: allow-knob -- per-worker channel set by the runtime-env plugin, not a cluster knob
     trace_dir = os.environ.get("RAY_TPU_JAX_TRACE_DIR")
     if trace_dir:
         try:

@@ -1,0 +1,3 @@
+"""Developer tooling that ships with the package but is not part of the
+runtime: the invariant lint suite lives under :mod:`ray_tpu.devtools.lint`.
+"""

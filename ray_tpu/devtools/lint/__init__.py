@@ -1,0 +1,23 @@
+"""AST-based invariant lint suite (`ray-tpu lint`).
+
+Public surface:
+
+- :func:`run_lint` — run the suite over a root, returns (violations, rules)
+- :func:`all_rules` / :func:`rule_names` — rule discovery
+- :func:`to_json` / :func:`render_text` — output formatting
+"""
+
+from ray_tpu.devtools.lint.engine import (  # noqa: F401
+    AllowEntry,
+    LintContext,
+    PyFile,
+    Rule,
+    Violation,
+    all_rules,
+    default_root,
+    parse_allow_comments,
+    render_text,
+    rule_names,
+    run_lint,
+    to_json,
+)

@@ -1,0 +1,351 @@
+"""Rule engine for the ray-tpu invariant lint suite.
+
+The suite is AST-based: every rule receives a :class:`LintContext` that lazily
+parses the python files under a root directory and exposes the allowlist
+comments found in them.  Rules return :class:`Violation` records; the engine
+applies allowlist suppression centrally and adds its own hygiene checks
+(allow entries must name a known rule and must carry a reason).
+
+Allowlist grammar (one comment, same line as the violation or the line
+directly above it)::
+
+    # lint: allow-<token> -- <reason>
+
+where ``<token>`` is either a rule's short allow token (e.g. ``blocking``)
+or the full rule name (e.g. ``no-blocking-in-loop``).  A missing reason is
+itself a violation, so the suite can guarantee "zero allowlist entries
+lacking a reason".
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Violation",
+    "AllowEntry",
+    "PyFile",
+    "LintContext",
+    "Rule",
+    "run_lint",
+    "all_rules",
+    "rule_names",
+    "to_json",
+    "render_text",
+    "default_root",
+]
+
+JSON_SCHEMA_VERSION = 1
+
+# Directories never scanned, wherever they appear under the root.
+_SKIP_DIRS = {
+    "__pycache__", ".git", ".wt-seed", ".claude", "node_modules",
+    ".pytest_cache", "build", "dist",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One rule finding, attributed to a file/line relative to the lint root."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass(frozen=True)
+class AllowEntry:
+    """A parsed ``# lint: allow-<token> -- <reason>`` comment.
+
+    A *standalone* comment (nothing but whitespace before it) covers the
+    next line; a trailing comment covers its own line.
+    """
+
+    token: str
+    reason: str
+    path: str
+    line: int
+    standalone: bool = False
+
+    def covers(self, line: int) -> bool:
+        return line == (self.line + 1 if self.standalone else self.line)
+
+
+class PyFile:
+    """A lazily parsed python source file."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self._source: Optional[str] = None
+        self._tree: Optional[ast.AST] = None
+        self._tree_error: Optional[SyntaxError] = None
+        self._allows: Optional[List[AllowEntry]] = None
+
+    @property
+    def source(self) -> str:
+        if self._source is None:
+            self._source = self.path.read_text(encoding="utf-8", errors="replace")
+        return self._source
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        """Parsed module, or ``None`` when the file does not parse."""
+        if self._tree is None and self._tree_error is None:
+            try:
+                self._tree = ast.parse(self.source, filename=self.rel)
+            except SyntaxError as e:
+                self._tree_error = e
+        return self._tree
+
+    @property
+    def allows(self) -> List[AllowEntry]:
+        if self._allows is None:
+            self._allows = parse_allow_comments(self.source, self.rel)
+        return self._allows
+
+
+_ALLOW_RE = re.compile(
+    r"lint:\s*allow-(?P<token>[A-Za-z0-9_-]+)"
+    r"(?:\s+--\s*(?P<reason>.*?))?\s*$"
+)
+
+
+def parse_allow_comments(source: str, rel: str) -> List[AllowEntry]:
+    """Extract allowlist entries from *real* comments (tokenize-based, so
+    examples inside docstrings are ignored)."""
+    if "lint:" not in source:
+        return []
+    entries: List[AllowEntry] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if m:
+                entries.append(
+                    AllowEntry(
+                        token=m.group("token"),
+                        reason=(m.group("reason") or "").strip(),
+                        path=rel,
+                        line=tok.start[0],
+                        standalone=not tok.line[: tok.start[1]].strip(),
+                    )
+                )
+    except tokenize.TokenError:
+        pass
+    return entries
+
+
+class LintContext:
+    """Shared state handed to every rule: the root, parsed files, allowlist."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root).resolve()
+        self._files: Optional[Dict[str, PyFile]] = None
+
+    # -- file access -----------------------------------------------------
+
+    def _scan(self) -> Dict[str, PyFile]:
+        if self._files is None:
+            files: Dict[str, PyFile] = {}
+            for path in sorted(self.root.rglob("*.py")):
+                rel_parts = path.relative_to(self.root).parts
+                if any(p in _SKIP_DIRS for p in rel_parts):
+                    continue
+                rel = "/".join(rel_parts)
+                files[rel] = PyFile(path, rel)
+            self._files = files
+        return self._files
+
+    def py_files(self, prefix: str = "") -> List[PyFile]:
+        """All python files whose root-relative path starts with *prefix*."""
+        return [f for rel, f in self._scan().items() if rel.startswith(prefix)]
+
+    def package_files(self) -> List[PyFile]:
+        """Files under ``<root>/ray_tpu`` when it exists, else the whole root.
+
+        Fixture trees mirror the real layout, so rules can address files by
+        the same relative paths in both worlds.
+        """
+        if (self.root / "ray_tpu").is_dir():
+            return self.py_files("ray_tpu/")
+        return self.py_files("")
+
+    def get_file(self, rel: str) -> Optional[PyFile]:
+        return self._scan().get(rel)
+
+    # -- allowlist -------------------------------------------------------
+
+    def allow_entries(self) -> List[AllowEntry]:
+        entries: List[AllowEntry] = []
+        for f in self.package_files():
+            entries.extend(f.allows)
+        # examples/ is scanned by reserved-kwargs, so honour allows there too
+        if (self.root / "ray_tpu").is_dir():
+            for f in self.py_files("examples/"):
+                entries.extend(f.allows)
+        return entries
+
+    def is_allowed(self, rel: str, line: int, tokens: Sequence[str]) -> bool:
+        """True when an allow comment with one of *tokens* covers *line*
+        (trailing comment on the line itself, or a standalone comment on
+        the line directly above)."""
+        f = self._scan().get(rel)
+        if f is None:
+            return False
+        return any(
+            entry.token in tokens and entry.covers(line) for entry in f.allows
+        )
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``allow_token`` and implement
+    :meth:`check`."""
+
+    name: str = ""
+    allow_token: str = ""
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        raise NotImplementedError
+
+    def allow_tokens(self) -> Tuple[str, ...]:
+        return (self.allow_token, self.name) if self.allow_token else (self.name,)
+
+
+def all_rules() -> List[Rule]:
+    """Instantiate the full rule set (import deferred to avoid cycles)."""
+    from ray_tpu.devtools.lint.rules import build_rules
+
+    return build_rules()
+
+
+def rule_names() -> List[str]:
+    return [r.name for r in all_rules()]
+
+
+def _allowlist_hygiene(ctx: LintContext, rules: Sequence[Rule]) -> List[Violation]:
+    known: Dict[str, str] = {}
+    for r in rules:
+        for tok in r.allow_tokens():
+            known[tok] = r.name
+    out: List[Violation] = []
+    for entry in ctx.allow_entries():
+        if entry.token not in known:
+            out.append(
+                Violation(
+                    rule="allowlist",
+                    path=entry.path,
+                    line=entry.line,
+                    message=(
+                        f"allow entry names unknown rule token "
+                        f"'{entry.token}' (known: {', '.join(sorted(known))})"
+                    ),
+                )
+            )
+        elif not entry.reason:
+            out.append(
+                Violation(
+                    rule="allowlist",
+                    path=entry.path,
+                    line=entry.line,
+                    message=(
+                        f"allow entry for '{entry.token}' has no reason — "
+                        "write '# lint: allow-%s -- <why this is safe>'"
+                        % entry.token
+                    ),
+                )
+            )
+    return out
+
+
+def run_lint(
+    root: Optional[Path] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> Tuple[List[Violation], List[Rule]]:
+    """Run the suite. Returns ``(violations, rules_run)``.
+
+    *rules* filters by rule name; unknown names raise :class:`ValueError`.
+    Allowlist hygiene always runs (it is what guarantees every suppression
+    carries a reason).
+    """
+    ctx = LintContext(root or default_root())
+    available = all_rules()
+    if rules:
+        by_name = {r.name: r for r in available}
+        unknown = [n for n in rules if n not in by_name]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(available: {', '.join(sorted(by_name))})"
+            )
+        selected = [by_name[n] for n in rules]
+    else:
+        selected = available
+
+    violations: List[Violation] = []
+    for rule in selected:
+        tokens = rule.allow_tokens()
+        for v in rule.check(ctx):
+            if ctx.is_allowed(v.path, v.line, tokens):
+                continue
+            violations.append(v)
+    # hygiene checks run against the full token vocabulary so an allow for a
+    # deselected rule is still recognised
+    violations.extend(_allowlist_hygiene(ctx, available))
+    violations = sorted(set(violations), key=lambda v: (v.path, v.line, v.rule, v.message))
+    return violations, selected
+
+
+def default_root() -> Path:
+    """Repo root inferred from this file's location (…/ray_tpu/devtools/lint)."""
+    return Path(__file__).resolve().parents[3]
+
+
+def to_json(
+    root: Path, violations: Sequence[Violation], rules: Sequence[Rule]
+) -> str:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    doc = {
+        "schema": JSON_SCHEMA_VERSION,
+        "root": str(root),
+        "rules": [r.name for r in rules],
+        "ok": not violations,
+        "counts": counts,
+        "violations": [v.as_dict() for v in violations],
+    }
+    return json.dumps(doc, indent=2, sort_keys=False)
+
+
+def render_text(
+    root: Path, violations: Sequence[Violation], rules: Sequence[Rule]
+) -> str:
+    lines = []
+    for v in violations:
+        lines.append(f"{v.path}:{v.line}: [{v.rule}] {v.message}")
+    n = len(violations)
+    lines.append(
+        f"ray-tpu lint: {n} violation{'s' if n != 1 else ''} "
+        f"({len(rules)} rule{'s' if len(rules) != 1 else ''} checked) in {root}"
+    )
+    return "\n".join(lines)

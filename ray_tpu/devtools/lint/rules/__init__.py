@@ -1,0 +1,25 @@
+"""The rule catalogue. ``build_rules()`` is the single discovery point used
+by the engine, the CLI, and the tests."""
+
+from typing import List
+
+from ray_tpu.devtools.lint.engine import Rule
+from ray_tpu.devtools.lint.rules.knob_registry import KnobRegistryRule
+from ray_tpu.devtools.lint.rules.wire_typed_errors import WireTypedErrorsRule
+from ray_tpu.devtools.lint.rules.protocol_fingerprint import ProtocolFingerprintRule
+from ray_tpu.devtools.lint.rules.no_blocking_in_loop import NoBlockingInLoopRule
+from ray_tpu.devtools.lint.rules.lock_order import LockOrderRule
+from ray_tpu.devtools.lint.rules.reserved_kwargs import ReservedKwargsRule
+
+__all__ = ["build_rules"]
+
+
+def build_rules() -> List[Rule]:
+    return [
+        KnobRegistryRule(),
+        WireTypedErrorsRule(),
+        ProtocolFingerprintRule(),
+        NoBlockingInLoopRule(),
+        LockOrderRule(),
+        ReservedKwargsRule(),
+    ]

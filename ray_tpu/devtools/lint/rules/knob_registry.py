@@ -1,0 +1,274 @@
+"""knob-registry: every ``RAY_TPU_*`` env read goes through core/config.py,
+and the README knob docs stay in sync with the registry (both directions).
+
+Detected read shapes (outside the registry file)::
+
+    os.environ.get("RAY_TPU_X", ...)
+    os.environ["RAY_TPU_X"]          # Load context only; writes are fine
+    os.getenv("RAY_TPU_X")
+    environ.get("RAY_TPU_X")         # from os import environ
+
+Suppression: ``# lint: allow-knob -- <reason>`` on the read (bootstrap vars
+that must be readable before/without the config singleton).
+
+README sync: every ``Config`` field must have its ``RAY_TPU_<FIELD>`` env
+name mentioned in README.md, and every ``RAY_TPU_*`` token in README must be
+a registered knob, a prefix wildcard ending in ``_`` matching at least one
+knob, or listed in :data:`NON_KNOB_ENV` (documented env vars that are not
+config knobs, with the reason they are exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+CONFIG_REL = "ray_tpu/core/config.py"
+
+# Env vars legitimately documented in README that are NOT Config knobs.
+# Value = why the exemption exists. Internal bootstrap vars (set by one
+# process, read by its child before config exists) belong here only if the
+# README documents them.
+NON_KNOB_ENV: Dict[str, str] = {
+    "RAY_TPU_REMOTE": "C++ preprocessor macro in the native task API, not an env var",
+    "RAY_TPU_SCHED_FUZZ_MAX_MS": "schedule-fuzz harness reads env per call so seed sweeps work mid-process",
+    "RAY_TPU_SCHED_FUZZ_SEED": "schedule-fuzz harness reads env per call so seed sweeps work mid-process",
+}
+
+_ENV_NAME_RE = re.compile(r"RAY_TPU_[A-Z0-9_]+_?")
+
+
+@dataclass
+class _EnvRead:
+    name: str
+    line: int
+
+
+class _EnvReadVisitor(ast.NodeVisitor):
+    """Collect RAY_TPU_* literal env reads in one module."""
+
+    def __init__(self) -> None:
+        self.reads: List[_EnvRead] = []
+        self._environ_aliases = {"environ"}
+        self._getenv_aliases = {"getenv"}
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "os":
+            for alias in node.names:
+                if alias.name == "environ":
+                    self._environ_aliases.add(alias.asname or alias.name)
+                elif alias.name == "getenv":
+                    self._getenv_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def _is_environ(self, node: ast.expr) -> bool:
+        text = _unparse(node)
+        return text.endswith(".environ") or text in self._environ_aliases
+
+    def _literal_ray_tpu(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value.startswith("RAY_TPU_"):
+                return node.value
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = None
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("get", "pop", "setdefault") and self._is_environ(func.value):
+                if node.args:
+                    name = self._literal_ray_tpu(node.args[0])
+            elif func.attr == "getenv" and _unparse(func.value) == "os":
+                if node.args:
+                    name = self._literal_ray_tpu(node.args[0])
+        elif isinstance(func, ast.Name) and func.id in self._getenv_aliases:
+            if node.args:
+                name = self._literal_ray_tpu(node.args[0])
+        if name:
+            self.reads.append(_EnvRead(name, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load) and self._is_environ(node.value):
+            sl = node.slice
+            name = self._literal_ray_tpu(sl) if isinstance(sl, ast.Constant) else None
+            if name:
+                self.reads.append(_EnvRead(name, node.lineno))
+        self.generic_visit(node)
+
+
+def _unparse(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+@dataclass
+class _Knob:
+    field: str
+    env: str
+    line: int
+    default_src: str
+    section: str
+
+
+def parse_registry(config_file: PyFile) -> List[_Knob]:
+    """Extract ``Config`` dataclass fields + their env names, source defaults,
+    and the ``# ---- section ----`` group each belongs to."""
+    tree = config_file.tree
+    if tree is None:
+        return []
+    sections: List[tuple] = []  # (line, title)
+    for i, line in enumerate(config_file.source.splitlines(), start=1):
+        m = re.match(r"\s*#\s*-{2,}\s*(.*?)\s*-{2,}\s*$", line)
+        if m and m.group(1):
+            sections.append((i, m.group(1)))
+    knobs: List[_Knob] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                    field_name = stmt.target.id
+                    if field_name.startswith("_"):
+                        continue
+                    default_src = (
+                        _unparse(stmt.value) if stmt.value is not None else ""
+                    )
+                    section = ""
+                    for line_no, title in sections:
+                        if line_no < stmt.lineno:
+                            section = title
+                    knobs.append(
+                        _Knob(
+                            field=field_name,
+                            env=f"RAY_TPU_{field_name.upper()}",
+                            line=stmt.lineno,
+                            default_src=default_src,
+                            section=section,
+                        )
+                    )
+            break
+    return knobs
+
+
+def knob_table_markdown(ctx: LintContext) -> str:
+    """Render the README knob table from the live registry (the docs artifact
+    this rule validates)."""
+    config_file = ctx.get_file(CONFIG_REL)
+    if config_file is None:
+        return ""
+    knobs = parse_registry(config_file)
+    out: List[str] = []
+    current = None
+    for k in knobs:
+        if k.section != current:
+            current = k.section
+            out.append("")
+            out.append(f"#### {current or 'Other'}")
+            out.append("")
+            out.append("| knob | env override | default |")
+            out.append("| --- | --- | --- |")
+        default = k.default_src.replace("|", "\\|")
+        out.append(f"| `{k.field}` | `{k.env}` | `{default}` |")
+    return "\n".join(out).strip() + "\n"
+
+
+class KnobRegistryRule(Rule):
+    name = "knob-registry"
+    allow_token = "knob"
+    description = (
+        "RAY_TPU_* env reads must go through core/config.py; README knob "
+        "docs must match the registry in both directions"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        out: List[Violation] = []
+        config_file = ctx.get_file(CONFIG_REL)
+        if config_file is None:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=CONFIG_REL,
+                    line=1,
+                    message="config registry file not found under lint root",
+                )
+            ]
+        knobs = parse_registry(config_file)
+        env_names = {k.env for k in knobs}
+
+        # 1) stray env reads outside the registry
+        for f in ctx.package_files():
+            if f.rel == CONFIG_REL or f.tree is None:
+                continue
+            visitor = _EnvReadVisitor()
+            visitor.visit(f.tree)
+            for read in visitor.reads:
+                hint = ""
+                if read.name in env_names:
+                    fld = read.name[len("RAY_TPU_"):].lower()
+                    hint = f" (read get_config().{fld} instead)"
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=f.rel,
+                        line=read.line,
+                        message=(
+                            f"os.environ read of {read.name} outside the "
+                            f"config registry{hint}"
+                        ),
+                    )
+                )
+
+        # 2) README <-> registry sync
+        readme = ctx.root / "README.md"
+        if readme.is_file():
+            text = readme.read_text(encoding="utf-8", errors="replace")
+            doc_tokens: Dict[str, int] = {}
+            for i, line in enumerate(text.splitlines(), start=1):
+                for m in _ENV_NAME_RE.finditer(line):
+                    doc_tokens.setdefault(m.group(0), i)
+            documented = set(doc_tokens)
+            # knobs missing from the docs
+            for k in knobs:
+                covered = k.env in documented or any(
+                    t.endswith("_") and k.env.startswith(t) for t in documented
+                )
+                if not covered:
+                    out.append(
+                        Violation(
+                            rule=self.name,
+                            path=CONFIG_REL,
+                            line=k.line,
+                            message=(
+                                f"knob '{k.field}' ({k.env}) is not documented "
+                                "in README.md (regenerate the knob table: "
+                                "ray-tpu lint --knob-table)"
+                            ),
+                        )
+                    )
+            # documented names with no backing knob
+            for token, line_no in sorted(doc_tokens.items()):
+                if token.endswith("_"):
+                    if any(e.startswith(token) for e in env_names) or any(
+                        e.startswith(token) for e in NON_KNOB_ENV
+                    ):
+                        continue
+                elif token in env_names or token in NON_KNOB_ENV:
+                    continue
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path="README.md",
+                        line=line_no,
+                        message=(
+                            f"README documents {token} but no such knob is "
+                            "registered in core/config.py (orphan doc entry)"
+                        ),
+                    )
+                )
+        return out

@@ -1,0 +1,216 @@
+"""lock-order: build the static lock-acquisition graph across the four
+lock-heavy control-plane modules and fail on cycles (the static shadow of a
+potential AB/BA deadlock).
+
+Lock identity: ``self.<attr> = threading.Lock()/RLock()`` assignments give
+``<File>:<Class>.<attr>`` nodes; module-level ``<name> = threading.Lock()``
+gives ``<File>:<name>``.  Acquisition edges come from lexically nested
+``with``/``async with`` blocks whose context expressions resolve to known
+locks — an outer hold of A around an acquisition of B adds edge A->B.
+Calls are not followed (a lock-holding method calling another locking
+method is invisible); keep lock scopes lexical and short so the graph
+stays meaningful.
+
+Suppression: ``# lint: allow-lock-order -- <reason>`` on the inner ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+LOCK_FILES = (
+    "ray_tpu/core/distributed/node_daemon.py",
+    "ray_tpu/core/distributed/gcs_server.py",
+    "ray_tpu/core/object_store.py",
+    "ray_tpu/core/distributed/task_events.py",
+)
+
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+def _unparse(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _LOCK_CTORS:
+        return _unparse(func.value).endswith("threading")
+    if isinstance(func, ast.Name):
+        return func.id in _LOCK_CTORS
+    return False
+
+
+class _FileLocks:
+    """Lock declarations found in one file."""
+
+    def __init__(self, f: PyFile):
+        self.f = f
+        # attr name -> set of class names declaring it as a lock
+        self.attr_locks: Dict[str, Set[str]] = {}
+        self.module_locks: Set[str] = set()
+        tree = f.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Assign)
+                        and _is_lock_ctor(sub.value)
+                    ):
+                        for target in sub.targets:
+                            if (
+                                isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"
+                            ):
+                                self.attr_locks.setdefault(
+                                    target.attr, set()
+                                ).add(node.name)
+        for node in getattr(tree, "body", []):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.module_locks.add(target.id)
+
+    def resolve(self, expr: ast.expr, cls: Optional[str]) -> Optional[str]:
+        """Map a with-context expression to a lock id, or None."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.attr_locks
+        ):
+            owners = self.attr_locks[expr.attr]
+            owner = cls if cls in owners else sorted(owners)[0]
+            return f"{self.f.rel}:{owner}.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.f.rel}:{expr.id}"
+        return None
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    allow_token = "lock-order"
+    description = (
+        "the static lock-acquisition graph over node_daemon/gcs_server/"
+        "object_store/task_events must be acyclic"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        # edge -> (path, line) of the inner acquisition that created it
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for rel in LOCK_FILES:
+            f = ctx.get_file(rel)
+            if f is None or f.tree is None:
+                continue
+            locks = _FileLocks(f)
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls = _enclosing_class(f.tree, node)
+                    self._walk(node.body, [], locks, cls, edges)
+
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+        out: List[Violation] = []
+        for cycle in _find_cycles(graph):
+            # attribute the violation to the edge that closes the cycle
+            closing = (cycle[-1], cycle[0])
+            path, line = edges.get(closing, edges.get((cycle[0], cycle[1]), ("", 1)))
+            pretty = " -> ".join(cycle + [cycle[0]])
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=path or LOCK_FILES[0],
+                    line=line,
+                    message=(
+                        f"lock-order cycle (potential AB/BA deadlock): {pretty}"
+                    ),
+                )
+            )
+        return out
+
+    def _walk(
+        self,
+        body: List[ast.stmt],
+        held: List[str],
+        locks: _FileLocks,
+        cls: Optional[str],
+        edges: Dict[Tuple[str, str], Tuple[str, int]],
+    ) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in node.items:
+                    lock_id = locks.resolve(item.context_expr, cls)
+                    if lock_id is not None:
+                        for outer in held + acquired:
+                            if outer != lock_id:
+                                edges.setdefault(
+                                    (outer, lock_id), (locks.f.rel, node.lineno)
+                                )
+                        acquired.append(lock_id)
+                self._walk(node.body, held + acquired, locks, cls, edges)
+                continue
+            for field_name in getattr(node, "_fields", ()):
+                value = getattr(node, field_name, None)
+                if (
+                    isinstance(value, list)
+                    and value
+                    and isinstance(value[0], (ast.stmt, ast.excepthandler))
+                ):
+                    self._walk(value, held, locks, cls, edges)
+
+
+def _enclosing_class(tree: ast.AST, fn: ast.AST) -> Optional[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if child is fn:
+                    return node.name
+    return None
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Deterministic simple-cycle detection (DFS back-edges); each cycle is
+    reported once, rotated to start at its smallest node."""
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    cycles: List[List[str]] = []
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str], visited: Set[str]):
+        visited.add(node)
+        stack.append(node)
+        on_stack.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                i = stack.index(nxt)
+                cyc = stack[i:]
+                j = cyc.index(min(cyc))
+                key = tuple(cyc[j:] + cyc[:j])
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(list(key))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: Set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return cycles

@@ -1,0 +1,210 @@
+"""no-blocking-in-loop: the daemon/GCS event loops in ``core/distributed/``
+must never block.  Flags, inside ``async def`` bodies and inside lambdas
+dispatched onto a loop via ``call_soon`` / ``call_soon_threadsafe`` /
+``call_later`` (the EventLoopThread pattern):
+
+- ``time.sleep(...)``                  -> use ``await asyncio.sleep(...)``
+- ``ray_tpu.get(...)`` / ``ray.get``   -> await the ref or use an executor
+- ``<fut>.result()``                   -> await it (``asyncio.wrap_future``)
+- blocking socket calls (``connect`` / ``accept`` / ``recv*`` / ``sendall``
+  on a socket-ish receiver, ``socket.create_connection``)
+
+Recognised-safe idiom (not flagged): calling ``.result()`` on members of a
+completed-task set from ``done, _ = await asyncio.wait(...)`` — those
+futures are already resolved, so ``.result()`` cannot block.
+
+Nested *sync* ``def`` bodies are skipped (they run wherever they are
+called, e.g. executor threads or done-callbacks on resolved futures);
+nested ``async def`` are scanned as their own scope.
+
+Suppression: ``# lint: allow-blocking -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+SCOPE_PREFIX = "ray_tpu/core/distributed/"
+
+_SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "accept", "connect", "sendall"}
+_DISPATCH_METHODS = {"call_soon", "call_soon_threadsafe", "call_later", "call_at"}
+
+
+def _unparse(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _sleep_aliases(tree: ast.AST) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _is_wait_call(node: ast.expr) -> bool:
+    """True for ``await asyncio.wait(...)`` values."""
+    if isinstance(node, ast.Await):
+        node = node.value
+    if isinstance(node, ast.Call):
+        text = _unparse(node.func)
+        return text.endswith("asyncio.wait") or text == "wait"
+    return False
+
+
+def _collect_safe_result_names(body: List[ast.stmt]) -> Set[str]:
+    """Names that hold members of an ``asyncio.wait`` done-set within this
+    (single) function body: the done-set names themselves and the loop vars
+    iterating over them."""
+    done_sets: Set[str] = set()
+    safe: Set[str] = set()
+    for node in _walk_same_scope(body):
+        if isinstance(node, ast.Assign) and _is_wait_call(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Tuple) and target.elts:
+                    first = target.elts[0]
+                    if isinstance(first, ast.Name):
+                        done_sets.add(first.id)
+                elif isinstance(target, ast.Name):
+                    done_sets.add(target.id)
+    for node in _walk_same_scope(body):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            iter_text = _unparse(node.iter)
+            if isinstance(node.iter, ast.Name) and node.iter.id in done_sets:
+                safe.add(node.target.id)
+            elif any(iter_text.startswith(d + ".") for d in done_sets):
+                safe.add(node.target.id)
+    return safe | done_sets
+
+
+def _walk_same_scope(body: List[ast.stmt]):
+    """Yield all nodes in *body* without descending into nested function or
+    class definitions (lambdas ARE descended into: they run in this scope's
+    thread)."""
+    _defs = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    stack: List[ast.AST] = [n for n in body if not isinstance(n, _defs)]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def _blocking_message(
+    call: ast.Call, sleep_aliases: Set[str], safe_results: Set[str]
+) -> Optional[str]:
+    func = call.func
+    text = _unparse(func)
+    if text == "time.sleep" or (
+        isinstance(func, ast.Name) and func.id in sleep_aliases
+    ):
+        return "time.sleep() blocks the event loop — use 'await asyncio.sleep(...)'"
+    if text in ("ray_tpu.get", "ray.get"):
+        return (
+            "blocking ray_tpu.get() on the event loop — await the ref or "
+            "resolve it in an executor"
+        )
+    if text.endswith("socket.create_connection"):
+        return (
+            "socket.create_connection() blocks the event loop — use "
+            "asyncio.open_connection()"
+        )
+    if isinstance(func, ast.Attribute):
+        recv = func.value
+        if func.attr == "result":
+            if isinstance(recv, ast.Name) and recv.id in safe_results:
+                return None
+            return (
+                "Future.result() blocks the event loop — await the future "
+                "(asyncio.wrap_future for concurrent futures)"
+            )
+        if func.attr in _SOCKET_METHODS and "sock" in _unparse(recv).lower():
+            return (
+                f"blocking socket .{func.attr}() on the event loop — use the "
+                "asyncio stream/protocol APIs"
+            )
+    return None
+
+
+class NoBlockingInLoopRule(Rule):
+    name = "no-blocking-in-loop"
+    allow_token = "blocking"
+    description = (
+        "no time.sleep / blocking sockets / Future.result / ray_tpu.get "
+        "inside async bodies or loop-dispatched callbacks in core/distributed/"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        out: List[Violation] = []
+        for f in ctx.package_files():
+            if not f.rel.startswith(SCOPE_PREFIX) or f.tree is None:
+                continue
+            sleep_aliases = _sleep_aliases(f.tree)
+
+            # async function bodies
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    self._scan_body(f, node.body, sleep_aliases, out)
+
+            # lambdas handed to loop.call_soon/_threadsafe/call_later from
+            # any (sync or async) context — EventLoopThread dispatch sites
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _DISPATCH_METHODS
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Lambda):
+                            self._scan_expr(f, arg.body, sleep_aliases, set(), out)
+        return out
+
+    def _scan_body(
+        self,
+        f: PyFile,
+        body: List[ast.stmt],
+        sleep_aliases: Set[str],
+        out: List[Violation],
+    ) -> None:
+        safe_results = _collect_safe_result_names(body)
+        for node in _walk_same_scope(body):
+            if isinstance(node, ast.Call):
+                msg = _blocking_message(node, sleep_aliases, safe_results)
+                if msg:
+                    out.append(
+                        Violation(
+                            rule=self.name, path=f.rel, line=node.lineno, message=msg
+                        )
+                    )
+
+    def _scan_expr(
+        self,
+        f: PyFile,
+        expr: ast.expr,
+        sleep_aliases: Set[str],
+        safe_results: Set[str],
+        out: List[Violation],
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                msg = _blocking_message(node, sleep_aliases, safe_results)
+                if msg:
+                    out.append(
+                        Violation(
+                            rule=self.name, path=f.rel, line=node.lineno, message=msg
+                        )
+                    )

@@ -1,0 +1,298 @@
+"""protocol-fingerprint: the frame-layout constants and codec markers in
+``wire.py`` / ``rpc.py`` / ``protocol.py`` are hashed and compared against a
+checked-in fingerprint keyed by ``PROTOCOL_VERSION``.  Editing the layout
+without bumping the version fails the lint; bumping the version without
+recording the new fingerprint also fails (run
+``ray-tpu lint --update-fingerprint`` after auditing the change).
+
+What goes into the hash (extracted statically, so the rule works on fixture
+trees and never imports the modules):
+
+- ``wire.py``: ``CODEC_*`` markers, ``_T_*`` typed-codec tags, the ``_I64``/
+  ``_F64``/``_U32`` struct formats, and ``Raw.__slots__``
+- ``rpc.py``: frame-type constants (``REQ``..``CANCEL``), ``MAX_FRAME``,
+  ``_POST_LEN``, and the ``_HEADER`` struct format
+- ``protocol.py``: ``RefMarker.__slots__``, the ``TaskResult`` field list,
+  and the key set of the dict built by ``make_task_spec``
+
+``PROTOCOL_VERSION`` itself is deliberately excluded from the hash: the
+fingerprint maps *version -> layout*, so a layout change under an unchanged
+version is exactly the failure mode being caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+WIRE_REL = "ray_tpu/core/distributed/wire.py"
+RPC_REL = "ray_tpu/core/distributed/rpc.py"
+PROTO_REL = "ray_tpu/core/distributed/protocol.py"
+FINGERPRINT_REL = "ray_tpu/devtools/lint/protocol_fingerprint.json"
+
+_WIRE_NAME_RE = re.compile(r"^(_T_[A-Z0-9_]+|CODEC_[A-Z0-9_]+|_I64|_F64|_U32)$")
+_RPC_NAMES = {
+    "REQ", "RES", "STREAM_REQ", "STREAM_ITEM", "STREAM_END", "CANCEL",
+    "MAX_FRAME", "_POST_LEN", "_HEADER",
+}
+
+
+def _const_repr(node: ast.expr) -> str:
+    """Deterministic string for a constant expression.
+
+    ``struct.Struct("<q")`` renders as ``Struct('<q')`` so the *format* is
+    what is fingerprinted; arithmetic like ``512 * 1024 * 1024`` is folded;
+    anything else falls back to the (deterministic) AST dump.
+    """
+    if isinstance(node, ast.Call):
+        func = node.func
+        fname = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", "")
+        if fname == "Struct" and node.args and isinstance(node.args[0], ast.Constant):
+            return f"Struct({node.args[0].value!r})"
+    try:
+        value = eval(  # noqa: S307 - constant folding only, no names/builtins
+            compile(ast.Expression(node), "<fingerprint>", "eval"),
+            {"__builtins__": {}},
+        )
+        return repr(value)
+    except Exception:
+        return ast.dump(node)
+
+
+def _module_constants(pyfile: PyFile, want) -> Dict[str, str]:
+    tree = pyfile.tree
+    if tree is None:
+        return {}
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and want(target.id):
+                out[target.id] = _const_repr(node.value)
+    return out
+
+
+def _class_slots(pyfile: PyFile, class_name: str) -> Optional[str]:
+    tree = pyfile.tree
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "__slots__"
+                ):
+                    return _const_repr(stmt.value)
+    return None
+
+
+def _namedtuple_fields(pyfile: PyFile, class_name: str) -> Optional[str]:
+    tree = pyfile.tree
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            fields = [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+            return repr(fields)
+    return None
+
+
+def _task_spec_keys(pyfile: PyFile) -> Optional[str]:
+    """Key set of the dict literal returned by make_task_spec."""
+    tree = pyfile.tree
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "make_task_spec":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    keys = sorted(
+                        k.value
+                        for k in sub.keys
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    )
+                    return repr(keys)
+    return None
+
+
+def read_protocol_version(wire_file: PyFile) -> Optional[int]:
+    tree = wire_file.tree
+    if tree is None:
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "PROTOCOL_VERSION":
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, int
+                ):
+                    return node.value.value
+    return None
+
+
+def compute_fingerprint(ctx: LintContext) -> Tuple[Optional[str], List[str]]:
+    """Returns (sha256 hex digest, list of problems). The digest is None when
+    any of the three layout files is missing or unparsable."""
+    problems: List[str] = []
+    layout: Dict[str, Dict[str, str]] = {}
+
+    wire = ctx.get_file(WIRE_REL)
+    if wire is None or wire.tree is None:
+        problems.append(f"{WIRE_REL} missing or unparsable")
+    else:
+        consts = _module_constants(wire, lambda n: bool(_WIRE_NAME_RE.match(n)))
+        slots = _class_slots(wire, "Raw")
+        if slots is not None:
+            consts["Raw.__slots__"] = slots
+        layout[WIRE_REL] = consts
+
+    rpc = ctx.get_file(RPC_REL)
+    if rpc is None or rpc.tree is None:
+        problems.append(f"{RPC_REL} missing or unparsable")
+    else:
+        layout[RPC_REL] = _module_constants(rpc, lambda n: n in _RPC_NAMES)
+
+    proto = ctx.get_file(PROTO_REL)
+    if proto is None or proto.tree is None:
+        problems.append(f"{PROTO_REL} missing or unparsable")
+    else:
+        consts = {}
+        slots = _class_slots(proto, "RefMarker")
+        if slots is not None:
+            consts["RefMarker.__slots__"] = slots
+        fields = _namedtuple_fields(proto, "TaskResult")
+        if fields is not None:
+            consts["TaskResult.fields"] = fields
+        keys = _task_spec_keys(proto)
+        if keys is not None:
+            consts["make_task_spec.keys"] = keys
+        layout[PROTO_REL] = consts
+
+    if problems:
+        return None, problems
+    canonical = json.dumps(layout, sort_keys=True)
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest(), []
+
+
+def fingerprint_path(root: Path) -> Path:
+    return Path(root) / FINGERPRINT_REL
+
+
+def load_recorded(root: Path) -> Dict[str, str]:
+    path = fingerprint_path(root)
+    if not path.is_file():
+        return {}
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {}
+    versions = doc.get("versions", doc)
+    return {str(k): str(v) for k, v in versions.items() if isinstance(v, str)}
+
+
+def update_fingerprint(root: Path) -> Tuple[Optional[int], Optional[str]]:
+    """Record the current layout hash under the current PROTOCOL_VERSION.
+    Returns (version, digest); raises on missing/unparsable layout files."""
+    ctx = LintContext(root)
+    wire = ctx.get_file(WIRE_REL)
+    if wire is None:
+        raise FileNotFoundError(f"{WIRE_REL} not found under {root}")
+    version = read_protocol_version(wire)
+    if version is None:
+        raise ValueError(f"PROTOCOL_VERSION not found in {WIRE_REL}")
+    digest, problems = compute_fingerprint(ctx)
+    if digest is None:
+        raise ValueError("; ".join(problems))
+    recorded = load_recorded(ctx.root)
+    recorded[str(version)] = digest
+    path = fingerprint_path(ctx.root)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        json.dumps(
+            {"schema": 1, "versions": dict(sorted(recorded.items(), key=lambda kv: int(kv[0])))},
+            indent=2,
+        )
+        + "\n",
+        encoding="utf-8",
+    )
+    return version, digest
+
+
+class ProtocolFingerprintRule(Rule):
+    name = "protocol-fingerprint"
+    allow_token = "fingerprint"
+    description = (
+        "frame-layout constants in wire.py/rpc.py/protocol.py must match the "
+        "fingerprint recorded for the current PROTOCOL_VERSION"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        wire = ctx.get_file(WIRE_REL)
+        if wire is None:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=WIRE_REL,
+                    line=1,
+                    message="wire.py not found under lint root",
+                )
+            ]
+        version = read_protocol_version(wire)
+        if version is None:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=WIRE_REL,
+                    line=1,
+                    message="PROTOCOL_VERSION literal not found in wire.py",
+                )
+            ]
+        digest, problems = compute_fingerprint(ctx)
+        if digest is None:
+            return [
+                Violation(rule=self.name, path=WIRE_REL, line=1, message=p)
+                for p in problems
+            ]
+        recorded = load_recorded(ctx.root)
+        expected = recorded.get(str(version))
+        if expected is None:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=FINGERPRINT_REL,
+                    line=1,
+                    message=(
+                        f"no fingerprint recorded for PROTOCOL_VERSION "
+                        f"{version} — audit the frame layout, then run "
+                        "'ray-tpu lint --update-fingerprint'"
+                    ),
+                )
+            ]
+        if expected != digest:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=WIRE_REL,
+                    line=1,
+                    message=(
+                        f"frame-layout constants changed but PROTOCOL_VERSION "
+                        f"is still {version} (recorded {expected[:12]}…, "
+                        f"current {digest[:12]}…) — bump PROTOCOL_VERSION in "
+                        "wire.py and run 'ray-tpu lint --update-fingerprint'"
+                    ),
+                )
+            ]
+        return []

@@ -1,0 +1,101 @@
+"""reserved-kwargs: user-facing entrypoints (functions/classes decorated
+with ``@ray_tpu.remote`` or ``@serve.deployment``, and methods of decorated
+classes) must not declare parameters that shadow the serve-reserved kwargs
+the framework strips or injects on the call path:
+
+- ``_request_id``   (stripped by DeploymentHandle before dispatch)
+- ``_trace`` / ``_serve_trace``  (trace context injected by the replica)
+- ``_serve_resume`` (stream-resume cursor injected on reconnect)
+
+A parameter with one of these names either never receives user values (the
+framework pops it) or collides with the injected value — both are silent
+API bugs.  Framework-internal resume-aware callables can opt in with
+``# lint: allow-reserved-kwarg -- <reason>`` on the ``def`` line.
+
+Scanned scope: the ``ray_tpu`` package and ``examples/``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+RESERVED = ("_request_id", "_trace", "_serve_trace", "_serve_resume")
+_ENTRYPOINT_DECORATORS = {"remote", "deployment"}
+
+
+def _decorator_name(dec: ast.expr) -> Optional[str]:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr
+    if isinstance(dec, ast.Name):
+        return dec.id
+    return None
+
+
+def _is_entrypoint_decorated(node) -> bool:
+    return any(
+        _decorator_name(d) in _ENTRYPOINT_DECORATORS
+        for d in getattr(node, "decorator_list", [])
+    )
+
+
+def _reserved_params(fn) -> List[ast.arg]:
+    args = fn.args
+    every = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    if args.vararg:
+        every.append(args.vararg)
+    if args.kwarg:
+        every.append(args.kwarg)
+    return [a for a in every if a.arg in RESERVED]
+
+
+class ReservedKwargsRule(Rule):
+    name = "reserved-kwargs"
+    allow_token = "reserved-kwarg"
+    description = (
+        "deployment/actor entrypoints must not shadow serve-reserved "
+        "kwargs (_request_id/_trace/_serve_trace/_serve_resume)"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        out: List[Violation] = []
+        files = list(ctx.package_files())
+        if (ctx.root / "ray_tpu").is_dir():
+            files += ctx.py_files("examples/")
+        for f in files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if _is_entrypoint_decorated(node):
+                        self._flag(f, node, node.name, out)
+                elif isinstance(node, ast.ClassDef) and _is_entrypoint_decorated(node):
+                    for member in node.body:
+                        if not isinstance(
+                            member, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            continue
+                        if member.name.startswith("__") and member.name != "__call__":
+                            continue
+                        self._flag(f, member, f"{node.name}.{member.name}", out)
+        return out
+
+    def _flag(self, f: PyFile, fn, qualname: str, out: List[Violation]) -> None:
+        for param in _reserved_params(fn):
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=f.rel,
+                    line=fn.lineno,
+                    message=(
+                        f"{qualname} declares parameter '{param.arg}', which "
+                        "shadows a serve-reserved kwarg the framework strips "
+                        "or injects — rename it (or allowlist a resume-aware "
+                        "callable with a reason)"
+                    ),
+                )
+            )

@@ -1,0 +1,230 @@
+"""wire-typed-errors: every error that can cross the RPC boundary must be a
+``RayTpuError`` subclass declared in ``ray_tpu/exceptions.py`` that survives
+``pickle.loads(pickle.dumps(e))`` preserving ``args`` and custom fields.
+
+Two checks, generated from the class tree — no hand-maintained list:
+
+1. **round-trip probe** (dynamic): ``exceptions.py`` is loaded as a
+   standalone module (it only imports stdlib, so this works for fixture
+   trees too), every class reachable from ``RayTpuError`` is instantiated
+   from its ``__init__`` signature with probe values, pickled, unpickled,
+   and compared on type / ``args`` / instance ``__dict__``.  The classic
+   failure is an ``__init__`` signature incompatible with pickle's default
+   ``Exception.__reduce__`` (which replays ``cls(*args)``).
+
+2. **declaration locality** (static): a class elsewhere in the package that
+   subclasses a tree class is flagged — the round-trip probe cannot see it,
+   and workers classify errors by ``isinstance`` against the canonical tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import pickle
+import sys
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
+
+EXC_REL = "ray_tpu/exceptions.py"
+ROOT_CLASS = "RayTpuError"
+
+_PROBE_VALUES = {
+    str: "probe",
+    int: 7,
+    float: 1.5,
+    bool: True,
+    bytes: b"probe",
+}
+
+
+def _tree_class_names(exc_file: PyFile) -> Dict[str, int]:
+    """Class names reachable from RayTpuError in exceptions.py -> def line."""
+    tree = exc_file.tree
+    if tree is None:
+        return {}
+    classes: Dict[str, List[str]] = {}
+    linenos: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            classes[node.name] = bases
+            linenos[node.name] = node.lineno
+    in_tree: Set[str] = set()
+    if ROOT_CLASS in classes:
+        in_tree.add(ROOT_CLASS)
+        changed = True
+        while changed:
+            changed = False
+            for name, bases in classes.items():
+                if name not in in_tree and any(b in in_tree for b in bases):
+                    in_tree.add(name)
+                    changed = True
+    return {name: linenos[name] for name in in_tree}
+
+
+def load_exceptions_module(exc_path) -> object:
+    """Load an exceptions.py as a standalone module (registered in
+    sys.modules so pickle-by-reference round-trips within the process)."""
+    mod_name = f"_ray_tpu_lint_exc_{abs(hash(str(exc_path)))}"
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(mod_name, exc_path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = module
+    try:
+        spec.loader.exec_module(module)
+    except Exception:
+        del sys.modules[mod_name]
+        raise
+    return module
+
+
+def _build_instance(cls):
+    """Instantiate *cls* from its __init__ signature using probe values."""
+    import inspect
+
+    sig = inspect.signature(cls.__init__)
+    args = []
+    kwargs = {}
+    for name, param in list(sig.parameters.items())[1:]:  # skip self
+        if param.kind in (param.VAR_POSITIONAL, param.VAR_KEYWORD):
+            continue
+        ann = param.annotation
+        value = None
+        known = False
+        if ann in _PROBE_VALUES:
+            value, known = _PROBE_VALUES[ann], True
+        elif isinstance(ann, str):
+            for t, v in _PROBE_VALUES.items():
+                if ann == t.__name__:
+                    value, known = v, True
+                    break
+        if param.default is not param.empty and not known:
+            # keep defaults for params we can't type (e.g. Optional[...]
+            # causes that are deliberately dropped from the wire)
+            continue
+        if not known:
+            value = "probe:%s" % name
+        if param.kind == param.POSITIONAL_ONLY:
+            args.append(value)
+        else:
+            # keyword form: a skipped (defaulted, untyped) param must not
+            # shift later positional values onto the wrong parameter
+            kwargs[name] = value
+    return cls(*args, **kwargs)
+
+
+def probe_class(cls) -> Optional[str]:
+    """Round-trip one exception class; returns a problem description or
+    None when the class is wire-safe."""
+    try:
+        inst = _build_instance(cls)
+    except Exception as e:  # noqa: BLE001 - any constructor failure is a finding
+        return f"could not instantiate from __init__ signature: {e!r}"
+    try:
+        clone = pickle.loads(pickle.dumps(inst))
+    except Exception as e:  # noqa: BLE001
+        return f"pickle round-trip raised: {e!r}"
+    if type(clone) is not type(inst):
+        return (
+            f"round-trip changed type: {type(inst).__name__} -> "
+            f"{type(clone).__name__}"
+        )
+    if clone.args != inst.args:
+        return f"round-trip lost args: {inst.args!r} -> {clone.args!r}"
+    lost = {
+        k: v
+        for k, v in vars(inst).items()
+        if vars(clone).get(k, "<missing>") != v
+    }
+    if lost:
+        return f"round-trip lost fields: {sorted(lost)}"
+    return None
+
+
+class WireTypedErrorsRule(Rule):
+    name = "wire-typed-errors"
+    allow_token = "wire-error"
+    description = (
+        "every RayTpuError subclass pickles round-trip preserving args and "
+        "fields, and is declared in ray_tpu/exceptions.py"
+    )
+
+    def check(self, ctx: LintContext) -> List[Violation]:
+        out: List[Violation] = []
+        exc_file = ctx.get_file(EXC_REL)
+        if exc_file is None:
+            return [
+                Violation(
+                    rule=self.name,
+                    path=EXC_REL,
+                    line=1,
+                    message="exceptions.py not found under lint root",
+                )
+            ]
+        tree_names = _tree_class_names(exc_file)
+
+        # 1) dynamic round-trip probe over the whole tree
+        try:
+            module = load_exceptions_module(exc_file.path)
+        except Exception as e:  # noqa: BLE001
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=EXC_REL,
+                    line=1,
+                    message=f"could not load exceptions.py for probing: {e!r}",
+                )
+            )
+        else:
+            for name, lineno in sorted(tree_names.items()):
+                cls = getattr(module, name, None)
+                if cls is None or not isinstance(cls, type):
+                    continue
+                problem = probe_class(cls)
+                if problem:
+                    out.append(
+                        Violation(
+                            rule=self.name,
+                            path=EXC_REL,
+                            line=lineno,
+                            message=f"{name}: {problem}",
+                        )
+                    )
+
+        # 2) tree subclasses declared outside exceptions.py
+        for f in ctx.package_files():
+            if f.rel == EXC_REL or f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for b in node.bases:
+                    base = (
+                        b.id
+                        if isinstance(b, ast.Name)
+                        else b.attr if isinstance(b, ast.Attribute) else None
+                    )
+                    if base in tree_names:
+                        out.append(
+                            Violation(
+                                rule=self.name,
+                                path=f.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"{node.name} subclasses {base} outside "
+                                    "ray_tpu/exceptions.py — declare wire "
+                                    "errors in the canonical tree so the "
+                                    "round-trip probe covers them"
+                                ),
+                            )
+                        )
+                        break
+        return out

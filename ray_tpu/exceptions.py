@@ -159,3 +159,18 @@ class ChannelError(RayTpuError):
 
 class ChannelTimeoutError(ChannelError, TimeoutError):
     """Compiled-graph channel read/write timed out."""
+
+
+class StreamQueueFullError(RayTpuError):
+    """A serve streaming consumer fell ``serve_stream_queue_max`` tokens
+    behind and its stream was dropped (backpressure instead of unbounded
+    replica RSS growth).  Crosses the replica -> proxy wire, so it lives
+    in the typed tree and round-trips pickle with its bound intact."""
+
+    def __init__(self, message: str = "", queue_max: int = 0):
+        super().__init__(message)
+        self.queue_max = queue_max
+
+    def __reduce__(self):
+        return (type(self),
+                (self.args[0] if self.args else "", self.queue_max))

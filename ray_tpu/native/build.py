@@ -22,7 +22,9 @@ def library_path() -> str:
 
     Cross-process safe: concurrent workers serialize on an flock and use
     per-pid temp names so a half-written .so is never published."""
-    override = os.environ.get("RAY_TPU_STORE_LIB")
+    from ray_tpu.core.config import get_config
+
+    override = get_config().store_lib
     if override:
         # Instrumented builds (TSAN/ASAN via cmake -DSANITIZE=...) run
         # the python suite against their own .so.

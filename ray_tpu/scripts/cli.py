@@ -36,9 +36,10 @@ BREADCRUMB = f"/tmp/ray_tpu_{os.getuid()}/last_cluster.json"
 def _resolve_address(args) -> str:
     if args.address:
         return args.address
-    env = os.environ.get("RAY_TPU_ADDRESS")
-    if env:
-        return env
+    from ray_tpu.core.config import get_config
+
+    if get_config().address:
+        return get_config().address
     try:
         with open(BREADCRUMB) as f:
             return json.load(f)["gcs_address"]
@@ -741,6 +742,51 @@ def cmd_down(args) -> None:
     cluster_down(args.config)
 
 
+def cmd_lint(args) -> None:
+    """Run the invariant lint suite; exits 0 clean / 1 violations /
+    2 usage errors. Needs no cluster."""
+    from ray_tpu.devtools.lint import (
+        all_rules,
+        default_root,
+        render_text,
+        run_lint,
+        to_json,
+    )
+
+    root = args.root or default_root()
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:22s} {rule.description}")
+        return
+    if args.update_fingerprint:
+        from ray_tpu.devtools.lint.rules.protocol_fingerprint import (
+            update_fingerprint,
+        )
+
+        version, digest = update_fingerprint(root)
+        print(f"recorded fingerprint {digest[:16]}… for "
+              f"PROTOCOL_VERSION {version}")
+        return
+    if args.knob_table:
+        from ray_tpu.devtools.lint.engine import LintContext
+        from ray_tpu.devtools.lint.rules.knob_registry import (
+            knob_table_markdown,
+        )
+
+        print(knob_table_markdown(LintContext(root)), end="")
+        return
+    try:
+        violations, rules = run_lint(root, args.rules)
+    except ValueError as e:
+        sys.exit(f"error: {e}")
+    if args.as_json:
+        print(to_json(root, violations, rules))
+    else:
+        print(render_text(root, violations, rules))
+    if violations:
+        sys.exit(1)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     p.add_argument("--address", help="GCS address host:port")
@@ -834,6 +880,26 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "stops it)")
     dn = sub.add_parser("down")
     dn.add_argument("config", help="cluster YAML path or cluster name")
+    ln = sub.add_parser(
+        "lint",
+        help="run the AST invariant lint suite (knob registry, wire-typed "
+             "errors, protocol fingerprint, async hot paths, lock order, "
+             "reserved kwargs) over the source tree")
+    ln.add_argument("--rule", action="append", dest="rules", metavar="NAME",
+                    help="run only this rule (repeatable)")
+    ln.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ln.add_argument("--root", default=None,
+                    help="tree to lint (default: the installed package's "
+                         "repo root)")
+    ln.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ln.add_argument("--update-fingerprint", action="store_true",
+                    help="record the current frame-layout hash for the "
+                         "current PROTOCOL_VERSION and exit")
+    ln.add_argument("--knob-table", action="store_true",
+                    help="print the README knob table generated from the "
+                         "config registry and exit")
     gp = sub.add_parser("logs")
     gp.add_argument("--node", help="node id prefix filter")
     gp.add_argument("--worker", help="worker id prefix filter")
@@ -849,6 +915,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "lines are retained GCS-side")
     args = p.parse_args(argv)
 
+    if args.cmd == "lint":
+        cmd_lint(args)
+        return
     if args.cmd == "up":
         cmd_up(args)
         return

@@ -12,7 +12,6 @@ bump (ref: _private/long_poll.py:173 LongPollHost).
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 import traceback
@@ -65,8 +64,7 @@ class ServeController:
         # successful check (ref: deployment initialization_timeout_s).
         self._started_at: Dict[str, float] = {}
         self._ready: set = set()
-        self._startup_grace_s = float(
-            os.environ.get("RAY_TPU_SERVE_STARTUP_GRACE_S", "600"))
+        self._startup_grace_s = get_config().serve_startup_grace_s
         self._health_timeout_s = get_config().serve_health_timeout_s
         self._drain_timeout_s = get_config().serve_drain_timeout_s
         # Retiring replica names -> wall deadline.  Entries block actor-

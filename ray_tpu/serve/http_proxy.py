@@ -44,10 +44,9 @@ def _error_status(e: BaseException) -> tuple:
     for transient routing/capacity conditions, 504 for deadline, 500
     otherwise."""
     import ray_tpu.exceptions as rexc
-    from ray_tpu.serve.llm import StreamQueueFullError
 
     if isinstance(e, (rexc.ActorDiedError, rexc.ActorUnavailableError,
-                      rexc.ReplicaDrainingError, StreamQueueFullError)):
+                      rexc.ReplicaDrainingError, rexc.StreamQueueFullError)):
         return 503, True
     if isinstance(e, (rexc.GetTimeoutError, TimeoutError)):
         return 504, False

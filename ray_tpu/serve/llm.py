@@ -40,11 +40,10 @@ import numpy as np
 from ray_tpu.util import tracing
 
 
-class StreamQueueFullError(RuntimeError):
-    """A streaming consumer fell serve_stream_queue_max tokens behind
-    and its stream was dropped (backpressure instead of unbounded
-    replica RSS growth). RAY_TPU_SERVE_STREAM_QUEUE_MAX tunes the
-    bound."""
+# Canonical home is the typed error tree (the wire-typed-errors lint
+# rule keeps every boundary-crossing error there); re-exported here for
+# the historical import path.
+from ray_tpu.exceptions import StreamQueueFullError  # noqa: F401
 
 
 class _Request:
@@ -96,7 +95,8 @@ class _Request:
                 self.error = StreamQueueFullError(
                     f"stream consumer fell {self.token_q.maxsize} tokens "
                     f"behind; stream dropped "
-                    f"(RAY_TPU_SERVE_STREAM_QUEUE_MAX)")
+                    f"(RAY_TPU_SERVE_STREAM_QUEUE_MAX)",
+                    queue_max=self.token_q.maxsize)
 
 
 class _EngineBase:

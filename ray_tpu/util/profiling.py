@@ -146,7 +146,9 @@ def node_log_dir(node_id: str) -> str:
     file rendezvous needs no extra plumbing through the spawn paths."""
     import tempfile
 
-    return os.environ.get("RAY_TPU_LOG_DIR") or os.path.join(
+    from ray_tpu.core.config import get_config
+
+    return get_config().log_dir or os.path.join(
         tempfile.gettempdir(), "ray_tpu_logs", node_id[:12])
 
 

@@ -24,7 +24,9 @@ _start_time = time.time()
 
 
 def usage_stats_enabled() -> bool:
-    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") == "1"
+    from ray_tpu.core.config import get_config
+
+    return bool(get_config().usage_stats_enabled)
 
 
 def record_library_usage(library: str) -> None:
@@ -103,7 +105,9 @@ def report_usage(url: Optional[str] = None,
     break a workload, same rule as the reference)."""
     if not usage_stats_enabled():
         return False
-    url = url or os.environ.get("RAY_TPU_USAGE_STATS_URL")
+    from ray_tpu.core.config import get_config
+
+    url = url or get_config().usage_stats_url or None
     if not url:
         return False
     try:

@@ -23,12 +23,14 @@ from ray_tpu.dag.dag_node import (
     MultiOutputNode,
 )
 
-_DEFAULT_STORAGE = os.environ.get(
-    "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu_workflows")
+def _default_storage() -> str:
+    from ray_tpu.core.config import get_config
+
+    return get_config().workflow_storage
 
 
 def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
-    return os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    return os.path.join(storage or _default_storage(), workflow_id)
 
 
 def _step_key(node: DAGNode, topo_index: int) -> str:
@@ -401,7 +403,7 @@ def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
 
 
 def list_all(storage: Optional[str] = None) -> List[Dict[str, Any]]:
-    root = storage or _DEFAULT_STORAGE
+    root = storage or _default_storage()
     out = []
     if not os.path.isdir(root):
         return out

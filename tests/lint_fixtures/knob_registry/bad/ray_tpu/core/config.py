@@ -1,0 +1,9 @@
+"""Fixture registry: one documented knob, one undocumented knob."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- fixture knobs ----
+    foo_knob: int = 1
+    ghost_knob: str = ""

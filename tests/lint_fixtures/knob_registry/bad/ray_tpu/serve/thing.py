@@ -1,0 +1,5 @@
+import os
+
+
+def read_it():
+    return os.environ.get("RAY_TPU_FOO_KNOB", "0")

@@ -1,0 +1,8 @@
+"""Fixture registry: documented knob, consumed via get_config()."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- fixture knobs ----
+    foo_knob: int = 1

@@ -1,0 +1,10 @@
+import os
+
+
+def read_it(cfg):
+    return cfg.foo_knob
+
+
+def bootstrap_read():
+    # lint: allow-knob -- fixture: pre-config bootstrap var with a reason
+    return os.environ.get("RAY_TPU_FOO_KNOB")

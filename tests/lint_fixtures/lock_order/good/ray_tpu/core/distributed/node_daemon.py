@@ -1,0 +1,17 @@
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ab_again(self):
+        with self._a:
+            with self._b:
+                pass

@@ -1,0 +1,12 @@
+import time
+
+import ray_tpu
+
+
+async def tick(sock, fut, loop):
+    time.sleep(0.1)
+    value = ray_tpu.get(fut)
+    data = sock.recv(1024)
+    result = fut.result()
+    loop.call_soon(lambda: time.sleep(0.01))
+    return value, data, result

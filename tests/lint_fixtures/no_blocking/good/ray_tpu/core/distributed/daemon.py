@@ -1,0 +1,17 @@
+import asyncio
+import time
+
+
+async def tick(tasks):
+    await asyncio.sleep(0.1)
+    done, pending = await asyncio.wait(tasks)
+    for t in done:
+        t.result()
+    # lint: allow-blocking -- fixture: measured sub-ms call, documented
+    time.sleep(0.0001)
+
+    def sync_helper():
+        # runs in an executor thread, not on the loop
+        time.sleep(0.5)
+
+    return sync_helper

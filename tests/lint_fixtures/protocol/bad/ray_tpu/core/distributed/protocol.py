@@ -1,0 +1,15 @@
+from typing import NamedTuple, Optional
+
+
+class RefMarker:
+    __slots__ = ("oid_binary", "owner")
+
+
+class TaskResult(NamedTuple):
+    oid: bytes
+    size: int
+    inline: Optional[bytes] = None
+
+
+def make_task_spec(fn, args):
+    return {"fn": fn, "args": args}

@@ -1,0 +1,9 @@
+import struct
+
+MAX_FRAME = 16 * 1024 * 1024
+_HEADER = struct.Struct("<IBBQ")
+_POST_LEN = 10
+
+REQ = 1
+RES = 2
+CANCEL = 6

@@ -1,0 +1,16 @@
+import struct
+
+PROTOCOL_VERSION = 5
+
+CODEC_PICKLE = 0
+CODEC_TYPED = 1
+
+_I64 = struct.Struct("<q")
+_U32 = struct.Struct("<I")
+
+_T_NONE = 0x00
+_T_INT = 0x03
+
+
+class Raw:
+    __slots__ = ("buffer",)

@@ -1,0 +1,16 @@
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment
+class App:
+    def __call__(self, request, _request_id=None):
+        return request
+
+    def stream(self, request, _serve_resume=None):
+        return request
+
+
+@ray_tpu.remote
+def task(x, _trace=None):
+    return x

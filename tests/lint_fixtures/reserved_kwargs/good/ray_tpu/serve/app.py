@@ -1,0 +1,17 @@
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment
+class App:
+    def __call__(self, request, request_id=None):
+        return request
+
+    # lint: allow-reserved-kwarg -- fixture: framework-internal resume-aware entrypoint
+    def stream(self, request, _serve_resume=None):
+        return request
+
+
+@ray_tpu.remote
+def task(x, trace=None):
+    return x

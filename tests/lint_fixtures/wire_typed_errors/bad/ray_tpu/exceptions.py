@@ -1,0 +1,14 @@
+"""Fixture tree with a round-trip-broken subclass."""
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class BadError(RayTpuError):
+    """__init__ requires two args but args holds one: pickle's default
+    reduce replays cls(*args) and explodes."""
+
+    def __init__(self, message: str, code: int):
+        super().__init__(message)
+        self.code = code

@@ -1,0 +1,5 @@
+from ray_tpu.exceptions import RayTpuError
+
+
+class StrayError(RayTpuError):
+    """Declared outside the canonical tree."""

@@ -1,0 +1,14 @@
+"""Fixture tree where every subclass round-trips."""
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class GoodError(RayTpuError):
+    def __init__(self, message: str = "", code: int = 0):
+        super().__init__(message)
+        self.code = code
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "", self.code))

@@ -1,0 +1,113 @@
+"""Wire-type contract for the RayTpuError tree (tier-1).
+
+Every subclass must survive ``pickle.loads(pickle.dumps(e))`` with ``args``
+and custom fields intact — these exceptions cross the worker/daemon and
+replica/proxy wires, so a lossy round-trip silently strips diagnostics at
+the caller.  The same probe backs the ``wire-typed-errors`` lint rule;
+this file pins the contract (and past regressions) as plain tests.
+"""
+import pickle
+
+import pytest
+
+import ray_tpu.exceptions as rexc
+from ray_tpu.devtools.lint.rules.wire_typed_errors import (
+    _build_instance,
+    probe_class,
+)
+
+
+class _Lossy(rexc.RayTpuError):
+    """The classic bug shape: required multi-arg __init__ relying on
+    Exception's default reduce, which replays ``cls(*args)`` — here
+    ``args`` is just ``(message,)``, so unpickling raises TypeError."""
+
+    def __init__(self, message: str, code: int):
+        super().__init__(message)
+        self.code = code
+
+
+class _Strict(rexc.RayTpuError):
+    """Required (no-default) params + a correct __reduce__."""
+
+    def __init__(self, message: str, code: int):
+        super().__init__(message)
+        self.code = code
+
+    def __reduce__(self):
+        return (type(self), (self.args[0], self.code))
+
+
+def _tree_classes():
+    out = []
+    for name in dir(rexc):
+        obj = getattr(rexc, name)
+        if (
+            isinstance(obj, type)
+            and issubclass(obj, rexc.RayTpuError)
+            and obj.__module__ == rexc.__name__
+        ):
+            out.append(obj)
+    return sorted(out, key=lambda c: c.__name__)
+
+
+def test_every_subclass_round_trips():
+    classes = _tree_classes()
+    assert len(classes) >= 10, "expected the full exception tree"
+    problems = [p for p in (probe_class(c) for c in classes) if p]
+    assert not problems, "\n".join(problems)
+
+
+def test_task_error_preserves_fields():
+    e = rexc.TaskError(
+        function_name="f", traceback_str="tb", pid=42, node_id="n" * 16
+    )
+    e2 = pickle.loads(pickle.dumps(e))
+    assert type(e2) is rexc.TaskError
+    assert (e2.function_name, e2.traceback_str, e2.pid, e2.node_id) == (
+        "f", "tb", 42, "n" * 16
+    )
+
+
+def test_stream_queue_full_error_round_trip():
+    """Regression: StreamQueueFullError used to be defined ad hoc in
+    serve/llm.py without a __reduce__; the default Exception reduce replayed
+    args into __init__ and dropped queue_max on unpickle."""
+    e = rexc.StreamQueueFullError("token queue full", queue_max=7)
+    e2 = pickle.loads(pickle.dumps(e))
+    assert type(e2) is rexc.StreamQueueFullError
+    assert e2.args == ("token queue full",)
+    assert e2.queue_max == 7
+    # the serve plane still imports it from its historical home
+    from ray_tpu.serve.llm import StreamQueueFullError as alias
+
+    assert alias is rexc.StreamQueueFullError
+
+
+def test_probe_detects_lossy_reduce():
+    problem = probe_class(_Lossy)
+    assert problem is not None and "raised" in problem
+
+
+def test_build_instance_fills_required_params():
+    inst = _build_instance(_Strict)
+    assert isinstance(inst, _Strict)
+    assert probe_class(_Strict) is None
+
+
+@pytest.mark.parametrize(
+    "cls,kwargs,fields",
+    [
+        (rexc.ActorDiedError, {"actor_id": "a" * 12, "reason": "oom"},
+         ("actor_id", "reason")),
+        (rexc.ReplicaDrainingError, {"replica_id": "rep-3"}, ("replica_id",)),
+        (rexc.ObjectLostError, {"object_id": "o" * 12, "message": "gone"},
+         ("object_id",)),
+    ],
+)
+def test_wire_fields_survive(cls, kwargs, fields):
+    e = cls(**kwargs)
+    e2 = pickle.loads(pickle.dumps(e))
+    assert type(e2) is cls and e2.args == e.args
+    for f in fields:
+        assert getattr(e2, f) == getattr(e, f)

@@ -708,18 +708,27 @@ def test_usage_stats_local_and_optin(tmp_path, monkeypatch):
     assert json.load(open(p))["schema_version"] == 1
 
     # Reporting is OPT-IN: disabled by default even with a URL set.
+    # The knobs flow through the config registry, so the frozen config
+    # singleton is reset around each env change.
+    from ray_tpu.core.config import reset_config
+
     monkeypatch.setenv("RAY_TPU_USAGE_STATS_URL", "http://example/x")
     monkeypatch.delenv("RAY_TPU_USAGE_STATS_ENABLED", raising=False)
+    reset_config()
     posted = []
     monkeypatch.setattr(
         urllib.request, "urlopen",
         lambda req, timeout=None: posted.append(req) or _FakeResp())
-    assert us.report_usage() is False
-    assert not posted
-    # Explicit opt-in sends exactly the inspectable snapshot.
-    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
-    assert us.report_usage() is True
-    assert json.loads(posted[0].data.decode())["schema_version"] == 1
+    try:
+        assert us.report_usage() is False
+        assert not posted
+        # Explicit opt-in sends exactly the inspectable snapshot.
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        reset_config()
+        assert us.report_usage() is True
+        assert json.loads(posted[0].data.decode())["schema_version"] == 1
+    finally:
+        reset_config()
 
 
 class _FakeResp:

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# CI entry for the invariant lint suite: run all six rules over the repo
+# and fail on any violation (same gate as tier-1 tests/test_lint.py).
+#
+#   tools/lint.sh              # human-readable report
+#   tools/lint.sh --json       # machine-readable report
+#   tools/lint.sh --rule NAME  # any ray-tpu lint flag passes through
+set -euo pipefail
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+# The CLI never needs an accelerator; force the CPU backend so a hostile
+# TPU environment can't hang the import.
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m ray_tpu.scripts.cli lint --root "$repo_root" "$@"
