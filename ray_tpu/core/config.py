@@ -348,6 +348,46 @@ class Config:
     # its syncer federation payload so serve TTFT/ITL histograms and
     # KV-cache counters appear in `ray-tpu metrics --federated`).
     serve_metrics_push_s: float = 2.0
+    # ---- disaggregated serving (PR: disagg plane; serve/disagg.py) ----
+    # Knob families: RAY_TPU_SERVE_DISAGG_* (prefill/decode split),
+    # RAY_TPU_SERVE_PREFIX_REGISTRY_* (cluster-wide prefix registry),
+    # RAY_TPU_SERVE_KV_MIGRATE_* (live KV migration on drain).
+    # Prefill/decode split: paged replicas offload long-prompt prefill
+    # to dedicated prefill actors and adopt the returned KV frames into
+    # their block pool instead of recomputing. Off by default: the
+    # split only pays for itself when long prompts interfere with
+    # decode ITL.
+    serve_disagg_enabled: bool = False
+    # Prompts shorter than this many tokens prefill locally even when
+    # disagg is on (the frame round-trip costs more than the compute).
+    serve_disagg_prompt_threshold: int = 64
+    # Dedicated prefill actors per engine pool (keyed by
+    # config/block-size/max-len so frames always fit the adopter).
+    serve_disagg_prefill_actors: int = 1
+    # Cluster-wide prefix registry: replicas publish block-aligned
+    # prefix digests over the gauge/syncer path and the handle routes
+    # prefix-warm requests to the replica already holding those blocks.
+    serve_prefix_registry_enabled: bool = True
+    # Per-replica cap on published digests (newest-registered win) so
+    # the gauge payload stays bounded on prefix-heavy workloads.
+    serve_prefix_registry_max_entries: int = 512
+    # Live KV migration on drain: a draining replica exports each
+    # in-flight stream's KV blocks as a migration ticket; the resumed
+    # stream adopts them on the new replica instead of recomputing the
+    # whole context (recompute stays the fallback when the ticket is
+    # missing, stale, or oversized).
+    serve_kv_migrate_enabled: bool = True
+    # Tickets whose KV frame exceeds this many bytes are not published
+    # (the resume falls back to recompute rather than bloating the GCS
+    # KV store with multi-MB blobs).
+    serve_kv_migrate_inline_max_bytes: int = 4194304
+    # Grace window the draining replica waits after publishing tickets
+    # so handles observe the failure and resume elsewhere before the
+    # process exits.
+    serve_kv_migrate_linger_s: float = 2.0
+    # Tickets older than this are treated as stale and ignored on
+    # consume (left-over tickets are also deleted on read).
+    serve_kv_migrate_ttl_s: float = 60.0
 
     # ---- client bootstrap / process-local paths ----
     # Cluster address used by ray_tpu.init() and the CLI when none is

@@ -1049,11 +1049,16 @@ class ServeGauges:
         per-app aggregate (sums of replicas / queue_depth / active;
         occupancy stays a sum too — the controller divides by replicas
         for a mean)."""
-        out: Dict[str, Dict[str, float]] = {}
+        out: Dict[str, Dict[str, Any]] = {}
         for n in self._gcs.nodes.view.alive_nodes():
             for app, agg in (getattr(n, "serve", None) or {}).items():
                 dst = out.setdefault(app, {})
                 for name, val in agg.items():
+                    # Per-replica disagg state (role + prefix digests)
+                    # is a union across nodes, not a sum.
+                    if name == "_replicas" and isinstance(val, dict):
+                        dst.setdefault("_replicas", {}).update(val)
+                        continue
                     try:
                         dst[name] = round(dst.get(name, 0.0) + float(val),
                                           3)

@@ -414,11 +414,17 @@ class NodeDaemon:
                     agg[name] = round(agg.get(name, 0.0) + float(val), 3)
                 except (TypeError, ValueError):
                     continue
+            # Per-replica disagg state (role, published prefix digests)
+            # rides the same TTL sweep: a SIGKILLed replica's registry
+            # entries stop routing within serve_gauge_ttl_s.
+            if ent.get("state"):
+                agg.setdefault("_replicas", {})[key[1]] = ent["state"]
         return apps
 
     async def report_serve_gauges(self, app: str, replica: str,
                                   gauges: Dict[str, float],
-                                  metrics: Optional[list] = None) -> dict:
+                                  metrics: Optional[list] = None,
+                                  state: Optional[dict] = None) -> dict:
         """Replica -> local daemon gauge push (the serve-autoscaling
         leg of the syncer plane; replicas never talk to the GCS).
 
@@ -427,9 +433,12 @@ class NodeDaemon:
         gauges appear verbatim in the federated exposition, and the
         optional `metrics` registry dump piggybacks into
         _metrics_dump's merge (histograms/counters the replica process
-        records)."""
+        records).  `state` carries non-additive per-replica facts —
+        disagg role and published prefix digests — surfaced under the
+        app's `_replicas` submap instead of the float aggregation."""
         self._serve_gauges[(app, replica)] = {
-            "ts": time.monotonic(), "gauges": dict(gauges)}
+            "ts": time.monotonic(), "gauges": dict(gauges),
+            "state": dict(state) if state else None}
         for name, val in gauges.items():
             try:
                 self._m_serve_gauge.set(float(val), {

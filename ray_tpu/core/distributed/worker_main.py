@@ -1113,6 +1113,12 @@ class WorkerService:
                     err = rexc.WorkerCrashedError(
                         f"actor method {name} interrupted by a stray "
                         f"cancel")
+            elif isinstance(e, rexc.RayTpuError):
+                # Typed passthrough, same as the task and streaming
+                # paths: callers dispatch on framework exception types
+                # (e.g. the handle retries ReplicaDrainingError from
+                # stream_next during a live-migration drain).
+                err = e
             else:
                 err = rexc.ActorError.from_exception(
                     e, name, pid=os.getpid(), node_id=self.core.node_id)

@@ -98,6 +98,24 @@ class ReplicaDrainingError(RayTpuError):
         return (type(self), (self.replica_id,))
 
 
+class KVMigrationError(RayTpuError):
+    """A live KV migration (serve/disagg.py) could not be applied on the
+    target replica — missing/stale ticket, frame-shape mismatch, or an
+    exhausted block pool.  Callers treat it as "fall back to recompute":
+    the resumed stream replays the context as an extended prompt instead
+    of adopting shipped blocks.  Wire-typed (lossless __reduce__) so the
+    fallback decision survives the actor boundary."""
+
+    def __init__(self, request_id: str = "", reason: str = ""):
+        self.request_id = request_id
+        self.reason = reason
+        super().__init__(f"KV migration failed for request "
+                         f"{request_id!r}: {reason or 'unknown'}")
+
+    def __reduce__(self):  # see TaskError.__reduce__
+        return (type(self), (self.request_id, self.reason))
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before or during execution."""
 

@@ -605,6 +605,35 @@ def copy_block(cache: PagedKVCache, dst: jax.Array, src: jax.Array
                         v=cache.v.at[:, dst].set(cache.v[:, src]))
 
 
+def gather_blocks(cache: PagedKVCache, block_ids) -> "jnp.ndarray":
+    """Extract pool blocks as one host-transferable KV frame: shape
+    (2, L, n, block_size, Hkv, D) with k stacked over v.  The frame is
+    the disaggregated-serving wire unit — a prefill actor gathers its
+    finished blocks, `jax.device_get` turns them into a plain ndarray,
+    and the bytes ride the zero-copy transfer plane like any sealed shm
+    object (serve/disagg.py ships them; import is `scatter_blocks`).
+    Exact roundtrip: no dtype change, so a migrated stream's decode is
+    bit-identical to never having moved."""
+    import numpy as np
+
+    ids = jnp.asarray(np.asarray(block_ids, np.int32))
+    return jnp.stack([cache.k[:, ids], cache.v[:, ids]])
+
+
+def scatter_blocks(cache: PagedKVCache, block_ids, frame) -> PagedKVCache:
+    """Write a `gather_blocks` frame into freshly-allocated pool blocks
+    of ANOTHER engine's cache (the decode-side adopt path).  The frame's
+    layer/head/dim geometry must match the receiving cache — the caller
+    (PagedLLMEngine.import_prefix) validates shapes before touching the
+    device."""
+    import numpy as np
+
+    ids = jnp.asarray(np.asarray(block_ids, np.int32))
+    frame = jnp.asarray(frame, cache.k.dtype)
+    return PagedKVCache(k=cache.k.at[:, ids].set(frame[0]),
+                        v=cache.v.at[:, ids].set(frame[1]))
+
+
 def make_paged_engine_fns(cfg: TransformerConfig, donate: bool = True):
     """Jitted (prefill_chunk, decode_burst, copy_block) with cache
     donation.  Chunk width C and table depth B_max ride in the argument

@@ -321,9 +321,20 @@ def cmd_serve(gcs: _Gcs, args) -> None:
     for app in names:
         print(f"  app {app}:")
         gauges = apps.get(app) or {}
-        if gauges:
+        # Per-replica disagg state rides the gauge payload under the
+        # non-numeric `_replicas` key: render it as its own section.
+        replicas = gauges.get("_replicas") or {}
+        numeric = {k: v for k, v in gauges.items()
+                   if isinstance(v, (int, float))}
+        if numeric:
             print("    gauges: " + "  ".join(
-                f"{k}={v:g}" for k, v in sorted(gauges.items())))
+                f"{k}={v:g}" for k, v in sorted(numeric.items())))
+        for rid in sorted(replicas):
+            ent = replicas[rid] or {}
+            parts = [f"role={ent.get('role', 'unified')}"]
+            if "prefixes" in ent:
+                parts.append(f"prefixes={len(ent['prefixes'] or ())}")
+            print(f"    replica {rid}: " + "  ".join(parts))
         lat = latency.get(app) or {}
         line = []
         if "ttft_mean_s" in lat:

@@ -182,8 +182,36 @@ class ServeController:
             st = self._state.get(app_name)
             if st is None:
                 return {"version": -1, "replicas": []}
-            return {"version": st["version"],
-                    "replicas": list(st["replicas"].keys())}
+            out: dict = {"version": st["version"],
+                         "replicas": list(st["replicas"].keys())}
+            # Cluster-wide prefix registry read side: the syncer-merged
+            # per-replica state (role + published prefix digests) maps
+            # digest -> owning replica for the handle's prefix-affinity
+            # routing.  Restricted to CURRENT replicas: a SIGKILLed or
+            # retired replica's stale digests never route (belt) even
+            # before the daemon's gauge TTL sweeps them (suspenders).
+            merged = (self._merged_gauges or {}).get(app_name) or {}
+            reps = merged.get("_replicas")
+            if isinstance(reps, dict):
+                live = set(out["replicas"])
+                owners: Dict[str, str] = {}
+                roles: Dict[str, str] = {}
+                for rid, ent in reps.items():
+                    if not isinstance(ent, dict):
+                        continue
+                    if ent.get("role"):
+                        roles[rid] = str(ent["role"])
+                    if rid not in live:
+                        continue
+                    if ent.get("block_size"):
+                        out["kv_block_size"] = int(ent["block_size"])
+                    for d in ent.get("prefixes") or ():
+                        owners[str(d)] = rid
+                if owners:
+                    out["prefix_owners"] = owners
+                if roles:
+                    out["roles"] = roles
+            return out
 
     def list_applications(self) -> List[str]:
         with self._lock:

@@ -112,6 +112,11 @@ class DeploymentHandle:
         # model_id -> replica name that recently served it (multiplexed
         # locality, ref: pow_2_scheduler.py multiplex-aware candidates).
         self._model_affinity: Dict[str, str] = {}
+        # Cluster-wide prefix registry read side (serve/disagg.py):
+        # aligned-prefix digest -> owning replica, refreshed with the
+        # routing table; prefix-warm requests prefer the owner.
+        self._prefix_owners: Dict[str, str] = {}
+        self._kv_block_size = 0
 
     def __reduce__(self):
         # Handles cross process boundaries by RECONSTRUCTION, not state
@@ -180,6 +185,10 @@ class DeploymentHandle:
                     return
                 raise
         with self._lock:
+            # Owner map updates on EVERY refresh (replicas publish new
+            # prefixes without a routing-version bump).
+            self._prefix_owners = routing.get("prefix_owners") or {}
+            self._kv_block_size = int(routing.get("kv_block_size") or 0)
             if routing["version"] != self._version or force:
                 names = routing["replicas"]
                 self._replicas = {}
@@ -192,7 +201,52 @@ class DeploymentHandle:
                                      for n in self._replicas}
                 self._version = routing["version"]
 
-    def _pick_replica(self, exclude: Optional[str] = None):
+    def _prefix_hint(self, args, kwargs):
+        """Prefix-affinity routing input: the replica (if any) that owns
+        registered KV blocks for this request's longest aligned token
+        prefix.  Returns (owner_or_None, applicable) — `applicable` is
+        True when the request was token-shaped and the registry had a
+        block size to align against (so the caller can count
+        remote_prefix_hit/miss only for requests that could match)."""
+        from ray_tpu.core.config import get_config
+
+        if not get_config().serve_prefix_registry_enabled:
+            return None, False
+        with self._lock:
+            owners = dict(self._prefix_owners)
+            bs = self._kv_block_size
+        req = (args[0] if args and isinstance(args[0], dict)
+               else kwargs.get("request"))
+        tokens = (req or {}).get("tokens") if isinstance(req, dict) else None
+        if not tokens or not isinstance(tokens, (list, tuple)) or not bs:
+            return None, False
+        if not owners:
+            return None, True
+        from ray_tpu.serve.disagg import request_digests
+
+        # Longest covered prefix first: route to the replica holding
+        # the deepest warm chain.
+        for _, digest in request_digests(list(tokens), bs):
+            rid = owners.get(digest)
+            if rid:
+                return rid, True
+        return None, True
+
+    def _count_prefix_route(self, prefer, applicable, pick) -> None:
+        if not applicable:
+            return
+        try:
+            from ray_tpu.serve import observability
+
+            observability.count_kv_event(
+                self._app, "remote_prefix_hit"
+                if prefer is not None and pick == prefer
+                else "remote_prefix_miss")
+        except Exception:  # noqa: BLE001 best-effort telemetry
+            pass
+
+    def _pick_replica(self, exclude: Optional[str] = None,
+                      prefer: Optional[str] = None):
         deadline = time.monotonic() + 30
         while True:
             # Sample and index under one lock hold — a concurrent _refresh
@@ -216,6 +270,16 @@ class DeploymentHandle:
                                     (self._outstanding.get(n, 0)
                                      for n in names), default=0):
                                 pick = cand
+                    # Prefix affinity: the replica already holding this
+                    # request's KV blocks skips the prefill entirely —
+                    # worth following unless it is clearly overloaded
+                    # (same guard as model affinity).
+                    if pick is None and prefer in names:
+                        load = self._outstanding.get(prefer, 0)
+                        if load <= 2 + min(
+                                (self._outstanding.get(n, 0)
+                                 for n in names), default=0):
+                            pick = prefer
                     if pick is None:
                         if len(names) == 1:
                             pick = names[0]
@@ -259,10 +323,12 @@ class DeploymentHandle:
         request_id = kwargs.pop("_request_id", None) or uuid.uuid4().hex
         ctx = kwargs.pop("_trace", None) or tracing.serve_ctx(request_id)
         self._refresh()
+        prefer, applicable = self._prefix_hint(args, kwargs)
         with tracing.serve_span(ctx, "serve.handle.route",
                                 app=self._app, method=self._method) as s:
-            name, replica = self._pick_replica()
+            name, replica = self._pick_replica(prefer=prefer)
             trace = tracing.child_ctx(ctx, s)
+        self._count_prefix_route(prefer, applicable, name)
         self._push_stats()
         # Mutable cell: retries re-route to a new replica; on_done must
         # decrement whichever replica CURRENTLY carries the request.
@@ -307,10 +373,12 @@ class DeploymentHandle:
         request_id = kwargs.pop("_request_id", None) or uuid.uuid4().hex
         ctx = kwargs.pop("_trace", None) or tracing.serve_ctx(request_id)
         self._refresh()
+        prefer, applicable = self._prefix_hint(args, kwargs)
         with tracing.serve_span(ctx, "serve.handle.route",
                                 app=self._app, method=self._method) as s:
-            name, replica = self._pick_replica()
+            name, replica = self._pick_replica(prefer=prefer)
             trace = tracing.child_ctx(ctx, s)
+        self._count_prefix_route(prefer, applicable, name)
         self._push_stats()
         # Mutable cell: failovers re-route to a new replica; on_done must
         # decrement whichever replica CURRENTLY carries the stream.

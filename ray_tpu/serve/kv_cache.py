@@ -20,9 +20,22 @@ without a store (standalone, unit tests) skip the arena.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def prefix_digest(tokens) -> str:
+    """Stable cluster-wide digest of a cumulative token prefix.  Keyed
+    on the raw token values (not positions), so two replicas that
+    prefilled the same prompt prefix — or a prefill actor that shipped
+    it — derive the SAME digest and the prefix registry can match them
+    without ever moving token lists through the GCS."""
+    h = hashlib.sha1()
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.hexdigest()[:16]
 
 
 class KVBlockAllocator:
@@ -53,6 +66,9 @@ class KVBlockAllocator:
         # 0) entries is the eviction LRU.
         self._by_key: Dict[tuple, int] = {}
         self._key_of: Dict[int, tuple] = {}
+        # key -> cluster-stable digest (computed once at registration;
+        # the gauge loop publishes these to the cluster prefix registry).
+        self._digest_of: Dict[tuple, str] = {}
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         # full-prompt key -> metadata (last-token logits) so a whole-
         # prompt hit can sample its first token without any forward.
@@ -94,6 +110,7 @@ class KVBlockAllocator:
         if key is not None:
             self._by_key.pop(key, None)
             self._meta.pop(key, None)
+            self._digest_of.pop(key, None)
         self.stats["evictions"] += 1
         return blk
 
@@ -232,8 +249,44 @@ class KVBlockAllocator:
         if prev_key is not None and prev_key != key:
             self._by_key.pop(prev_key, None)
             self._meta.pop(prev_key, None)
+            self._digest_of.pop(prev_key, None)
         self._by_key[key] = blk
         self._key_of[blk] = key
+        self._digest_of[key] = prefix_digest(key)
+
+    def adopt(self, tokens: List[int], meta: Any = None
+              ) -> Optional[List[int]]:
+        """Adopt-path for KV frames received over the transfer plane
+        (disaggregated prefill handoff / live migration): allocate
+        blocks covering ``tokens``, register them as a reusable prefix,
+        and return the block ids STILL REFERENCED — the engine scatters
+        the received frame into them on-device, then calls ``free`` to
+        park them cached-free (contents intact, LRU-evictable).  The
+        next lookup of the prompt walks the normal prefix-hit path with
+        zero recompute.  None when the pool can't cover the frame (the
+        caller falls back to recompute)."""
+        if not self.prefix_sharing or not tokens:
+            return None
+        bs = self.block_size
+        need = -(-len(tokens) // bs)
+        blocks = self.alloc(need)
+        if blocks is None:
+            return None
+        self.register_prefix(tokens, blocks, meta=meta)
+        return blocks
+
+    def prefix_digests(self, limit: int = 0) -> List[str]:
+        """Digests of the block-ALIGNED registered prefixes (the
+        publishable half of the prefix map: whole-prompt partial-tail
+        keys stay local — a remote replica can only splice aligned
+        chains into a longer prompt).  Most-recently-registered last;
+        ``limit`` > 0 keeps the newest that many (gauge-payload bound)."""
+        with self._lock:
+            out = [d for k, d in self._digest_of.items()
+                   if len(k) % self.block_size == 0]
+        if limit > 0 and len(out) > limit:
+            out = out[-limit:]
+        return out
 
     def unregister_block(self, blk: int) -> None:
         """Drop a block's prefix key (its content is about to diverge
@@ -243,6 +296,7 @@ class KVBlockAllocator:
             if key is not None:
                 self._by_key.pop(key, None)
                 self._meta.pop(key, None)
+                self._digest_of.pop(key, None)
             self._cached.pop(blk, None)
 
     def cow(self, blk: int) -> Tuple[int, bool]:
@@ -265,6 +319,7 @@ class KVBlockAllocator:
                     key = self._key_of.pop(blk)
                     self._by_key.pop(key, None)
                     self._meta.pop(key, None)
+                    self._digest_of.pop(key, None)
                     return blk, False
             new = self._free.popleft() if self._free \
                 else self._evict_cached()
@@ -297,6 +352,7 @@ class KVBlockAllocator:
                 "blocks_cached": cached,
                 "blocks_active": active,
                 "occupancy": round(active / usable, 4) if usable else 0.0,
+                "prefixes_registered": len(self._by_key),
                 "arena_bytes": self.arena_bytes,
                 **self.stats,
             }

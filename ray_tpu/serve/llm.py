@@ -691,11 +691,18 @@ class PagedLLMEngine(_EngineBase):
         self._pending_lock = threading.Lock()
         self._work = threading.Event()
         self._stop = False
+        # Serializes whole engine ticks against the foreign-thread KV
+        # surface (import_prefix / export_streams): those read and
+        # replace self.cache, which a mid-tick decode would otherwise
+        # race.  Uncontended cost is one lock per tick.
+        self._tick_lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
                       "ttft_sum": 0.0, "completed": 0,
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefill_chunks": 0, "queue_waits": 0,
-                      "preemptions": 0}
+                      "preemptions": 0, "adopted_blocks": 0,
+                      "migrated_blocks": 0, "migrate_fallbacks": 0,
+                      "disagg_prefills": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -1072,15 +1079,95 @@ class PagedLLMEngine(_EngineBase):
 
     def _loop(self):
         while not self._stop:
-            progressed = False
-            # Admit as many waiting requests as slots + blocks allow.
-            while self._admit_one():
-                progressed = True
-            progressed |= self._decode_tick()
-            progressed |= self._prefill_tick()
+            with self._tick_lock:
+                progressed = False
+                # Admit as many waiting requests as slots + blocks allow.
+                while self._admit_one():
+                    progressed = True
+                progressed |= self._decode_tick()
+                progressed |= self._prefill_tick()
             if not progressed:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
+
+    # -- disaggregated serving / live migration -------------------------
+    def import_prefix(self, tokens: List[int], kv, block_size: int,
+                      last_logits=None) -> int:
+        """Adopt a KV frame computed by ANOTHER engine (a dedicated
+        prefill actor's handoff, or a draining replica's live-migration
+        export) into this engine's block pool: allocate blocks, scatter
+        the frame on-device, register the prefix, park the blocks
+        cached-free.  The next admission of a prompt starting with
+        ``tokens`` walks the ordinary prefix-hit path — zero recompute.
+
+        Returns the number of blocks imported; 0 when the frame can't
+        be adopted (geometry mismatch, pool exhausted, sharing off) —
+        the caller falls back to recompute.  Thread-safe against the
+        engine loop (tick lock)."""
+        import numpy as np
+
+        from ray_tpu.models.decoding import scatter_blocks
+
+        kv = np.asarray(kv)
+        n_need = -(-len(tokens) // self.block_size)
+        if (block_size != self.block_size or kv.ndim != 6
+                or kv.shape[0] != 2
+                or kv.shape[1:] != (self.cfg.n_layers, kv.shape[2],
+                                    self.block_size, self.cfg.n_kv_heads,
+                                    self.cfg.head_dim)
+                or kv.shape[2] < n_need):
+            return 0
+        meta = (self._jnp.asarray(last_logits)
+                if last_logits is not None else None)
+        with self._tick_lock:
+            blocks = self.allocator.adopt(tokens, meta=meta)
+            if blocks is None:
+                return 0
+            self.cache = scatter_blocks(self.cache, blocks,
+                                        kv[:, :, :len(blocks)])
+            # Our allocation reference retires; registered blocks park
+            # cached-free with contents intact, exactly like a finished
+            # request's published prefix.
+            self.allocator.free(blocks)
+            return len(blocks)
+
+    def export_streams(self) -> List[Dict[str, Any]]:
+        """Snapshot every in-flight DECODING stream as a migration
+        ticket: the context tokens whose KV is already written (the
+        last emitted token's KV is pending as the next decode input, so
+        it stays out) plus the device frame of the covering blocks.
+        The receiving engine `import_prefix`s the frame and the
+        handle's resume protocol re-admits prompt+emitted — which then
+        prefix-hits the imported chain and recomputes at most one
+        partial block instead of the whole context.  Exact KV roundtrip
+        keeps a greedy stream's continuation byte-identical to never
+        having moved."""
+        import jax
+        import numpy as np
+
+        from ray_tpu.models.decoding import gather_blocks
+
+        out: List[Dict[str, Any]] = []
+        bs = self.block_size
+        with self._tick_lock:
+            for i, req in enumerate(self._slots):
+                if req is None or req.prefilling or req.token_q is None:
+                    continue
+                rid = (req.trace or {}).get("trace_id")
+                if not rid:
+                    continue  # untraceable: recompute fallback applies
+                n_kv = int(self._lengths[i])
+                ctx = req.prompt + req.out_tokens
+                n_kv = min(n_kv, len(ctx))
+                nb = min(len(req.blocks), -(-n_kv // bs)) if n_kv else 0
+                if nb <= 0:
+                    continue
+                frame = np.asarray(jax.device_get(
+                    gather_blocks(self.cache, req.blocks[:nb])))
+                out.append({"request_id": rid,
+                            "tokens": list(ctx[:n_kv]),
+                            "block_size": bs, "kv": frame})
+        return out
 
 
 def dryrun_tp_serving(cfg, tp: int, *, timeout: float = 45.0) -> None:
@@ -1122,6 +1209,8 @@ class LLMDeployment:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache_size: int = 4, speculation_k: int = 0,
                  tensor_parallel: int = 0,
+                 prefix_sharing: Optional[bool] = None,
+                 disagg: Optional[bool] = None,
                  params_loader: Optional[Callable] = None):
         """`cfg_name`: a registry name (ray_tpu.models.configs) or a
         TransformerConfig instance — e.g. the config half of
@@ -1164,15 +1253,57 @@ class LLMDeployment:
             self.engine = PagedLLMEngine(
                 cfg, params, num_slots=num_slots, max_len=max_len,
                 block_size=block_size, num_blocks=num_blocks,
-                prefill_chunk=prefill_chunk, seed=seed, store=store)
+                prefill_chunk=prefill_chunk, seed=seed,
+                prefix_sharing=prefix_sharing, store=store)
         else:
             self.engine = LLMEngine(cfg, params, num_slots=num_slots,
                                     max_len=max_len,
                                     prefix_cache_size=prefix_cache_size,
                                     speculation_k=speculation_k, mesh=mesh)
+        # Disaggregated serving: this replica decodes; chunked prefill
+        # of long prompts offloads to dedicated prefill actors whose
+        # finished KV blocks ship back as frames (serve/disagg.py).
+        from ray_tpu.core.config import get_config
+
+        if disagg is None:
+            disagg = get_config().serve_disagg_enabled
+        self._disagg = None
+        self.disagg_role = "unified"
+        # Prefill actors re-derive weights from (cfg, seed); a custom
+        # params_loader would hand them different weights than this
+        # replica decodes with — KV frames would silently mismatch.
+        if disagg and engine == "paged" and params_loader is None:
+            from ray_tpu.serve.disagg import DisaggPrefillClient
+
+            self._disagg = DisaggPrefillClient(
+                cfg_name=cfg_name, seed=seed,
+                block_size=self.engine.block_size,
+                max_len=max_len)
+            self.disagg_role = "decode"
+
+    def set_serve_context(self, app: str, replica_id: str) -> None:
+        """Replica-actor hook: lets the disagg client tag its prefill
+        actors' gauge pushes with the hosting app."""
+        if self._disagg is not None:
+            self._disagg.set_serve_context(app, replica_id)
+
+    def _maybe_offload_prefill(self, tokens) -> None:
+        """Disagg hot path: a long prompt whose KV this replica doesn't
+        already hold prefills on a dedicated prefill actor; the finished
+        blocks ship back as a frame and import into the local pool, so
+        the engine's own admission sees a whole-prompt prefix hit and
+        the decode loop never runs the long prefill chunks.  Any
+        failure (actor down, pool full) degrades to local prefill."""
+        if self._disagg is None:
+            return
+        try:
+            self._disagg.prefill_into(self.engine, list(tokens))
+        except Exception:  # noqa: BLE001 degrade to local prefill
+            pass
 
     def __call__(self, request: dict,
                  _serve_trace: Optional[dict] = None) -> dict:
+        self._maybe_offload_prefill(request["tokens"])
         toks = self.engine.generate(
             request["tokens"],
             max_tokens=int(request.get("max_tokens", 32)),
@@ -1192,6 +1323,8 @@ class LLMDeployment:
         continuation — no duplicated or re-generated tokens."""
         resume = [it["token"] for it in (_serve_resume or {}).get(
             "items", []) if isinstance(it, dict) and "token" in it]
+        if not resume:
+            self._maybe_offload_prefill(request["tokens"])
         for tok in self.engine.generate_stream(
                 request["tokens"],
                 max_tokens=int(request.get("max_tokens", 32)),
@@ -1202,6 +1335,47 @@ class LLMDeployment:
 
     def stats(self, _request: Optional[dict] = None) -> dict:
         return self.engine.engine_stats()
+
+    def serve_state(self) -> dict:
+        """Replica gauge-loop hook: disagg role + the digests of this
+        engine's registered (aligned) prefixes.  Rides the existing
+        report_serve_gauges/syncer push into the GCS-resident prefix
+        registry (no new RPC plane); the handle's prefix-affinity
+        routing reads the merged owner map back out of controller
+        routing state."""
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        state: dict = {"role": self.disagg_role}
+        alloc = getattr(self.engine, "allocator", None)
+        if alloc is not None and cfg.serve_prefix_registry_enabled:
+            state["block_size"] = int(self.engine.block_size)
+            state["prefixes"] = alloc.prefix_digests(
+                limit=cfg.serve_prefix_registry_max_entries)
+        return state
+
+    def adopt_kv(self, tokens, kv, block_size: int, last_logits=None,
+                 source: str = "migrate") -> int:
+        """Import a shipped KV frame (migration ticket / disagg handoff)
+        into the hosted engine's pool.  Raises KVMigrationError when the
+        engine can't adopt it — the caller's recompute fallback takes
+        over.  Returns the number of blocks imported."""
+        from ray_tpu.exceptions import KVMigrationError
+
+        imp = getattr(self.engine, "import_prefix", None)
+        if imp is None:
+            raise KVMigrationError(
+                reason="engine has no paged block pool to adopt into")
+        n = imp(tokens, kv, block_size, last_logits=last_logits)
+        if n <= 0:
+            raise KVMigrationError(
+                reason=f"import_prefix rejected frame "
+                       f"({len(tokens)} tokens, block_size "
+                       f"{block_size})")
+        key = ("migrated_blocks" if source == "migrate"
+               else "adopted_blocks")
+        self.engine.stats[key] += n
+        return n
 
     def engine_gauges(self) -> dict:
         """Replica gauge hook: the Replica actor piggybacks these on the

@@ -101,6 +101,12 @@ class Replica:
         else:
             self._callable = cls_or_fn
             self._is_func = True
+        ctx_hook = getattr(self._callable, "set_serve_context", None)
+        if callable(ctx_hook):
+            try:
+                ctx_hook(self._app, replica_id)
+            except Exception:  # noqa: BLE001 context is best-effort
+                pass
         self._gauge_stop = threading.Event()
         threading.Thread(target=self._gauge_loop, daemon=True).start()
 
@@ -140,10 +146,19 @@ class Replica:
                 eng = getattr(self._callable, "engine", None)
                 if eng is not None and hasattr(eng, "engine_stats"):
                     observability.mirror_engine(eng, app)
+                # Disagg role + published prefix digests ride the same
+                # push (the cluster-wide prefix registry's write side).
+                state = None
+                sthook = getattr(self._callable, "serve_state", None)
+                if callable(sthook):
+                    try:
+                        state = sthook() or None
+                    except Exception:  # noqa: BLE001
+                        state = None
                 daemon.call("NodeDaemon", "report_serve_gauges",
                             app=app, replica=self.replica_id,
                             gauges=gauges, metrics=registry_dump(),
-                            timeout=2)
+                            state=state, timeout=2)
             except Exception:  # noqa: BLE001 best-effort telemetry
                 continue
 
@@ -228,6 +243,8 @@ class Replica:
         exactly-once continuation."""
         self._check_admission()
         self._total += 1
+        if resume and resume.get("request_id"):
+            self._maybe_adopt_migration(resume)
         # Trace continuity across failover: a resumed stream keeps the
         # ORIGINAL request id as its trace id (the resume dict carries
         # it) so the whole request renders as one perfetto track; the
@@ -265,6 +282,60 @@ class Replica:
                                      ctx=trace, resumed=bool(resume))
         self._ongoing += 1
         return sid
+
+    # -- live KV migration (serve/disagg.py) ----------------------------
+    def _maybe_adopt_migration(self, resume: dict) -> None:
+        """Warm-migration consume side: a draining replica published
+        this stream's KV blocks as a ticket keyed by request id; adopt
+        them into the local engine BEFORE the resumed context re-admits,
+        so the engine's prefix hit covers the shipped blocks and
+        recompute shrinks to the un-shipped tail.  Every failure path
+        degrades to the ordinary recompute-as-extended-prompt resume."""
+        from ray_tpu.core.config import get_config
+
+        if not get_config().serve_kv_migrate_enabled:
+            return
+        adopt = getattr(self._callable, "adopt_kv", None)
+        if not callable(adopt):
+            return
+        eng = getattr(self._callable, "engine", None)
+        try:
+            from ray_tpu.serve import observability
+            from ray_tpu.serve.disagg import consume_migration_ticket
+
+            ticket = consume_migration_ticket(resume["request_id"])
+            if ticket is None:
+                return
+            adopt(ticket["tokens"], ticket["kv"], ticket["block_size"],
+                  source="migrate")
+            observability.observe_kv_migrate(
+                self._app, max(0.0, time.time()
+                               - float(ticket.get("ts") or time.time())))
+        except Exception:  # noqa: BLE001 KVMigrationError / transport
+            if eng is not None and hasattr(eng, "stats"):
+                try:
+                    eng.stats["migrate_fallbacks"] += 1
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _export_migration_tickets(self) -> int:
+        """Warm-migration publish side (drain path): snapshot every
+        in-flight engine stream's KV blocks into GCS-KV tickets so the
+        survivors can adopt instead of recompute."""
+        from ray_tpu.core.config import get_config
+
+        if not get_config().serve_kv_migrate_enabled:
+            return 0
+        eng = getattr(self._callable, "engine", None)
+        exp = getattr(eng, "export_streams", None)
+        if not callable(exp):
+            return 0
+        try:
+            from ray_tpu.serve.disagg import publish_migration_tickets
+
+            return publish_migration_tickets(self.replica_id, exp())
+        except Exception:  # noqa: BLE001 degrade to recompute resume
+            return 0
 
     def stream_next(self, stream_id: str, max_items: int = 32,
                     timeout_s: float = 1.0) -> dict:
@@ -318,12 +389,28 @@ class Replica:
         migrate-by-recompute through the handle's stream-resume path.
         Self-terminating: a controller that dies right after sending the
         drain RPC leaks no orphan replica."""
-        if timeout_s is None:
-            from ray_tpu.core.config import get_config
+        from ray_tpu.core.config import get_config
 
-            timeout_s = get_config().serve_drain_timeout_s
+        knobs = get_config()
+        if timeout_s is None:
+            timeout_s = knobs.serve_drain_timeout_s
         first = not self._draining
         self._draining = True
+        migrated = 0
+        if first and self._streams and knobs.serve_kv_migrate_enabled:
+            # Live migration: publish every in-flight stream's KV blocks
+            # as tickets, then fail the streams with the typed draining
+            # error — attached clients drain what's already queued, hit
+            # the error, and the handle's resume path re-admits them on
+            # a survivor that adopts the shipped blocks (recompute stays
+            # the fallback for anything without a ticket).
+            migrated = self._export_migration_tickets()
+            from ray_tpu.exceptions import ReplicaDrainingError
+
+            for st in list(self._streams.values()):
+                st.error = ReplicaDrainingError(self.replica_id)
+                st.cancelled.set()
+                st.finished.set()
 
         def reaper():
             import os
@@ -333,12 +420,17 @@ class Replica:
                 if self._ongoing <= 0 and not self._streams:
                     break
                 time.sleep(0.1)
+            # Linger so in-flight stream_next RPCs observe the typed
+            # draining error (and fresh tickets get consumed) before
+            # the process exits out from under them.
+            if migrated:
+                time.sleep(max(0.0, knobs.serve_kv_migrate_linger_s))
             self._gauge_stop.set()
             os._exit(0)
 
         if first:
             threading.Thread(target=reaper, daemon=True).start()
-        return self.stats()
+        return dict(self.stats(), migrated_tickets=migrated)
 
     def stats(self) -> dict:
         return {"replica_id": self.replica_id, "ongoing": self._ongoing,

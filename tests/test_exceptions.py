@@ -101,6 +101,9 @@ def test_build_instance_fills_required_params():
         (rexc.ActorDiedError, {"actor_id": "a" * 12, "reason": "oom"},
          ("actor_id", "reason")),
         (rexc.ReplicaDrainingError, {"replica_id": "rep-3"}, ("replica_id",)),
+        (rexc.KVMigrationError,
+         {"request_id": "req-9", "reason": "shape mismatch"},
+         ("request_id", "reason")),
         (rexc.ObjectLostError, {"object_id": "o" * 12, "message": "gone"},
          ("object_id",)),
     ],
