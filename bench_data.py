@@ -1,0 +1,243 @@
+"""Data-plane benchmarks for the streaming Dataset executor (ISSUE 17).
+
+Probes (each prints one JSON line, all saved to BENCH_DATA_r{N}.json via
+tools/record_data_bench.py):
+
+  shuffle_transfer  The all-to-all byte movement of a shuffle round on
+                    an in-proc daemon cluster (virtual_node.py — real
+                    stores, real raw/RPC servers, no worker processes).
+                    Legacy = every mapper→reducer partition is its own
+                    pickled object pulled point-to-point over
+                    `stream_pull_object` and assembled on the reducer
+                    heap (the pre-17 dataset.random_shuffle wire
+                    shape, N² small transfers). Streaming = mappers
+                    seal ONE offset-addressed bundle each and reducers
+                    range-pull exactly their partition's slice over the
+                    raw-frame chunk protocol (`fetch_object_range` →
+                    daemon `get_object_chunk`). Same logical bytes
+                    moved; asserts streaming >= 2x aggregate GB/s.
+                    Also times relay-tree prestage of one bundle to
+                    every node (`broadcast_object`) — the multi-node
+                    read-local path — as an unasserted extra.
+
+  data_to_train     A synthetic train loop fed by the streaming
+                    pipeline through the device-prefetch stage
+                    (Dataset.iter_jax_batches when JAX is importable,
+                    device_prefetching over numpy otherwise): fixed
+                    per-step compute, wall-clocked end to end. Asserts
+                    the step loop is >= 90% busy — i.e. the pipeline +
+                    double-buffered feed hides (de)serialization and
+                    host->device behind compute.
+
+Usage: python bench_data.py [--quick] [--only p1,p2] [--out PATH]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+RESULTS = []
+
+
+def emit(metric: str, value: float, unit: str, baseline: float = None,
+         **extra) -> None:
+    rec = {"metric": metric, "value": round(value, 3), "unit": unit,
+           "vs_baseline": round(value / baseline, 3) if baseline else None}
+    rec.update(extra)
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def bench_shuffle_transfer(quick: bool) -> None:
+    import asyncio
+
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.core.distributed.transfer import (RawChunkFetcher,
+                                                   fetch_object_range)
+    from ray_tpu.core.distributed.virtual_node import InProcDaemonCluster
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.data.streaming import shuffle as sh
+
+    n = 4                                    # mappers == reducers == nodes
+    part = (4 if quick else 16) << 20        # one reducer partition
+    bundle_size = sh.header_size(n) + n * part
+    moved = n * (n - 1) * part               # cross-node bytes, both paths
+
+    async def run():
+        vc = InProcDaemonCluster(n, store_capacity=4 * bundle_size)
+        await vc.start()
+        fetcher = RawChunkFetcher()
+        clients = {}
+        try:
+            seed = os.urandom(1 << 20)
+            part_bytes = (seed * (part // len(seed) + 1))[:part]
+            bundle = sh.pack_bundle([part_bytes] * n)
+            slots = sh.parse_header(bundle)
+            bundles, parts = [], {}
+            for i, d in enumerate(vc.daemons):
+                oid = ObjectID(os.urandom(20))
+                d.store.put_raw(oid, bundle)
+                bundles.append(oid)
+                # Legacy wire shape: each partition is its OWN object.
+                for j in range(n):
+                    po = ObjectID(os.urandom(20))
+                    d.store.put_raw(po, part_bytes)
+                    parts[(i, j)] = po
+                clients[i] = AsyncRpcClient(d.server.address)
+
+            # -- legacy: N^2 pickled point-to-point pulls, heap join --
+            async def legacy_reduce(j):
+                for i, d in enumerate(vc.daemons):
+                    if i == j:
+                        continue
+                    chunks = []
+                    async for item in clients[i].stream(
+                            "NodeDaemon", "stream_pull_object",
+                            object_id=parts[(i, j)].binary(),
+                            timeout=600):
+                        if item.get("missing"):
+                            raise RuntimeError("partition vanished")
+                        chunks.append(item["data"])
+                    data = b"".join(chunks)
+                    assert len(data) == part
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[legacy_reduce(j) for j in range(n)])
+            dt_old = time.perf_counter() - t0
+
+            # -- streaming: raw range pulls of the bundle slices ------
+            async def range_reduce(j):
+                off, ln = slots[j]
+                for i, d in enumerate(vc.daemons):
+                    if i == j:
+                        continue
+                    res = await fetch_object_range(
+                        d.server.address, bundles[i].binary(), off, ln,
+                        fetcher)
+                    assert res is not None
+                    total, view = res
+                    assert total == bundle_size and len(view) == ln
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[range_reduce(j) for j in range(n)])
+            dt_new = time.perf_counter() - t0
+
+            # -- extra: relay-tree prestage of one bundle to all ------
+            t0 = time.perf_counter()
+            rep = await clients[0].call(
+                "NodeDaemon", "broadcast_object",
+                object_id=bundles[0].binary(),
+                targets=[d.server.address for d in vc.daemons[1:]],
+                timeout=600)
+            dt_bcast = time.perf_counter() - t0
+            assert rep["ok"] and rep["nodes"] == n - 1, rep
+        finally:
+            for c in clients.values():
+                await c.close()
+            fetcher.close()
+            await vc.stop()
+        return dt_old, dt_new, dt_bcast
+
+    dt_old, dt_new, dt_bcast = asyncio.run(run())
+    gbps_old = moved / dt_old / 1e9
+    gbps_new = moved / dt_new / 1e9
+    emit("shuffle_transfer_gbps", gbps_new, "GB/s", baseline=gbps_old,
+         nodes=n, partition_mib=part >> 20, moved_mib=moved >> 20)
+    emit("shuffle_transfer_legacy_gbps", gbps_old, "GB/s",
+         nodes=n, moved_mib=moved >> 20)
+    emit("shuffle_prestage_gbps",
+         bundle_size * (n - 1) / dt_bcast / 1e9, "GB/s",
+         bundle_mib=bundle_size >> 20, nodes=n)
+    assert gbps_new >= 2.0 * gbps_old, (
+        f"streaming shuffle transfer {gbps_new:.2f} GB/s < 2x legacy "
+        f"{gbps_old:.2f} GB/s")
+
+
+def bench_data_to_train(quick: bool) -> None:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+
+    rows = 40_000 if quick else 160_000
+    batch = 1024
+    step_s = 0.005                       # fixed synthetic compute per step
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        ds = (rd.range(rows, parallelism=8)
+              .map_batches(lambda b: {"x": b["id"].astype(np.float32)},
+                           batch_format="numpy"))
+        try:
+            import jax  # noqa: F401 — full host->device feed when present
+
+            feed = ds.iter_jax_batches(batch_size=batch, drop_last=True)
+        except Exception:  # noqa: BLE001 — numpy double-buffer fallback
+            from ray_tpu.data.streaming.prefetch import device_prefetching
+
+            feed = device_prefetching(
+                ds.iter_batches(batch_size=batch, batch_format="numpy",
+                                drop_last=True),
+                lambda b: {k: np.ascontiguousarray(v)
+                           for k, v in b.items()},
+                name="bench")
+
+        first = next(iter_ := iter(feed))    # warmup outside the clock
+        assert np.asarray(first["x"]).shape[0] == batch
+        steps, busy = 0, 0.0
+        t_wall = time.perf_counter()
+        for b in iter_:
+            t0 = time.perf_counter()
+            # The "train step": fixed-duration compute on the batch.
+            x = np.asarray(b["x"])
+            acc = 0.0
+            while time.perf_counter() - t0 < step_s:
+                acc += float(x[:64].sum())
+            busy += time.perf_counter() - t0
+            steps += 1
+        wall = time.perf_counter() - t_wall
+    finally:
+        ray_tpu.shutdown()
+
+    frac = busy / wall
+    expected = rows // batch - 1
+    assert steps >= expected - 1, (steps, expected)
+    emit("data_to_train_busy_fraction", frac, "fraction", steps=steps,
+         batch_rows=batch, step_ms=step_s * 1e3,
+         wall_seconds=round(wall, 2))
+    assert frac >= 0.90, (
+        f"train loop only {frac:.1%} busy: the streaming feed is not "
+        f"hiding data time behind compute")
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    out_path = "BENCH_DATA_r17.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    only = None
+    if "--only" in sys.argv:
+        only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
+
+    def want(probe: str) -> bool:
+        return only is None or probe in only
+
+    if want("shuffle_transfer"):
+        bench_shuffle_transfer(quick)
+    if want("data_to_train"):
+        bench_data_to_train(quick)
+
+    out = {"kind": "data", "mode": "quick" if quick else "full",
+           "host_cpus": len(os.sched_getaffinity(0)), "results": RESULTS,
+           "recorded_unix": time.time()}
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"metric": "data_suite", "value": len(RESULTS),
+                      "unit": "probes", "vs_baseline": None}))
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

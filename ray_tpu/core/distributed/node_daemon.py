@@ -2398,6 +2398,22 @@ class NodeDaemon:
         return {"total_size": total,
                 "data": Raw(view) if use_raw else bytes(view)}
 
+    async def object_info(self, object_id: bytes) -> dict:
+        """Size/seal state of a local (possibly still-arriving) object.
+        Range readers (streaming-shuffle reducers fetching one
+        partition's slice of a bundle) call this first to learn the
+        object size without pulling a byte of payload."""
+        oid = ObjectID(object_id)
+        buf = self.store.get_buffer(oid)
+        if buf is not None:
+            size = buf.size
+            buf.release()
+            return {"size": size, "sealed": True}
+        sink = self._recv_partials.get(object_id)
+        if sink is not None:
+            return {"size": sink.size, "sealed": sink.sealed}
+        return {"missing": True}
+
     async def stream_pull_object(self, object_id: bytes,
                                  raw: bool = False):
         """Chunked whole-object stream (ref: object_manager.proto Push,

@@ -494,6 +494,57 @@ def plan_broadcast_tree(targets: List[Any], fanout: int
     return plan
 
 
+async def fetch_object_range(
+    address: str,
+    oid_b: bytes,
+    offset: int,
+    length: int,
+    fetcher: "RawChunkFetcher",
+    *,
+    chunk_bytes: Optional[int] = None,
+    dest: Optional[memoryview] = None,
+) -> Optional[Tuple[int, memoryview]]:
+    """Pull an arbitrary byte range of a remote object — the range-serve
+    reuse path for streaming-shuffle bundles: a reducer fetches only its
+    partition's slice of a mapper's sealed bundle instead of the whole
+    object. Rides the same raw-frame `get_object_chunk` protocol as
+    striped_pull (the daemon serves sealed AND in-flight partials), so
+    a reducer can start on a bundle while the mapper is still writing
+    later partitions.
+
+    Returns (total_object_size, view-of-range) or None when the holder
+    does not have the object. `dest` (when given) must be at least
+    `length` bytes; the range lands there and the returned view aliases
+    it."""
+    if chunk_bytes is None:
+        from ray_tpu.core.config import get_config
+
+        chunk_bytes = get_config().object_transfer_chunk_bytes
+    own = dest is None
+    if own:
+        dest = memoryview(bytearray(length))
+    total_size: Optional[int] = None
+    got = 0
+    for off, ln in chunk_ranges(length, chunk_bytes) or [(0, 0)]:
+        res = await fetcher.fetch(address, oid_b, offset + off, ln,
+                                  dest=dest[off:off + ln] if ln else None)
+        if res is None:
+            return None
+        total_size, data = res
+        if data is not None and ln:   # small/pickled reply: copy in
+            dest[off:off + len(data)] = data[:ln]
+            got += min(len(data), ln)
+        else:
+            got += ln
+        # The daemon clamps reads at the object end; a short serve
+        # means the requested range overruns the object.
+        if offset + off + ln > total_size:
+            raise ValueError(
+                f"range [{offset}, {offset + length}) overruns object "
+                f"of {total_size} bytes")
+    return (total_size or 0), dest[:got]
+
+
 def make_transfer_metrics(tags: Dict[str, str]) -> Dict[str, Any]:
     """Per-component transfer metric handles. Instances created under
     the same name share sample storage (registry adoption); per-

@@ -1,10 +1,13 @@
 """ray_tpu.data: distributed data pipelines (reference: ray.data).
 
 Arrow blocks in the shared-memory object store, lazy plans with map-stage
-fusion, a streaming executor with bounded in-flight backpressure, and
-TPU device feeding (`Dataset.iter_jax_batches` double-buffers host→HBM).
+fusion, a byte-budgeted streaming executor over the zero-copy transfer
+plane (data/streaming — backpressure windows, relay-tree shuffle,
+elastic splits), and TPU device feeding (`Dataset.iter_jax_batches`
+keeps device_put of batch k+1 overlapping compute on batch k).
 """
 from ray_tpu.data.dataset import Dataset, GroupedData, from_block_list
+from ray_tpu.data.streaming.split import StreamingIngest
 from ray_tpu.data.read_api import (
     from_arrow, from_huggingface, from_items, from_numpy, from_pandas,
     from_torch, range, range_tensor, read_bigquery, read_binary_files,
@@ -12,7 +15,7 @@ from ray_tpu.data.read_api import (
     read_parquet, read_sql, read_text, read_tfrecords, read_webdataset)
 
 __all__ = [
-    "Dataset", "GroupedData", "from_block_list",
+    "Dataset", "GroupedData", "StreamingIngest", "from_block_list",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_huggingface", "from_torch",
     "read_parquet", "read_csv", "read_json", "read_text",

@@ -66,6 +66,31 @@ def _rebatch(block_iter: Iterable[B.Block], batch_size: int,
         yield B.to_batch(carry, batch_format)
 
 
+def _jax_feed(batch_iter: Iterator[dict], sharding, dtypes,
+              prefetch: Optional[int], name: str) -> Iterator[Any]:
+    """Shared device feed for Dataset / streaming-split iterators:
+    dtype cast + device_put behind a DevicePrefetcher of the configured
+    depth (RAY_TPU_DATA_STREAM_PREFETCH_DEPTH when `prefetch` is None)."""
+    import jax
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.data.streaming.prefetch import device_prefetching
+
+    def to_device(np_batch):
+        if dtypes:
+            np_batch = {k: v.astype(dtypes[k]) if k in dtypes else v
+                        for k, v in np_batch.items()}
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding)
+                    for k, v in np_batch.items()}
+        return {k: jax.device_put(v) for k, v in np_batch.items()}
+
+    depth = (get_config().data_stream_prefetch_depth
+             if prefetch is None else prefetch)
+    yield from device_prefetching(batch_iter, to_device, depth=depth,
+                                  name=name)
+
+
 def _torch_batches(batch_iter: Iterator[dict]) -> Iterator[dict]:
     """numpy batches → torch tensors (copying read-only shm views;
     torch needs writable memory for in-place training ops)."""
@@ -179,8 +204,22 @@ class Dataset:
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Distributed map-reduce shuffle: each block scatters rows into
         num_blocks partitions; reducers concat+permute
-        (ref: data/_internal shuffle — push-based variant not needed yet)."""
+        (ref: data/_internal shuffle — push-based variant not needed yet).
+
+        On the streaming path every mapper packs its partitions into ONE
+        offset-addressed bundle that rides the broadcast/relay trees
+        (prestaged node-local on multi-node clusters) instead of N²
+        point-to-point pickled gets — see data/streaming/shuffle.py."""
+        def streaming_ref_fn(refs):
+            from ray_tpu.data.streaming.shuffle import streaming_shuffle_refs
+
+            return streaming_shuffle_refs(refs, seed, self._name())
+
         def ref_fn(refs):
+            from ray_tpu.data.streaming import streaming_enabled
+
+            if streaming_enabled():
+                return streaming_ref_fn(refs)
             refs = list(refs)
             if not refs:
                 return refs
@@ -353,8 +392,21 @@ class Dataset:
         ingest path). Blocks are handed out first-come-first-served by a
         coordinator actor, so fast consumers take more and slow ones
         never stall the pipeline; `equal=True` instead enforces
-        round-robin handout (consumers advance in lockstep)."""
-        coord = _SplitCoordinator.remote(self, n, equal)
+        round-robin handout (consumers advance in lockstep).
+
+        On the streaming path the coordinator is the ack-based
+        StreamSplitCoordinator (data/streaming/split.py): it tracks one
+        outstanding block per consumer and supports live resplit() on
+        elastic world-size change — no epoch restart, no lost or
+        duplicated samples."""
+        from ray_tpu.data.streaming import streaming_enabled
+
+        if streaming_enabled():
+            from ray_tpu.data.streaming.split import StreamSplitCoordinator
+
+            coord = StreamSplitCoordinator.remote(self, n, equal)
+        else:
+            coord = _SplitCoordinator.remote(self, n, equal)
         return [StreamingSplitIterator(coord, i) for i in range(n)]
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
@@ -374,10 +426,26 @@ class Dataset:
     # ---------------- execution / consumption ----------------
     def to_block_refs(self) -> Iterator[Any]:
         from ray_tpu.data.stats import DatasetStats
+        from ray_tpu.data.streaming import streaming_enabled, streaming_execute
 
         self._last_stats = DatasetStats()
+        if streaming_enabled():
+            # Default path: byte-budgeted streaming operator graph over
+            # the transfer plane (RAY_TPU_DATA_STREAM_ENABLED=0 falls
+            # back to the legacy block-materializing executor).
+            try:
+                yield from streaming_execute(self._read_tasks, self._stages,
+                                             stats=self._last_stats)
+            finally:
+                from ray_tpu.data.streaming import metrics as _dm
+
+                _dm.on_execution(self._name(), self._last_stats)
+            return
         yield from execute(self._read_tasks, self._stages,
                            stats=self._last_stats)
+
+    def _name(self) -> str:
+        return getattr(self, "_label", "ds")
 
     def iter_blocks(self) -> Iterator[B.Block]:
         for ref in self.to_block_refs():
@@ -622,29 +690,16 @@ class Dataset:
     # ---------------- device feeding (TPU-specific) ----------------
     def iter_jax_batches(self, *, batch_size: int, sharding=None,
                          dtypes: Optional[dict] = None, drop_last: bool = True,
-                         prefetch: int = 2) -> Iterator[Any]:
-        """Double-buffered host→HBM feed: next batch's `device_put` is
-        issued while the current one computes (the plasma→HBM analogue of
-        the reference's iter_torch_batches + async prefetch)."""
-        import jax
-
-        def to_device(np_batch):
-            if dtypes:
-                np_batch = {k: v.astype(dtypes[k]) if k in dtypes else v
-                            for k, v in np_batch.items()}
-            if sharding is not None:
-                return {k: jax.device_put(v, sharding)
-                        for k, v in np_batch.items()}
-            return {k: jax.device_put(v) for k, v in np_batch.items()}
-
-        buf: List[Any] = []
-        for np_batch in self.iter_batches(batch_size=batch_size,
-                                          batch_format="numpy",
-                                          drop_last=drop_last):
-            buf.append(to_device(np_batch))
-            if len(buf) > prefetch:
-                yield buf.pop(0)
-        yield from buf
+                         prefetch: Optional[int] = None) -> Iterator[Any]:
+        """Pipeline-resident host→HBM feed: a background thread owns
+        batch formation + `jax.device_put` and keeps up to `prefetch`
+        device-resident batches parked, so the transfer of batch k+1
+        overlaps compute on batch k (double buffering at the default
+        depth; see data/streaming/prefetch.py)."""
+        yield from _jax_feed(
+            self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                              drop_last=drop_last),
+            sharding, dtypes, prefetch, self._name())
 
     def __repr__(self):
         names = [getattr(s, "name", "?") for s in self._stages]
@@ -722,6 +777,18 @@ class StreamingSplitIterator:
         yield from _torch_batches(self.iter_batches(
             batch_size=batch_size, batch_format="numpy",
             drop_last=drop_last))
+
+    def iter_jax_batches(self, *, batch_size: int, sharding=None,
+                         dtypes: Optional[dict] = None,
+                         drop_last: bool = True,
+                         prefetch: Optional[int] = None) -> Iterator[Any]:
+        """Device-prefetched shard feed: the train-worker counterpart of
+        Dataset.iter_jax_batches, so each elastic shard keeps device_put
+        of batch k+1 overlapping compute on batch k."""
+        yield from _jax_feed(
+            self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                              drop_last=drop_last),
+            sharding, dtypes, prefetch, f"split-{self._idx}")
 
     def iter_rows(self) -> Iterator[Any]:
         for blk in self.iter_blocks():

@@ -21,14 +21,28 @@ class StageStats:
     last_output: Optional[float] = None
     peak_queue: int = 0       # max observed operator input-queue depth
     peak_in_flight: int = 0   # max concurrently running tasks
+    # -- streaming-executor byte accounting (data/streaming) --
+    rows_out: int = 0         # rows produced by this operator
+    bytes_out: int = 0        # bytes produced (sealed block sizes)
+    stall_s: float = 0.0      # seconds submission was byte-backpressured
+    peak_inflight_bytes: int = 0  # max produced-but-unconsumed bytes
+    spilled_tasks: int = 0    # over-budget submissions via spill fallback
 
     def on_submit(self) -> None:
         self.tasks += 1
         if self.first_submit is None:
             self.first_submit = time.monotonic()
 
-    def on_output(self) -> None:
+    def on_output(self, rows: int = 0, nbytes: int = 0) -> None:
         self.last_output = time.monotonic()
+        self.rows_out += rows
+        self.bytes_out += nbytes
+
+    def on_stall(self, seconds: float) -> None:
+        self.stall_s += seconds
+
+    def on_inflight_bytes(self, n: int) -> None:
+        self.peak_inflight_bytes = max(self.peak_inflight_bytes, n)
 
     def on_queue(self, depth: int) -> None:
         self.peak_queue = max(self.peak_queue, depth)
@@ -67,10 +81,18 @@ class DatasetStats:
     def summary(self) -> str:
         lines = ["Dataset execution stats:"]
         for st in self.stages:
-            lines.append(
+            line = (
                 f"  {st.name}: {st.tasks} tasks, {st.wall_s * 1000:.0f} ms"
                 f" wall, peak in-flight {st.peak_in_flight}, "
                 f"peak queue {st.peak_queue}")
+            if st.bytes_out or st.stall_s or st.rows_out:
+                line += (
+                    f", {st.rows_out} rows / "
+                    f"{st.bytes_out / 1e6:.2f} MB out, "
+                    f"stalled {st.stall_s * 1000:.0f} ms")
+                if st.spilled_tasks:
+                    line += f", spilled {st.spilled_tasks} tasks"
+            lines.append(line)
         lines.append(
             f"  consumed: {self.consumed_rows} rows, "
             f"{self.consumed_bytes / 1e6:.2f} MB")

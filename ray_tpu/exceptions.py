@@ -179,6 +179,52 @@ class ChannelTimeoutError(ChannelError, TimeoutError):
     """Compiled-graph channel read/write timed out."""
 
 
+class DataPlaneError(RayTpuError):
+    """A streaming Dataset pipeline (data/streaming) failed in a way the
+    operator graph cannot retry internally — an operator task raised on
+    every attempt, a shuffle bundle was lost with its producer, or the
+    split coordinator died mid-epoch.  Carries the operator name so the
+    consumer-side traceback points at the stage, not the iterator.
+    Wire-typed (lossless __reduce__): it crosses the coordinator ->
+    consumer and worker -> driver wires."""
+
+    def __init__(self, message: str = "", operator: str = ""):
+        self.operator = operator
+        super().__init__(message or f"data plane failure in operator "
+                         f"{operator!r}")
+
+    def __reduce__(self):  # see TaskError.__reduce__
+        return (type(self),
+                (self.args[0] if self.args else "", self.operator))
+
+
+class BackpressureTimeout(DataPlaneError, TimeoutError):
+    """A byte-stalled operator made no forward progress for
+    ``data_stream_stall_timeout_s`` — every downstream consumer stopped
+    pulling (deadlocked sink, wedged trainer) while the operator sat at
+    its in-flight byte cap.  Raising beats stalling forever: the stall
+    seconds already accrued are in Dataset.stats().  Subclasses
+    TimeoutError so generic timeout handlers also catch it."""
+
+    def __init__(self, message: str = "", operator: str = "",
+                 waited_s: float = 0.0, inflight_bytes: int = 0):
+        self.waited_s = waited_s
+        self.inflight_bytes = inflight_bytes
+        super().__init__(
+            message or (
+                f"operator {operator!r} backpressured for "
+                f"{waited_s:.1f}s with {inflight_bytes} bytes in flight "
+                f"and no downstream progress"
+            ),
+            operator,
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.operator, self.waited_s,
+                             self.inflight_bytes))
+
+
 class StreamQueueFullError(RayTpuError):
     """A serve streaming consumer fell ``serve_stream_queue_max`` tokens
     behind and its stream was dropped (backpressure instead of unbounded

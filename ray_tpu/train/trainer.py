@@ -58,6 +58,14 @@ class DataParallelTrainer:
             return None
         shards = {}
         for name, ds in self.datasets.items():
+            shard = getattr(ds, "shard", None)
+            if callable(shard) and hasattr(ds, "coordinator"):
+                # StreamingIngest (data/streaming/split.py): ONE
+                # streaming execution shared across gang formations —
+                # a world-size change resplit()s the live coordinator
+                # mid-epoch instead of re-executing the dataset.
+                shards[name] = shard(rank, world)
+                continue
             split = getattr(ds, "split", None)
             if callable(split):
                 # No silent fallback: a failed split would hand every

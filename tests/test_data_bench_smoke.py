@@ -1,0 +1,29 @@
+"""Slow-marked smoke for bench_data.py: the data-plane probes run end to
+end at --quick scale and their acceptance asserts hold (streaming
+shuffle >= 2x legacy GB/s, train loop >= 90% busy). Excluded from
+tier-1 (-m 'not slow'); full-size numbers are recorded by
+tools/record_data_bench.py."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_data_quick_probes(tmp_path):
+    out_path = tmp_path / "bench_data_smoke.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_data.py"),
+         "--quick", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, (
+        proc.stdout[-3000:] + "\n" + proc.stderr[-3000:])
+    doc = json.loads(out_path.read_text())
+    metrics = {r["metric"]: r for r in doc["results"]}
+    assert metrics["shuffle_transfer_gbps"]["vs_baseline"] >= 2.0
+    assert metrics["data_to_train_busy_fraction"]["value"] >= 0.90

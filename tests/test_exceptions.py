@@ -106,6 +106,13 @@ def test_build_instance_fills_required_params():
          ("request_id", "reason")),
         (rexc.ObjectLostError, {"object_id": "o" * 12, "message": "gone"},
          ("object_id",)),
+        (rexc.DataPlaneError,
+         {"message": "map op died", "operator": "map:tokenize"},
+         ("operator",)),
+        (rexc.BackpressureTimeout,
+         {"operator": "shuffle", "waited_s": 12.5,
+          "inflight_bytes": 1 << 26},
+         ("operator", "waited_s", "inflight_bytes")),
     ],
 )
 def test_wire_fields_survive(cls, kwargs, fields):

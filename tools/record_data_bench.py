@@ -1,0 +1,57 @@
+"""Record bench_data.py output into BENCH_DATA_r{N}.json (round-end
+artifact; same shape as record_scale_bench.py's). Usage:
+    python tools/record_data_bench.py 17 [--quick] [--only p1,p2]
+
+Extra args pass straight through to bench_data.py — `--only
+shuffle_transfer` re-records just the all-to-all byte-movement probe
+without booting the driver cluster for the train feed.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    rnd = int(sys.argv[1])
+    args = [a for a in sys.argv[2:]]
+    path = os.path.join(REPO, f"BENCH_DATA_r{rnd:02d}.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_data.py"),
+         "--out", path, *args],
+        capture_output=True, text=True, timeout=7200)
+    results = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                results.append(json.loads(line))
+            except ValueError:
+                pass
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout[-4000:])
+        sys.stderr.write(out.stderr[-4000:])
+        raise SystemExit(f"bench_data exited {out.returncode} "
+                         f"({len(results)} metrics recorded before)")
+    doc = {
+        "round": rnd,
+        "host": {
+            "nproc": len(os.sched_getaffinity(0)),
+            "note": "timeshared VM: driver, in-proc daemons, and workers "
+                    "share the host cores; GB/s numbers compare paths on "
+                    "the SAME host, not absolute fabric bandwidth.",
+        },
+        "recorded_at_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "results": results,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path} ({len(results)} metrics)")
+
+
+if __name__ == "__main__":
+    main()
