@@ -188,6 +188,13 @@ class Config:
     # Fallback directory when /dev/shm is exhausted.
     object_spilling_dir: str = "/tmp/ray_tpu_spill"
     object_spilling_threshold: float = 0.8
+    # Large-put direct-write fast path: puts of at least this many bytes
+    # land in the store file via write() (kernel page-cache copy — no
+    # per-page fault + zero-fill like the mmap path pays, ~3x on tmpfs)
+    # and their deleted files park in the native store's bounded
+    # warm-file recycle pool for the next large create. 0 disables the
+    # fast path (always mmap+copy).
+    put_direct_min_bytes: int = 1024 * 1024
 
     # ---- ownership / lineage ----
     # Keep lineage for reconstruction while refs exist
@@ -325,6 +332,35 @@ class Config:
     # this many tokens behind has its stream dropped with an explicit
     # error instead of growing replica RSS without limit.
     serve_stream_queue_max: int = 1024
+    # Prompt-lookup speculative decoding on the paged engine: default
+    # draft window K for engines/deployments that don't pass
+    # speculation_k explicitly. 0/1 disables; >= 2 verifies K
+    # candidates (1 carried token + K-1 n-gram proposals) per tick in
+    # one width-K device call. Exact under greedy decoding.
+    serve_speculation_k: int = 0
+    # Trailing n-gram length the drafter matches against each slot's
+    # own context (prompt + generated tokens) to mine proposals.
+    serve_speculation_ngram: int = 2
+    # ---- decode on rails (PR: compiled-DAG serving hot loop) ----
+    # Stream token frames over the compiled-DAG channel plane instead of
+    # per-batch stream_next RPCs: the handle pre-creates a shm ring on
+    # its own node and the replica's stream drain runs as a pinned rails
+    # stage whose frames ride versioned channel writes (same-host mmap,
+    # cross-host RemoteChannelWriter push through the reader node's
+    # daemon). Kill switch: off => every stream admits on the ordinary
+    # RPC pull path; on-stream failures always spill there too.
+    serve_rails_enabled: bool = True
+    # Ring capacity per rails stream (bytes).
+    serve_rails_capacity_bytes: int = 1 << 20
+    # Per-replica rails lane width: concurrent pinned stream stages.
+    # Attach requests beyond this spill to the RPC pull path at
+    # admission time (never mid-stream).
+    serve_rails_max_streams: int = 32
+    # Handle-side ring poll slice; a slice that yields no frame
+    # rate-limits a replica liveness probe (serve_rails_probe_s) so a
+    # SIGKILLed replica surfaces as a resume, not a silent hang.
+    serve_rails_tick_s: float = 0.2
+    serve_rails_probe_s: float = 1.0
     # Daemon-side TTL for per-replica serve gauges: a replica that
     # stopped pushing (crash, scale-down) ages out of the syncer's
     # "serve" entry instead of pinning stale queue depth.

@@ -22,7 +22,7 @@ import threading
 import time
 import uuid
 from collections import defaultdict, deque
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -53,6 +53,105 @@ from ray_tpu.core.distributed.wire import Raw
 logger = logging.getLogger(__name__)
 
 ACTOR_STATES_TRANSIENT = ("PENDING_CREATION", "RESTARTING")
+
+
+# Byte-exact serialized None (the serializer is deterministic for None):
+# lets the hot get() path recognize a None reply without deserializing.
+_NONE_PAYLOAD = serialization.dumps(None)
+
+# One shared condition for every _LightFuture: a per-future
+# threading.Condition (an RLock + waiter deque) was a measurable slice of
+# actor-call submission at >10k calls/s on a single-core host. Waiters are
+# rare relative to futures (get() blocks on at most a handful at a time),
+# so notify_all on the shared condition wakes few threads.
+_lf_cond = threading.Condition(threading.Lock())
+
+_LF_PENDING = 0
+_LF_DONE = 1
+_LF_CANCELLED = 2
+_LF_ERROR = 3
+
+
+class _LightFuture:
+    """Minimal concurrent.futures.Future replacement for the task/actor
+    submission waiter: supports exactly the subset the submit/get paths
+    use (done/cancel/set_result/set_exception/result/add_done_callback),
+    value is always None — results travel via the inline cache / store,
+    the future only signals completion."""
+
+    __slots__ = ("_state", "_exc", "_cbs", "stream_state", "__weakref__")
+
+    def __init__(self):
+        self._state = _LF_PENDING
+        self._exc = None
+        self._cbs = None
+
+    def done(self) -> bool:
+        return self._state != _LF_PENDING
+
+    def cancelled(self) -> bool:
+        return self._state == _LF_CANCELLED
+
+    def _finish(self, state: int, exc=None) -> bool:
+        with _lf_cond:
+            if self._state != _LF_PENDING:
+                return False
+            self._exc = exc
+            self._state = state
+            _lf_cond.notify_all()
+            cbs, self._cbs = self._cbs, None
+        if cbs:
+            for cb in cbs:
+                try:
+                    cb(self)
+                except Exception:  # noqa: BLE001
+                    logger.exception("future callback failed")
+        return True
+
+    def set_result(self, _value=None) -> None:
+        self._finish(_LF_DONE)
+
+    def set_exception(self, exc) -> None:
+        self._finish(_LF_ERROR, exc)
+
+    def cancel(self) -> bool:
+        return self._finish(_LF_CANCELLED)
+
+    def exception(self, timeout=None):
+        self.result(timeout)
+        return self._exc
+
+    def add_done_callback(self, cb) -> None:
+        with _lf_cond:
+            if self._state == _LF_PENDING:
+                if self._cbs is None:
+                    self._cbs = [cb]
+                else:
+                    self._cbs.append(cb)
+                return
+        try:
+            cb(self)
+        except Exception:  # noqa: BLE001
+            logger.exception("future callback failed")
+
+    def result(self, timeout=None):
+        if self._state == _LF_PENDING:
+            with _lf_cond:
+                if timeout is None:
+                    while self._state == _LF_PENDING:
+                        _lf_cond.wait()
+                else:
+                    deadline = time.monotonic() + timeout
+                    while self._state == _LF_PENDING:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise FutureTimeoutError()
+                        _lf_cond.wait(remaining)
+        if self._state == _LF_CANCELLED:
+            raise CancelledError()
+        if self._state == _LF_ERROR:
+            raise self._exc
+        return None
 
 
 class _TaskLane:
@@ -736,8 +835,14 @@ class DistributedCoreWorker:
         self._agcs: Optional[AsyncRpcClient] = None
         # Batched directory registration (one RPC per burst, not per
         # result; ref: object location updates ride batched pubsub).
+        # Producers append under _loc_lock from any thread; only the
+        # first append of a burst pays the loop wake-up — on one-core
+        # hosts the self-pipe write alone costs ~ms under GIL contention,
+        # so a wake per put() would tax the large-put fast path.
+        self._loc_lock = threading.Lock()
         self._loc_batch: List[Tuple[bytes, int]] = []
         self._loc_flushing = False
+        self._loc_wake_pending = False
         # Per-worker-address actor push batching.
         self._push_queues: Dict[str, "deque"] = {}
         self._push_flushing: Dict[str, bool] = {}
@@ -767,6 +872,11 @@ class DistributedCoreWorker:
         self._shutdown = False
         install_refcounter(self._ref_added, self._ref_removed,
                            self._ref_serialized)
+        # Open the async GCS control connection now, off the critical
+        # path: the first put() otherwise pays TCP setup inside its
+        # location flush, which contends with the store write for the
+        # GIL on small hosts.
+        self.loop_thread.submit(self._warm_gcs())
         if is_driver:
             if log_to_driver and get_config().log_to_driver:
                 self.loop_thread.submit(self._stream_logs_to_driver())
@@ -1127,20 +1237,25 @@ class DistributedCoreWorker:
         return size
 
     def queue_location(self, oid: ObjectID, size: int) -> None:
-        """Thread-safe enqueue onto the batched location flusher."""
-        self.loop_thread.loop.call_soon_threadsafe(
-            self._loc_enqueue, oid.binary(), size)
+        """Thread-safe enqueue onto the batched location flusher.
 
-    def _loc_enqueue(self, oid_b: bytes, size: int) -> None:
-        self._loc_batch.append((oid_b, size))
-        if not self._loc_flushing:
-            self._loc_flushing = True
-            asyncio.ensure_future(self._flush_locations())
+        The entry lands in the shared batch directly; the loop is woken
+        at most once per burst (coalesced via _loc_wake_pending), so a
+        tight put() loop pays one self-pipe write, not one per object."""
+        with self._loc_lock:
+            self._loc_batch.append((oid.binary(), size))
+            if self._loc_wake_pending:
+                return
+            self._loc_wake_pending = True
+        self.loop_thread.loop.call_soon_threadsafe(self._loc_kick)
 
     async def _flush_locations(self) -> None:
         try:
-            while self._loc_batch:
-                batch, self._loc_batch = self._loc_batch, []
+            while True:
+                with self._loc_lock:
+                    if not self._loc_batch:
+                        break
+                    batch, self._loc_batch = self._loc_batch, []
                 entries = [(o, self.node_id, s) for o, s in batch]
                 gcs = await self._aget_gcs()
                 sent = False
@@ -1161,13 +1276,16 @@ class DistributedCoreWorker:
                     logger.warning(
                         "add_locations failed %d entries; retrying in 2s",
                         len(batch))
-                    self._loc_batch.extend(batch)
+                    with self._loc_lock:
+                        self._loc_batch.extend(batch)
                     self.loop_thread.loop.call_later(2.0, self._loc_kick)
                     return
         finally:
             self._loc_flushing = False
 
     def _loc_kick(self) -> None:
+        with self._loc_lock:
+            self._loc_wake_pending = False
         if self._loc_batch and not self._loc_flushing:
             self._loc_flushing = True
             asyncio.ensure_future(self._flush_locations())
@@ -1176,6 +1294,15 @@ class DistributedCoreWorker:
 
     def _cache_inline_locked(self, oid: ObjectID, payload: bytes) -> None:
         if oid not in self._inline_cache:
+            if payload == _NONE_PAYLOAD:
+                # Canonical None result: share the ONE payload object and
+                # skip the eviction ring — a burst of side-effect actor
+                # calls would otherwise churn (and spill) the ring with
+                # thousands of identical ~100-byte entries. Freed on
+                # decref like any owned inline entry, so growth stays
+                # bounded by live refs.
+                self._inline_cache[oid] = _NONE_PAYLOAD
+                return
             self._inline_cache[oid] = payload
             self._inline_cache_order.append(oid)
 
@@ -1220,6 +1347,10 @@ class DistributedCoreWorker:
             # 1) inline cache
             payload = self._inline_cache.get(oid)
             if payload is not None:
+                if payload == _NONE_PAYLOAD:
+                    # Dominant actor-call reply shape (methods returning
+                    # None): skip the per-get deserialize.
+                    return None
                 return serialization.deserialize(payload)
             # 2) local store (zero-copy)
             buf = self.store.get_buffer(oid)
@@ -2324,6 +2455,13 @@ class DistributedCoreWorker:
             self._agcs = AsyncRpcClient(self.gcs_address)
         return self._agcs
 
+    async def _warm_gcs(self) -> None:
+        """Best-effort eager connect; real calls retry lazily anyway."""
+        try:
+            await (await self._aget_gcs())._ensure_conn()
+        except Exception:  # noqa: BLE001 GCS not up yet: first call retries
+            pass
+
     def _lease_and_push(self, spec, demand, sched) -> dict:
         """Sync facade (reconstruction path runs on plain threads)."""
         return self.loop_thread.run(
@@ -2413,29 +2551,69 @@ class DistributedCoreWorker:
         num_returns = 0 if streaming else options.num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(1, num_returns + 1)]
-        fut: Future = Future()
+        fut = _LightFuture()
+        addr = self.address
+        # ONE lock round-trip registers everything the call owns: pending
+        # entries, ownership, the returned refs' counts (the refs are
+        # created _preregistered below — no per-ref _ref_added), and arg
+        # pins. Return refs are self-owned, so _ref_added's borrow branch
+        # can never apply; plain increments are equivalent.
         with self._lock:
+            pending = self._pending_objects
+            owned = self._owned
+            refcounts = self._refcounts
             for oid in return_ids:
-                self._pending_objects[oid] = fut
-                self._owned.add(oid)
-        self._pin_task_deps(deps, fut)
+                pending[oid] = fut
+                owned.add(oid)
+                refcounts[oid] += 1
+            if deps:
+                dep_oids = [ObjectID(d) for d in deps]
+                for oid in dep_oids:
+                    refcounts[oid] += 1
+        if deps:
+            def unpin(_f, dep_oids=dep_oids):
+                if self._shutdown:
+                    return
+                with self._lock:
+                    for oid in dep_oids:
+                        self._decref_locked(oid)
+
+            fut.add_done_callback(unpin)
+        # Per-(options, method) wire-options cache: the SAME dict object
+        # rides every spec for this method, so a burst batch pickles it
+        # once (pickle memoizes by identity). Nothing mutates
+        # spec["options"] driver-side; executors see a private unpickled
+        # copy.
+        wire_opts = getattr(options, "_wire_opts", None)
+        if wire_opts is None or wire_opts["name"] != method_name:
+            wire_opts = {"max_retries": options.max_task_retries,
+                         "streaming": streaming,
+                         "name": method_name}
+            options._wire_opts = wire_opts
         # seq is assigned on the loop at push time, per (actor,
         # incarnation-address) — each restarted incarnation starts at 0,
-        # so no cross-incarnation base handshake is needed.
-        spec = protocol.make_task_spec(
-            task_id=task_id.binary(), fn_key=b"", args_blob=args_blob,
-            num_returns=num_returns, caller_address=self.address,
-            job_id=self.job_id, actor_id=aid, method_name=method_name,
-            seq=-1,
-            options={"max_retries": options.max_task_retries,
-                     "streaming": streaming,
-                     "name": method_name},
-        )
+        # so no cross-incarnation base handshake is needed. Spec built as
+        # a literal (one dict op) with the submit stamp folded in — see
+        # _stamp_submit for why the stamp rides the spec.
+        spec = {
+            "task_id": task_id.binary(),
+            "fn_key": b"",
+            "args_blob": args_blob,
+            "num_returns": num_returns,
+            "caller_address": addr,
+            "job_id": self.job_id,
+            "options": wire_opts,
+            "actor_id": aid,
+            "method_name": method_name,
+            "seq": -1,
+            "attempt": 0,
+            "submit_ts": time.time(),
+            "submit_ctx": self._submit_identity,
+        }
         if get_config().tracing_enabled:
             from ray_tpu.util import tracing
 
             spec["trace_ctx"] = tracing.inject()
-        self._stamp_submit(spec)
         gen = None
         if streaming:
             # Same discovery design as streaming tasks
@@ -2466,7 +2644,8 @@ class DistributedCoreWorker:
             self.loop_thread.loop.call_soon_threadsafe(self._drain_submits)
         if streaming:
             return gen
-        return [ObjectRef(oid, self.address) for oid in return_ids]
+        return [ObjectRef(oid, addr, _preregistered=True)
+                for oid in return_ids]
 
     def _drain_submits(self) -> None:
         # Clear the flag BEFORE draining: an append racing the drain then
@@ -2625,10 +2804,16 @@ class DistributedCoreWorker:
         if addr:
             for item in batch:
                 self._task_locations[item[1]["task_id"]] = addr
+        delta = self._delta_frame(batch)
         try:
-            replies = await client.call(
-                "Worker", "push_actor_tasks",
-                specs=[item[1] for item in batch], timeout=None)
+            if delta is not None:
+                replies = await client.call(
+                    "Worker", "push_actor_tasks_delta",
+                    template=delta[0], deltas=delta[1], timeout=None)
+            else:
+                replies = await client.call(
+                    "Worker", "push_actor_tasks",
+                    specs=[item[1] for item in batch], timeout=None)
         except asyncio.CancelledError:
             # Loop shutdown: cancel the batch, don't re-park it (same
             # respawn-during-cancel-sweep hazard as _TaskLane).
@@ -2646,6 +2831,40 @@ class DistributedCoreWorker:
                 self._task_locations.pop(item[1]["task_id"], None)
         self._finish_actor_batch(batch, replies)
 
+    @staticmethod
+    def _delta_frame(batch: list) -> Optional[tuple]:
+        """Compress a same-destination burst into ONE template spec plus
+        per-call (task_id, seq, submit_ts) deltas. A tight actor-call
+        burst is N copies of the same spec differing only in those three
+        fields; shipping the template once cuts the per-call pickle/
+        unpickle and spec-dict churn on both ends of the push RPC.
+        Returns None (send full specs) for singletons or heterogeneous
+        batches — correctness never depends on the delta path."""
+        if len(batch) < 2:
+            return None
+        t = batch[0][1]
+        t_aid = t["actor_id"]
+        t_method = t["method_name"]
+        t_blob = t["args_blob"]
+        t_opts = t["options"]
+        t_nret = t["num_returns"]
+        t_attempt = t["attempt"]
+        if "trace_ctx" in t or "_push_retries" in t:
+            return None
+        deltas = [(t["task_id"], t["seq"], t["submit_ts"])]
+        for _, s, _, _, _ in batch[1:]:
+            if s["actor_id"] != t_aid \
+                    or s["method_name"] != t_method \
+                    or (s["args_blob"] is not t_blob
+                        and s["args_blob"] != t_blob) \
+                    or s["options"] is not t_opts \
+                    or s["num_returns"] != t_nret \
+                    or s["attempt"] != t_attempt \
+                    or "trace_ctx" in s or "_push_retries" in s:
+                return None
+            deltas.append((s["task_id"], s["seq"], s["submit_ts"]))
+        return t, deltas
+
     def _finish_actor_batch(self, batch: list, replies: list) -> None:
         """Complete a whole reply batch under ONE lock acquisition
         (inline-result caching + pending-object cleanup), then wake the
@@ -2656,6 +2875,16 @@ class DistributedCoreWorker:
             pending = self._pending_objects
             for (aid, spec, return_ids, fut, options), reply in zip(
                     batch, replies):
+                if type(reply) is int:
+                    # Wire-compressed single-None reply (see
+                    # worker_main.push_actor_tasks): reconstruct from our
+                    # own return ids; every such result shares the ONE
+                    # canonical payload object.
+                    oid = return_ids[0]
+                    if oid not in self._inline_cache:
+                        self._inline_cache[oid] = _NONE_PAYLOAD
+                    pending.pop(oid, None)
+                    continue
                 err = reply.get("error")
                 if isinstance(err, rexc.TaskCancelledError):
                     self._cancelled_tasks.pop(spec["task_id"], None)

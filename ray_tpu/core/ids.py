@@ -44,7 +44,10 @@ class BaseID:
                 f"{type(self).__name__} must be {self.SIZE} bytes, got {len(binary)}"
             )
         self._binary = binary
-        self._hash = hash((type(self).__name__, binary))
+        # hash(bytes) directly — no per-id (typename, binary) tuple.
+        # Different ID types sharing a hash only costs a bucket probe;
+        # __eq__ is type-exact, so correctness is unchanged.
+        self._hash = hash(binary)
 
     @classmethod
     def generate(cls) -> "BaseID":

@@ -16,11 +16,15 @@ class ObjectRef:
     __slots__ = ("_id", "_owner", "_skip_refcount", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner: Optional[str] = None,
-                 *, _skip_refcount: bool = False):
+                 *, _skip_refcount: bool = False,
+                 _preregistered: bool = False):
         self._id = object_id
         self._owner = owner  # owner address "host:port" or None for local
         self._skip_refcount = _skip_refcount
-        if not _skip_refcount:
+        # _preregistered: the creator already counted this ref (e.g. the
+        # actor-submit fast path registers all return refs under one
+        # lock) — skip the add, keep the __del__ decref.
+        if not (_skip_refcount or _preregistered):
             _refcounter_add(self)
 
     def id(self) -> ObjectID:

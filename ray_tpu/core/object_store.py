@@ -75,6 +75,8 @@ def _load_lib() -> ctypes.CDLL:
                              ctypes.POINTER(ctypes.c_uint32),
                              ctypes.POINTER(ctypes.c_uint64),
                              ctypes.POINTER(ctypes.c_uint32)]
+    lib.rts_recycle_bytes.restype = ctypes.c_uint64
+    lib.rts_recycle_bytes.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -86,6 +88,25 @@ def get_lib() -> ctypes.CDLL:
     if _lib is None:
         _lib = _load_lib()
     return _lib
+
+
+def _direct_write_min() -> int:
+    """Size floor for the large-put direct-write fast path (0 = off)."""
+    from ray_tpu.core.config import get_config
+
+    return get_config().put_direct_min_bytes
+
+
+def _write_all(fd: int, parts: List[memoryview]) -> None:
+    """Sequential write() of iovec parts into a store file at offset 0.
+    The kernel copies straight into (possibly recycled, already-warm)
+    tmpfs page cache — the mmap path's per-page fault + zero-fill never
+    happens, which is the entire win of the large-put fast path."""
+    for part in parts:
+        mv = part if part.contiguous else memoryview(bytes(part))
+        while len(mv):
+            n = os.write(fd, mv)
+            mv = mv[n:]
 
 
 class _StoreState:
@@ -411,10 +432,17 @@ class ObjectStore:
         if rc != RTS_OK:
             raise RuntimeError(f"rts_create failed: {rc}")
         try:
-            with mmap.mmap(fd.value, size) as mm:
-                view = memoryview(mm)
-                serialization.write_to(view, meta, buffers)
-                view.release()
+            if size >= _direct_write_min() > 0:
+                # Large-put fast path: hand the kernel the serialized
+                # layout as an iovec.  write() copies into the tmpfs page
+                # cache directly — no per-page fault + zero-fill like the
+                # mmap path (~3x on this host class), and still one copy.
+                _write_all(fd.value, serialization.iov_parts(meta, buffers))
+            else:
+                with mmap.mmap(fd.value, size) as mm:
+                    view = memoryview(mm)
+                    serialization.write_to(view, meta, buffers)
+                    view.release()
         except BaseException:
             os.close(fd.value)
             lib.rts_abort(self._handle, oid.binary())
@@ -447,7 +475,9 @@ class ObjectStore:
         if rc != RTS_OK:
             raise RuntimeError(f"rts_create failed: {rc}")
         try:
-            if size:
+            if size >= _direct_write_min() > 0:
+                _write_all(fd.value, [memoryview(data)])
+            elif size:
                 with mmap.mmap(fd.value, size) as mm:
                     mm[:size] = data
         except BaseException:
@@ -627,6 +657,15 @@ class ObjectStore:
     @property
     def num_objects(self) -> int:
         return get_lib().rts_num_objects(self._handle)
+
+    @property
+    def recycle_bytes(self) -> int:
+        """Bytes parked in the warm-file recycle pool (deleted large
+        objects whose tmpfs files — and faulted-in pages — are kept for
+        the next large create).  Not part of ``used``: no live object
+        backs them, but they do count toward the store's tmpfs footprint
+        and eviction drains them first."""
+        return get_lib().rts_recycle_bytes(self._handle)
 
     def disconnect(self) -> None:
         self._state.close()

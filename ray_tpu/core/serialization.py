@@ -80,8 +80,29 @@ def write_to(buf: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
     return off
 
 
-def dumps(obj: Any, *, is_error: bool = False) -> bytes:
-    meta, buffers = serialize(obj, is_error=is_error)
+_PAD64 = bytes(64)
+
+
+def iov_parts(meta: bytes, buffers: List[memoryview]) -> List[memoryview]:
+    """The serialized layout as an iovec — byte-identical to what
+    `write_to` produces, but as a list of views the store's direct-write
+    fast path hands straight to write() without materializing a
+    contiguous copy."""
+    parts = [memoryview(meta)]
+    off = len(meta)
+    for v in buffers:
+        pad = _align(off) - off
+        if pad:
+            parts.append(memoryview(_PAD64)[:pad])
+        parts.append(memoryview(v))
+        off = _align(off) + len(v)
+    return parts
+
+
+def concat(meta: bytes, buffers: List[memoryview]) -> bytes:
+    """Materialize the serialized layout as one contiguous bytes (the
+    inline-reply path; large objects should go through put_serialized /
+    iov_parts instead — no contiguous intermediate)."""
     if not buffers:
         return meta  # head + pickle, nothing to align
     out = io.BytesIO()
@@ -93,6 +114,11 @@ def dumps(obj: Any, *, is_error: bool = False) -> bytes:
         out.write(v)
         off = _align(off) + len(v)
     return out.getvalue()
+
+
+def dumps(obj: Any, *, is_error: bool = False) -> bytes:
+    meta, buffers = serialize(obj, is_error=is_error)
+    return concat(meta, buffers)
 
 
 def deserialize(data) -> Any:

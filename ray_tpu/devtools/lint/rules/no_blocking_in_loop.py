@@ -17,13 +17,22 @@ Nested *sync* ``def`` bodies are skipped (they run wherever they are
 called, e.g. executor threads or done-callbacks on resolved futures);
 nested ``async def`` are scanned as their own scope.
 
+A second scope guards the decode-on-rails hot loops (serve's compiled
+streaming path): the per-frame bodies of the replica's rails pump, the
+handle's channel pull, and the local ring's read/publish paths must stay
+RPC-free — a per-token actor round trip is exactly the overhead rails
+exist to remove.  Flagged there: ``ray_tpu.get``/``ray.get``, actor
+``.remote(...)`` submissions, and daemon/GCS ``.call(...)``.  Exception
+handlers are NOT scanned: idle-slice liveness probes and error recovery
+are off the hot path by definition, which is where such calls belong.
+
 Suppression: ``# lint: allow-blocking -- <reason>``.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from ray_tpu.devtools.lint.engine import LintContext, PyFile, Rule, Violation
 
@@ -31,6 +40,16 @@ SCOPE_PREFIX = "ray_tpu/core/distributed/"
 
 _SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "accept", "connect", "sendall"}
 _DISPATCH_METHODS = {"call_soon", "call_soon_threadsafe", "call_later", "call_at"}
+
+# file -> dotted qualnames whose bodies are rails hot loops.  The channel
+# entries pin the local ring to pure mmap+poll (RemoteChannelWriter, the
+# cross-host endpoint, is deliberately absent: its job IS the daemon RPC).
+RAILS_HOT_LOOPS: Dict[str, Set[str]] = {
+    "ray_tpu/serve/replica.py": {"Replica._rails_pump"},
+    "ray_tpu/serve/handle.py": {"StreamingResponse._rails_next"},
+    "ray_tpu/experimental/channel.py": {"Channel.read", "Channel.write",
+                                        "Channel.write_bytes"},
+}
 
 
 def _unparse(node: ast.expr) -> str:
@@ -102,6 +121,52 @@ def _walk_same_scope(body: List[ast.stmt]):
             stack.append(child)
 
 
+def _walk_hot_path(body: List[ast.stmt]):
+    """Yield nodes on a rails hot loop's per-frame path: skip nested
+    defs/classes (they run elsewhere) AND except handlers (idle-slice
+    probes / error recovery run off the hot path)."""
+    _defs = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    stack: List[ast.AST] = [n for n in body if not isinstance(n, _defs)]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Try):
+            children = list(node.body) + list(node.orelse) + list(node.finalbody)
+        else:
+            children = list(ast.iter_child_nodes(node))
+        for child in children:
+            if isinstance(child, (*_defs, ast.ExceptHandler)):
+                continue
+            stack.append(child)
+
+
+def _rpc_message(call: ast.Call) -> Optional[str]:
+    """RPC-shaped calls banned on a rails per-frame path."""
+    func = call.func
+    text = _unparse(func)
+    if text in ("ray_tpu.get", "ray.get"):
+        return (
+            "ray_tpu.get() on a rails hot loop — per-frame round trips "
+            "defeat the compiled path; move it to an idle-slice handler"
+        )
+    if isinstance(func, ast.Attribute):
+        if func.attr == "remote":
+            return (
+                f"actor RPC '{_unparse(func)}(...)' on a rails hot loop — "
+                "frames must ride the channel plane, not per-token actor "
+                "calls"
+            )
+        recv = _unparse(func.value).lower()
+        if func.attr == "call" and any(
+            k in recv for k in ("rpc", "daemon", "client", "gcs")
+        ):
+            return (
+                f"daemon/GCS RPC '{_unparse(func)}(...)' on a rails hot "
+                "loop — the local ring must stay pure mmap+poll"
+            )
+    return None
+
+
 def _blocking_message(
     call: ast.Call, sleep_aliases: Set[str], safe_results: Set[str]
 ) -> Optional[str]:
@@ -143,12 +208,17 @@ class NoBlockingInLoopRule(Rule):
     allow_token = "blocking"
     description = (
         "no time.sleep / blocking sockets / Future.result / ray_tpu.get "
-        "inside async bodies or loop-dispatched callbacks in core/distributed/"
+        "inside async bodies or loop-dispatched callbacks in "
+        "core/distributed/; no RPC round trips on the decode-on-rails "
+        "per-frame paths (serve rails pump, handle channel pull, local "
+        "ring read/publish)"
     )
 
     def check(self, ctx: LintContext) -> List[Violation]:
         out: List[Violation] = []
         for f in ctx.package_files():
+            if f.tree is not None and f.rel in RAILS_HOT_LOOPS:
+                self._scan_rails(f, RAILS_HOT_LOOPS[f.rel], out)
             if not f.rel.startswith(SCOPE_PREFIX) or f.tree is None:
                 continue
             sleep_aliases = _sleep_aliases(f.tree)
@@ -172,6 +242,48 @@ class NoBlockingInLoopRule(Rule):
                         if isinstance(arg, ast.Lambda):
                             self._scan_expr(f, arg.body, sleep_aliases, set(), out)
         return out
+
+    def _scan_rails(
+        self, f: PyFile, qualnames: Set[str], out: List[Violation]
+    ) -> None:
+        """Scan the named hot-loop bodies for RPC-shaped calls.  A listed
+        qualname that no longer resolves is itself a violation, so the
+        registry can't silently rot as functions move."""
+        found: Set[str] = set()
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qn = f"{cls.name}.{fn.name}"
+                if qn not in qualnames:
+                    continue
+                found.add(qn)
+                for node in _walk_hot_path(fn.body):
+                    if isinstance(node, ast.Call):
+                        msg = _rpc_message(node)
+                        if msg:
+                            out.append(
+                                Violation(
+                                    rule=self.name,
+                                    path=f.rel,
+                                    line=node.lineno,
+                                    message=msg,
+                                )
+                            )
+        for missing in sorted(qualnames - found):
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=f.rel,
+                    line=1,
+                    message=(
+                        f"rails hot-loop registry names {missing!r} but no "
+                        "such method exists — update RAILS_HOT_LOOPS"
+                    ),
+                )
+            )
 
     def _scan_body(
         self,

@@ -596,6 +596,94 @@ def paged_prefill_chunk(params, cache: PagedKVCache, tokens: jax.Array,
     return PagedKVCache(k=new_k, v=new_v), last
 
 
+def paged_verify_step(params, cache: PagedKVCache, cand_tokens: jax.Array,
+                      block_tables: jax.Array, lengths: jax.Array,
+                      active: jax.Array, temps: jax.Array, rng: jax.Array,
+                      cfg: TransformerConfig):
+    """Speculative verification through the block pool: K candidate
+    tokens PER SLOT in one call (the paged analogue of `verify_step` —
+    same prompt-lookup drafting, same greedy acceptance rule).
+
+    cand_tokens (S, K): column 0 is each slot's last sampled token
+    (whose KV is not yet written), columns 1..K-1 the proposals.
+    block_tables (S, B_max) / lengths (S,) are the host-side paged
+    state; each table must already cover positions up to lengths+K
+    (the engine extends tables before issuing, exactly as it does for
+    a decode burst).
+
+    Returns (cache, tok_out (S, K), accepted (S,)).  KV for ALL K
+    candidates scatters into the slot's OWN blocks at positions
+    lengths..lengths+K-1 — rejected drafts need no device rollback:
+    the engine advances lengths by accepted+1 and every paged mask
+    (kv_pos <= position) treats the stale tail as garbage until the
+    next decode overwrites it in place.  The blocks are exclusively
+    owned by construction (COW at decode start + fresh growth allocs),
+    so stale writes can never corrupt a registered/shared prefix.
+    """
+    cd = cfg.compute_dtype
+    s_count, k_w = cand_tokens.shape
+    bs = cache.k.shape[2]
+    t_w = block_tables.shape[1] * bs
+    positions = lengths[:, None] + jnp.arange(k_w, dtype=jnp.int32)  # (S,K)
+    x = params["embed"].astype(cd)[cand_tokens]          # (S, K, d)
+    wb = jnp.take_along_axis(block_tables, positions // bs,
+                             axis=1)                     # (S, K)
+    wb = jnp.where(active[:, None], wb, 0)
+    off = jnp.where(active[:, None], positions % bs, 0)
+    kv_pos = jnp.arange(t_w)
+    attn_mask = kv_pos[None, None, :] <= positions[:, :, None]  # (S,K,T_w)
+
+    def layer(carry, layer_in):
+        x = carry
+        bp, k_cache, v_cache = layer_in                  # (N,bs,Hkv,D)
+        q, k, v = _qkv(bp, x, cfg, positions)            # (S,K,H,D)
+        k_cache = k_cache.at[wb, off].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[wb, off].set(v.astype(v_cache.dtype))
+        kb = k_cache[block_tables]                       # (S,B,bs,Hkv,D)
+        vb = v_cache[block_tables]
+        kh = kb.reshape(s_count, t_w, *kb.shape[3:])
+        vh = vb.reshape(s_count, t_w, *vb.shape[3:])
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
+        s = jnp.einsum("sqhd,sthd->sqht", q.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        s = jnp.where(attn_mask[:, :, None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("sqht,sthd->sqhd", p, vh.astype(jnp.float32))
+        attn = attn.reshape(s_count, k_w, cfg.n_heads * cfg.head_dim)
+        x = x + jnp.einsum("bth,hd->btd", attn.astype(cd),
+                           bp["wo"].astype(cd))
+        x = x + _mlp(bp, x, cfg)
+        return x, (k_cache, v_cache)
+
+    x, new_kv = jax.lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    new_k, new_v = new_kv
+    logits = _final_logits(params, x, cfg)               # (S, K, vocab)
+    # Same acceptance rule as the contiguous verify_step: proposal i is
+    # correct iff the model's greedy token at the previous position
+    # equals it; acceptance is the run of correct proposals.  Sampling
+    # slots (temps > 0) accept nothing and degrade to an exact normal
+    # decode step via the properly-sampled column 0.
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, K)
+    match = (cand_tokens[:, 1:] == greedy[:, :-1])
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    accepted = jnp.where(temps > 0.0, 0, acc.sum(axis=1))   # (S,)
+    rng, sub = jax.random.split(rng)
+    first_sampled = sample_per_slot(logits[:, 0], sub, temps)
+    tok_out = greedy.at[:, 0].set(first_sampled)
+    return PagedKVCache(k=new_k, v=new_v), tok_out, accepted, rng
+
+
+def make_paged_spec_fns(cfg: TransformerConfig, donate: bool = True):
+    """Jitted paged speculative verifier (K rides in the candidate
+    shape, slot width S in every row dim: one compile per (S, K) pair,
+    the same tier discipline as the paged burst)."""
+    return jax.jit(functools.partial(paged_verify_step, cfg=cfg),
+                   donate_argnums=(1,) if donate else ())
+
+
 def copy_block(cache: PagedKVCache, dst: jax.Array, src: jax.Array
                ) -> PagedKVCache:
     """Copy one pool block across all layers (the device half of

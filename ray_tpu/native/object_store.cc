@@ -60,6 +60,25 @@ struct Slot {
 // reclaim such slots after this many seconds.
 constexpr uint64_t kStaleCreatingSecs = 300;
 
+// Warm-file recycle pool: deleting/evicting a LARGE object parks its tmpfs
+// file here (pages stay resident and faulted-in) instead of unlinking it;
+// the next large create claims a matching file via rename and writes onto
+// already-warm pages.  Fresh tmpfs page allocation is the dominant cost of
+// a large create (~1.3 GB/s fault-bound vs ~6 GB/s rewriting warm pages on
+// this class of host), so steady-state put/transfer traffic that cycles
+// similar sizes runs at warm-page speed.  The pool is bounded (entry count
+// and a byte cap derived from capacity) and its bytes count toward the
+// store's tmpfs footprint, so eviction drains it before touching live
+// objects.
+constexpr uint32_t kRecycleSlots = 64;
+constexpr uint64_t kRecycleMinBytes = 1ULL << 20;  // only pool files >= 1 MiB
+
+struct RecycleEntry {
+  std::atomic<uint64_t> size;  // 0 = empty
+  std::atomic<uint32_t> seq;   // names the file: .recycle.<idx>.<seq>
+  uint32_t pad;
+};
+
 struct IndexHeader {
   uint64_t magic;
   uint64_t capacity;
@@ -67,6 +86,10 @@ struct IndexHeader {
   std::atomic<uint64_t> used;
   std::atomic<uint64_t> clock;
   std::atomic<uint64_t> num_objects;
+  std::atomic<uint64_t> recycle_bytes;
+  std::atomic<uint32_t> recycle_seq;
+  uint32_t pad0;
+  RecycleEntry recycle[kRecycleSlots];
   pthread_mutex_t mutex;  // robust, process-shared
 };
 
@@ -101,6 +124,53 @@ void ObjectPath(const Store* s, const uint8_t* id, bool building, char* out,
   char hexid[2 * kIdSize + 1];
   IdToHex(id, hexid);
   snprintf(out, outlen, "%s/%s%s", s->dir, hexid, building ? ".building" : "");
+}
+
+void RecyclePath(const Store* s, uint32_t idx, uint32_t seq, char* out,
+                 size_t outlen) {
+  snprintf(out, outlen, "%s/.recycle.%u.%u", s->dir, idx, seq);
+}
+
+uint64_t RecycleCap(const Store* s) { return s->hdr->capacity / 8; }
+
+// Unlink pooled files until `need` bytes are freed (UINT64_MAX = drain all).
+// Caller holds the index lock.  Returns bytes freed.
+uint64_t DrainRecycleLocked(Store* s, uint64_t need) {
+  uint64_t freed = 0;
+  for (uint32_t i = 0; i < kRecycleSlots && freed < need; i++) {
+    RecycleEntry* e = &s->hdr->recycle[i];
+    uint64_t sz = e->size.load(std::memory_order_acquire);
+    if (sz == 0) continue;
+    char path[4300];
+    RecyclePath(s, i, e->seq.load(), path, sizeof(path));
+    e->size.store(0, std::memory_order_release);
+    s->hdr->recycle_bytes.fetch_sub(sz);
+    unlink(path);
+    freed += sz;
+  }
+  return freed;
+}
+
+// Try to park a sealed object's file in the recycle pool instead of
+// unlinking it.  Caller holds the index lock.  Returns true when the file
+// was renamed into the pool (caller must NOT unlink it).
+bool TryRecycleLocked(Store* s, const uint8_t* id, uint64_t size) {
+  if (size < kRecycleMinBytes) return false;
+  if (s->hdr->recycle_bytes.load() + size > RecycleCap(s)) return false;
+  for (uint32_t i = 0; i < kRecycleSlots; i++) {
+    RecycleEntry* e = &s->hdr->recycle[i];
+    if (e->size.load(std::memory_order_acquire) != 0) continue;
+    uint32_t seq = s->hdr->recycle_seq.fetch_add(1);
+    char src[4300], dst[4300];
+    ObjectPath(s, id, /*building=*/false, src, sizeof(src));
+    RecyclePath(s, i, seq, dst, sizeof(dst));
+    if (rename(src, dst) != 0) return false;
+    e->seq.store(seq);
+    e->size.store(size, std::memory_order_release);
+    s->hdr->recycle_bytes.fetch_add(size);
+    return true;
+  }
+  return false;
 }
 
 int LockIndex(Store* s) {
@@ -281,6 +351,9 @@ uint64_t rts_evict(void* handle, uint64_t bytes_needed) {
   Store* s = static_cast<Store*>(handle);
   uint64_t freed = 0;
   if (LockIndex(s) != 0) return 0;
+  // Pooled warm files are the cheapest bytes to give back: no live object
+  // dies when they go.
+  freed += DrainRecycleLocked(s, bytes_needed);
   // Reclaim slots orphaned in kCreating by a crashed writer.
   uint64_t now = (uint64_t)time(nullptr);
   for (uint64_t i = 0; i < s->hdr->num_slots; i++) {
@@ -330,13 +403,21 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size, int* fd_out) {
   Store* s = static_cast<Store*>(handle);
   if (LockIndex(s) != 0) return RTS_ERR_IO;
   // Capacity check + eviction, decided under the lock so concurrent
-  // creators cannot both pass and oversubscribe tmpfs.
-  if (s->hdr->used.load() + size > s->hdr->capacity) {
-    uint64_t need = s->hdr->used.load() + size - s->hdr->capacity;
-    UnlockIndex(s);
-    rts_evict(handle, need);
-    if (LockIndex(s) != 0) return RTS_ERR_IO;
-    if (s->hdr->used.load() + size > s->hdr->capacity) {
+  // creators cannot both pass and oversubscribe tmpfs.  Pooled warm files
+  // count toward the footprint (their pages are still resident) and are
+  // drained before any live object is evicted.
+  if (s->hdr->used.load() + s->hdr->recycle_bytes.load() + size >
+      s->hdr->capacity) {
+    uint64_t need = s->hdr->used.load() + s->hdr->recycle_bytes.load() +
+                    size - s->hdr->capacity;
+    uint64_t drained = DrainRecycleLocked(s, need);
+    if (drained < need) {
+      UnlockIndex(s);
+      rts_evict(handle, need - drained);
+      if (LockIndex(s) != 0) return RTS_ERR_IO;
+    }
+    if (s->hdr->used.load() + s->hdr->recycle_bytes.load() + size >
+        s->hdr->capacity) {
       UnlockIndex(s);
       return RTS_ERR_FULL;
     }
@@ -359,13 +440,49 @@ int rts_create(void* handle, const uint8_t* id, uint64_t size, int* fd_out) {
   slot->state.store(kCreating, std::memory_order_release);
   s->hdr->used.fetch_add(size);
   s->hdr->num_objects.fetch_add(1);
+  // Claim a pooled warm file of a compatible size (>= requested, bounded
+  // waste) while still under the lock; the rename happens after unlock —
+  // the claimed entry is already ours (size zeroed), so no racer can touch
+  // the file.
+  uint64_t reuse_sz = 0;
+  char reuse_path[4300];
+  if (size >= kRecycleMinBytes) {
+    for (uint32_t i = 0; i < kRecycleSlots; i++) {
+      RecycleEntry* e = &s->hdr->recycle[i];
+      uint64_t rsz = e->size.load(std::memory_order_acquire);
+      if (rsz >= size && rsz <= 2 * size) {
+        RecyclePath(s, i, e->seq.load(), reuse_path, sizeof(reuse_path));
+        e->size.store(0, std::memory_order_release);
+        s->hdr->recycle_bytes.fetch_sub(rsz);
+        reuse_sz = rsz;
+        break;
+      }
+    }
+  }
   UnlockIndex(s);
 
   char path[4300];
   ObjectPath(s, id, /*building=*/true, path, sizeof(path));
-  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0666);
-  if (fd < 0 || (size > 0 && ftruncate(fd, size) != 0)) {
-    if (fd >= 0) close(fd);
+  int fd = -1;
+  if (reuse_sz > 0) {
+    if (rename(reuse_path, path) == 0) {
+      fd = open(path, O_RDWR);
+      if (fd >= 0 && reuse_sz != size && ftruncate(fd, size) != 0) {
+        close(fd);
+        fd = -1;
+      }
+    } else {
+      unlink(reuse_path);  // claimed but unusable; don't leak the file
+    }
+  }
+  if (fd < 0) {
+    fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0666);
+    if (fd >= 0 && size > 0 && ftruncate(fd, size) != 0) {
+      close(fd);
+      fd = -1;
+    }
+  }
+  if (fd < 0) {
     unlink(path);
     LockIndex(s);
     slot->state.store(kTombstone);
@@ -510,14 +627,26 @@ int rts_delete(void* handle, const uint8_t* id, int force) {
     UnlockIndex(s);
     return RTS_ERR_STATE;
   }
-  char path[4300];
-  ObjectPath(s, id, false, path, sizeof(path));
-  unlink(path);
-  s->hdr->used.fetch_sub(slot->size.load());
+  uint64_t sz = slot->size.load();
+  // Explicit GC delete is the steady-state recycling point: large files
+  // park in the warm pool for the next large create instead of unlinking.
+  if (!TryRecycleLocked(s, id, sz)) {
+    char path[4300];
+    ObjectPath(s, id, false, path, sizeof(path));
+    unlink(path);
+  }
+  s->hdr->used.fetch_sub(sz);
   s->hdr->num_objects.fetch_sub(1);
   slot->state.store(kTombstone);
   UnlockIndex(s);
   return RTS_OK;
+}
+
+// Bytes held by the warm-file recycle pool (introspection: the pool is
+// tmpfs footprint but neither `used` nor an object — the quiescence leak
+// guard asserts it stays bounded).
+uint64_t rts_recycle_bytes(void* handle) {
+  return static_cast<Store*>(handle)->hdr->recycle_bytes.load();
 }
 
 // List up to `max` sealed object ids into out (max * 20 bytes). Returns count.

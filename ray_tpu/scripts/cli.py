@@ -334,6 +334,15 @@ def cmd_serve(gcs: _Gcs, args) -> None:
             parts = [f"role={ent.get('role', 'unified')}"]
             if "prefixes" in ent:
                 parts.append(f"prefixes={len(ent['prefixes'] or ())}")
+            rails = ent.get("rails")
+            if rails:
+                parts.append(
+                    f"rails={rails.get('mode', 'off')}"
+                    f"({rails.get('active', 0)}/{rails.get('width', 0)} "
+                    f"active, {rails.get('spilled_total', 0)} spilled)")
+            if ent.get("spec_accept_rate") is not None:
+                parts.append(
+                    f"spec_accept={100 * ent['spec_accept_rate']:.0f}%")
             print(f"    replica {rid}: " + "  ".join(parts))
         lat = latency.get(app) or {}
         line = []

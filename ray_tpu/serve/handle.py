@@ -41,6 +41,37 @@ def _retryable_errors():
             rexc.ReplicaDrainingError)
 
 
+def _rails_ring():
+    """Decode on rails, reader side: pre-create a shm ring on THIS node
+    (reads are a local mmap poll, exactly the compiled DAG's placement
+    rule) and describe it for the replica's writer endpoint — a
+    same-host replica mmaps the path, a cross-host one pushes versioned
+    frames through this node's daemon.  Returns None when rails are off
+    or the ring can't be built (the stream then admits on RPC pulls)."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    if not cfg.serve_rails_enabled:
+        return None
+    try:
+        from ray_tpu.experimental.channel import Channel
+
+        ch = Channel.create(1, capacity=cfg.serve_rails_capacity_bytes)
+    except Exception:  # noqa: BLE001 — no /dev/shm etc.
+        return None
+    addr = None
+    try:
+        from ray_tpu.api import _global_worker
+
+        addr = getattr(_global_worker(), "daemon_address", None)
+    except Exception:  # noqa: BLE001 local mode
+        addr = None
+    return {"ch": ch,
+            "desc": {"path": ch.path, "capacity": ch.capacity,
+                     "n_readers": ch.n_readers, "n_slots": ch.n_slots,
+                     "daemon_address": addr}}
+
+
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef.
 
@@ -414,12 +445,14 @@ class DeploymentHandle:
                 trace=trace2)
             return replica2, sid_ref2
 
+        rails = _rails_ring()
         sid_ref = replica.handle_request_streaming.remote(
             self._method, args, kwargs, model_id=self._model_id,
-            trace=trace)
+            trace=trace,
+            **({"rails": rails["desc"]} if rails else {}))
         return StreamingResponse(replica, sid_ref, on_done,
                                  resume_fn=resume_fn,
-                                 request_id=request_id)
+                                 request_id=request_id, rails=rails)
 
 
 class StreamingResponse:
@@ -436,7 +469,8 @@ class StreamingResponse:
     sequence across the failover."""
 
     def __init__(self, replica, sid_ref, on_done, max_items: int = 32,
-                 resume_fn=None, request_id: Optional[str] = None):
+                 resume_fn=None, request_id: Optional[str] = None,
+                 rails: Optional[dict] = None):
         self._replica = replica
         self._sid_ref = sid_ref
         self._sid = None
@@ -445,6 +479,10 @@ class StreamingResponse:
         self._settled = False
         self._resume_fn = resume_fn
         self._emitted: list = []
+        self._rails = rails        # {"ch": Channel, "desc": {...}} | None
+        self._rails_offset = 0     # items landed over the ring so far
+        self.rails = False         # pull mode currently in effect
+        self.rails_used = False    # ever attached (survives the spill)
         self.request_id = request_id or uuid.uuid4().hex
         self.resumes = 0  # failovers survived (observability/tests)
 
@@ -454,6 +492,22 @@ class StreamingResponse:
             if self._on_done:
                 self._on_done()
 
+    def _drop_rails(self):
+        """Release the ring (normal end, cancel, or spill to RPC).  The
+        replica-side pump observes the close as ChannelClosedError on
+        its next write and retires its lane slot."""
+        r, self._rails = self._rails, None
+        self.rails = False
+        if r is not None:
+            try:
+                r["ch"].close()
+                r["ch"].unlink()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __del__(self):
+        self._drop_rails()
+
     def cancel(self):
         if self._settled:
             return  # already finished or cancelled
@@ -462,7 +516,51 @@ class StreamingResponse:
                 self._replica.cancel_stream.remote(self._sid)
             except Exception:  # noqa: BLE001
                 pass
+        self._drop_rails()
         self._settle()
+
+    def _rails_next(self, pull_timeout: float) -> dict:
+        """One pull over the ring: poll in short slices, probing replica
+        liveness on idle slices so a SIGKILLed replica surfaces as the
+        same typed error the RPC path would raise (-> resume ladder).
+        Error frames re-raise in-band: retryable ones (draining, died)
+        resume, user exceptions propagate to the consumer."""
+        from ray_tpu.core.config import get_config
+        from ray_tpu.experimental.channel import (ChannelClosedError,
+                                                  ChannelTimeoutError)
+        import ray_tpu.exceptions as rexc
+
+        cfg = get_config()
+        deadline = time.monotonic() + pull_timeout
+        next_probe = time.monotonic() + cfg.serve_rails_probe_s
+        while True:
+            try:
+                frame = self._rails["ch"].read(
+                    timeout=cfg.serve_rails_tick_s, reader_idx=0)
+            except ChannelTimeoutError:
+                now = time.monotonic()
+                if now >= deadline:
+                    raise TimeoutError(
+                        f"rails stream idle for {pull_timeout}s")
+                if now >= next_probe:
+                    ray_tpu.get(self._replica.check_health.remote(),
+                                timeout=cfg.serve_rails_probe_s + 5.0)
+                    next_probe = time.monotonic() + cfg.serve_rails_probe_s
+                continue
+            except ChannelClosedError:
+                raise rexc.ActorUnavailableError(
+                    "rails ring closed under a live stream")
+            err = frame.get("err") if isinstance(frame, dict) else None
+            if err is not None:
+                raise err
+            if int(frame.get("o", -1)) != self._rails_offset:
+                # Out-of-order frame: never expected from the versioned
+                # ring — treat as lane loss, not silent corruption.
+                raise rexc.ActorUnavailableError(
+                    f"rails frame offset {frame.get('o')} != "
+                    f"{self._rails_offset}")
+            self._rails_offset += len(frame["items"])
+            return frame
 
     def __iter__(self):
         from ray_tpu.core.config import get_config
@@ -474,13 +572,29 @@ class StreamingResponse:
             while True:
                 try:
                     if self._sid is None:
-                        self._sid = ray_tpu.get(self._sid_ref,
-                                                timeout=pull_timeout)
-                    batch = ray_tpu.get(
-                        self._replica.stream_next.remote(
-                            self._sid, max_items=self._max_items),
-                        timeout=pull_timeout)
+                        sid = ray_tpu.get(self._sid_ref,
+                                          timeout=pull_timeout)
+                        if isinstance(sid, dict):
+                            self.rails = (bool(sid.get("rails"))
+                                          and self._rails is not None)
+                            self.rails_used |= self.rails
+                            sid = sid["sid"]
+                        self._sid = sid
+                        if not self.rails:
+                            self._drop_rails()  # admission-time spill
+                    if self.rails:
+                        batch = self._rails_next(pull_timeout)
+                    else:
+                        batch = ray_tpu.get(
+                            self._replica.stream_next.remote(
+                                self._sid, max_items=self._max_items),
+                            timeout=pull_timeout)
                 except _retryable_errors():
+                    # Lane loss / drain / replica death: spill to the
+                    # ordinary RPC path and re-admit through the resume
+                    # protocol (PR 9 machinery, unchanged) — the emitted
+                    # prefix pins the exactly-once sequence.
+                    self._drop_rails()
                     if (self._resume_fn is None
                             or self.resumes >= max_resumes):
                         raise
@@ -496,4 +610,5 @@ class StreamingResponse:
                 if batch["done"]:
                     return
         finally:
+            self._drop_rails()
             self._settle()

@@ -32,6 +32,7 @@ import queue
 import threading
 import time
 import uuid
+import warnings
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
@@ -628,6 +629,8 @@ class PagedLLMEngine(_EngineBase):
                  prefill_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None, seed: int = 0,
                  max_burst: int = 8, prefix_sharing: Optional[bool] = None,
+                 speculation_k: Optional[int] = None,
+                 speculation_ngram: Optional[int] = None,
                  store=None):
         import jax
         import jax.numpy as jnp
@@ -636,6 +639,7 @@ class PagedLLMEngine(_EngineBase):
         from ray_tpu.models.decoding import (
             init_paged_cache,
             make_paged_engine_fns,
+            make_paged_spec_fns,
             sample_one,
         )
         from ray_tpu.serve.kv_cache import KVBlockAllocator
@@ -662,7 +666,21 @@ class PagedLLMEngine(_EngineBase):
         self.eos_id = eos_id
         self.max_burst = max(1, max_burst if eos_id is None else
                              min(max_burst, 4))
-        self._advance_margin = self.max_burst
+        # Prompt-lookup speculative decoding on the paged pool (opt-in,
+        # knob-defaulted): each tick verifies K candidates per slot in
+        # one width-K call; drafts come from n-gram matches in the
+        # slot's own context.  Exact under greedy decoding; sampling
+        # slots degrade to normal decode.
+        if speculation_k is None:
+            speculation_k = knobs.serve_speculation_k
+        if speculation_ngram is None:
+            speculation_ngram = knobs.serve_speculation_ngram
+        self._spec_k = speculation_k if speculation_k >= 2 else 0
+        self._spec_ngram = max(1, speculation_ngram)
+        # The free-margin _maybe_finish keeps must cover whichever
+        # advance is larger — a burst OR a spec window — without
+        # inflating the burst depth itself.
+        self._advance_margin = max(self.max_burst, self._spec_k)
         self._b_max = math.ceil(max_len / self.block_size)
         prefix_sharing = (knobs.kv_block_prefix_sharing
                           if prefix_sharing is None else prefix_sharing)
@@ -672,6 +690,8 @@ class PagedLLMEngine(_EngineBase):
         self.cache = init_paged_cache(cfg, self.num_blocks, self.block_size)
         self._prefill_chunk_fn, self._decode, self._copy_block = \
             make_paged_engine_fns(cfg)
+        if self._spec_k:
+            self._verify = make_paged_spec_fns(cfg)
         self._sample_one = jax.jit(sample_one)
         bytes_per_block = (2 * cfg.n_layers * self.block_size
                            * cfg.n_kv_heads * cfg.head_dim
@@ -702,7 +722,8 @@ class PagedLLMEngine(_EngineBase):
                       "prefill_chunks": 0, "queue_waits": 0,
                       "preemptions": 0, "adopted_blocks": 0,
                       "migrated_blocks": 0, "migrate_fallbacks": 0,
-                      "disagg_prefills": 0}
+                      "disagg_prefills": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -736,6 +757,13 @@ class PagedLLMEngine(_EngineBase):
                 jnp.zeros((w, self._b_max), jnp.int32), jnp.asarray(z),
                 jnp.zeros((w,), bool), jnp.zeros((w,), jnp.float32),
                 self._rng, n_steps=self.max_burst)
+            if self._spec_k:
+                self.cache, _, _, self._rng = self._verify(
+                    self.params, self.cache,
+                    jnp.zeros((w, self._spec_k), jnp.int32),
+                    jnp.zeros((w, self._b_max), jnp.int32),
+                    jnp.asarray(z), jnp.zeros((w,), bool),
+                    jnp.zeros((w,), jnp.float32), self._rng)
         for c in self._chunk_tiers:
             self.cache, _ = self._prefill_chunk_fn(
                 self.params, self.cache, jnp.zeros((c,), jnp.int32),
@@ -979,12 +1007,16 @@ class PagedLLMEngine(_EngineBase):
         import jax.numpy as jnp
 
         burst = self.max_burst
+        # One tick advances either a burst (burst tokens of KV) or a
+        # spec window (K tokens of KV); cover whichever is larger so
+        # the spec/burst choice below never re-runs allocation.
+        adv = max(burst, self._spec_k)
         idx: List[int] = []
         stalled: List[int] = []
         for i, req in enumerate(self._slots):
             if req is None or req.prefilling:
                 continue
-            if self._ensure_blocks(req, int(self._lengths[i]) + burst):
+            if self._ensure_blocks(req, int(self._lengths[i]) + adv):
                 idx.append(i)
             else:
                 stalled.append(i)
@@ -1017,6 +1049,9 @@ class PagedLLMEngine(_EngineBase):
             active[j] = True
             temps[j] = self._slots[i].temperature
         try:
+            if self._spec_k and self._spec_tick(idx, tables, lengths,
+                                                active, temps):
+                return True
             t0 = time.time()
             self.cache, tok_mat, self._rng = self._decode(
                 self.params, self.cache, jnp.asarray(tokens),
@@ -1044,6 +1079,74 @@ class PagedLLMEngine(_EngineBase):
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._fail_request(req, e)
+        return True
+
+    def _spec_tick(self, idx: List[int], tables, lengths, active,
+                   temps) -> bool:
+        """One speculative verify tick over the compacted decode lanes.
+        Returns False when too few slots carry a draft (caller falls
+        back to the plain burst — no wasted K-wide call); the majority
+        rule mirrors the fixed engine's.  Called from inside
+        _decode_tick's try block after _ensure_blocks already extended
+        every participating table to cover the K window, so the kernel's
+        scatter is always in-bounds and always lands in exclusively-
+        owned blocks (COW at decode start + fresh growth allocs) —
+        rejected drafts are rolled back by length arithmetic alone."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import ngram_propose
+
+        k = self._spec_k
+        w = tables.shape[0]
+        cand = np.zeros((w, k), np.int32)
+        drafted = 0
+        greedy_active = 0
+        for j, i in enumerate(idx):
+            req = self._slots[i]
+            cand[j, 0] = self._last_tokens[i]
+            props = []
+            if req.temperature == 0.0:
+                greedy_active += 1
+                ctx = req.prompt + req.out_tokens
+                props = ngram_propose(ctx, k - 1, self._spec_ngram)
+            for col in range(1, k):
+                cand[j, col] = (props[col - 1] if col - 1 < len(props)
+                                else self._last_tokens[i])
+            if props:
+                drafted += 1
+        if drafted == 0 or 2 * drafted < greedy_active \
+                or 2 * greedy_active < len(idx):
+            return False
+        self.stats["spec_proposed"] += (k - 1) * greedy_active
+        t0 = time.time()
+        self.cache, tok_out, accepted, self._rng = self._verify(
+            self.params, self.cache, jnp.asarray(cand),
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(active), jnp.asarray(temps), self._rng)
+        tok_out = np.asarray(tok_out)              # (w, k)
+        accepted = np.asarray(accepted)            # (w,)
+        t1 = time.time()
+        for j, i in enumerate(idx):
+            req = self._slots[i]
+            a = int(accepted[j])
+            self.stats["spec_accepted"] += a
+            # KV was written for the whole K window; only a+1 positions
+            # are real.  Advancing lengths by a+1 IS the rollback: the
+            # paged masks (kv_pos <= position) treat the stale tail as
+            # garbage and the next decode overwrites it in place.
+            self._lengths[i] += a + 1
+            n0 = len(req.out_tokens)
+            for tok in tok_out[j, :a + 1]:
+                tok = int(tok)
+                if len(req.out_tokens) >= req.max_tokens:
+                    break  # over-generated tail: trim
+                req.emit(tok)
+                self._last_tokens[i] = tok
+                self.stats["tokens_generated"] += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    break
+            self._obs_burst(req, t0, t1, len(req.out_tokens) - n0)
+            self._maybe_finish(i)
         return True
 
     def _preempt(self, slot: int) -> None:
@@ -1198,16 +1301,19 @@ class LLMDeployment:
     {"tokens": [...]}.  Build with serve.deployment(LLMDeployment).bind(...).
 
     `engine="paged"` (default) serves through the paged KV-cache engine;
-    `engine="fixed"` keeps the fixed-slot engine.  Tensor-parallel
-    deployments fall back to the fixed engine (the paged kernels are
-    single-device for now)."""
+    `engine="fixed"` is DEPRECATED explicit opt-in to the fixed-slot
+    engine (emits a DeprecationWarning — the paged engine covers its
+    whole feature set at equal HBM, including speculative decoding).
+    Tensor-parallel deployments still fall back to the fixed engine
+    without a warning (the paged kernels are single-device for now)."""
 
     def __init__(self, cfg_name, *, engine: str = "paged",
                  num_slots: int = 8, max_len: int = 512, seed: int = 0,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_cache_size: int = 4, speculation_k: int = 0,
+                 prefix_cache_size: int = 4,
+                 speculation_k: Optional[int] = None,
                  tensor_parallel: int = 0,
                  prefix_sharing: Optional[bool] = None,
                  disagg: Optional[bool] = None,
@@ -1241,6 +1347,15 @@ class LLMDeployment:
             mesh = build_mesh(MeshConfig(tp=tensor_parallel, fsdp=1),
                               devices=devs)
             engine = "fixed"
+        elif engine == "fixed":
+            warnings.warn(
+                "LLMDeployment(engine='fixed') is deprecated: the paged "
+                "engine is the default and covers the fixed engine's "
+                "feature set (prefix caching, speculative decoding) at "
+                "equal HBM with block-granular sharing. The fixed "
+                "engine remains only as the tensor-parallel fallback "
+                "and for explicit opt-in.",
+                DeprecationWarning, stacklevel=2)
         if engine == "paged":
             store = None
             try:
@@ -1254,12 +1369,14 @@ class LLMDeployment:
                 cfg, params, num_slots=num_slots, max_len=max_len,
                 block_size=block_size, num_blocks=num_blocks,
                 prefill_chunk=prefill_chunk, seed=seed,
-                prefix_sharing=prefix_sharing, store=store)
+                prefix_sharing=prefix_sharing,
+                speculation_k=speculation_k, store=store)
         else:
             self.engine = LLMEngine(cfg, params, num_slots=num_slots,
                                     max_len=max_len,
                                     prefix_cache_size=prefix_cache_size,
-                                    speculation_k=speculation_k, mesh=mesh)
+                                    speculation_k=speculation_k or 0,
+                                    mesh=mesh)
         # Disaggregated serving: this replica decodes; chunked prefill
         # of long prompts offloads to dedicated prefill actors whose
         # finished KV blocks ship back as frames (serve/disagg.py).
@@ -1347,6 +1464,10 @@ class LLMDeployment:
 
         cfg = get_config()
         state: dict = {"role": self.disagg_role}
+        es = self.engine.engine_stats()
+        if es.get("spec_proposed"):
+            state["spec_accept_rate"] = round(
+                es.get("spec_accepted", 0) / es["spec_proposed"], 4)
         alloc = getattr(self.engine, "allocator", None)
         if alloc is not None and cfg.serve_prefix_registry_enabled:
             state["block_size"] = int(self.engine.block_size)

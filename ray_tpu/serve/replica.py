@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import pickle
 import queue
 import threading
 import time
@@ -81,6 +82,65 @@ class _Stream:
         return {"items": items, "done": done}
 
 
+class _RailsLane:
+    """Pre-leased writer lane for rails streams: the serve analogue of a
+    compiled-DAG stage host.  Replicas are actors, so — like the
+    compiled DAG's ActorMethodNode stages, which run their loop inside
+    the actor rather than on a separate leased worker — the decode tick
+    loop is pinned HERE: a bounded set of dedicated pump threads, each
+    dedicated to one stream for its life.  The width bound is the lane's
+    lease: attach requests past it spill to the RPC pull path at
+    admission (a mid-stream stage never loses its slot)."""
+
+    def __init__(self, width: int):
+        self.width = max(0, int(width))
+        self._sem = threading.Semaphore(self.width)
+        self._lock = threading.Lock()
+        self.active = 0
+        self.attached_total = 0
+        self.spilled_total = 0
+
+    def try_attach(self) -> bool:
+        if self.width <= 0 or not self._sem.acquire(blocking=False):
+            with self._lock:
+                self.spilled_total += 1
+            return False
+        with self._lock:
+            self.active += 1
+            self.attached_total += 1
+        return True
+
+    def release(self) -> None:
+        with self._lock:
+            self.active -= 1
+        self._sem.release()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"width": self.width, "active": self.active,
+                    "attached_total": self.attached_total,
+                    "spilled_total": self.spilled_total}
+
+
+def _rails_writer(desc: dict):
+    """Per-edge transport selection, mirroring the compiled DAG's
+    `_writer_endpoint`: the ring always lives on the READER's (handle's)
+    node, so a same-host replica mmaps it directly and a cross-host one
+    pushes versioned raw frames through that node's daemon."""
+    import os
+
+    from ray_tpu.experimental.channel import Channel, RemoteChannelWriter
+
+    if os.path.exists(desc["path"]):
+        return Channel(desc["path"], desc["capacity"], desc["n_readers"],
+                       desc["n_slots"])
+    addr = desc.get("daemon_address")
+    if not addr:
+        return None
+    return RemoteChannelWriter(addr, desc["path"], desc["capacity"],
+                               desc["n_readers"], desc["n_slots"])
+
+
 class Replica:
     def __init__(self, cls_or_fn, init_args, init_kwargs, replica_id: str):
         self.replica_id = replica_id
@@ -88,6 +148,8 @@ class Replica:
         self._total = 0
         self._start = time.time()
         self._streams: Dict[str, _Stream] = {}
+        self._rails: Optional[_RailsLane] = None
+        self._rails_lock = threading.Lock()
         self._draining = False
         # replica_id format: "serve:<app>#g<gen>#<idx>"
         self._app = replica_id.split(":", 1)[-1].split("#", 1)[0]
@@ -155,6 +217,17 @@ class Replica:
                         state = sthook() or None
                     except Exception:  # noqa: BLE001
                         state = None
+                # Rails pull mode rides the same state payload so
+                # `ray-tpu serve status` renders compiled/fallback per
+                # replica next to the disagg role.
+                if self._rails is not None:
+                    from ray_tpu.core.config import get_config
+
+                    rs = self._rails.stats()
+                    rs["mode"] = ("compiled"
+                                  if get_config().serve_rails_enabled
+                                  else "fallback")
+                    state = dict(state or {}, rails=rs)
                 daemon.call("NodeDaemon", "report_serve_gauges",
                             app=app, replica=self.replica_id,
                             gauges=gauges, metrics=registry_dump(),
@@ -230,7 +303,8 @@ class Replica:
                                  kwargs: dict,
                                  model_id: Optional[str] = None,
                                  resume: Optional[dict] = None,
-                                 trace: Optional[dict] = None) -> str:
+                                 trace: Optional[dict] = None,
+                                 rails: Optional[dict] = None):
         """Start a streaming call; returns a stream id the caller pulls
         with stream_next().
 
@@ -240,7 +314,15 @@ class Replica:
         injected and recompute only the continuation; for everything
         else the generator is re-run and the first `offset` items are
         skipped server-side — either way the caller appends an
-        exactly-once continuation."""
+        exactly-once continuation.
+
+        `rails` is a handle-created ring descriptor (decode on rails):
+        when the lane attaches, frames push to the caller over the
+        channel plane instead of stream_next pulls, and the reply is
+        {"sid": ..., "rails": True/False} so the caller knows which pull
+        mode this stream runs in.  A refused attach (kill switch off,
+        lane at width, no route to the ring) is an admission-time spill:
+        the stream serves normally over RPC."""
         self._check_admission()
         self._total += 1
         if resume and resume.get("request_id"):
@@ -278,10 +360,108 @@ class Replica:
             out = itertools.islice(out, skip, None)
         sid = uuid.uuid4().hex
         self._gc_streams()
-        self._streams[sid] = _Stream(out, model_id=model_id,
-                                     ctx=trace, resumed=bool(resume))
+        st = _Stream(out, model_id=model_id,
+                     ctx=trace, resumed=bool(resume))
+        self._streams[sid] = st
         self._ongoing += 1
+        if rails is not None:
+            return {"sid": sid, "rails": self._rails_attach(sid, st, rails)}
         return sid
+
+    # -- decode on rails ------------------------------------------------
+    def _rails_lane(self) -> _RailsLane:
+        with self._rails_lock:
+            if self._rails is None:
+                from ray_tpu.core.config import get_config
+
+                self._rails = _RailsLane(
+                    get_config().serve_rails_max_streams)
+            return self._rails
+
+    def _rails_attach(self, sid: str, st: _Stream, desc: dict) -> bool:
+        """Pin this stream onto the rails lane: open the writer endpoint
+        to the handle's ring and dedicate a pump thread.  Any failure is
+        an admission-time spill (return False, stream stays on RPC)."""
+        from ray_tpu.core.config import get_config
+
+        if not get_config().serve_rails_enabled:
+            return False
+        lane = self._rails_lane()
+        if not lane.try_attach():
+            return False
+        writer = None
+        try:
+            writer = _rails_writer(desc)
+        except Exception:  # noqa: BLE001 bad descriptor / daemon gone
+            writer = None
+        if writer is None:
+            lane.release()
+            with lane._lock:
+                lane.spilled_total += 1
+            return False
+        threading.Thread(target=self._rails_pump,
+                         args=(sid, st, writer, lane), daemon=True).start()
+        return True
+
+    def _rails_pump(self, sid: str, st: _Stream, writer, lane: _RailsLane):
+        """Pinned rails stage loop (the serve analogue of the compiled
+        DAG's `_compiled_node_loop`): drain the stream's decode ticks
+        into offset-tagged frames over versioned channel writes.  Errors
+        ship in-band ({"err": e}); the reader decides whether they are
+        retryable (drain/death -> resume over RPC) or terminal."""
+        from ray_tpu.experimental.channel import (ChannelClosedError,
+                                                  ChannelTimeoutError)
+
+        def put(frame) -> bool:
+            # The ring's slot window is the backpressure bound: a slow
+            # consumer blocks the write, not the stream drop — retry
+            # short slices until the stream itself is torn down.
+            while True:
+                try:
+                    writer.write(frame, timeout=5.0)
+                    return True
+                except ChannelTimeoutError:
+                    if st.cancelled.is_set():
+                        return False
+                except (ChannelClosedError, Exception):  # noqa: BLE001
+                    return False
+
+        offset = 0
+        try:
+            while True:
+                try:
+                    batch = st.next_batch(max_items=32, timeout_s=0.2)
+                except BaseException as e:  # noqa: BLE001
+                    try:
+                        pickle.dumps(e)
+                    except Exception:  # noqa: BLE001
+                        e = RuntimeError(repr(e))
+                    put({"err": e})
+                    return
+                n = len(batch["items"])
+                if n or batch["done"]:
+                    t0 = time.time()
+                    if not put({"o": offset, "items": batch["items"],
+                                "done": batch["done"]}):
+                        return
+                    offset += n
+                    if n:
+                        tracing.record_serve_span(
+                            st.ctx, "serve.replica.rails_frame", t0,
+                            items=n, done=batch["done"])
+                if batch["done"]:
+                    return
+        finally:
+            self._drop_stream(sid)
+            lane.release()
+            # The handle owns the ring's lifecycle; a cross-host writer
+            # only needs its daemon RPC client released.
+            client = getattr(writer, "_client", None)
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # -- live KV migration (serve/disagg.py) ----------------------------
     def _maybe_adopt_migration(self, resume: dict) -> None:
@@ -433,10 +613,13 @@ class Replica:
         return dict(self.stats(), migrated_tickets=migrated)
 
     def stats(self) -> dict:
-        return {"replica_id": self.replica_id, "ongoing": self._ongoing,
-                "total": self._total, "streams": len(self._streams),
-                "draining": self._draining,
-                "uptime": time.time() - self._start}
+        out = {"replica_id": self.replica_id, "ongoing": self._ongoing,
+               "total": self._total, "streams": len(self._streams),
+               "draining": self._draining,
+               "uptime": time.time() - self._start}
+        if self._rails is not None:
+            out["rails"] = self._rails.stats()
+        return out
 
     def getpid(self) -> int:
         """Worker-process pid — lets chaos tooling SIGKILL the actual

@@ -1,0 +1,10 @@
+import ray_tpu
+
+
+class Replica:
+    def _rails_pump(self, sid, st, writer, lane):
+        while True:
+            batch = ray_tpu.get(st.ref)
+            self._replica.stream_next.remote(sid)
+            self.daemon.call("NodeDaemon", "report", timeout=2)
+            writer.write(batch)

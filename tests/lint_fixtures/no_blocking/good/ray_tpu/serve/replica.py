@@ -1,0 +1,13 @@
+import ray_tpu
+
+
+class Replica:
+    def _rails_pump(self, sid, st, writer, lane):
+        while True:
+            try:
+                batch = st.next_batch(32, 0.2)
+            except TimeoutError:
+                # idle slice: the liveness probe is off the hot path
+                ray_tpu.get(self._replica.check_health.remote())
+                continue
+            writer.write(batch)

@@ -177,10 +177,24 @@ def test_no_blocking_fixture():
     assert "ray_tpu.get" in msgs
     assert "socket" in msgs
     assert "Future.result" in msgs
-    assert len(bad) == 5  # incl. the call_soon lambda
+    # rails hot-loop scope: RPC-shaped calls on the per-frame path
+    assert "rails hot loop" in msgs
+    assert "per-token actor" in msgs          # .remote(...) submission
+    assert "pure mmap+poll" in msgs           # daemon .call(...)
+    assert len(bad) == 8  # incl. the call_soon lambda + 3 rails hits
     # good tree: await asyncio.sleep, done-set .result(), allowlisted
-    # sleep, and a nested sync def are all accepted
+    # sleep, a nested sync def, and a rails probe inside an except
+    # handler (off the hot path) are all accepted
     assert not lint(FIXTURES / "no_blocking" / "good", ["no-blocking-in-loop"])
+
+
+def test_no_blocking_rails_registry_rot(tmp_path):
+    """A RAILS_HOT_LOOPS entry whose method vanished is itself flagged."""
+    pkg = tmp_path / "ray_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "replica.py").write_text("class Replica:\n    pass\n")
+    vs = lint(tmp_path, ["no-blocking-in-loop"])
+    assert len(vs) == 1 and "RAILS_HOT_LOOPS" in vs[0].message
 
 
 def test_lock_order_fixture():

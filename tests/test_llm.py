@@ -208,21 +208,96 @@ def test_verify_step_exact_speculative_acceptance(params):
     assert int(cache3.lengths[0]) == 5   # advanced exactly one
 
 
+def test_paged_verify_step_exact_acceptance(params):
+    """The PAGED speculative verifier is exact under greedy decoding:
+    correct proposals accept through the block pool, the first wrong
+    proposal rejects, and the continuation after the rejected draft is
+    bit-identical to sequential paged decode — the stale KV the wrong
+    candidate scattered into the slot's own block is masked by length
+    arithmetic and overwritten in place (no device rollback)."""
+    from ray_tpu.models.decoding import (
+        init_paged_cache,
+        paged_decode_step,
+        paged_prefill_chunk,
+        paged_verify_step,
+    )
+
+    prompt = [5, 6, 7, 8]
+    bs = 4
+    table = jnp.asarray([[1, 2, 3, 4]], jnp.int32)   # 16 positions
+
+    def fresh_prefilled():
+        cache = init_paged_cache(CFG, num_blocks=9, block_size=bs)
+        toks = jnp.zeros((8,), jnp.int32).at[:4].set(jnp.asarray(prompt))
+        cache, last = paged_prefill_chunk(params, cache, toks, table[0],
+                                          jnp.int32(0), jnp.int32(4), CFG)
+        return cache, int(jnp.argmax(last))
+
+    # Reference: sequential greedy paged decode of 6 tokens.
+    cache, t0 = fresh_prefilled()
+    ref = [t0]
+    lengths = jnp.asarray([4], jnp.int32)
+    for _ in range(5):
+        cache, logits = paged_decode_step(
+            params, cache, jnp.asarray([ref[-1]], jnp.int32), table,
+            lengths, jnp.asarray([True]), CFG)
+        ref.append(int(jnp.argmax(logits[0])))
+        lengths = lengths + 1
+
+    # Speculative: candidates = [t0, ref[1], ref[2], WRONG].
+    cache2, t0b = fresh_prefilled()
+    assert t0b == ref[0]
+    wrong = (ref[3] + 1) % CFG.vocab_size
+    cand = jnp.asarray([[t0b, ref[1], ref[2], wrong]], jnp.int32)
+    cache2, tok_out, accepted, _ = paged_verify_step(
+        params, cache2, cand, table, jnp.asarray([4], jnp.int32),
+        jnp.asarray([True]), jnp.asarray([0.0], jnp.float32),
+        jax.random.key(0), CFG)
+    a = int(accepted[0])
+    assert a == 2                        # two correct proposals
+    emitted = [int(t) for t in np.asarray(tok_out[0, :a + 1])]
+    assert emitted == ref[1:4]           # accepted + bonus == reference
+
+    # Rollback is length arithmetic: advance by a+1 only and keep
+    # decoding — exact despite the rejected draft's stale KV at the
+    # very next position (the decode scatter overwrites it first).
+    lengths = jnp.asarray([4 + 1 + a], jnp.int32)
+    cont = [emitted[-1]]
+    for _ in range(2):
+        cache2, logits = paged_decode_step(
+            params, cache2, jnp.asarray([cont[-1]], jnp.int32), table,
+            lengths, jnp.asarray([True]), CFG)
+        cont.append(int(jnp.argmax(logits[0])))
+        lengths = lengths + 1
+    assert cont[1:] == ref[4:6]
+
+    # A sampling slot (temp>0) accepts nothing — exact fallback.
+    cache3, _ = fresh_prefilled()
+    _, _, accepted3, _ = paged_verify_step(
+        params, cache3, cand, table, jnp.asarray([4], jnp.int32),
+        jnp.asarray([True]), jnp.asarray([0.7], jnp.float32),
+        jax.random.key(1), CFG)
+    assert int(accepted3[0]) == 0
+
+
 def test_engine_speculative_matches_plain_greedy(params):
     """With prompt-lookup speculation on, greedy generation must be
     BIT-IDENTICAL to the plain engine (speculation is exact — only
     faster), and drafts must actually be proposed on a repetitive
     prompt."""
-    prompt = [1, 2, 3, 1, 2, 3, 1, 2]   # n-gram lookup has matches
-    plain = LLMEngine(CFG, params, num_slots=2, max_len=64,
-                      prefill_buckets=(16,), prefix_cache_size=0)
-    ref = plain.generate(prompt, max_tokens=12)
+    # Small bursts make the drafter check often; a long-enough greedy
+    # continuation settles into repetition the n-gram lookup can mine.
+    prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+    plain = LLMEngine(CFG, params, num_slots=2, max_len=256,
+                      prefill_buckets=(16,), prefix_cache_size=0,
+                      max_burst=2)
+    ref = plain.generate(prompt, max_tokens=96)
     plain.shutdown()
 
-    spec = LLMEngine(CFG, params, num_slots=2, max_len=64,
+    spec = LLMEngine(CFG, params, num_slots=2, max_len=256,
                      prefill_buckets=(16,), prefix_cache_size=0,
-                     speculation_k=4)
-    out = spec.generate(prompt, max_tokens=12)
+                     max_burst=2, speculation_k=4)
+    out = spec.generate(prompt, max_tokens=96)
     assert out == ref
     st = spec.engine_stats()
     assert st["spec_proposed"] > 0

@@ -220,3 +220,54 @@ def test_create_for_receive_zero_and_spill(store):
     buf = store.get_buffer(big)
     assert bytes(buf.view[:8]) == b"spilled!"
     buf.release()
+
+
+# ---------------------------------------------------------------------------
+# large-put fast path: store quiescence (the warm-file recycle pool is
+# bounded and a churn of large puts leaks neither objects nor bytes)
+# ---------------------------------------------------------------------------
+
+def test_large_put_recycle_pool_quiescence(store):
+    """Leak guard for the direct-write large-put path: churning large
+    objects through the store must return used/num_objects to baseline,
+    park at most capacity/8 of warm files (the native pool's bound), and
+    actually hand the parked files back to the next large create — the
+    pool recycles, it doesn't accumulate."""
+    cap = 64 * 1024 * 1024
+    used0, n0 = store.used, store.num_objects
+    size = 2 * 1024 * 1024   # >= put_direct_min_bytes: fast path
+    payload = np.arange(size // 8, dtype=np.float64)
+
+    # one roundtrip through the fast path before the churn
+    oid = ObjectID.from_random()
+    store.put(oid, payload)
+    value, buf = store.get(oid)
+    np.testing.assert_array_equal(value, payload)
+    buf.release()
+    store.delete(oid)
+
+    # churn: every cycle leaves the pool within its bound
+    for _ in range(12):
+        oid = ObjectID.from_random()
+        store.put(oid, payload)
+        assert store.delete(oid)
+        assert store.recycle_bytes <= cap // 8
+
+    # quiescent: no live objects or bytes left behind...
+    assert store.used == used0
+    assert store.num_objects == n0
+    # ...the pool holds something (deletes really parked files), bounded
+    assert 0 < store.recycle_bytes <= cap // 8
+    # ...and on disk only dot-prefixed store metadata (.index,
+    # .recycle.*) remains — no orphaned object files
+    leftovers = [f for f in os.listdir(store.directory)
+                 if not f.startswith(".")]
+    assert leftovers == []
+
+    # the next large create claims a warm file instead of growing the
+    # pool's tmpfs footprint
+    parked = store.recycle_bytes
+    oid = ObjectID.from_random()
+    store.put(oid, payload)
+    assert store.recycle_bytes < parked
+    store.delete(oid)

@@ -187,6 +187,90 @@ def test_prefix_share_outputs_identical_to_unshared(tiny_model):
 
 
 # ---------------------------------------------------------------------------
+# engine: speculative decoding on the paged pool
+# ---------------------------------------------------------------------------
+def test_paged_engine_speculative_matches_plain_greedy(tiny_model):
+    """With prompt-lookup speculation on, the paged engine's greedy
+    output is BIT-IDENTICAL to the non-speculative paged engine
+    (speculation is exact — only faster), drafts are actually proposed
+    on a repetitive prompt, and sampling requests fall back per slot."""
+    # Small bursts make the drafter check often; a long-enough greedy
+    # continuation settles into repetition the n-gram lookup can mine.
+    prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+    kw = dict(max_len=256, max_burst=2, prefix_sharing=False)
+    plain = make_engine(tiny_model, **kw)
+    ref = plain.generate(prompt, max_tokens=96, timeout=300)
+    plain.shutdown()
+
+    spec = make_engine(tiny_model, speculation_k=4, **kw)
+    out = spec.generate(prompt, max_tokens=96, timeout=300)
+    assert out == ref
+    st = spec.engine_stats()
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] > 0     # drafts actually advanced decode
+    # Sampling path still works alongside (falls back per slot).
+    sampled = spec.generate(prompt, max_tokens=6, temperature=0.8,
+                            timeout=120)
+    assert len(sampled) == 6
+    spec.shutdown()
+
+
+def test_paged_spec_rejected_drafts_with_shared_prefix_cow(tiny_model):
+    """Speculation composes with prefix sharing: generations over a
+    registered (shared, COW-tailed) prefix spec-decode into the COW
+    copy; rejected drafts leave the registered blocks pristine, so
+    repeated and divergent generations all match the unshared
+    non-speculative reference bit-for-bit."""
+    prompt = [1, 2, 3, 1, 2, 3]    # 6 tokens: partial tail at bs=4
+    kw = dict(max_len=256, max_burst=2)
+    ref_eng = make_engine(tiny_model, prefix_sharing=False, **kw)
+    ref = ref_eng.generate(prompt, max_tokens=64, timeout=300)
+    ref_div = ref_eng.generate(prompt[:4] + [9, 9], max_tokens=8,
+                               timeout=120)
+    ref_eng.shutdown()
+
+    eng = make_engine(tiny_model, prefix_sharing=True, speculation_k=4,
+                      **kw)
+    first = eng.generate(prompt, max_tokens=64, timeout=300)
+    assert first == ref
+    # Prefix hit: the shared tail block is COWed, then speculation
+    # writes (including rejected drafts) land only in the copy.
+    second = eng.generate(prompt, max_tokens=64, timeout=300)
+    assert second == ref
+    snap = eng.allocator.snapshot()
+    assert snap["cow_copies"] >= 1
+    # Divergent continuation off the shared aligned prefix still
+    # matches; the registered blocks were never corrupted by the
+    # speculative writer.
+    div = eng.generate(prompt[:4] + [9, 9], max_tokens=8, timeout=120)
+    assert div == ref_div
+    third = eng.generate(prompt, max_tokens=64, timeout=300)
+    assert third == ref
+    assert eng.stats["spec_proposed"] > 0
+    eng.shutdown()
+
+
+def test_fixed_engine_explicit_optin_deprecated(tiny_model):
+    """engine='fixed' on LLMDeployment is explicit opt-in and warns;
+    the default (paged) does not."""
+    import warnings as _warnings
+
+    from ray_tpu.serve.llm import LLMDeployment
+
+    cfg, _ = tiny_model
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", DeprecationWarning)
+        dep = LLMDeployment("tiny", num_slots=2, max_len=32)
+        assert isinstance(dep.engine, PagedLLMEngine)
+        dep.engine.shutdown()
+    with pytest.warns(DeprecationWarning, match="engine='fixed'"):
+        dep = LLMDeployment("tiny", engine="fixed", num_slots=2,
+                            max_len=32)
+    assert isinstance(dep.engine, LLMEngine)
+    dep.engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # engine: allocator-full admission queues (waits, not errors)
 # ---------------------------------------------------------------------------
 def test_allocator_full_requests_wait_then_complete(tiny_model):

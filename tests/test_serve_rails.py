@@ -1,0 +1,192 @@
+"""Decode on rails: serve streams ride the compiled-DAG channel plane.
+
+Covers: rails-on parity (item sequence identical to the RPC path, pull
+mode actually compiled); the RAY_TPU_SERVE_RAILS_ENABLED kill switch
+(admission-time fallback to RPC pulls, disabled-fallback contract);
+replica SIGKILL mid-stream with rails attached -> byte-identical
+exactly-once continuation through the ordinary RPC resume machinery;
+replica-side lane admission (width bound, kill switch, unroutable ring
+descriptor all spill at admission, never mid-stream)."""
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.config import get_config
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _restore_rails_knobs():
+    cfg = get_config()
+    keep = {k: getattr(cfg, k) for k in (
+        "serve_rails_enabled", "serve_rails_max_streams",
+        "serve_rails_tick_s", "serve_rails_probe_s")}
+    yield
+    for k, v in keep.items():
+        setattr(cfg, k, v)
+
+
+# ---------------------------------------------------------------------------
+# rails on: same items, compiled pull mode
+# ---------------------------------------------------------------------------
+def test_rails_stream_parity_and_mode():
+    @serve.deployment(num_replicas=1)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(ticker.bind(), name="rails_parity")
+    try:
+        resp = h.remote_streaming({"n": 37})
+        got = list(resp)
+        assert [x["i"] for x in got] == list(range(37))
+        assert resp.rails_used, "stream never attached to the rails lane"
+        assert resp.resumes == 0
+    finally:
+        serve.delete("rails_parity")
+
+
+def test_rails_disabled_falls_back_to_rpc():
+    """Kill switch contract: rails off => no ring is created, the stream
+    admits on RPC pulls, and the item sequence is unchanged."""
+    get_config().serve_rails_enabled = False
+
+    @serve.deployment(num_replicas=1)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            yield {"i": i}
+
+    h = serve.run(ticker.bind(), name="rails_off")
+    try:
+        resp = h.remote_streaming({"n": 23})
+        got = list(resp)
+        assert [x["i"] for x in got] == list(range(23))
+        assert not resp.rails_used
+    finally:
+        serve.delete("rails_off")
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL the serving replica mid-stream with rails attached
+# ---------------------------------------------------------------------------
+def test_rails_sigkill_midstream_exactly_once():
+    """Lane loss spills to the ordinary RPC path: the ring goes quiet,
+    the liveness probe surfaces the death as the same typed error the
+    RPC path raises, and the resume protocol re-admits the emitted
+    prefix on a survivor — the consumer sees one exactly-once
+    sequence."""
+    get_config().serve_rails_probe_s = 0.3
+
+    @serve.deployment(num_replicas=2)
+    def ticker(request):
+        for i in range(int(request["n"])):
+            time.sleep(0.03)
+            yield {"i": i, "pid": os.getpid()}
+
+    h = serve.run(ticker.bind(), name="rails_kill")
+    try:
+        resp = h.remote_streaming({"n": 40})
+        got, killed = [], False
+        for item in resp:
+            got.append(item)
+            if len(got) == 5 and not killed:
+                killed = True
+                assert resp.rails, "expected a rails-attached stream"
+                os.kill(item["pid"], signal.SIGKILL)
+        assert [x["i"] for x in got] == list(range(40))  # exactly once
+        assert len({x["pid"] for x in got}) == 2  # continued elsewhere
+        assert resp.resumes >= 1
+        assert resp.rails_used and not resp.rails  # spilled to RPC
+    finally:
+        serve.delete("rails_kill")
+
+
+# ---------------------------------------------------------------------------
+# replica-side lane admission (in-process, no cluster round trips)
+# ---------------------------------------------------------------------------
+def _unit_replica():
+    from ray_tpu.serve.replica import Replica
+
+    def endless(request=None):
+        for i in range(int((request or {}).get("n", 4))):
+            yield i
+
+    return Replica(endless, (), {}, "serve:railsunit#g0#0")
+
+
+def test_rails_attach_spills_when_disabled_or_full():
+    cfg = get_config()
+    r = _unit_replica()
+    desc = {"path": "/dev/shm/does-not-exist", "capacity": 1 << 16,
+            "n_readers": 1, "n_slots": 8, "daemon_address": None}
+
+    cfg.serve_rails_enabled = False
+    out = r.handle_request_streaming("__call__", ({"n": 2},), {},
+                                     rails=desc)
+    assert out["rails"] is False  # kill switch wins before the lane
+    assert r.stream_next(out["sid"], max_items=8)["items"] == [0, 1]
+
+    # Lane width 0: every attach spills at admission.
+    cfg.serve_rails_enabled = True
+    cfg.serve_rails_max_streams = 0
+    out = r.handle_request_streaming("__call__", ({"n": 2},), {},
+                                     rails=desc)
+    assert out["rails"] is False
+    assert r._rails.stats()["spilled_total"] == 1
+
+    # Unroutable descriptor (no ring file, no daemon): attach releases
+    # its slot and spills.
+    r2 = _unit_replica()
+    cfg.serve_rails_max_streams = 4
+    out = r2.handle_request_streaming("__call__", ({"n": 2},), {},
+                                      rails=desc)
+    assert out["rails"] is False
+    st = r2._rails.stats()
+    assert st["active"] == 0 and st["spilled_total"] == 1
+
+
+def test_rails_pump_frames_offset_tagged_and_done():
+    """The pinned pump drains the stream into offset-tagged frames over
+    the ring and retires the stream + lane slot at the terminal
+    frame."""
+    from ray_tpu.experimental.channel import Channel
+
+    get_config().serve_rails_enabled = True
+    get_config().serve_rails_max_streams = 4
+    r = _unit_replica()
+    ch = Channel.create(1, capacity=1 << 16)
+    try:
+        desc = {"path": ch.path, "capacity": ch.capacity,
+                "n_readers": ch.n_readers, "n_slots": ch.n_slots,
+                "daemon_address": None}
+        out = r.handle_request_streaming("__call__", ({"n": 6},), {},
+                                         rails=desc)
+        assert out["rails"] is True
+        items, offset, done = [], 0, False
+        while not done:
+            frame = ch.read(timeout=10.0, reader_idx=0)
+            assert frame["o"] == offset
+            items += frame["items"]
+            offset += len(frame["items"])
+            done = frame["done"]
+        assert items == list(range(6))
+        deadline = time.monotonic() + 5.0
+        while r._rails.stats()["active"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = r._rails.stats()
+        assert st["active"] == 0 and st["attached_total"] == 1
+        assert out["sid"] not in r._streams  # stream retired by the pump
+    finally:
+        ch.close()
+        ch.unlink()
