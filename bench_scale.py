@@ -21,6 +21,13 @@ Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
                     RAY_TPU_TASK_EVENTS_RESOURCES) on vs off in paired
                     subprocess runs; asserts the best-pair slowdown is
                     <5% (--only opt-in, same reason as obs_overhead)
+  gcs_attribution_overhead
+                    many_tasks with GCS load attribution (the _caller
+                    tag + per-RPC sink upsert,
+                    RAY_TPU_GCS_ATTRIBUTION_ENABLED) on vs off in
+                    paired subprocess runs; asserts the best-pair
+                    slowdown is <5% (--only opt-in, same reason as
+                    obs_overhead)
   elastic_recovery  kill one rank of an 8-rank training gang mid-step;
                     wall time from kill to the replacement rank's first
                     completed step, elastic supervisor (PG kept, restart
@@ -110,11 +117,17 @@ def bench_many_nodes(quick: bool) -> None:
         stats = gcs.syncer.stats()
         agg = vc.aggregate_stats()
         sub_view = len(vc.nodes[0].view.nodes)
+        # Control-plane load attribution at scale: every virtual
+        # daemon's pushes ride the real NodeSyncer, so the GCS's
+        # per-service x per-component shares must name the syncer as
+        # the dominant caller at N nodes.
+        shares = gcs.attribution.shares()
         await vc.stop()
         await gcs.stop()
-        return t_register, t_churn, alive, stats, agg, sub_view
+        return t_register, t_churn, alive, stats, agg, sub_view, shares
 
-    t_register, t_churn, alive, stats, agg, sub_view = asyncio.run(run())
+    (t_register, t_churn, alive, stats, agg, sub_view,
+     shares) = asyncio.run(run())
     assert alive >= n, f"only {alive}/{n} virtual daemons alive"
     assert agg["errors"] == 0, agg
     assert stats["applied_deltas"] > 0, stats
@@ -134,6 +147,16 @@ def bench_many_nodes(quick: bool) -> None:
          deltas=stats["applied_deltas"], suppressed=int(agg["suppressed"]),
          fulls=stats["applied_full"],
          delta_bytes=int(agg["bytes_sent"]))
+    comp = shares["component_handler_share"]
+    assert comp.get("syncer", 0.0) > 0.0, shares
+    emit("many_nodes_gcs_syncer_handler_share",
+         comp.get("syncer", 0.0), "share",
+         requests=int(shares["total"]["requests"]),
+         handler_seconds=round(shares["total"]["handler_s"], 3),
+         by_component={c: round(v, 4) for c, v in comp.items()},
+         top_rows=[[r["service"], r["component"], r["requests"],
+                    round(r["handler_share"], 4)]
+                   for r in shares["rows"][:8]])
 
 
 def _fill_store_object(store, oid, size: int) -> None:
@@ -391,6 +414,25 @@ def bench_attribution_overhead(quick: bool) -> None:
         f"{pairs}")
 
 
+def bench_gcs_attribution_overhead(quick: bool) -> None:
+    """GCS load-attribution overhead: many_tasks with the control-plane
+    attribution seam (client-side _caller injection + the per-RPC
+    attribution-sink dict upsert on the GCS) on vs off. The seam is one
+    tuple in kwargs client-side and one dict upsert + perf_counter pair
+    server-side — the best-pair slowdown must stay under 5%."""
+    pairs = _paired_many_tasks(
+        quick, "gcs_attribution",
+        {"RAY_TPU_GCS_ATTRIBUTION_ENABLED": "0"})
+    best = min(pairs, key=lambda p: p[0] / p[1])
+    ratio = best[0] / best[1]
+    emit("gcs_attribution_overhead_ratio", ratio, "x", baseline=None,
+         tasks_per_second_on=best[1], tasks_per_second_off=best[0],
+         all_pairs=[[round(o, 1), round(n, 1)] for o, n in pairs])
+    assert ratio < 1.05, (
+        f"GCS load attribution costs >5% many_tasks throughput: "
+        f"{pairs}")
+
+
 def bench_elastic_recovery(quick: bool) -> None:
     """Elastic-recovery probe (ISSUE 8): SIGKILL one rank of an 8-rank
     gang mid-step and measure kill -> training-resumed wall time, where
@@ -534,7 +576,7 @@ def main() -> None:
     # and must not share the driver's cluster.
     standalone = {"many_nodes", "object_transfer", "broadcast",
                   "obs_overhead", "attribution_overhead",
-                  "elastic_recovery"}
+                  "gcs_attribution_overhead", "elastic_recovery"}
     if want("many_nodes"):
         bench_many_nodes(quick)
     if want("object_transfer"):
@@ -548,6 +590,9 @@ def main() -> None:
     if want("attribution_overhead") and only is not None:
         # Subprocess-spawning probe, same opt-in rule as obs_overhead.
         bench_attribution_overhead(quick)
+    if want("gcs_attribution_overhead") and only is not None:
+        # Subprocess-spawning probe, same opt-in rule as obs_overhead.
+        bench_gcs_attribution_overhead(quick)
     if want("elastic_recovery") and only is not None:
         # Boots a driver cluster + three train jobs: opt-in so the
         # default full suite doesn't triple its wall time.
@@ -644,6 +689,21 @@ def main() -> None:
         dt = time.perf_counter() - t0
         emit("queued_flood_per_second", n / dt, "tasks/s", baseline=5163,
              total=n, submit_seconds=round(t_submit, 2))
+        # Who loaded the control plane during the flood: per-service x
+        # per-component GCS handler-time shares (the flood's driver
+        # submits as "client", daemons lease/heartbeat as "scheduler",
+        # completions flush as "task-events").
+        from ray_tpu.util import state as rt_state
+
+        fl = rt_state.gcs_load()["load"]
+        emit("queued_flood_gcs_requests", fl["total"]["requests"],
+             "requests",
+             handler_seconds=round(fl["total"]["handler_s"], 3),
+             by_component={c: round(v, 4) for c, v in
+                           fl["component_handler_share"].items()},
+             top_rows=[[r["service"], r["component"], r["requests"],
+                        round(r["handler_share"], 4)]
+                       for r in fl["rows"][:8]])
         del refs
 
     # ---- many_args / many_returns / many_gets -------------------------

@@ -282,6 +282,32 @@ class Config:
     # policy, raylet/worker_killing_policy.h:64).
     memory_monitor_refresh_ms: int = 250
     memory_usage_threshold: float = 0.95
+    # GCS load attribution: every GCS RPC carries its caller's identity
+    # (node id + component — syncer/serve-gauges/task-events/scheduler/
+    # client) and the GCS accumulates per-service x per-component
+    # request/bytes/handler-time shares (`ray-tpu gcs top`). The shares
+    # are the measure-then-shard evidence for the GCS sharding arc.
+    # RAY_TPU_GCS_ATTRIBUTION_ENABLED=0 is the bench kill switch the
+    # gcs_attribution_overhead probe flips.
+    gcs_attribution_enabled: bool = True
+    # Wall budget for a single GCS handler: any handler exceeding it is
+    # logged (method + caller + args digest) and journaled so slow-path
+    # regressions name their caller (RAY_TPU_GCS_SLOW_HANDLER_MS; 0
+    # disables the audit; read once at GCS start).
+    gcs_slow_handler_ms: float = 100.0
+    # GCS event-loop audit cadence: a sleep(interval) on the GCS's own
+    # loop measures its overshoot (lag) and samples the asyncio task
+    # backlog + KV/store sizes into gcs-labelled gauges (0 disables).
+    gcs_loop_audit_ms: int = 500
+    # Cluster flight recorder: a bounded, PersistentStore-durable
+    # journal of state transitions (node join/death, failover, drain +
+    # KV migration, autoscale/elastic resizes, PG repair) queryable via
+    # `ray-tpu events` / state.cluster_events() and surviving GCS
+    # restart (RAY_TPU_GCS_FLIGHT_RECORDER_ENABLED=0 disables).
+    gcs_flight_recorder_enabled: bool = True
+    # In-memory + durable journal bound: oldest entries evicted (and
+    # deleted from the store) past this many.
+    gcs_flight_max_events: int = 4096
 
     # ---- placement groups / gang scheduling ----
     # Two-phase gang reserve (ref: gcs_placement_group_scheduler.h:274

@@ -729,6 +729,11 @@ class DistributedCoreWorker:
             self.loop_thread.run(self._owner_server.start())
             self.address = self._owner_server.address
         self._owner_clients: Dict[str, SyncRpcClient] = {}
+        # GCS load attribution: drivers and workers are the "client"
+        # component — ad-hoc state reads, KV, object directory calls.
+        from ray_tpu.core.distributed.rpc import set_caller_identity
+
+        set_caller_identity(node_id, "client")
         self.gcs = SyncRpcClient(gcs_address, self.loop_thread)
         from ray_tpu.core.distributed.pull_manager import PullManager
         from ray_tpu.core.distributed.transfer import (
@@ -1664,7 +1669,7 @@ class DistributedCoreWorker:
         (the buffer owns retry/drop policy)."""
         gcs = await self._aget_gcs()
         await gcs.call("TaskEvents", "add_task_events", timeout=10,
-                       **payload)
+                       _caller=(self.node_id, "task-events"), **payload)
 
     def _record_task_status(self, spec: dict, state: str,
                             ts: Optional[float] = None,

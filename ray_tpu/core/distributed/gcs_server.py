@@ -75,6 +75,7 @@ class KV:
         self._store = store or NullStore()
         self._data: Dict[Tuple[str, bytes], bytes] = dict(
             self._store.all("kv"))
+        self.flight: Optional["FlightRecorder"] = None  # set by GcsServer
 
     def put(self, namespace: str, key: bytes, value: bytes,
             overwrite: bool = True) -> bool:
@@ -83,6 +84,16 @@ class KV:
             return False
         self._data[k] = value
         self._store.put("kv", k, value)
+        if (self.flight is not None and namespace == "serve"
+                and key.startswith(b"migrate:")):
+            # Live KV-migration tickets (serve drain) transit this KV:
+            # journal the publish leg so `ray-tpu events` shows the
+            # drain's migration hops next to the drain itself.
+            self.flight.record(
+                "serve.kv_migrate",
+                "migration ticket published: "
+                + key[len(b"migrate:"):].decode("utf-8", "replace"),
+                fields={"nbytes": len(value)})
         return True
 
     def get(self, namespace: str, key: bytes) -> Optional[bytes]:
@@ -123,12 +134,21 @@ class NodeInfo:
                 "node", "WARNING",
                 f"node {node_id[:8]} re-registered after being marked "
                 f"dead", node_id=node_id, address=address)
+            self._gcs.flight.record(
+                "node.rejoin",
+                f"node {node_id[:8]} re-registered after being marked "
+                f"dead", node_id=node_id, severity="WARNING",
+                fields={"address": address})
         else:
             logger.info("node %s registered at %s resources=%s",
                         node_id[:8], address, resources)
             self._gcs.event_log.emit("node", "INFO",
                                      f"node {node_id[:8]} registered",
                                      node_id=node_id, address=address)
+            self._gcs.flight.record(
+                "node.join", f"node {node_id[:8]} registered",
+                node_id=node_id,
+                fields={"address": address, "resources": dict(resources)})
         self._gcs.syncer.on_node_registered(node_id)
         self._gcs.pubsub.publish(
             "node", {"event": "added", "node_id": node_id,
@@ -180,6 +200,10 @@ class NodeInfo:
         self._gcs.event_log.emit("node", "WARNING",
                                  f"node {node_id[:8]} dead: {reason}",
                                  node_id=node_id, reason=reason)
+        self._gcs.flight.record(
+            "node.drain" if reason == "drained" else "node.death",
+            f"node {node_id[:8]} dead: {reason}", node_id=node_id,
+            severity="WARNING", fields={"reason": reason})
         self._gcs.syncer.on_node_dead(node_id)
         self._gcs.pubsub.publish(
             "node", {"event": "dead", "node_id": node_id, "reason": reason})
@@ -362,6 +386,16 @@ class ActorManager:
             "actor", "WARNING",
             f"actor {rec.actor_id[:8]} ({rec.cls_name}) dead: {reason}",
             actor_id=rec.actor_id, reason=reason)
+        if rec.detached or (rec.name or "").startswith("serve:"):
+            # Journal-worthy deaths only: detached/serve actors are
+            # cluster infrastructure (controllers, proxies, prefill
+            # workers) — per-job actor churn stays out of the journal.
+            self._gcs.flight.record(
+                "actor.death",
+                f"actor {rec.name or rec.actor_id[:8]} "
+                f"({rec.cls_name}) dead: {reason}",
+                node_id=rec.node_id or None, severity="WARNING",
+                fields={"actor_id": rec.actor_id, "name": rec.name})
         rec.state = ACTOR_DEAD
         rec.death_reason = reason
         rec.worker_address = ""
@@ -425,6 +459,18 @@ class ActorManager:
                 f"actor {rec.actor_id[:8]} restarting "
                 f"({rec.restarts_used}/{rec.max_restarts}): {reason}",
                 actor_id=rec.actor_id)
+            # Serve controller/proxy failover is a cluster transition
+            # worth a durable record; plain actor restarts journal only
+            # when the actor is detached infrastructure.
+            name = rec.name or ""
+            if rec.detached or name.startswith("serve:"):
+                self._gcs.flight.record(
+                    "serve.failover" if name.startswith("serve:")
+                    else "actor.failover",
+                    f"actor {name or rec.actor_id[:8]} restarting "
+                    f"({rec.restarts_used}/{rec.max_restarts}): {reason}",
+                    node_id=rec.node_id or None, severity="WARNING",
+                    fields={"actor_id": rec.actor_id, "name": rec.name})
         else:
             self._mark_dead(rec, reason)
 
@@ -790,6 +836,13 @@ class PlacementGroupManager:
                 f"{node_id[:8]}; re-reserving "
                 f"{sum(1 for n in rec.nodes if n is None)} bundle(s)",
                 pg_id=rec.pg_id)
+            self._gcs.flight.record(
+                "pg.repair",
+                f"pg {rec.pg_id[:8]} gang lost node {node_id[:8]}; "
+                f"re-reserving "
+                f"{sum(1 for n in rec.nodes if n is None)} bundle(s)",
+                node_id=node_id, severity="WARNING",
+                fields={"pg_id": rec.pg_id})
             if was_created:
                 self._wake_waiters(rec.pg_id)
             self._pending.put_nowait(rec.pg_id)
@@ -902,6 +955,12 @@ class PlacementGroupManager:
             f"pg {rec.pg_id[:8]} gang committed "
             f"({len(new_idxs)}/{len(rec.bundles)} bundles new)",
             pg_id=rec.pg_id)
+        if new_idxs:
+            self._gcs.flight.record(
+                "pg.commit",
+                f"pg {rec.pg_id[:8]} gang committed "
+                f"({len(new_idxs)}/{len(rec.bundles)} bundles new)",
+                fields={"pg_id": rec.pg_id, "nodes": list(placement)})
         self._gcs.pubsub.publish("pg", {"pg_id": rec.pg_id,
                                         "state": PG_CREATED,
                                         "nodes": placement})
@@ -950,8 +1009,15 @@ class EventLog:
     with severity, queryable via `ray-tpu list events` and the dashboard.
     """
 
+    # Decision sources whose events arrive over RPC (the elastic
+    # supervisor's resize decisions, autoscaler verdicts) and must also
+    # land in the durable flight recorder — their direct emitters live
+    # outside the GCS process, so the mirror is the one hook point.
+    MIRRORED_SOURCES = ("elastic", "autoscaler")
+
     def __init__(self, max_events: int = 20000):
         self.events: deque = deque(maxlen=max_events)
+        self.flight: Optional["FlightRecorder"] = None  # set by GcsServer
 
     def emit(self, source: str, severity: str, message: str,
              **fields) -> dict:
@@ -959,6 +1025,9 @@ class EventLog:
             "ts": time.time(), "source": source,
             "severity": severity, "message": message, **fields,
         })
+        if self.flight is not None and source in self.MIRRORED_SOURCES:
+            self.flight.record(source, message, severity=severity,
+                               fields=fields or None)
         return {"ok": True}
 
     def add_event(self, source: str, severity: str, message: str,
@@ -986,6 +1055,246 @@ class EventLog:
         return out
 
 
+class FlightRecorder:
+    """Cluster flight recorder: a bounded, PersistentStore-durable
+    journal of state transitions that previously vanished into logs —
+    node join/death/re-registration, controller/proxy failover, drain +
+    KV migration, autoscale and elastic resize decisions, PG repair.
+    Queryable by time/kind/node via `state.cluster_events()` /
+    `ray-tpu events`, and it survives a GCS restart: entries are
+    persisted to the same store that backs KV/actors/PGs, so the
+    post-recovery journal still explains how the cluster got here.
+
+    The on-loop cost of ``record()`` is a deque append plus an executor
+    handoff; the fsyncing store write always runs OFF the GCS loop
+    (pinned by the lint suite's `no-blocking-in-loop` journal registry).
+    """
+
+    _RESERVED = ("seq", "ts", "kind", "severity", "message", "node_id",
+                 "self")
+
+    def __init__(self, gcs: "GcsServer", store=None):
+        from ray_tpu.core.distributed.gcs_storage import NullStore
+
+        cfg = get_config()
+        self._gcs = gcs
+        self._store = store or NullStore()
+        self._enabled = cfg.gcs_flight_recorder_enabled
+        self._max = max(16, cfg.gcs_flight_max_events)
+        self.events: deque = deque()
+        self._seq = 0
+        # Boot-load the journal the last GCS incarnation left behind
+        # (constructor runs before the server accepts RPCs, so blocking
+        # store reads are fine here — same as the KV table load).
+        for seq, entry in sorted(self._store.all("flight").items()):
+            self.events.append(entry)
+            try:
+                self._seq = max(self._seq, int(seq))
+            except (TypeError, ValueError):
+                pass
+        while len(self.events) > self._max:
+            evicted = self.events.popleft()
+            self._store.delete("flight", evicted.get("seq"))
+
+    def record(self, kind: str, message: str,
+               node_id: Optional[str] = None, severity: str = "INFO",
+               fields: Optional[dict] = None) -> dict:
+        """Journal one state transition (also the RPC entry point, so
+        out-of-process components can journal through the GCS)."""
+        if not self._enabled:
+            return {"ok": False, "disabled": True}
+        clean = {(f"field_{k}" if k in self._RESERVED else k): v
+                 for k, v in (fields or {}).items()}
+        self._seq += 1
+        entry = {"seq": self._seq, "ts": time.time(), "kind": kind,
+                 "severity": severity, "message": message,
+                 "node_id": node_id, **clean}
+        self.events.append(entry)
+        evict = self.events.popleft() if len(self.events) > self._max \
+            else None
+        self._schedule_persist(entry, evict)
+        return {"ok": True, "seq": self._seq}
+
+    def _schedule_persist(self, entry: dict, evict: Optional[dict]
+                          ) -> None:
+        # The store write fsyncs under a lock — never on the GCS loop.
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # No running loop (unit tests, boot): synchronous is safe.
+            self._persist(entry, evict)
+            return
+        loop.run_in_executor(None, self._persist, entry, evict)
+
+    def _persist(self, entry: dict, evict: Optional[dict]) -> None:
+        try:
+            self._store.put("flight", entry["seq"], entry)
+            if evict is not None:
+                self._store.delete("flight", evict.get("seq"))
+        except Exception:  # noqa: BLE001 — journal is best-effort
+            pass
+
+    def list_events(self, kind: Optional[str] = None,
+                    node_id: Optional[str] = None,
+                    since: Optional[float] = None,
+                    until: Optional[float] = None,
+                    limit: int = 200) -> List[dict]:
+        """Newest-first scan with time/kind/node filters; the result is
+        returned oldest-first (a readable timeline)."""
+        out: List[dict] = []
+        for e in reversed(self.events):
+            if since is not None and e["ts"] < since:
+                break  # deque is time-ordered; the rest is older still
+            if until is not None and e["ts"] > until:
+                continue
+            if kind is not None and not e["kind"].startswith(kind):
+                continue
+            if node_id is not None and e.get("node_id") != node_id:
+                continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    def kinds(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for e in self.events:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        return counts
+
+    def stats(self) -> dict:
+        return {"enabled": self._enabled, "events": len(self.events),
+                "seq": self._seq, "max_events": self._max,
+                "kinds": self.kinds(),
+                "durable": type(self._store).__name__ != "NullStore"}
+
+
+def _arg_digest(value: Any) -> str:
+    """Compact, bounded description of one handler argument for the
+    slow-handler audit — sizes for payloads, truncated reprs for the
+    rest (never the full value: a 10 MB blob must not become a 10 MB
+    log line)."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return f"bytes[{len(value)}]"
+    if isinstance(value, (list, tuple, set)):
+        return f"{type(value).__name__}[{len(value)}]"
+    if isinstance(value, dict):
+        return f"dict[{len(value)}]"
+    text = repr(value)
+    return text if len(text) <= 48 else text[:45] + "..."
+
+
+class GcsLoadAttribution:
+    """GCS load attribution (tentpole of the measure-then-shard arc):
+    every handled RPC is accounted per (service, caller component) —
+    requests, bytes in, handler wall time — via the RpcServer
+    attribution sink, with caller identity riding the reserved
+    `_caller` kwarg rpc.py injects client-side. `shares()` turns the
+    raw accumulators into the per-service x per-component load shares
+    `ray-tpu gcs top` renders and the sharding PR will cite.
+
+    Also the slow-handler audit: any handler exceeding
+    RAY_TPU_GCS_SLOW_HANDLER_MS is logged with method + caller + a
+    bounded args digest (built lazily — fast handlers never pay for
+    repr) and kept in a small recent-ring for `ray-tpu doctor`."""
+
+    SLOW_KEEP = 32
+
+    def __init__(self, gcs: "GcsServer"):
+        cfg = get_config()
+        self._gcs = gcs
+        self._t0 = time.time()
+        # (service, component) -> [requests, bytes, handler_seconds]
+        self._by: Dict[Tuple[str, str], List[float]] = {}
+        self._slow_budget_s = max(0.0, cfg.gcs_slow_handler_ms) / 1000.0
+        self.slow_total = 0
+        self._slow_recent: deque = deque(maxlen=self.SLOW_KEEP)
+
+    def sink(self, attr: tuple, seconds: float, kwargs: dict,
+             stream: bool = False) -> None:
+        """Installed as RpcServer.attribution_sink — one dict upsert
+        per handled RPC is the entire on-loop cost of attribution.
+        A stream's `seconds` is its open lifetime (await-time, not
+        loop occupancy): count the request and bytes, skip the time
+        accumulators and the slow-handler audit."""
+        service, method, caller, nbytes = attr
+        component = caller[1] if caller else "unknown"
+        slot = self._by.get((service, component))
+        if slot is None:
+            slot = self._by[(service, component)] = [0, 0, 0.0]
+        slot[0] += 1
+        slot[1] += nbytes
+        if stream:
+            return
+        slot[2] += seconds
+        if self._slow_budget_s and seconds >= self._slow_budget_s:
+            self._record_slow(service, method, caller, seconds, kwargs)
+
+    def _record_slow(self, service: str, method: str,
+                     caller: Optional[tuple], seconds: float,
+                     kwargs: dict) -> None:
+        digest = ", ".join(f"{k}={_arg_digest(v)}"
+                           for k, v in list(kwargs.items())[:8])
+        who = f"{caller[1]}@{caller[0][:8]}" if caller else "unknown"
+        entry = {"ts": time.time(), "service": service, "method": method,
+                 "caller": list(caller) if caller else None,
+                 "wall_ms": round(seconds * 1000.0, 3), "args": digest}
+        self.slow_total += 1
+        self._slow_recent.append(entry)
+        logger.warning(
+            "slow GCS handler %s.%s: %.1fms (budget %.0fms) caller=%s "
+            "args=[%s]", service, method, seconds * 1000.0,
+            self._slow_budget_s * 1000.0, who, digest)
+        self._gcs.event_log.emit(
+            "gcs", "WARNING",
+            f"slow handler {service}.{method}: "
+            f"{seconds * 1000.0:.1f}ms (caller {who})",
+            service=service, method=method, wall_ms=entry["wall_ms"])
+
+    def shares(self) -> dict:
+        """Per-service x per-component request/bytes/handler-time load
+        shares since GCS boot, plus the per-component handler-time
+        rollup the doctor's top finding quotes."""
+        total_req, total_bytes, total_s = 0, 0, 0.0
+        for reqs, nbytes, secs in self._by.values():
+            total_req += reqs
+            total_bytes += nbytes
+            total_s += secs
+        rows = []
+        for (service, component), (reqs, nbytes, secs) in sorted(
+                self._by.items(), key=lambda kv: -kv[1][2]):
+            rows.append({
+                "service": service, "component": component,
+                "requests": reqs, "bytes": nbytes,
+                "handler_s": round(secs, 6),
+                "requests_share": round(reqs / total_req, 4)
+                if total_req else 0.0,
+                "bytes_share": round(nbytes / total_bytes, 4)
+                if total_bytes else 0.0,
+                "handler_share": round(secs / total_s, 4)
+                if total_s else 0.0,
+            })
+        by_comp: Dict[str, float] = {}
+        for (_service, component), (_r, _b, secs) in self._by.items():
+            by_comp[component] = by_comp.get(component, 0.0) + secs
+        comp_shares = {c: (round(s / total_s, 4) if total_s else 0.0)
+                       for c, s in sorted(by_comp.items(),
+                                          key=lambda kv: -kv[1])}
+        return {
+            "window_s": round(time.time() - self._t0, 1),
+            "total": {"requests": total_req, "bytes": total_bytes,
+                      "handler_s": round(total_s, 6)},
+            "rows": rows,
+            "component_handler_share": comp_shares,
+            "slow_handlers": {
+                "total": self.slow_total,
+                "budget_ms": round(self._slow_budget_s * 1000.0, 1),
+                "recent": list(self._slow_recent),
+            },
+        }
+
+
 class MetricsFederation:
     """Cluster-wide metrics view (the analogue of Prometheus federation
     over the reference's per-node metrics agents): nodes piggyback
@@ -1010,7 +1319,11 @@ class MetricsFederation:
 
         dumps = {nid[:12]: rec["dump"]
                  for nid, rec in self._node_dumps.items()}
-        dumps["gcs"] = registry_dump()
+        # The GCS's own process metrics (RPC handler histograms,
+        # event-loop lag/backlog, KV + flight-journal sizes) ride the
+        # same exposition, labelled with the GCS's node identity so
+        # multi-cluster scrapes stay distinguishable.
+        dumps[f"gcs:{self._gcs.node_id[:12]}"] = registry_dump()
         return merge_dumps(dumps)
 
     def stats(self) -> dict:
@@ -1031,7 +1344,134 @@ class MetricsFederation:
             "task_events": self._gcs.task_events.stats(),
             "hung_tasks": self._gcs.task_events.hung_tasks(),
             "serve": self._gcs.serve_gauges.summary(),
+            "gcs": self.gcs_load(),
         }
+
+    def gcs_load(self) -> dict:
+        """Control-plane self-observability blob: attribution shares,
+        the event-loop audit, and flight-journal stats — served both
+        standalone (`ray-tpu gcs top`) and inside cluster_summary."""
+        return {
+            "node_id": self._gcs.node_id,
+            "load": self._gcs.attribution.shares(),
+            "loop": dict(self._gcs.loop_audit),
+            "flight": self._gcs.flight.stats(),
+        }
+
+    # -- doctor ---------------------------------------------------------
+    #
+    # Heuristic thresholds (share of GCS handler time worth flagging,
+    # loop lag, recent-death window) — tuned to flag real saturation
+    # without firing on an idle two-node cluster.
+    DOCTOR_SHARE_WARN = 0.35
+    DOCTOR_MIN_HANDLER_S = 0.05
+    DOCTOR_LAG_WARN_S = 0.25
+    DOCTOR_DEATH_WINDOW_S = 600.0
+
+    _SHARE_HINTS = {
+        "serve-gauges": "raise RAY_TPU_SERVE_METRICS_PUSH_S",
+        "syncer": "raise RAY_TPU_METRICS_SYNC_INTERVAL_MS",
+        "task-events": "raise RAY_TPU_TASK_EVENTS_FLUSH_MS or lower "
+                       "RAY_TPU_TASK_EVENTS_MAX_BUFFER",
+        "scheduler": "check heartbeat cadence / lease churn "
+                     "(RAY_TPU_HEALTH_CHECK_PERIOD_MS)",
+        "client": "batch driver-side GCS reads",
+    }
+
+    def doctor(self) -> dict:
+        """One fused health report: federated metrics freshness, hung
+        tasks, task-event drop/eviction counters, GCS load shares, the
+        event-loop audit, and recent flight-recorder entries — ranked
+        findings, highest score first, each with an actionable hint."""
+        gcs = self._gcs
+        now = time.time()
+        findings: List[dict] = []
+
+        def add(kind: str, severity: str, score: float, message: str,
+                hint: str, **extra) -> None:
+            findings.append({"kind": kind, "severity": severity,
+                             "score": round(score, 1),
+                             "message": message, "hint": hint, **extra})
+
+        load = gcs.attribution.shares()
+        total_s = load["total"]["handler_s"]
+        for comp, share in load["component_handler_share"].items():
+            if (comp != "unknown" and share >= self.DOCTOR_SHARE_WARN
+                    and total_s >= self.DOCTOR_MIN_HANDLER_S):
+                add("gcs-load", "warning", 40 + share * 55,
+                    f"component '{comp}' is {share:.0%} of GCS handler "
+                    f"time ({total_s:.2f}s total)",
+                    self._SHARE_HINTS.get(
+                        comp, "profile this component's GCS call sites"),
+                    component=comp, share=share)
+        slow = load["slow_handlers"]
+        if slow["total"]:
+            worst = max(slow["recent"], key=lambda e: e["wall_ms"],
+                        default=None)
+            add("gcs-slow-handler", "warning",
+                45 + min(20.0, slow["total"]),
+                f"{slow['total']} GCS handler(s) exceeded the "
+                f"{slow['budget_ms']:.0f}ms budget"
+                + (f" (worst: {worst['service']}.{worst['method']} "
+                   f"{worst['wall_ms']:.0f}ms)" if worst else ""),
+                "inspect the slow-handler log lines; raise "
+                "RAY_TPU_GCS_SLOW_HANDLER_MS only if expected",
+                recent=slow["recent"][-3:])
+        lag = gcs.loop_audit.get("lag_max_s", 0.0)
+        if lag >= self.DOCTOR_LAG_WARN_S:
+            add("gcs-loop-lag",
+                "critical" if lag >= 4 * self.DOCTOR_LAG_WARN_S
+                else "warning", 60 + min(30.0, lag * 10),
+                f"GCS event loop lagged up to {lag * 1000:.0f}ms",
+                "a handler or import is blocking the loop; check the "
+                "slow-handler audit and gcs-load shares", lag_s=lag)
+        hung = gcs.task_events.hung_tasks(limit=10)
+        if hung:
+            oldest = min(h.get("hung_ts") or now for h in hung)
+            add("hung-tasks", "critical", 85 + min(10.0, len(hung)),
+                f"{len(hung)} task(s) flagged hung "
+                f"(oldest {now - oldest:.0f}s ago)",
+                "`ray-tpu stack <node>` for live tracebacks; see "
+                "attached auto-captured dumps", tasks=hung[:5])
+        te = gcs.task_events.stats()
+        dropped = (te.get("worker_dropped_status", 0)
+                   + te.get("worker_dropped_profile", 0))
+        evicted = te.get("evicted", 0)
+        if dropped or evicted:
+            add("task-event-loss", "info",
+                20 + min(20.0, (dropped + evicted) / 1000),
+                f"task-event telemetry is incomplete: {dropped} dropped "
+                f"worker-side, {evicted} evicted by the GCS cap",
+                "raise RAY_TPU_TASK_EVENTS_MAX_BUFFER / "
+                "RAY_TPU_TASK_EVENTS_MAX_PER_JOB if completeness "
+                "matters", dropped=dropped, evicted=evicted)
+        deaths = [e for e in gcs.flight.list_events(kind="node.death",
+                                                    limit=50)
+                  if now - e["ts"] <= self.DOCTOR_DEATH_WINDOW_S]
+        if deaths:
+            add("node-churn", "warning", 70 + min(15.0, len(deaths) * 3),
+                f"{len(deaths)} node death(s) in the last "
+                f"{self.DOCTOR_DEATH_WINDOW_S / 60:.0f}min "
+                f"(latest: {deaths[-1]['message']})",
+                "`ray-tpu events --kind node` for the timeline; check "
+                "host health / preemptions",
+                nodes=[e.get("node_id") for e in deaths[-5:]])
+        cfg = get_config()
+        stale_after = max(3 * cfg.metrics_sync_interval_ms / 1000.0, 10.0)
+        stale = {nid: s for nid, s in self.stats()["staleness_s"].items()
+                 if s > stale_after}
+        if stale:
+            add("stale-metrics", "warning", 55 + min(15.0, len(stale) * 3),
+                f"{len(stale)} node(s) have not shipped metrics for "
+                f">{stale_after:.0f}s: {sorted(stale)[:5]}",
+                "their syncer pushes are stalling — check daemon health",
+                nodes=stale)
+        findings.sort(key=lambda f: -f["score"])
+        return {"ts": now, "healthy": not findings,
+                "findings": findings,
+                "checks": ["gcs-load", "gcs-slow-handler", "gcs-loop-lag",
+                           "hung-tasks", "task-event-loss", "node-churn",
+                           "stale-metrics"]}
 
 
 class ServeGauges:
@@ -1294,6 +1734,15 @@ class GcsServer:
         from ray_tpu.core.distributed.syncer import ClusterSyncer
 
         self.store = open_store(storage_dir)
+        # The GCS's own node identity: labels its process metrics in the
+        # federated exposition and survives restarts when durable (the
+        # journal a recovered GCS serves should carry the same label).
+        import uuid
+
+        meta = self.store.all("meta")
+        self.node_id = meta.get("gcs_id") or uuid.uuid4().hex
+        if meta.get("gcs_id") != self.node_id:
+            self.store.put("meta", "gcs_id", self.node_id)
         self.pubsub = Pubsub()
         self.kv = KV(self.store)
         self.nodes = NodeInfo(self)
@@ -1315,9 +1764,20 @@ class GcsServer:
         self.diagnosis = DiagnosisManager(self)
         self.serve_gauges = ServeGauges(self)
         self.event_log = EventLog()
+        self.flight = FlightRecorder(self, self.store)
+        self.event_log.flight = self.flight
+        self.kv.flight = self.flight
+        self.attribution = GcsLoadAttribution(self)
+        # Event-loop audit state (filled by _audit_loop; read by
+        # gcs_load()/doctor even when the audit is disabled).
+        self.loop_audit: Dict[str, Any] = {
+            "samples": 0, "lag_last_s": 0.0, "lag_max_s": 0.0,
+            "backlog": 0}
         self.autoscaler_state = AutoscalerStateManager(self)
         self.logs = LogManager(self)
         self.server = RpcServer(host, port)
+        if get_config().gcs_attribution_enabled:
+            self.server.attribution_sink = self.attribution.sink
         self._daemon_clients: Dict[str, AsyncRpcClient] = {}
         self._tasks: List[asyncio.Task] = []
 
@@ -1345,6 +1805,7 @@ class GcsServer:
             ("Metrics", self.metrics),
             ("Diagnosis", self.diagnosis),
             ("Serve", self.serve_gauges),
+            ("FlightRecorder", self.flight),
         ]:
             self.server.add_service(name, svc)
         port = await self.server.start()
@@ -1354,12 +1815,68 @@ class GcsServer:
             asyncio.ensure_future(self.actors.scheduling_loop()),
             asyncio.ensure_future(self.placement_groups.scheduling_loop()),
             asyncio.ensure_future(self.syncer.broadcast_loop()),
+            asyncio.ensure_future(self._audit_loop()),
         ]
+        self.flight.record("gcs.start", "GCS serving",
+                           node_id=self.node_id,
+                           fields={"address": self.server.address})
         # Resume scheduling of state loaded from durable storage.
         self.actors.requeue_loaded()
         self.placement_groups.requeue_loaded()
         logger.info("GCS listening on %s", self.server.address)
         return port
+
+    async def _audit_loop(self) -> None:
+        """GCS event-loop audit. The GCS runs on a plain asyncio.run
+        loop (not an EventLoopThread), so it has no lag probe of its
+        own: a timed sleep measures its overshoot — lag means some
+        handler or import blocked the loop — and each tick also samples
+        the asyncio task backlog and KV/journal sizes into gcs-labelled
+        gauges that ride the federated exposition."""
+        from ray_tpu.util.metrics import Gauge, process_sample
+
+        interval = get_config().gcs_loop_audit_ms / 1000.0
+        if interval <= 0:
+            return
+        g_lag = Gauge("raytpu_gcs_loop_lag_seconds",
+                      "GCS event-loop lag (audit sleep overshoot)")
+        g_backlog = Gauge("raytpu_gcs_loop_backlog",
+                          "asyncio tasks pending on the GCS loop")
+        g_kv = Gauge("raytpu_gcs_kv_keys",
+                     "keys in the GCS KV store")
+        g_flight = Gauge("raytpu_gcs_flight_events",
+                         "entries in the cluster flight recorder")
+        # The GCS's own process footprint, in the same registry the
+        # federation labels with this GCS's node id: the control plane
+        # monitors itself with the machinery it runs for everyone else.
+        g_proc = {
+            "rss_bytes": Gauge("raytpu_gcs_process_rss_bytes",
+                               "GCS process resident set size"),
+            "cpu_seconds": Gauge("raytpu_gcs_process_cpu_seconds",
+                                 "GCS process cumulative CPU time"),
+            "open_fds": Gauge("raytpu_gcs_process_open_fds",
+                              "GCS process open file descriptors"),
+            "threads": Gauge("raytpu_gcs_process_threads",
+                             "GCS process live threads"),
+        }
+        audit = self.loop_audit
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(interval)
+            lag = max(0.0, time.monotonic() - t0 - interval)
+            audit["samples"] += 1
+            audit["lag_last_s"] = round(lag, 6)
+            audit["lag_max_s"] = max(audit["lag_max_s"], round(lag, 6))
+            audit["backlog"] = sum(1 for t in asyncio.all_tasks()
+                                   if not t.done())
+            g_lag.set(lag)
+            g_backlog.set(audit["backlog"])
+            g_kv.set(len(self.kv._data))
+            g_flight.set(len(self.flight.events))
+            for name, value in process_sample().items():
+                g = g_proc.get(name)
+                if g is not None:
+                    g.set(value)
 
     def _start_metrics_http(self) -> None:
         """Federated /metrics on the GCS (ref: the dashboard's

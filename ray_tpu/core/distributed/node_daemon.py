@@ -261,8 +261,15 @@ class NodeDaemon:
     # lifecycle
     # ------------------------------------------------------------------
     async def start(self) -> int:
+        from ray_tpu.core.distributed.rpc import set_caller_identity
+
         self.server.add_service("NodeDaemon", self)
         port = await self.server.start()
+        # GCS load attribution: the daemon's default identity is its
+        # scheduling plane (leases, heartbeats, object directory);
+        # subsystems acting as a different component (syncer pushes,
+        # task-event flushes) pass an explicit per-call `_caller`.
+        set_caller_identity(self.node_id, "scheduler")
         self.gcs = AsyncRpcClient(self.gcs_address)
         await self.gcs.call(
             "NodeInfo", "register_node", node_id=self.node_id,
@@ -2008,6 +2015,7 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     async def _flush_task_events(self, **payload) -> None:
         await self.gcs.call("TaskEvents", "add_task_events", timeout=10,
+                            _caller=(self.node_id, "task-events"),
                             **payload)
 
     def _dump_lock(self, pid: int) -> asyncio.Lock:

@@ -128,6 +128,48 @@ def _instrumentation_enabled() -> bool:
     return get_config().metrics_rpc_enabled
 
 
+# ---------------------------------------------------------------------------
+# Caller identity (GCS load attribution)
+# ---------------------------------------------------------------------------
+#
+# Every process declares WHO it is once (node id + component:
+# syncer / serve-gauges / task-events / scheduler / client); both
+# clients then ride a reserved `_caller` kwarg inside the existing
+# (service, method, kwargs) request tuple — zero wire-format change, no
+# protocol bump. The server pops it before handler dispatch (user
+# handlers never see it) and, when an attribution sink is installed
+# (the GCS), accounts request/bytes/handler-time per (service,
+# component). Call sites that act as a DIFFERENT component than their
+# process default (the daemon's syncer push vs its scheduler RPCs)
+# pass an explicit `_caller=(node_id, component)` kwarg which wins.
+
+_caller_identity: Optional[Tuple[str, str]] = None
+
+
+def set_caller_identity(node_id: str, component: str) -> None:
+    """Declare this process's default caller identity for GCS load
+    attribution. Applied to every subsequent RPC from this process
+    unless the call site passes an explicit ``_caller=`` kwarg."""
+    global _caller_identity
+    _caller_identity = (node_id, component)
+
+
+def get_caller_identity() -> Optional[Tuple[str, str]]:
+    return _caller_identity
+
+
+def _attribution_enabled() -> bool:
+    from ray_tpu.core.config import get_config
+
+    return get_config().gcs_attribution_enabled
+
+
+def _inject_caller(kwargs: dict) -> None:
+    if _caller_identity is not None and "_caller" not in kwargs \
+            and _attribution_enabled():
+        kwargs["_caller"] = _caller_identity
+
+
 # Precomputed sample KEYS for the per-frame/per-call fast paths
 # (metrics.*_key): the transport observes ~10 samples per RPC round
 # trip, and building + sorting a tags dict per observation was a
@@ -314,6 +356,13 @@ class RpcServer:
         self._writers: set = set()
         self._metrics = rpc_metrics() if _instrumentation_enabled() \
             else None
+        # GCS load attribution: when installed (GcsServer only), called
+        # as sink((service, method, caller, in_nbytes), wall_s, kwargs,
+        # stream=...) after every handler — caller is the popped
+        # `_caller` identity tuple or None; stream=True means wall_s is
+        # a stream's open lifetime, not loop occupancy. Must never
+        # raise into the dispatch path.
+        self.attribution_sink: Optional[Any] = None
 
     def add_service(self, name: str, service: Any) -> None:
         self._services[name] = service
@@ -386,9 +435,11 @@ class RpcServer:
 
         async def run_unary(req_id: int, fn, kwargs: dict, codec: int,
                             mkey: Optional[tuple] = None,
-                            t_recv: float = 0.0) -> None:
-            if metrics is not None:
+                            t_recv: float = 0.0,
+                            attr: Optional[tuple] = None) -> None:
+            if metrics is not None or attr is not None:
                 now = _time.perf_counter()
+            if metrics is not None:
                 metrics["queue_wait"].observe_key(
                     mkey, max(0.0, now - t_recv))
                 metrics["inflight"].inc_key(_K_SRV)
@@ -410,6 +461,13 @@ class RpcServer:
                     metrics["inflight"].inc_key(_K_SRV, -1)
                     metrics["handler"].observe_key(
                         mkey, _time.perf_counter() - now)
+                if attr is not None:
+                    sink = self.attribution_sink
+                    if sink is not None:
+                        try:
+                            sink(attr, _time.perf_counter() - now, kwargs)
+                        except Exception:  # noqa: BLE001
+                            pass
             try:
                 await send(RES, req_id, reply, codec)
             except (ConnectionError, OSError):
@@ -417,9 +475,11 @@ class RpcServer:
 
         async def run_stream(req_id: int, fn, kwargs: dict, codec: int,
                              mkey: Optional[tuple] = None,
-                             t_recv: float = 0.0) -> None:
-            if metrics is not None:
+                             t_recv: float = 0.0,
+                             attr: Optional[tuple] = None) -> None:
+            if metrics is not None or attr is not None:
                 now = _time.perf_counter()
+            if metrics is not None:
                 metrics["queue_wait"].observe_key(
                     mkey, max(0.0, now - t_recv))
                 metrics["inflight"].inc_key(_K_SRV)
@@ -441,6 +501,19 @@ class RpcServer:
                     metrics["inflight"].inc_key(_K_SRV, -1)
                     metrics["handler"].observe_key(
                         mkey, _time.perf_counter() - now)
+                if attr is not None:
+                    sink = self.attribution_sink
+                    if sink is not None:
+                        # A stream's wall lifetime is await-time (a
+                        # subscription can stay open for hours), not
+                        # loop occupancy: count the request and its
+                        # bytes, but no handler seconds, and keep it
+                        # out of the slow-handler audit.
+                        try:
+                            sink(attr, _time.perf_counter() - now,
+                                 kwargs, stream=True)
+                        except Exception:  # noqa: BLE001
+                            pass
             try:
                 await send(STREAM_END, req_id, end, codec)
             except (ConnectionError, OSError):
@@ -480,6 +553,11 @@ class RpcServer:
                     (service, method, kwargs), codec = _de_codec(payload)
                 except Exception:  # noqa: BLE001
                     continue
+                # Reserved attribution kwarg: popped unconditionally so
+                # handlers never see it, accounted only when a sink is
+                # installed (the GCS).
+                caller = kwargs.pop("_caller", None) \
+                    if isinstance(kwargs, dict) else None
                 svc = self._services.get(service)
                 fn = (None if svc is None or method.startswith("_")
                       else getattr(svc, method, None))
@@ -491,9 +569,12 @@ class RpcServer:
                     continue
                 mkey = (_key_for(service, method)
                         if metrics is not None else None)
+                attr = ((service, method, caller,
+                         len(payload) + _HEADER.size)
+                        if self.attribution_sink is not None else None)
                 runner = (run_stream if ftype == STREAM_REQ else run_unary)
                 task = asyncio.ensure_future(
-                    runner(req_id, fn, kwargs, codec, mkey, t_recv))
+                    runner(req_id, fn, kwargs, codec, mkey, t_recv, attr))
                 inflight[req_id] = task
                 self._conn_tasks.add(task)
                 task.add_done_callback(self._conn_tasks.discard)
@@ -630,6 +711,7 @@ class AsyncRpcClient:
 
     async def _call(self, service: str, method: str,
                     timeout: Optional[float] = None, **kwargs) -> Any:
+        _inject_caller(kwargs)
         await self._ensure_conn()
         self._req_id += 1
         req_id = self._req_id
@@ -674,6 +756,7 @@ class AsyncRpcClient:
     def stream(self, service: str, method: str,
                timeout: Optional[float] = None, **kwargs):
         async def gen():
+            _inject_caller(kwargs)
             await self._ensure_conn()
             self._req_id += 1
             req_id = self._req_id
@@ -980,6 +1063,7 @@ class SyncRpcClient:
         the same rule) — unless the caller declares the method
         `idempotent=True` (reads, status polls, overwriting KV puts).
         """
+        _inject_caller(kwargs)
         payload = _ser((service, method, kwargs), self.codec)
         with self._lock:
             self._req_id += 1

@@ -186,11 +186,14 @@ class NodeSyncer:
                     delta: Optional[Dict[str, Any]] = None,
                     full: bool = False, keepalive: bool = False) -> str:
         msnap = self._metrics_payload()
+        # GCS load attribution: pushes are the syncer's own load, not
+        # the daemon's scheduler default.
+        whoami = (self.node_id, "syncer")
         if keepalive:
             reply = await self.gcs.call(
                 "Syncer", "push_update", node_id=self.node_id,
                 version=self.version, keepalive=True, metrics=msnap,
-                timeout=10)
+                _caller=whoami, timeout=10)
             kind = "keepalive"
         else:
             payload = dict(state) if full else delta
@@ -199,7 +202,7 @@ class NodeSyncer:
             reply = await self.gcs.call(
                 "Syncer", "push_update", node_id=self.node_id,
                 version=version, base_version=base, state=payload,
-                full=full, metrics=msnap, timeout=10)
+                full=full, metrics=msnap, _caller=whoami, timeout=10)
             kind = "full" if full else "delta"
         if not reply.get("registered", True):
             # The GCS does not know us (restart) or marked us dead

@@ -51,6 +51,21 @@ RAILS_HOT_LOOPS: Dict[str, Set[str]] = {
                                         "Channel.write_bytes"},
 }
 
+# file -> dotted qualnames on the flight-recorder journal write path.
+# These run ON the GCS event loop for every journalled state transition
+# (node death during a storm, drain fan-out, PG repair), so the durable
+# append — PersistentStore.put fsyncs under a lock — must leave the loop
+# via run_in_executor.  Flagged here: blocking calls (same set as the
+# async-body scan) plus DIRECT store writes (.put/.delete on a store-ish
+# receiver).  Exception handlers are exempt (the loop-less sync fallback
+# for journal writes issued before/after the GCS loop runs lives there).
+JOURNAL_WRITE_PATHS: Dict[str, Set[str]] = {
+    "ray_tpu/core/distributed/gcs_server.py": {
+        "FlightRecorder.record",
+        "FlightRecorder._schedule_persist",
+    },
+}
+
 
 def _unparse(node: ast.expr) -> str:
     try:
@@ -203,6 +218,23 @@ def _blocking_message(
     return None
 
 
+def _store_write_message(call: ast.Call) -> Optional[str]:
+    """Direct durable-store writes banned on the journal write path:
+    PersistentStore.put/.delete fsync under a lock, so every journalled
+    transition would stall the GCS loop for a disk round trip."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in ("put", "delete"):
+        return None
+    recv = _unparse(func.value).lower()
+    if "store" in recv:
+        return (
+            f"durable store .{func.attr}() on the flight-recorder write "
+            "path — PersistentStore fsyncs under a lock; ship the entry "
+            "through loop.run_in_executor instead"
+        )
+    return None
+
+
 class NoBlockingInLoopRule(Rule):
     name = "no-blocking-in-loop"
     allow_token = "blocking"
@@ -211,7 +243,8 @@ class NoBlockingInLoopRule(Rule):
         "inside async bodies or loop-dispatched callbacks in "
         "core/distributed/; no RPC round trips on the decode-on-rails "
         "per-frame paths (serve rails pump, handle channel pull, local "
-        "ring read/publish)"
+        "ring read/publish); no blocking or direct durable-store writes "
+        "on the flight-recorder journal path"
     )
 
     def check(self, ctx: LintContext) -> List[Violation]:
@@ -219,6 +252,8 @@ class NoBlockingInLoopRule(Rule):
         for f in ctx.package_files():
             if f.tree is not None and f.rel in RAILS_HOT_LOOPS:
                 self._scan_rails(f, RAILS_HOT_LOOPS[f.rel], out)
+            if f.tree is not None and f.rel in JOURNAL_WRITE_PATHS:
+                self._scan_journal(f, JOURNAL_WRITE_PATHS[f.rel], out)
             if not f.rel.startswith(SCOPE_PREFIX) or f.tree is None:
                 continue
             sleep_aliases = _sleep_aliases(f.tree)
@@ -281,6 +316,52 @@ class NoBlockingInLoopRule(Rule):
                     message=(
                         f"rails hot-loop registry names {missing!r} but no "
                         "such method exists — update RAILS_HOT_LOOPS"
+                    ),
+                )
+            )
+
+    def _scan_journal(
+        self, f: PyFile, qualnames: Set[str], out: List[Violation]
+    ) -> None:
+        """Scan the flight-recorder write-path bodies for blocking calls
+        and direct durable-store writes.  Like the rails registry, a
+        listed qualname that no longer resolves is itself a violation."""
+        sleep_aliases = _sleep_aliases(f.tree)
+        found: Set[str] = set()
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qn = f"{cls.name}.{fn.name}"
+                if qn not in qualnames:
+                    continue
+                found.add(qn)
+                for node in _walk_hot_path(fn.body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    msg = _blocking_message(
+                        node, sleep_aliases, set()
+                    ) or _store_write_message(node)
+                    if msg:
+                        out.append(
+                            Violation(
+                                rule=self.name,
+                                path=f.rel,
+                                line=node.lineno,
+                                message=msg,
+                            )
+                        )
+        for missing in sorted(qualnames - found):
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=f.rel,
+                    line=1,
+                    message=(
+                        f"journal write-path registry names {missing!r} but "
+                        "no such method exists — update JOURNAL_WRITE_PATHS"
                     ),
                 )
             )

@@ -18,6 +18,9 @@ Usage:
     python -m ray_tpu.scripts.cli logs [--dead [WORKER]]
     python -m ray_tpu.scripts.cli serve status
     python -m ray_tpu.scripts.cli serve trace <request-id> [-o out.json]
+    python -m ray_tpu.scripts.cli gcs top   # control-plane load shares
+    python -m ray_tpu.scripts.cli events [--kind node] [--node ID]
+    python -m ray_tpu.scripts.cli doctor    # ranked health findings
     python -m ray_tpu.scripts.cli start --head [--num-cpus N ...]
     python -m ray_tpu.scripts.cli start --address <gcs> [--num-cpus N]
 """
@@ -146,6 +149,15 @@ def cmd_status(gcs: _Gcs, args) -> None:
     worst = max(staleness.values(), default=0.0)
     print(f"  metrics federation: {m.get('nodes_reporting', 0)} nodes "
           f"reporting (worst staleness {worst:.1f}s)")
+    # GCS load attribution: who is spending the control plane's time.
+    gload = (obs.get("gcs") or {}).get("load") or {}
+    shares = gload.get("component_handler_share") or {}
+    if shares:
+        top3 = ", ".join(f"{c} {s:.0%}" for c, s in list(shares.items())[:3])
+        slow = (gload.get("slow_handlers") or {}).get("total", 0)
+        slow_note = f", {slow} slow handler(s)" if slow else ""
+        print(f"  gcs load: {top3} of handler time{slow_note} "
+              f"(`ray-tpu gcs top`)")
     hung = obs.get("hung_tasks") or []
     if hung:
         names = ", ".join(
@@ -163,6 +175,87 @@ def cmd_status(gcs: _Gcs, args) -> None:
         print(f"  elastic events (latest {len(ev)}):")
         for e in ev:
             print(f"    [{e.get('severity', '?')}] {e.get('message', '')}")
+
+
+def cmd_gcs(gcs: _Gcs, args) -> None:
+    """GCS control-plane self-observability (`ray-tpu gcs top`): the
+    per-service x per-caller-component load shares the attribution
+    sink accumulates, the event-loop audit, and the slow-handler ring
+    — the measure-then-shard evidence for the GCS sharding arc."""
+    blob = gcs.call("Metrics", "gcs_load")
+    load = blob.get("load", {})
+    total = load.get("total", {})
+    print(f"GCS @ {gcs.address} (id {blob.get('node_id', '?')[:12]}) — "
+          f"window {load.get('window_s', 0):.0f}s")
+    print(f"  {total.get('requests', 0)} requests / "
+          f"{total.get('bytes', 0) / 1e6:.2f} MB in / "
+          f"{total.get('handler_s', 0):.3f}s handler time")
+    rows = [[r["service"], r["component"], r["requests"],
+             f"{r['requests_share']:.1%}", r["bytes"],
+             f"{r['handler_s']:.4f}", f"{r['handler_share']:.1%}"]
+            for r in load.get("rows", [])[:args.limit]]
+    if rows:
+        print(_fmt_table(rows, ["SERVICE", "COMPONENT", "REQS", "REQ%",
+                                "BYTES", "HANDLER_S", "TIME%"]))
+    shares = load.get("component_handler_share") or {}
+    if shares:
+        print("  by component: "
+              + ", ".join(f"{c} {s:.1%}" for c, s in shares.items()))
+    loop = blob.get("loop", {})
+    print(f"  loop audit: lag last/max "
+          f"{loop.get('lag_last_s', 0) * 1000:.1f}/"
+          f"{loop.get('lag_max_s', 0) * 1000:.1f} ms, "
+          f"backlog {loop.get('backlog', 0)}, "
+          f"{loop.get('samples', 0)} samples")
+    slow = load.get("slow_handlers", {})
+    if slow.get("total"):
+        print(f"  slow handlers: {slow['total']} over "
+              f"{slow.get('budget_ms', 0):.0f}ms budget")
+        for e in slow.get("recent", [])[-3:]:
+            who = e.get("caller")
+            who_s = f"{who[1]}@{who[0][:8]}" if who else "unknown"
+            print(f"    {e['service']}.{e['method']} "
+                  f"{e['wall_ms']:.0f}ms caller={who_s} [{e['args']}]")
+    flight = blob.get("flight", {})
+    print(f"  flight recorder: {flight.get('events', 0)} entries "
+          f"({'durable' if flight.get('durable') else 'memory-only'}, "
+          f"seq {flight.get('seq', 0)})")
+
+
+def cmd_events(gcs: _Gcs, args) -> None:
+    """Cluster flight recorder (`ray-tpu events`): durable state-
+    transition journal, filterable by kind prefix / node / age."""
+    import datetime
+
+    since = time.time() - args.since_s if args.since_s else None
+    ev = gcs.call("FlightRecorder", "list_events", kind=args.kind,
+                  node_id=args.node, since=since, limit=args.limit)
+    if not ev:
+        print("no matching flight-recorder entries")
+        return
+    rows = []
+    for e in ev:
+        ts = datetime.datetime.fromtimestamp(e["ts"]).strftime("%H:%M:%S")
+        rows.append([ts, e["kind"], e.get("severity", "INFO"),
+                     (e.get("node_id") or "-")[:12], e["message"]])
+    print(_fmt_table(rows, ["TIME", "KIND", "SEV", "NODE", "MESSAGE"]))
+
+
+def cmd_doctor(gcs: _Gcs, args) -> None:
+    """Fused health report (`ray-tpu doctor`): ranked findings over
+    federated metrics, hung tasks, task-event loss, GCS load shares,
+    loop lag, and recent flight-recorder entries."""
+    rep = gcs.call("Metrics", "doctor", timeout=60)
+    findings = rep.get("findings", [])
+    if not findings:
+        print(f"cluster @ {gcs.address} healthy — "
+              f"{len(rep.get('checks', []))} checks passed")
+        return
+    print(f"cluster @ {gcs.address} — {len(findings)} finding(s):")
+    for i, f in enumerate(findings, 1):
+        print(f"{i:3d}. [{f['severity'].upper()} {f['score']:.0f}] "
+              f"{f['kind']}: {f['message']}")
+        print(f"      hint: {f['hint']}")
 
 
 def cmd_list(gcs: _Gcs, args) -> None:
@@ -854,6 +947,29 @@ def main(argv: Optional[List[str]] = None) -> None:
                                         "X-Request-Id header value)")
     stp.add_argument("-o", "--out", default=None,
                      help="output path (default trace-<id>.json)")
+    gcp = sub.add_parser(
+        "gcs", help="GCS control-plane self-observability: per-service "
+                    "x per-caller-component load shares, the event-loop "
+                    "audit, and the slow-handler ring (gcs top)")
+    gsub = gcp.add_subparsers(dest="gcs_cmd", required=True)
+    gtp = gsub.add_parser("top")
+    gtp.add_argument("--limit", type=int, default=20,
+                     help="max (service, component) rows to print")
+    ep = sub.add_parser(
+        "events", help="cluster flight recorder: the durable journal of "
+                       "state transitions (node join/death, failover, "
+                       "drain + KV migration, resizes, PG repair)")
+    ep.add_argument("--kind", help="kind prefix filter (e.g. 'node', "
+                                   "'serve', 'pg.repair')")
+    ep.add_argument("--node", help="exact node id filter")
+    ep.add_argument("--since-s", type=float, default=None, dest="since_s",
+                    help="only entries younger than this many seconds")
+    ep.add_argument("--limit", type=int, default=50)
+    sub.add_parser(
+        "doctor", help="fused cluster health report: ranked findings "
+                       "over federated metrics, hung tasks, event loss, "
+                       "GCS load shares, loop lag, and the flight "
+                       "recorder")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--host", default="127.0.0.1")
     dp.add_argument("--port", type=int, default=8265)
@@ -963,7 +1079,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
      "metrics": cmd_metrics, "stack": cmd_stack, "top": cmd_top,
      "profile": cmd_profile, "logs": cmd_logs,
-     "serve": cmd_serve}[args.cmd](gcs, args)
+     "serve": cmd_serve, "gcs": cmd_gcs, "events": cmd_events,
+     "doctor": cmd_doctor}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

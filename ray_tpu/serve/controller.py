@@ -273,10 +273,16 @@ class ServeController:
 
             if not is_initialized():
                 return
-            gcs = getattr(_global_worker(), "gcs", None)
+            w = _global_worker()
+            gcs = getattr(w, "gcs", None)
             if gcs is None:
                 return
-            self._merged_gauges = gcs.call("Serve", "merged", timeout=5)
+            # GCS load attribution: the controller's gauge poll is the
+            # "serve-gauges" component, not generic client traffic.
+            self._merged_gauges = gcs.call(
+                "Serve", "merged", timeout=5,
+                _caller=(getattr(w, "node_id", "") or "controller",
+                         "serve-gauges"))
         except Exception:  # noqa: BLE001 gauge plane is best-effort
             self._merged_gauges = None
 

@@ -110,12 +110,19 @@ def publish_migration_tickets(replica_id: str,
             "replica": replica_id,
             "ts": time.time(),
         })
+        t0 = time.time()
         try:
             w.kv_put("serve", _TICKET_PREFIX
                      + t["request_id"].encode(), blob)
             published += 1
         except Exception:  # noqa: BLE001 fallback: recompute
             continue
+        from ray_tpu.util import tracing
+
+        tracing.record_serve_span(
+            tracing.serve_ctx(t["request_id"]), "serve.kv.migrate",
+            t0, time.time(), side="publish", replica=replica_id,
+            nbytes=kv.nbytes, tokens=len(t["tokens"]))
     return published
 
 

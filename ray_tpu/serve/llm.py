@@ -1404,7 +1404,8 @@ class LLMDeployment:
         if self._disagg is not None:
             self._disagg.set_serve_context(app, replica_id)
 
-    def _maybe_offload_prefill(self, tokens) -> None:
+    def _maybe_offload_prefill(self, tokens,
+                               trace: Optional[dict] = None) -> None:
         """Disagg hot path: a long prompt whose KV this replica doesn't
         already hold prefills on a dedicated prefill actor; the finished
         blocks ship back as a frame and import into the local pool, so
@@ -1413,14 +1414,21 @@ class LLMDeployment:
         failure (actor down, pool full) degrades to local prefill."""
         if self._disagg is None:
             return
+        t0 = time.time()
         try:
-            self._disagg.prefill_into(self.engine, list(tokens))
+            offloaded = self._disagg.prefill_into(self.engine,
+                                                  list(tokens))
         except Exception:  # noqa: BLE001 degrade to local prefill
-            pass
+            return
+        if offloaded:
+            tracing.record_serve_span(trace, "serve.prefill.offload",
+                                      t0, time.time(),
+                                      tokens=len(tokens))
 
     def __call__(self, request: dict,
                  _serve_trace: Optional[dict] = None) -> dict:
-        self._maybe_offload_prefill(request["tokens"])
+        self._maybe_offload_prefill(request["tokens"],
+                                    trace=_serve_trace)
         toks = self.engine.generate(
             request["tokens"],
             max_tokens=int(request.get("max_tokens", 32)),

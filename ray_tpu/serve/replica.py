@@ -483,6 +483,7 @@ class Replica:
             from ray_tpu.serve import observability
             from ray_tpu.serve.disagg import consume_migration_ticket
 
+            t0 = time.time()
             ticket = consume_migration_ticket(resume["request_id"])
             if ticket is None:
                 return
@@ -491,6 +492,11 @@ class Replica:
             observability.observe_kv_migrate(
                 self._app, max(0.0, time.time()
                                - float(ticket.get("ts") or time.time())))
+            tracing.record_serve_span(
+                tracing.serve_ctx(resume["request_id"]),
+                "serve.kv.migrate", t0, time.time(), side="adopt",
+                replica=self.replica_id,
+                tokens=len(ticket["tokens"]))
         except Exception:  # noqa: BLE001 KVMigrationError / transport
             if eng is not None and hasattr(eng, "stats"):
                 try:

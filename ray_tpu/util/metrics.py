@@ -482,6 +482,35 @@ def _with_node(key, origin: str) -> _TagKey:
     return tuple(sorted(items))
 
 
+def process_sample() -> Dict[str, float]:
+    """Best-effort self-metrics for the calling process: RSS bytes,
+    cumulative CPU seconds, open fds, live threads.  Linux /proc is the
+    primary source; getrusage is the portable fallback (its ru_maxrss
+    is a high-water mark, not current RSS — still the right order of
+    magnitude for a leak alarm).  Used by the GCS audit loop so the
+    control plane's own footprint shows up node-labelled in the
+    federated exposition alongside every daemon it monitors."""
+    import os
+    import resource
+
+    out: Dict[str, float] = {}
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out["cpu_seconds"] = ru.ru_utime + ru.ru_stime
+    out["rss_bytes"] = float(ru.ru_maxrss) * 1024.0
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["rss_bytes"] = float(rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        out["open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    out["threads"] = float(threading.active_count())
+    return out
+
+
 _registry: Optional[MetricsRegistry] = None
 _registry_lock = threading.Lock()
 

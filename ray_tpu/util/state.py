@@ -175,6 +175,37 @@ def elastic_events(limit: int = 100) -> List[dict]:
                        limit=limit, timeout=30)
 
 
+def cluster_events(kind: Optional[str] = None,
+                   node_id: Optional[str] = None,
+                   since: Optional[float] = None,
+                   until: Optional[float] = None,
+                   limit: int = 200) -> List[dict]:
+    """Cluster flight-recorder timeline: durable state transitions
+    (node join/death/re-registration, serve failover, drain + KV
+    migration, autoscale and elastic resizes, PG repair) oldest-first.
+    `kind` is a prefix match ("node" matches node.join/node.death...);
+    `since`/`until` are wall-clock bounds. Survives GCS restarts."""
+    return _gcs().call("FlightRecorder", "list_events", kind=kind,
+                       node_id=node_id, since=since, until=until,
+                       limit=limit, timeout=30)
+
+
+def gcs_load() -> dict:
+    """GCS control-plane self-observability: per-service x per-caller-
+    component load shares (requests/bytes/handler time) since GCS boot,
+    the slow-handler audit, the event-loop audit, and flight-journal
+    stats. Same blob as cluster_status()["observability"]["gcs"]."""
+    return _gcs().call("Metrics", "gcs_load", timeout=30)
+
+
+def doctor() -> dict:
+    """One fused cluster health report: ranked findings over federated
+    metrics freshness, hung tasks, task-event loss, GCS load shares,
+    event-loop lag, and recent flight-recorder entries. Each finding
+    has a severity, a score (higher = worse) and an actionable hint."""
+    return _gcs().call("Metrics", "doctor", timeout=30)
+
+
 def placement_groups() -> List[dict]:
     """All placement groups with gang state: per-PG `placed`/
     `bundle_count` shows a gang mid-repair (holes being re-reserved)."""

@@ -71,6 +71,59 @@ def test_gcs_restart_preserves_state_and_serves(durable_cluster):
     assert ray_tpu.get(f.remote(7), timeout=60) == 21
 
 
+def test_flight_recorder_survives_gcs_restart(durable_cluster):
+    """The cluster flight recorder is journalled through the same
+    durable store as the registries: entries written before a GCS crash
+    (gcs.start, node.join) are still listed — with their original
+    sequence numbers — by the restarted GCS, which appends its own new
+    gcs.start after them.  The GCS's durable node identity is stable
+    across the restart too."""
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+    from ray_tpu.util import state
+
+    cluster = durable_cluster
+    w = _global_worker()
+
+    before = state.cluster_events(limit=500)
+    kinds = [e["kind"] for e in before]
+    assert "gcs.start" in kinds
+    assert "node.join" in kinds
+    load = state.gcs_load()
+    gcs_id = load["node_id"]
+    assert load["flight"]["durable"] is True
+    first_start = next(e for e in before if e["kind"] == "gcs.start")
+
+    cluster.kill_gcs()
+    time.sleep(1.0)
+    cluster.restart_gcs()
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if any(n["Alive"] for n in ray_tpu.nodes()):
+                break
+        except Exception:  # noqa: BLE001 reconnecting
+            pass
+        time.sleep(0.5)
+
+    after = state.cluster_events(limit=500)
+    # The pre-crash entries survived verbatim (same seq, same ts) and
+    # the restarted GCS journalled a SECOND gcs.start after them.
+    starts = [e for e in after if e["kind"] == "gcs.start"]
+    assert len(starts) >= 2
+    assert starts[0]["seq"] == first_start["seq"]
+    assert starts[0]["ts"] == pytest.approx(first_start["ts"])
+    assert any(e["kind"] == "node.join" for e in after)
+    assert starts[-1]["seq"] > starts[0]["seq"]
+    # Durable identity: the restarted GCS reloaded the same node id.
+    assert state.gcs_load()["node_id"] == gcs_id
+    # Kind-prefix and since filters work over the reloaded journal.
+    only_nodes = state.cluster_events(kind="node", limit=500)
+    assert only_nodes and all(e["kind"].startswith("node")
+                              for e in only_nodes)
+
+
 def test_gcs_restart_restarts_lost_actor_worker(durable_cluster):
     """If the actor's WORKER died while the GCS was down, the reloaded
     ALIVE record fails validation and the actor restarts."""

@@ -549,8 +549,9 @@ def test_metrics_federation_from_two_nodes():
             for nid in node_ids:
                 assert f'node="{nid}"' in text
             assert 'method="push_update"' in text
-            # The GCS's own registry federates too.
-            assert 'node="gcs"' in text
+            # The GCS's own registry federates too, labelled with the
+            # GCS's durable node id (not a bare "gcs" placeholder).
+            assert f'node="gcs:{cluster.gcs.node_id[:12]}"' in text
             stats = await client.call("Metrics", "stats", timeout=10)
             assert stats["nodes_reporting"] >= 2
             summary = await client.call("Metrics", "cluster_summary",
@@ -591,6 +592,199 @@ def test_metrics_federation_from_two_nodes():
         asyncio.run(run())
     finally:
         cfg.metrics_sync_interval_ms = saved
+
+
+def test_metrics_federation_daemon_churn():
+    """Federation under churn: kill one of two daemons and the GCS's
+    health check marks it dead, which expires its gauges from the
+    federated exposition and cluster_summary — stale metrics from a
+    dead node must not masquerade as live.  The death lands in the
+    flight recorder, and `doctor` turns it into a ranked node-churn
+    finding (the 2-node chaos acceptance check)."""
+    import asyncio
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+    from ray_tpu.core.distributed.virtual_node import InProcDaemonCluster
+
+    cfg = get_config()
+    saved = (cfg.metrics_sync_interval_ms, cfg.health_check_period_ms,
+             cfg.health_check_initial_delay_ms,
+             cfg.health_check_failure_threshold, cfg.syncer_keepalive_ms)
+    cfg.metrics_sync_interval_ms = 100
+    cfg.health_check_period_ms = 100
+    cfg.health_check_initial_delay_ms = 0
+    cfg.health_check_failure_threshold = 3
+    cfg.syncer_keepalive_ms = 50
+
+    async def run():
+        cluster = InProcDaemonCluster(2, store_capacity=64 << 20)
+        await cluster.start()
+        client = AsyncRpcClient(cluster.gcs.server.address)
+        victim, survivor = [d.node_id[:12] for d in cluster.daemons]
+        try:
+            loop = asyncio.get_running_loop()
+            text = ""
+            deadline = loop.time() + 20
+            while loop.time() < deadline:
+                text = await client.call("Metrics", "federated_text",
+                                         timeout=10)
+                if (f'node="{victim}"' in text
+                        and f'node="{survivor}"' in text):
+                    break
+                await asyncio.sleep(0.1)
+            assert f'node="{victim}"' in text
+
+            # Kill daemon 0: its syncer keepalives stop, the health
+            # check marks it dead, and the federation drops its dump.
+            await cluster.daemons[0].stop()
+            deadline = loop.time() + 30
+            while loop.time() < deadline:
+                text = await client.call("Metrics", "federated_text",
+                                         timeout=10)
+                if f'node="{victim}"' not in text:
+                    break
+                await asyncio.sleep(0.2)
+            assert f'node="{victim}"' not in text
+            assert f'node="{survivor}"' in text
+
+            summary = await client.call("Metrics", "cluster_summary",
+                                        timeout=10)
+            assert victim not in summary["metrics"]["staleness_s"]
+            assert summary["metrics"]["nodes_reporting"] == 1
+
+            # The death was journalled and doctor ranks it.
+            deaths = await client.call("FlightRecorder", "list_events",
+                                       kind="node.death", timeout=10)
+            assert any((e.get("node_id") or "").startswith(victim)
+                       for e in deaths)
+            rep = await client.call("Metrics", "doctor", timeout=10)
+            assert rep["healthy"] is False
+            churn = [f for f in rep["findings"]
+                     if f["kind"] == "node-churn"]
+            assert churn and churn[0]["severity"] == "warning"
+            assert "node death" in churn[0]["message"]
+        finally:
+            await client.close()
+            cluster.daemons = cluster.daemons[1:]
+            await cluster.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        (cfg.metrics_sync_interval_ms, cfg.health_check_period_ms,
+         cfg.health_check_initial_delay_ms,
+         cfg.health_check_failure_threshold,
+         cfg.syncer_keepalive_ms) = saved
+
+
+def test_gcs_load_attribution_and_slow_handler_audit():
+    """GCS load attribution end to end: tagged callers land in
+    per-service x per-component share rows, untagged callers bucket
+    under 'unknown', and a handler over the (here: zero) slow budget
+    is captured by the audit with method + caller + args digest."""
+    import asyncio
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed import rpc as rpc_mod
+    from ray_tpu.core.distributed.gcs_server import GcsServer
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+
+    cfg = get_config()
+    saved_slow = cfg.gcs_slow_handler_ms
+
+    async def run():  # noqa: C901
+        # Sub-microsecond budget (read once at GCS start): every
+        # handler is "slow", so the audit path is deterministic.
+        cfg.gcs_slow_handler_ms = 0.001
+        gcs = GcsServer()
+        port = await gcs.start()
+        tagged = AsyncRpcClient(f"127.0.0.1:{port}")
+        try:
+            rpc_mod.set_caller_identity("nodeA" + "0" * 11, "syncer")
+            for i in range(10):
+                await tagged.call("KV", "put", namespace="t",
+                                  key=b"k%d" % i, value=b"v" * 64,
+                                  timeout=10)
+            rpc_mod._caller_identity = None
+            await tagged.call("KV", "get", namespace="t", key=b"k0",
+                              timeout=10)
+
+            load = (await tagged.call("Metrics", "gcs_load",
+                                      timeout=10))["load"]
+            by = {(r["service"], r["component"]): r
+                  for r in load["rows"]}
+            assert by[("KV", "syncer")]["requests"] == 10
+            assert by[("KV", "syncer")]["bytes"] > 0
+            assert ("KV", "unknown") in by
+            shares = load["component_handler_share"]
+            assert 0.0 < shares["syncer"] <= 1.0
+            assert abs(sum(shares.values()) - 1.0) < 1e-6
+
+            # Every handler exceeds the sub-microsecond budget; the
+            # audit captures method, caller, and an args digest.
+            rpc_mod.set_caller_identity("nodeA" + "0" * 11, "syncer")
+            await tagged.call("KV", "put", namespace="t", key=b"slow",
+                              value=b"x" * 128, timeout=10)
+            slow = (await tagged.call(
+                "Metrics", "gcs_load", timeout=10))["load"]["slow_handlers"]
+            assert slow["total"] >= 1
+            rec = slow["recent"][-1]
+            assert rec["service"] == "KV" and rec["method"] == "put"
+            assert rec["caller"][1] == "syncer"
+            assert "bytes[128]" in rec["args"]
+            # ... and the event log carries the warning for dashboards.
+            ev = await tagged.call("EventLog", "list_events",
+                                   source="gcs", timeout=10)
+            assert any(e["severity"] == "WARNING" for e in ev)
+        finally:
+            rpc_mod._caller_identity = None
+            await tagged.close()
+            await gcs.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        cfg.gcs_slow_handler_ms = saved_slow
+        rpc_mod._caller_identity = None
+
+
+def test_attribution_disabled_skips_injection():
+    """RAY_TPU_GCS_ATTRIBUTION_ENABLED=0: clients stop injecting the
+    reserved _caller kwarg, so every request buckets as 'unknown' —
+    the off switch for the overhead-sensitive."""
+    import asyncio
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.distributed import rpc as rpc_mod
+    from ray_tpu.core.distributed.gcs_server import GcsServer
+    from ray_tpu.core.distributed.rpc import AsyncRpcClient
+
+    cfg = get_config()
+    saved = cfg.gcs_attribution_enabled
+
+    async def run():
+        gcs = GcsServer()
+        port = await gcs.start()
+        client = AsyncRpcClient(f"127.0.0.1:{port}")
+        try:
+            cfg.gcs_attribution_enabled = False
+            rpc_mod.set_caller_identity("nodeB" + "0" * 11, "syncer")
+            await client.call("KV", "put", namespace="t", key=b"k",
+                              value=b"v", timeout=10)
+            rows = (await client.call(
+                "Metrics", "gcs_load", timeout=10))["load"]["rows"]
+            comps = {r["component"] for r in rows if r["service"] == "KV"}
+            assert comps == {"unknown"}
+        finally:
+            rpc_mod._caller_identity = None
+            await client.close()
+            await gcs.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        cfg.gcs_attribution_enabled = saved
 
 
 def test_daemon_metrics_endpoint(obs_cluster):

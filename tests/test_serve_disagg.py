@@ -219,6 +219,40 @@ def test_migration_ticket_roundtrip_and_at_most_once():
     assert consume_migration_ticket("rid-never-published") is None
 
 
+def test_migration_publish_emits_trace_span():
+    """A published ticket emits a serve.kv.migrate span carrying the
+    REQUEST's id as its trace id, so `ray-tpu serve trace <id>` shows
+    the migration hop on the same track as the request's other legs."""
+    import numpy as np
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.serve.disagg import (
+        consume_migration_ticket,
+        publish_migration_tickets,
+    )
+    from ray_tpu.util import tracing
+
+    cfg = get_config()
+    saved = cfg.serve_trace_enabled
+    cfg.serve_trace_enabled = True
+    try:
+        tracing.drain()
+        kv = np.zeros((2, 2, 2, BS, 4, 16), np.float32)
+        assert publish_migration_tickets(
+            "serve:app#g1#0",
+            [{"request_id": "rid-span", "tokens": list(range(8)),
+              "block_size": BS, "kv": kv}]) == 1
+        spans = [s for s in tracing.drain()
+                 if s["name"] == "serve.kv.migrate"]
+        assert len(spans) == 1
+        assert spans[0]["trace_id"] == "rid-span"
+        assert spans[0]["attrs"]["side"] == "publish"
+        assert spans[0]["attrs"]["nbytes"] == kv.nbytes
+    finally:
+        cfg.serve_trace_enabled = saved
+        consume_migration_ticket("rid-span")  # delete the ticket
+
+
 def test_migration_ticket_size_bound_and_ttl():
     import pickle
 
