@@ -161,9 +161,21 @@ def bench_data_to_train(quick: bool) -> None:
     import ray_tpu
     from ray_tpu import data as rd
 
+    from ray_tpu.train import observability as obs
+
     rows = 40_000 if quick else 160_000
     batch = 1024
     step_s = 0.005                       # fixed synthetic compute per step
+
+    # Satellite: the per-step phase recorder must agree with this
+    # bench's hand-rolled busy fraction. The feed is wrapped in a
+    # PhasedIterator (started after the warmup fetch) so every next()
+    # charges data_wait and both clocks cover the same window; the
+    # recorder is deliberately NOT set_active so the prefetcher hook
+    # cannot double-charge blocked gets.
+    rec = obs.StepPhaseRecorder(run="bench_data", run_id="bench_data#0",
+                                rank=0, world_size=1, enabled=True)
+    rec._trace_steps = 0        # attribution math only, no span minting
 
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     try:
@@ -186,16 +198,18 @@ def bench_data_to_train(quick: bool) -> None:
 
         first = next(iter_ := iter(feed))    # warmup outside the clock
         assert np.asarray(first["x"]).shape[0] == batch
+        iter_ = obs.PhasedIterator(iter_, rec)
         steps, busy = 0, 0.0
         t_wall = time.perf_counter()
         for b in iter_:
-            t0 = time.perf_counter()
-            # The "train step": fixed-duration compute on the batch.
-            x = np.asarray(b["x"])
-            acc = 0.0
-            while time.perf_counter() - t0 < step_s:
-                acc += float(x[:64].sum())
-            busy += time.perf_counter() - t0
+            with obs.step(rec), rec.phase("compute"):
+                t0 = time.perf_counter()
+                # The "train step": fixed-duration compute on the batch.
+                x = np.asarray(b["x"])
+                acc = 0.0
+                while time.perf_counter() - t0 < step_s:
+                    acc += float(x[:64].sum())
+                busy += time.perf_counter() - t0
             steps += 1
         wall = time.perf_counter() - t_wall
     finally:
@@ -210,6 +224,16 @@ def bench_data_to_train(quick: bool) -> None:
     assert frac >= 0.90, (
         f"train loop only {frac:.1%} busy: the streaming feed is not "
         f"hiding data time behind compute")
+
+    snap = rec.snapshot()
+    attr_frac = snap["busy_fraction"]
+    emit("data_to_train_attr_busy_fraction", attr_frac, "fraction",
+         baseline=frac, steps=snap["steps"],
+         data_wait_s=round(snap.get("data_wait_s", 0.0), 3),
+         compute_s=round(snap.get("compute_s", 0.0), 3))
+    assert abs(attr_frac - frac) <= 0.05, (
+        f"per-step attribution busy fraction {attr_frac:.1%} disagrees "
+        f"with hand-rolled {frac:.1%} by more than 5 points")
 
 
 def main() -> None:

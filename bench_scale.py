@@ -28,6 +28,16 @@ Probes (each prints one JSON line, all also saved to BENCH_SCALE_r05.json):
                     paired subprocess runs; asserts the best-pair
                     slowdown is <5% (--only opt-in, same reason as
                     obs_overhead)
+  train_steps       4-rank instrumented train loop (step_phases +
+                    phase("compute") + report per step); emits steps/s
+                    (--only opt-in: boots its own driver cluster)
+  train_obs_overhead
+                    train_steps with the train-plane observability
+                    (per-step recorder + histograms + step spans +
+                    gauge push, RAY_TPU_TRAIN_OBS_ENABLED) on vs off
+                    in paired subprocess runs; asserts the best-pair
+                    step-rate slowdown is <5% (--only opt-in, same
+                    reason as obs_overhead)
   elastic_recovery  kill one rank of an 8-rank training gang mid-step;
                     wall time from kill to the replacement rank's first
                     completed step, elastic supervisor (PG kept, restart
@@ -359,17 +369,19 @@ def bench_obs_overhead(quick: bool) -> None:
 
 
 def _paired_many_tasks(quick: bool, label: str,
-                       off_env: dict, rounds: int = 3) -> list:
-    """Paired on/off many_tasks subprocess runs (see bench_obs_overhead
+                       off_env: dict, rounds: int = 3,
+                       probe: str = "many_tasks",
+                       metric: str = "many_tasks_per_second") -> list:
+    """Paired on/off `probe` subprocess runs (see bench_obs_overhead
     for why pairing: host load on a timeshared box drifts on minute
     timescales, so only back-to-back pairs compare like with like)."""
     import tempfile
 
     def one_run(tag: str, extra: dict) -> float:
         path = os.path.join(tempfile.mkdtemp(prefix=f"{label}_probe_"),
-                            f"many_tasks_{tag}.json")
+                            f"{probe}_{tag}.json")
         cmd = [sys.executable, os.path.abspath(__file__), "--only",
-               "many_tasks", "--out", path]
+               probe, "--out", path]
         if quick:
             cmd.append("--quick")
         env = dict(os.environ, **extra)
@@ -382,7 +394,7 @@ def _paired_many_tasks(quick: bool, label: str,
         with open(path) as f:
             doc = json.load(f)
         (rate,) = [r["value"] for r in doc["results"]
-                   if r["metric"] == "many_tasks_per_second"]
+                   if r["metric"] == metric]
         return rate
 
     pairs = []
@@ -431,6 +443,77 @@ def bench_gcs_attribution_overhead(quick: bool) -> None:
     assert ratio < 1.05, (
         f"GCS load attribution costs >5% many_tasks throughput: "
         f"{pairs}")
+
+
+def bench_train_steps(quick: bool) -> None:
+    """Instrumented-train-loop step-rate probe: a 4-rank
+    DataParallelTrainer gang running fixed-duration steps through the
+    full `train.step_phases()` / `train.phase("compute")` /
+    `train.report()` path. Emits steps/s per rank (rank 0's clock);
+    bench_train_obs_overhead runs this on vs off the
+    RAY_TPU_TRAIN_OBS_ENABLED kill switch."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train import (DataParallelTrainer, RunConfig,
+                               ScalingConfig)
+
+    world = 4
+    steps = 60 if quick else 150
+    step_s = 0.010                       # fixed synthetic compute per step
+
+    def loop(config):
+        import time as _t
+
+        from ray_tpu import train as _tr
+
+        n, dur = config["steps"], config["step_s"]
+        t_wall = _t.perf_counter()
+        for _ in range(n):
+            with _tr.step_phases():
+                with _tr.phase("compute"):
+                    t0 = _t.perf_counter()
+                    while _t.perf_counter() - t0 < dur:
+                        pass
+            _tr.report({})
+        _tr.report({"elapsed_s": _t.perf_counter() - t_wall,
+                    "steps": n})
+
+    ray_tpu.init(num_cpus=world)
+    try:
+        trainer = DataParallelTrainer(
+            loop, train_loop_config={"steps": steps, "step_s": step_s},
+            scaling_config=ScalingConfig(
+                num_workers=world, resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(name="bench_train_steps"),
+            backend=None)
+        result = trainer.fit()
+    finally:
+        ray_tpu.shutdown()
+    assert result.error is None, result.error
+    rate = result.metrics["steps"] / result.metrics["elapsed_s"]
+    emit("train_steps_per_second", rate, "steps/s", world=world,
+         steps=steps, step_ms=step_s * 1e3,
+         obs_enabled=os.environ.get("RAY_TPU_TRAIN_OBS_ENABLED", "1"))
+
+
+def bench_train_obs_overhead(quick: bool) -> None:
+    """Train-observability overhead: the instrumented step loop with
+    the whole train-obs plane (per-step recorder + histograms + step
+    spans + gauge pusher) on vs off the RAY_TPU_TRAIN_OBS_ENABLED kill
+    switch, in paired subprocess runs. Per step the plane costs two
+    perf_counter reads per phase, two histogram observes, and one span
+    mint — the best-pair step-rate slowdown must stay under 5%."""
+    pairs = _paired_many_tasks(
+        quick, "train_obs",
+        {"RAY_TPU_TRAIN_OBS_ENABLED": "0"},
+        probe="train_steps", metric="train_steps_per_second")
+    best = min(pairs, key=lambda p: p[0] / p[1])
+    ratio = best[0] / best[1]
+    emit("train_obs_overhead_ratio", ratio, "x", baseline=None,
+         steps_per_second_on=best[1], steps_per_second_off=best[0],
+         all_pairs=[[round(o, 1), round(n, 1)] for o, n in pairs])
+    assert ratio < 1.05, (
+        f"train-plane observability costs >5% step rate: {pairs}")
 
 
 def bench_elastic_recovery(quick: bool) -> None:
@@ -576,7 +659,8 @@ def main() -> None:
     # and must not share the driver's cluster.
     standalone = {"many_nodes", "object_transfer", "broadcast",
                   "obs_overhead", "attribution_overhead",
-                  "gcs_attribution_overhead", "elastic_recovery"}
+                  "gcs_attribution_overhead", "elastic_recovery",
+                  "train_steps", "train_obs_overhead"}
     if want("many_nodes"):
         bench_many_nodes(quick)
     if want("object_transfer"):
@@ -597,6 +681,13 @@ def main() -> None:
         # Boots a driver cluster + three train jobs: opt-in so the
         # default full suite doesn't triple its wall time.
         bench_elastic_recovery(quick)
+    if want("train_steps") and only is not None:
+        # Boots a driver cluster + one train gang: opt-in (and the
+        # subprocess leg of train_obs_overhead).
+        bench_train_steps(quick)
+    if want("train_obs_overhead") and only is not None:
+        # Subprocess-spawning probe, same opt-in rule as obs_overhead.
+        bench_train_obs_overhead(quick)
     if only is not None and not (only - standalone):
         _write_results(out_path, quick)
         return

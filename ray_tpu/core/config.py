@@ -337,6 +337,33 @@ class Config:
     # gang back toward the target world size.
     elastic_grow_check_s: float = 10.0
 
+    # ---- train-plane observability (train/observability.py;
+    # RAY_TPU_TRAIN_OBS_*) ----
+    # Kill switch for the whole train-plane observability stack:
+    # per-step phase attribution, per-rank gauge federation, step
+    # spans, and the GCS TrainRunState aggregator's inputs.
+    train_obs_enabled: bool = True
+    # Cadence of the per-rank gauge push (worker -> local node daemon
+    # -> syncer -> GCS). Rides the existing serve-gauge report path.
+    train_obs_push_s: float = 1.0
+    # Node-daemon TTL sweep for per-(run, rank) train gauges: a rank
+    # that stops pushing (dead, SIGSTOPped) ages out of the node's
+    # synced state after this long, but stays in the GCS aggregator's
+    # retained view (marked stale) for blame attribution.
+    train_obs_gauge_ttl_s: float = 30.0
+    # Step window for cross-rank skew: the per-rank gauges carry mean
+    # step time over the last N steps; the GCS computes p99/p50 across
+    # ranks from those windows.
+    train_obs_window_steps: int = 20
+    # Step spans emitted per rank per attempt before span minting stops
+    # (bounds trace volume for long runs; the shared tracing ring
+    # buffer also caps at 10k records). 0 disables step spans entirely.
+    train_obs_trace_steps: int = 512
+    # Peak accelerator FLOP/s used as the MFU denominator when
+    # ScalingConfig.flops_per_step is set. 0 => report achieved FLOP/s
+    # only and skip the MFU estimate.
+    train_obs_peak_flops: float = 0.0
+
     # ---- serving plane (paged KV cache engine; serve/llm.py,
     # serve/kv_cache.py — RAY_TPU_KV_BLOCK_* / RAY_TPU_SERVE_*) ----
     # Tokens per KV block. Small blocks waste less HBM on short tails

@@ -3094,6 +3094,14 @@ class DistributedCoreWorker:
         uninstall_refcounter()
         with self._lock:
             self._flush_frees_locked()
+        # Ship whatever the event pipeline still holds (statuses, spans)
+        # before the loop thread dies — the flusher's own tick may be
+        # seconds out on an idle-backed-off process.
+        try:
+            self.task_events.stop()
+            self.loop_thread.run(self.task_events.flush_final(), timeout=2)
+        except Exception:  # noqa: BLE001
+            pass
         if self._pinned_lanes or self._lane_reaper is not None:
             try:
                 self.loop_thread.run(self._close_pinned_lanes(), timeout=8)

@@ -249,6 +249,13 @@ class NodeDaemon:
         # serve controller reads one merged view instead of polling
         # every replica per autoscale decision.
         self._serve_gauges: Dict[tuple, dict] = {}
+        # Train-rank gauges: (run, rank) -> {"ts", "gauges"}. Training
+        # ranks on this node push cumulative step/phase counters here
+        # (train/observability.py GaugePusher); the per-run map rides
+        # the syncer delta to the GCS TrainRunState. TTL-swept — but
+        # the GCS retains what it saw, so a SIGSTOPped rank stays
+        # attributable after it ages out here.
+        self._train_gauges: Dict[tuple, dict] = {}
         # Worker-process metric registry dumps: origin -> {"ts", "dump"}.
         # Replicas piggyback theirs on the gauge push, other serve
         # workers (HTTP proxy) use report_metrics; _metrics_dump merges
@@ -393,6 +400,7 @@ class NodeDaemon:
             "idle_workers": len(self._idle),
             "busy_workers": busy,
             "serve": self._serve_state(),
+            "train": self._train_state(),
         }
 
     def _serve_state(self) -> Dict[str, Any]:
@@ -427,6 +435,44 @@ class NodeDaemon:
             if ent.get("state"):
                 agg.setdefault("_replicas", {})[key[1]] = ent["state"]
         return apps
+
+    def _train_state(self) -> Dict[str, Any]:
+        """Per-run map of this node's training-rank gauges, keyed
+        run -> "rank@attempt" (ranks are NOT summed — the GCS skew
+        computation needs each rank's step window separately). TTL-swept
+        so a finished run's counters stop shipping; the push timestamp
+        rides along as `ts_age_s` so the GCS can spot a rank that went
+        quiet (SIGSTOP) before the TTL reaps it."""
+        ttl = get_config().train_obs_gauge_ttl_s
+        now = time.monotonic()
+        runs: Dict[str, Dict[str, dict]] = {}
+        for key, ent in list(self._train_gauges.items()):
+            age = now - ent["ts"]
+            if age > ttl:
+                del self._train_gauges[key]
+                continue
+            run, rank = key
+            g = dict(ent["gauges"])
+            g["ts_age_s"] = round(age, 1)
+            runs.setdefault(run, {})[f"{rank}@{g.get('attempt', 0)}"] = g
+        return runs
+
+    async def report_train_gauges(self, run: str, rank: int,
+                                  gauges: Dict[str, Any],
+                                  metrics: Optional[list] = None) -> dict:
+        """Training rank -> local daemon gauge push (the train-plane
+        leg of the syncer federation; ranks never talk to the GCS).
+        The optional `metrics` registry dump piggybacks the rank's
+        raytpu_train_* histograms into the node's federation payload,
+        same as serve replicas."""
+        self._train_gauges[(run, int(rank))] = {
+            "ts": time.monotonic(), "gauges": dict(gauges)}
+        if metrics is not None:
+            self._worker_metric_dumps[f"train:{run}:{rank}"] = {
+                "ts": time.monotonic(), "dump": metrics}
+        if self.syncer is not None:
+            self.syncer.mark_dirty()
+        return {"ok": True}
 
     async def report_serve_gauges(self, app: str, replica: str,
                                   gauges: Dict[str, float],

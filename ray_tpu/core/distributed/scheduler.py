@@ -45,13 +45,17 @@ class NodeView:
     # active streams, KV-pool occupancy) — the controller's autoscale
     # signal rides the syncer instead of per-decision replica polls.
     serve: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # Train-rank step/phase gauges on this node, keyed run -> "rank@N"
+    # (node_daemon._train_state): the GCS TrainRunState's goodput/skew
+    # input rides the syncer the same way serve gauges do.
+    train: Dict[str, dict] = dataclasses.field(default_factory=dict)
 
 
 # Dynamic NodeView attributes the syncer may overwrite from a reported
 # state dict (the "available"/"queued" pair keeps heartbeat parity).
 _SYNCED_ATTRS = ("available", "queued", "store_used", "store_objects",
                  "spilled_bytes", "workers", "idle_workers", "busy_workers",
-                 "serve")
+                 "serve", "train")
 # Everything a daemon needs of a peer to make spillback decisions —
 # the cluster-view fan-out entry.
 _WIRE_ATTRS = ("node_id", "address", "total", "available", "alive",

@@ -64,6 +64,7 @@ STATE_KEYS = (
     "idle_workers",     # ... of which idle (warm pool)
     "busy_workers",     # ... of which leased/actor-bound
     "serve",            # per-app serve replica gauges (autoscale input)
+    "train",            # per-(run, rank) train step/phase gauges
 )
 
 

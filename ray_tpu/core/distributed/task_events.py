@@ -376,17 +376,51 @@ class TaskEventBuffer:
         flusher)."""
         delay = self.flush_period_s
         while not self._stop:
-            await asyncio.sleep(delay)
-            cfg = get_config()
-            if cfg.tracing_enabled or cfg.serve_trace_enabled:
-                from ray_tpu.util import tracing
-
-                self._spans_pending.extend(tracing.drain())
+            # A backed-off sleep must still wake within one period of
+            # new spans appearing: a long-parked warm worker that lands
+            # a restarted train gang mints its whole (short) leg under a
+            # 16 s delay and would lose every span at teardown.
+            slept = 0.0
+            while not self._stop:
+                await asyncio.sleep(min(self.flush_period_s,
+                                        delay - slept))
+                slept += self.flush_period_s
+                if slept >= delay or self._spans_waiting():
+                    break
+            self._drain_span_source()
             shipped = await self._ship_spans()
             if await self.flush_once() or shipped:
                 delay = self.flush_period_s
             else:
                 delay = min(delay * 2, max(self.flush_period_s, 16.0))
+
+    def _spans_waiting(self) -> bool:
+        if self._spans_pending:
+            return True
+        cfg = get_config()
+        if not (cfg.tracing_enabled or cfg.serve_trace_enabled
+                or cfg.train_obs_enabled):
+            return False
+        from ray_tpu.util import tracing
+
+        return tracing.has_pending()
+
+    def _drain_span_source(self) -> None:
+        cfg = get_config()
+        if (cfg.tracing_enabled or cfg.serve_trace_enabled
+                or cfg.train_obs_enabled):
+            from ray_tpu.util import tracing
+
+            self._spans_pending.extend(tracing.drain())
+
+    async def flush_final(self) -> None:
+        """Last-gasp flush at teardown (gang shutdown, process exit):
+        drain freshly minted spans and ship everything still buffered so
+        a short-lived leg's trace survives the actor dying before the
+        next flush tick. Best effort — the GCS may already be gone."""
+        self._drain_span_source()
+        await self._ship_spans()
+        await self.flush_once()
 
     async def _ship_spans(self) -> bool:
         spans = self._spans_pending

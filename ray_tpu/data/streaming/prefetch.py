@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Optional
 
 _SENTINEL = object()
@@ -76,7 +77,18 @@ class DevicePrefetcher:
             self.hits += 1
         except queue.Empty:
             self.misses += 1
+            t0 = time.perf_counter()
             item = self._q.get()
+            # A blocked get IS the input pipeline stalling the step
+            # loop: charge it to the active train session's data_wait
+            # phase (no-op outside a training step loop) so
+            # StreamingIngest-fed loops get attribution for free.
+            try:
+                from ray_tpu.train import observability as _tobs
+
+                _tobs.on_data_wait(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — accounting must never break
+                pass
         if item is _SENTINEL:
             self._record()
             if self._err is not None:

@@ -18,6 +18,8 @@ Usage:
     python -m ray_tpu.scripts.cli logs [--dead [WORKER]]
     python -m ray_tpu.scripts.cli serve status
     python -m ray_tpu.scripts.cli serve trace <request-id> [-o out.json]
+    python -m ray_tpu.scripts.cli train status
+    python -m ray_tpu.scripts.cli train trace <run> [-o out.json]
     python -m ray_tpu.scripts.cli gcs top   # control-plane load shares
     python -m ray_tpu.scripts.cli events [--kind node] [--node ID]
     python -m ray_tpu.scripts.cli doctor    # ranked health findings
@@ -166,6 +168,20 @@ def cmd_status(gcs: _Gcs, args) -> None:
         more = f" (+{len(hung) - 5} more)" if len(hung) > 5 else ""
         print(f"  HUNG tasks: {len(hung)} — {names}{more}  "
               f"(`ray-tpu stack --task <id>` for stacks)")
+    # Active train runs: world size, step rate, goodput — the one-line
+    # version of `ray-tpu train status`.
+    for run, s in ((obs.get("train") or {}).get("runs") or {}).items():
+        if not s.get("active"):
+            continue
+        line = (f"  train run '{run}': world={s.get('world', 0)} "
+                f"steps={s.get('steps', 0)} "
+                f"rate={s.get('step_rate', 0.0):.2f}/s")
+        if s.get("goodput") is not None:
+            line += f" goodput={s['goodput']:.0%}"
+        skew = s.get("skew") or {}
+        if skew.get("stale_ranks"):
+            line += f" STALE ranks {skew['stale_ranks']}"
+        print(line + "  (`ray-tpu train status`)")
     # Elastic training plane: recent gang restarts / shrinks / grows.
     try:
         ev = gcs.call("EventLog", "list_events", source="elastic", limit=5)
@@ -453,6 +469,74 @@ def cmd_serve(gcs: _Gcs, args) -> None:
         if cts:
             print("    counters: " + "  ".join(
                 f"{k}={v:g}" for k, v in sorted(cts.items())))
+
+
+def cmd_train(gcs: _Gcs, args) -> None:
+    """Train-plane goodput observability (`ray-tpu train ...`):
+    status renders the GCS TrainRunState rollup (per-run goodput
+    split, step rate, cross-rank skew + blame rank, restart
+    accounting, MFU when hinted); trace dumps ONE run's per-rank
+    step/phase span tracks as a perfetto/chrome trace."""
+    if args.train_cmd == "trace":
+        from ray_tpu.util.timeline import train_chrome_trace
+
+        spans = gcs.call("TaskEvents", "list_spans",
+                         trace_id=args.run_id, limit=10000, timeout=30)
+        if not spans and "#" not in args.run_id:
+            spans = [s for s in gcs.call("TaskEvents", "list_spans",
+                                         limit=10000, timeout=30)
+                     if (s.get("trace_id") or "").startswith(
+                         f"{args.run_id}#")]
+        if not spans:
+            sys.exit(f"no spans for train run {args.run_id!r} "
+                     f"(RAY_TPU_TRAIN_OBS_ENABLED=0, or the span "
+                     f"buffer has not flushed yet?)")
+        out = args.out or f"train-trace-{args.run_id.replace('#', '_')}.json"
+        with open(out, "w") as f:
+            json.dump(train_chrome_trace(spans), f)
+        print(f"wrote {len(spans)} spans to {out} "
+              f"(open in https://ui.perfetto.dev)")
+        return
+    try:
+        runs = gcs.call("Train", "summary", timeout=30).get("runs", {})
+    except Exception as e:  # noqa: BLE001 — pre-observability GCS
+        sys.exit(f"no train summary from GCS: {e}")
+    if not runs:
+        print("no train runs reporting")
+        return
+    print(f"train @ {gcs.address}")
+    for run in sorted(runs):
+        s = runs[run]
+        state = "active" if s.get("active") else \
+            f"idle {s.get('last_seen_age_s', 0):.0f}s"
+        print(f"  run '{run}' ({s.get('run_id')}, attempt "
+              f"{s.get('attempt', 0)}, {state}):")
+        line = (f"    world={s.get('world', 0)}  steps={s.get('steps', 0)}"
+                f"  rate={s.get('step_rate', 0.0):.2f}/s")
+        if s.get("restarts"):
+            line += (f"  restarts={s['restarts']} "
+                     f"(lost {s.get('lost_restart_s', 0):.1f}s)")
+        print(line)
+        split = s.get("split") or {}
+        if split:
+            print(f"    goodput: {s.get('goodput', 0):.1%}  ("
+                  + "  ".join(f"{k}={v:.1%}" for k, v in split.items())
+                  + ")")
+        skew = s.get("skew") or {}
+        if skew:
+            line = (f"    skew: p50={skew.get('p50_step_s', 0) * 1e3:.1f}ms"
+                    f"  p99={skew.get('p99_step_s', 0) * 1e3:.1f}ms"
+                    f"  p99/p50={skew.get('ratio', 0):.2f}")
+            if skew.get("blame_rank") is not None:
+                line += f"  blame=rank {skew['blame_rank']}"
+            if skew.get("stale_ranks"):
+                line += f"  STALE={skew['stale_ranks']}"
+            print(line)
+        if s.get("achieved_flops"):
+            line = f"    flops: {s['achieved_flops']:.3g}/s achieved"
+            if s.get("mfu") is not None:
+                line += f"  mfu={s['mfu']:.1%}"
+            print(line)
 
 
 def cmd_job(args) -> None:
@@ -947,6 +1031,20 @@ def main(argv: Optional[List[str]] = None) -> None:
                                         "X-Request-Id header value)")
     stp.add_argument("-o", "--out", default=None,
                      help="output path (default trace-<id>.json)")
+    tvp = sub.add_parser(
+        "train", help="train-plane goodput observability: per-run "
+                      "goodput split / step rate / cross-rank skew "
+                      "(status) and per-rank step-phase span traces "
+                      "(trace <run>)")
+    tsub = tvp.add_subparsers(dest="train_cmd", required=True)
+    tsub.add_parser("status")
+    ttp = tsub.add_parser("trace")
+    ttp.add_argument("run_id", help="run id (experiment name + fit "
+                                    "attempt, e.g. 'mnist#0'; a bare "
+                                    "experiment name matches every "
+                                    "attempt)")
+    ttp.add_argument("-o", "--out", default=None,
+                     help="output path (default train-trace-<run>.json)")
     gcp = sub.add_parser(
         "gcs", help="GCS control-plane self-observability: per-service "
                     "x per-caller-component load shares, the event-loop "
@@ -1079,8 +1177,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     {"status": cmd_status, "list": cmd_list, "timeline": cmd_timeline,
      "metrics": cmd_metrics, "stack": cmd_stack, "top": cmd_top,
      "profile": cmd_profile, "logs": cmd_logs,
-     "serve": cmd_serve, "gcs": cmd_gcs, "events": cmd_events,
-     "doctor": cmd_doctor}[args.cmd](gcs, args)
+     "serve": cmd_serve, "train": cmd_train, "gcs": cmd_gcs,
+     "events": cmd_events, "doctor": cmd_doctor}[args.cmd](gcs, args)
 
 
 if __name__ == "__main__":

@@ -8,7 +8,8 @@ from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, Result,
                                   RunConfig, ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report)
+                                   get_dataset_shard, phase, report,
+                                   step_phases)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 
 __all__ = [
@@ -16,6 +17,7 @@ __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "step_phases", "phase",
     "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
 ]
 

@@ -35,6 +35,12 @@ class ScalingConfig:
     # (default num_workers) when capacity returns.
     min_workers: Optional[int] = None
     max_workers: Optional[int] = None
+    # Optional hint for the train-plane observability MFU estimate
+    # (train/observability.py): total FLOPs one optimizer step performs
+    # across the gang. The GCS TrainRunState turns it into achieved
+    # FLOP/s (flops_per_step * step rate) and, when
+    # RAY_TPU_TRAIN_OBS_PEAK_FLOPS is set, an MFU fraction.
+    flops_per_step: Optional[float] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 GetTimeoutError)
+from ray_tpu.train import observability as train_obs
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import FailureConfig, Result
 from ray_tpu.train.worker_group import WorkerGroup
@@ -223,6 +224,10 @@ class ElasticSupervisor:
         failures = 0
         world = self.target
         pg = None
+        experiment = t.run_config.name or "train"
+        run_id = train_obs.next_run_id(experiment)
+        attempt = 0          # gang-restart index within this fit
+        interrupt_ts: Optional[float] = None
         latest_ckpt: Optional[str] = (
             t._resume.path if t._resume else None)
         history: List[dict] = []
@@ -258,10 +263,15 @@ class ElasticSupervisor:
                     strategy=self.scaling.placement_strategy,
                     backend_name=t.backend_name,
                     trial_dir=t.run_config.resolve_storage(),
-                    experiment_name=t.run_config.name or "train",
-                    pg=pg, ready_timeout=self.replace_timeout)
+                    experiment_name=experiment,
+                    pg=pg, ready_timeout=self.replace_timeout,
+                    run_meta={
+                        "run_id": run_id, "attempt": attempt,
+                        "flops_per_step": self.scaling.flops_per_step})
             except Exception as e:  # noqa: BLE001 — PG demoted under us
                 failures += 1
+                attempt += 1
+                interrupt_ts = time.time()
                 self.stats["restarts"]["preemption"] += 1
                 RESTARTS_TOTAL.inc(tags={"cause": "preemption"})
                 if 0 <= self.fc.max_failures < failures:
@@ -286,6 +296,14 @@ class ElasticSupervisor:
                 group.start_all(t._fn, t._config, master_env,
                                 latest_ckpt, t._shard_fn,
                                 timeout=start_to)
+                # Restart gap: failure detection -> new gang running,
+                # charged to lost_restart by the GCS TrainRunState.
+                gap = (time.time() - interrupt_ts) if interrupt_ts else 0.0
+                interrupt_ts = None
+                train_obs.emit_run_event(
+                    experiment, run_id,
+                    f"gang start (attempt {attempt}, world {world})",
+                    attempt=attempt, world=world, gap_s=round(gap, 3))
                 m, latest_ckpt, part = self._drain(group, world,
                                                    latest_ckpt)
                 # A resumed gang that was already past its last step
@@ -303,6 +321,8 @@ class ElasticSupervisor:
                 if g.latest_checkpoint:
                     latest_ckpt = g.latest_checkpoint
                 last_metrics = g.last_metrics or last_metrics
+                attempt += 1
+                interrupt_ts = time.time()
                 self.stats["grows"] += 1
                 RESTARTS_TOTAL.inc(tags={"cause": "grow"})
                 self._emit("INFO",
@@ -322,6 +342,8 @@ class ElasticSupervisor:
                     latest_ckpt = f.latest_checkpoint
                 last_metrics = f.last_metrics or last_metrics
                 failures += 1
+                attempt += 1
+                interrupt_ts = time.time()
                 self.stats["restarts"][f.cause] = (
                     self.stats["restarts"].get(f.cause, 0) + 1)
                 RESTARTS_TOTAL.inc(tags={"cause": f.cause})
@@ -366,6 +388,8 @@ class ElasticSupervisor:
                     "world=%d", world)
             except Exception as e:  # noqa: BLE001 — gang formation died
                 failures += 1
+                attempt += 1
+                interrupt_ts = time.time()
                 cause = classify_failure(repr(e))
                 self.stats["restarts"][cause] = (
                     self.stats["restarts"].get(cause, 0) + 1)

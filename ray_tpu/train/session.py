@@ -7,6 +7,14 @@ through a result queue consumed by the trainer
 session is a module-global installed by the TrainWorker actor; `report`
 enqueues (metrics, checkpoint-dir) and the trainer drains the queue via
 actor polling.
+
+The session also owns this rank's step/phase attribution
+(train/observability.py): `report()` delimits implicit steps and times
+the checkpoint persist into the `checkpoint` phase, `step_phases()` /
+`phase()` expose explicit step markup, `get_dataset_shard` wraps plain
+iterators so their blocking `next()` charges `data_wait`, and a
+background pusher federates the per-rank counters over the node-daemon
+gauge path.
 """
 from __future__ import annotations
 
@@ -17,6 +25,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, Optional
 
+from ray_tpu.train import observability as train_obs
 from ray_tpu.train.checkpoint import Checkpoint
 
 _session_lock = threading.Lock()
@@ -27,7 +36,8 @@ class TrainSession:
     def __init__(self, *, world_rank: int, world_size: int, local_rank: int,
                  trial_dir: str, latest_checkpoint: Optional[Checkpoint],
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 experiment_name: str = "train"):
+                 experiment_name: str = "train",
+                 run_meta: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -46,6 +56,17 @@ class TrainSession:
         # restarting from 0 would re-target checkpoint_000001... and mix
         # stale files into — or clobber — the dir we may be restoring from.
         self._ckpt_seq = self._existing_ckpt_max()
+        # Step/phase attribution for this rank (run id == experiment
+        # name + fit attempt, stable across gang restarts; the restart
+        # index rides along as `attempt`).
+        meta = run_meta or {}
+        self.run_id = meta.get("run_id") or f"{experiment_name}#0"
+        self.recorder = train_obs.StepPhaseRecorder(
+            run=experiment_name, run_id=self.run_id,
+            rank=world_rank, world_size=world_size,
+            attempt=int(meta.get("attempt", 0) or 0),
+            flops_per_step=meta.get("flops_per_step"))
+        self._pusher = train_obs.GaugePusher(self.recorder)
 
     def _existing_ckpt_max(self) -> int:
         try:
@@ -67,6 +88,7 @@ class TrainSession:
                checkpoint: Optional[Checkpoint] = None) -> None:
         persisted = None
         if checkpoint is not None:
+            t0 = time.perf_counter()
             self._ckpt_seq += 1
             dest = os.path.join(self.trial_dir,
                                 f"checkpoint_{self._ckpt_seq:06d}")
@@ -77,7 +99,9 @@ class TrainSession:
                 shutil.copytree(checkpoint.path, dest)
             persisted = dest
             self.latest_checkpoint = Checkpoint(persisted)
+            self.recorder.observe_persist(time.perf_counter() - t0)
         self.last_progress_ts = time.time()
+        self.recorder.on_report()
         self.results.put({"metrics": dict(metrics), "checkpoint": persisted})
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
@@ -87,6 +111,15 @@ class TrainSession:
         shard = self.dataset_shards.get(name)
         if shard is None:
             raise KeyError(f"no dataset shard named {name!r}")
+        if (self.recorder.enabled
+                and not hasattr(shard, "iter_batches")
+                and (hasattr(shard, "__next__")
+                     or hasattr(shard, "__iter__"))):
+            # Plain iterator/iterable shard: time its next() into
+            # data_wait. Dataset-shaped shards keep their API surface —
+            # their feed goes through the device prefetcher, which
+            # charges data_wait via the observability hook.
+            return train_obs.PhasedIterator(shard, self.recorder)
         return shard
 
 
@@ -94,12 +127,20 @@ def install_session(s: TrainSession) -> None:
     global _session
     with _session_lock:
         _session = s
+    train_obs.set_active(s.recorder)
+    s._pusher.start()
 
 
 def uninstall_session() -> None:
     global _session
     with _session_lock:
-        _session = None
+        prev, _session = _session, None
+    if prev is not None:
+        # Close any step left open, then flush a final gauge push so
+        # the GCS sees the rank's terminal counters.
+        prev.recorder.step_end()
+        prev._pusher.stop(flush=True)
+    train_obs.set_active(None)
 
 
 def _get() -> TrainSession:
@@ -123,6 +164,21 @@ def get_dataset_shard(name: str = "train"):
     return _get().get_dataset_shard(name)
 
 
+def step_phases():
+    """Explicit step delimiter: `with train.step_phases() as step:` —
+    phases recorded inside (via `step.phase(...)` or the module-level
+    `train.phase(...)`) attribute to this step, and the step closes at
+    block exit rather than at the next `report()`."""
+    return train_obs.step(_get().recorder)
+
+
+def phase(name: str):
+    """Attribute the block's wall time to one phase
+    ("data_wait"/"compute"/"sync"/"checkpoint") of the current step:
+    `with train.phase("compute"): loss = train_step(...)`."""
+    return _get().recorder.phase(name)
+
+
 class TrainContext:
     def get_world_size(self) -> int:
         return _get().world_size
@@ -138,6 +194,9 @@ class TrainContext:
 
     def get_experiment_name(self) -> str:
         return _get().experiment_name
+
+    def get_run_id(self) -> str:
+        return _get().run_id
 
 
 def get_context() -> TrainContext:

@@ -14,6 +14,7 @@ import logging
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu.train import observability as train_obs
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (FailureConfig, Result, RunConfig,
                                   ScalingConfig)
@@ -92,6 +93,9 @@ class DataParallelTrainer:
         max_failures = fc.max_failures
         backoff = RestartBackoff(fc)
         attempt = 0
+        experiment = self.run_config.name or "train"
+        run_id = train_obs.next_run_id(experiment)
+        interrupt_ts: Optional[float] = None
         latest_ckpt: Optional[str] = (
             self._resume.path if self._resume else None)
         history: list = []
@@ -103,7 +107,10 @@ class DataParallelTrainer:
                 strategy=self.scaling_config.placement_strategy,
                 backend_name=self.backend_name,
                 trial_dir=self.run_config.resolve_storage(),
-                experiment_name=self.run_config.name or "train")
+                experiment_name=experiment,
+                run_meta={
+                    "run_id": run_id, "attempt": attempt,
+                    "flops_per_step": self.scaling_config.flops_per_step})
             try:
                 from ray_tpu.train.backend import resolve_backend
 
@@ -111,6 +118,16 @@ class DataParallelTrainer:
                     *group.master_addr())
                 group.start_all(self._fn, self._config, master_env,
                                 latest_ckpt, self._shard_fn)
+                # Restart gap: wall time from failure detection to the
+                # new gang running — what TrainRunState charges to the
+                # run's lost_restart bucket.
+                gap = (time.time() - interrupt_ts) if interrupt_ts else 0.0
+                interrupt_ts = None
+                train_obs.emit_run_event(
+                    experiment, run_id,
+                    f"gang start (attempt {attempt})", attempt=attempt,
+                    world=self.scaling_config.num_workers,
+                    gap_s=round(gap, 3))
                 last_metrics, latest_ckpt, history_part = self._drain(group)
                 history.extend(history_part)
                 ckpt = Checkpoint(latest_ckpt) if latest_ckpt else None
@@ -119,6 +136,7 @@ class DataParallelTrainer:
                               config=self._config)
             except _WorkerGroupFailure as e:
                 attempt += 1
+                interrupt_ts = time.time()
                 RESTARTS_TOTAL.inc(tags={"cause": e.cause})
                 history.extend(e.history)
                 if e.latest_checkpoint:

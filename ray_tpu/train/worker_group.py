@@ -34,12 +34,17 @@ class TrainWorker:
     """Actor hosting one rank of the gang."""
 
     def __init__(self, rank: int, world_size: int, backend_name, trial_dir: str,
-                 experiment_name: str):
+                 experiment_name: str,
+                 run_meta: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.backend = resolve_backend(backend_name)
         self.trial_dir = trial_dir
         self.experiment_name = experiment_name
+        # Observability identity: {"run_id", "attempt", "flops_per_step"}
+        # — the stable run id (experiment + fit attempt) plus this
+        # gang's restart index, stamped onto gauges and step spans.
+        self.run_meta = run_meta or {}
         self.session: Optional[TrainSession] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[str] = None
@@ -75,7 +80,8 @@ class TrainWorker:
             local_rank=self.rank,  # one worker per host in this build
             trial_dir=self.trial_dir, latest_checkpoint=ckpt,
             dataset_shards=dataset_shards,
-            experiment_name=self.experiment_name)
+            experiment_name=self.experiment_name,
+            run_meta=self.run_meta)
         self._install_progress_probe(self.session)
 
         def target():
@@ -150,7 +156,8 @@ class WorkerGroup:
     def __init__(self, *, num_workers: int, resources: Dict[str, float],
                  strategy: str, backend_name, trial_dir: str,
                  experiment_name: str, pg: Optional[PlacementGroup] = None,
-                 ready_timeout: float = 60.0):
+                 ready_timeout: float = 60.0,
+                 run_meta: Optional[Dict[str, Any]] = None):
         self.num_workers = num_workers
         self._owns_pg = pg is None
         self.pg = pg if pg is not None else placement_group(
@@ -166,7 +173,8 @@ class WorkerGroup:
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self.pg, placement_group_bundle_index=i),
                 max_concurrency=4,
-            ).remote(i, num_workers, backend_name, trial_dir, experiment_name)
+            ).remote(i, num_workers, backend_name, trial_dir, experiment_name,
+                     run_meta or {})
             for i in range(num_workers)
         ]
         # rank -> worker pid, learned from start_all (chaos/status use).

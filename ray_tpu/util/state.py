@@ -242,6 +242,25 @@ def serve_summary() -> dict:
                        timeout=30).get("serve", {})
 
 
+def train_runs() -> dict:
+    """Train-plane goodput view: per-run wall-time split (productive
+    compute vs data-stall vs sync-stall vs checkpoint vs
+    lost-to-restart), current step rate, cross-rank skew window with
+    blame-rank attribution, restart accounting, and the optional MFU
+    estimate ({run: {...}}). Same blob as
+    cluster_status()["observability"]["train"]["runs"]."""
+    return _gcs().call("Train", "summary", timeout=30).get("runs", {})
+
+
+def train_trace(run_id: str, filename: Optional[str] = None) -> str:
+    """Dump one training run's per-rank step/phase span tracks as a
+    chrome/perfetto trace; returns the written path. Convenience
+    re-export of ray_tpu.util.timeline.train_trace."""
+    from ray_tpu.util.timeline import train_trace as _tt
+
+    return _tt(run_id, filename=filename)
+
+
 def request_trace(request_id: str,
                   filename: Optional[str] = None) -> str:
     """Dump one serve request's end-to-end span track (proxy -> handle

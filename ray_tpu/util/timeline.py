@@ -208,3 +208,68 @@ def request_trace(request_id: str,
     with open(filename, "w") as f:
         json.dump(request_chrome_trace(spans), f)
     return filename
+
+
+# ---------------------------------------------------------------------------
+# Per-run train traces (`ray-tpu train trace <run>`): the run id
+# (experiment name + fit attempt, e.g. "mnist#0") IS the trace id.
+# Stable across gang restarts within a fit, so a chaos run's failover
+# leg renders in the same trace as the attempt it replaced.
+# ---------------------------------------------------------------------------
+
+def train_chrome_trace(spans: List[dict]) -> List[dict]:
+    """Chrome-trace events for ONE training run: a dedicated
+    `run:<id>` process with one thread PER RANK, so cross-rank skew is
+    visible as ragged step edges down the rank rows.  `train.step`
+    spans carry the per-phase attribution in args; `phase.*` child
+    spans nest inside their step slice on the same rank row.  A gang
+    restart's new attempt renders on `rank N (attempt K)` rows — the
+    visible second act of a failover."""
+    out: List[dict] = []
+    for s in spans:
+        if s.get("end_ts") is None or s.get("start_ts") is None:
+            continue
+        attrs = s.get("attrs", {}) or {}
+        rank = attrs.get("rank", "?")
+        attempt = attrs.get("attempt", 0)
+        tid = f"{rank:>04}:rank {rank}" if isinstance(rank, int) \
+            else f"zzzz:rank {rank}"
+        if attempt:
+            tid += f" (attempt {attempt})"
+        out.append({
+            "name": s.get("name", "span"),
+            "cat": "train_run",
+            "ph": "X",
+            "ts": s["start_ts"] * 1e6,
+            "dur": max(1.0, (s["end_ts"] - s["start_ts"]) * 1e6),
+            "pid": f"run:{s.get('trace_id') or '?'}",
+            "tid": tid,
+            "args": {**attrs,
+                     "trace_id": s.get("trace_id"),
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id"),
+                     "node_id": s.get("node_id"),
+                     "pid": s.get("pid")},
+        })
+    return out
+
+
+def train_trace(run_id: str, filename: Optional[str] = None) -> str:
+    """Dump one training run's per-rank step/phase spans as a chrome
+    trace; returns the path (default `train-trace-<run>.json`)."""
+    spans = fetch_spans(trace_id=run_id)
+    if not spans and "#" not in run_id:
+        # Bare experiment name: take every fit attempt of it
+        # ("mnist" matches "mnist#0", "mnist#1", ...).
+        spans = [s for s in fetch_spans()
+                 if (s.get("trace_id") or "").startswith(f"{run_id}#")]
+    if not spans:
+        raise ValueError(
+            f"no spans recorded for train run {run_id!r} (is "
+            f"RAY_TPU_TRAIN_OBS_ENABLED=0, or has the span buffer "
+            f"not flushed yet?)")
+    if filename is None:
+        filename = f"train-trace-{run_id.replace('#', '_')}.json"
+    with open(filename, "w") as f:
+        json.dump(train_chrome_trace(spans), f)
+    return filename

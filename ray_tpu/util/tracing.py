@@ -203,6 +203,31 @@ def record_serve_span(ctx: Optional[Dict[str, Any]], name: str,
     s.finish(end_ts)
 
 
+def train_enabled() -> bool:
+    """Train-plane step/phase tracing — shares the
+    RAY_TPU_TRAIN_OBS_ENABLED kill switch with the rest of the train
+    observability stack (gauges, TrainRunState)."""
+    return get_config().train_obs_enabled
+
+
+def record_train_span(run_id: Optional[str], name: str, start_ts: float,
+                      end_ts: Optional[float] = None,
+                      parent_id: Optional[str] = None,
+                      **attrs) -> Optional[str]:
+    """Record an already-timed train-plane span. The run id IS the
+    trace id (experiment name + fit attempt), so `ray-tpu train trace
+    <run>` is a trace_id filter over the GCS span sink — the same query
+    shape as serve request traces. Step loops measure their own wall
+    windows, so spans are minted after the fact; returns the span id so
+    phase children can parent under their step."""
+    if not run_id or not train_enabled():
+        return None
+    s = Span(name, trace_id=run_id, parent_id=parent_id, attrs=attrs)
+    s.start = start_ts
+    s.finish(end_ts)
+    return s.span_id
+
+
 def drain() -> List[dict]:
     """Take all finished spans (the worker's event flusher ships them to
     the GCS TaskEvents sink)."""
@@ -215,6 +240,13 @@ def drain() -> List[dict]:
         except Exception:  # noqa: BLE001 exporter must not break flushing
             pass
     return out
+
+
+def has_pending() -> bool:
+    """Cheap liveness probe for the flush loop's idle backoff: a parked
+    worker that suddenly mints spans (e.g. lands a restarted train gang)
+    must wake within one flush period, not sit out a backed-off sleep."""
+    return bool(_buffer)
 
 
 def set_exporter(fn: Optional[Callable[[List[dict]], None]]) -> None:
